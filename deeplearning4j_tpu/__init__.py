@@ -1,0 +1,19 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the capability
+surface of Deeplearning4j 0.9.x, rebuilt from scratch on JAX/XLA.
+
+See SURVEY.md at the repo root for the structural analysis of the reference and
+the mapping from its CUDA/JVM architecture to this TPU-first design.
+"""
+__version__ = "0.1.0"
+
+from .nn.conf import (NeuralNetConfiguration, MultiLayerConfiguration,
+                      OptimizationAlgorithm, GradientNormalization, BackpropType,
+                      WorkspaceMode, CacheMode, GlobalConfig)
+from .nn.conf.inputs import InputType
+from .nn.activations import Activation
+from .nn.losses import LossFunction, LossFunctions
+from .nn.weights import WeightInit
+from .nn.updaters import (Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp, AdaGrad,
+                          AdaDelta, NoOp, AMSGrad)
+from .nn.multilayer import MultiLayerNetwork
+from .datasets.dataset import DataSet, MultiDataSet, DataSetIterator, ListDataSetIterator

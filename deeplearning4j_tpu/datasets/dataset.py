@@ -1,0 +1,172 @@
+"""DataSet container and iterator protocol.
+
+TPU-native equivalent of ND4J's ``DataSet``/``MultiDataSet`` and the
+``DataSetIterator`` interfaces the reference trains from (SURVEY.md §2.1 "Async
+data iterators", L4). Arrays are host numpy; device transfer happens once per
+step inside the jitted train step (with donation), replacing the reference's
+device-affinity buffering (``MagicQueue``).
+"""
+from __future__ import annotations
+
+import numpy as np
+from typing import List, Optional, Sequence
+
+
+class DataSet:
+    """features/labels (+ optional masks). Masks follow reference semantics:
+    features_mask/labels_mask are [batch, T] 0/1 arrays for sequence data."""
+
+    def __init__(self, features, labels=None, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    numExamples = num_examples
+
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(self.features[:n_train],
+                    None if self.labels is None else self.labels[:n_train],
+                    None if self.features_mask is None else self.features_mask[:n_train],
+                    None if self.labels_mask is None else self.labels_mask[:n_train])
+        b = DataSet(self.features[n_train:],
+                    None if self.labels is None else self.labels[n_train:],
+                    None if self.features_mask is None else self.features_mask[n_train:],
+                    None if self.labels_mask is None else self.labels_mask[n_train:])
+        return a, b
+
+    splitTestAndTrain = split_test_and_train
+
+    def shuffle(self, seed=None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        def cat(xs):
+            if any(x is None for x in xs):
+                return None
+            return np.concatenate(xs, axis=0)
+        return DataSet(cat([d.features for d in datasets]),
+                       cat([d.labels for d in datasets]),
+                       cat([d.features_mask for d in datasets]),
+                       cat([d.labels_mask for d in datasets]))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        out = []
+        for i in range(0, n, batch_size):
+            sl = slice(i, min(i + batch_size, n))
+            out.append(DataSet(
+                self.features[sl],
+                None if self.labels is None else self.labels[sl],
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl]))
+        return out
+
+
+class MultiDataSet:
+    """Multi-input/multi-output container (ND4J ``MultiDataSet``), consumed by
+    ``ComputationGraph.fit``."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in _as_list(features)]
+        self.labels = [np.asarray(l) for l in _as_list(labels)]
+        self.features_masks = (None if features_masks is None
+                               else [None if m is None else np.asarray(m)
+                                     for m in _as_list(features_masks)])
+        self.labels_masks = (None if labels_masks is None
+                             else [None if m is None else np.asarray(m)
+                                   for m in _as_list(labels_masks)])
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class DataSetIterator:
+    """Iterator protocol (ND4J ``DataSetIterator``): python-iterable + reset()."""
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Reference ``ListDataSetIterator``: iterate a pre-built list of DataSets."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None and len(datasets) == 1:
+            datasets = datasets[0].batch_by(batch_size)
+        self._data = list(datasets)
+        self._pos = 0
+        self._batch = batch_size or (self._data[0].num_examples() if self._data else 0)
+
+    def __next__(self):
+        if self._pos >= len(self._data):
+            raise StopIteration
+        d = self._data[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._batch
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps any python iterable of DataSets."""
+
+    def __init__(self, iterable):
+        self._iterable = iterable
+        self._it = None
+
+    def __iter__(self):
+        self._it = iter(self._iterable)
+        return self
+
+    def __next__(self):
+        if self._it is None:
+            self._it = iter(self._iterable)
+        return next(self._it)
+
+    def reset(self):
+        self._it = iter(self._iterable)
+
+    def batch(self):
+        return -1

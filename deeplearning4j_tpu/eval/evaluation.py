@@ -1,0 +1,126 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+TPU-native equivalent of reference ``deeplearning4j-nn/.../eval/Evaluation.java``
+(1627 LoC; SURVEY.md §2.1 "Evaluation"). Accumulates a confusion matrix over
+``eval(labels, predictions)`` calls; time-series inputs [b, T, C] are flattened
+with optional [b, T] masks like the reference's ``evalTimeSeries``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes):
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual, predicted):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual, predicted):
+        return int(self.matrix[actual, predicted])
+
+
+class Evaluation:
+    def __init__(self, num_classes=None, top_n=1):
+        self.num_classes = num_classes
+        self.top_n = top_n
+        self.confusion = None
+        self.top_n_correct = 0
+        self.total = 0
+
+    # ------------------------------------------------------------------
+    def _ensure(self, n):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # [b, T, C] time series
+            b, t, c = labels.shape
+            labels = labels.reshape(b * t, c)
+            predictions = predictions.reshape(b * t, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(b * t) > 0
+                labels = labels[m]
+                predictions = predictions[m]
+        elif mask is not None:
+            m = np.asarray(mask).ravel() > 0
+            labels = labels[m]
+            predictions = predictions[m]
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        self.confusion.add(actual, pred)
+        self.total += len(actual)
+        if self.top_n > 1:
+            topn = np.argsort(-predictions, axis=-1)[:, :self.top_n]
+            self.top_n_correct += int(np.sum(topn == actual[:, None]))
+
+    # ------------------------------------------------------------- metrics
+    def _tp(self, i):
+        return self.confusion.matrix[i, i]
+
+    def _fp(self, i):
+        return self.confusion.matrix[:, i].sum() - self._tp(i)
+
+    def _fn(self, i):
+        return self.confusion.matrix[i, :].sum() - self._tp(i)
+
+    def accuracy(self) -> float:
+        if self.total == 0:
+            return 0.0
+        return float(np.trace(self.confusion.matrix)) / self.total
+
+    def top_n_accuracy(self) -> float:
+        if self.total == 0 or self.top_n <= 1:
+            return self.accuracy()
+        return self.top_n_correct / self.total
+
+    def precision(self, cls=None) -> float:
+        if cls is not None:
+            d = self._tp(cls) + self._fp(cls)
+            return float(self._tp(cls)) / d if d else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if (self.confusion.matrix[i, :].sum() + self.confusion.matrix[:, i].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls=None) -> float:
+        if cls is not None:
+            d = self._tp(cls) + self._fn(cls)
+            return float(self._tp(cls)) / d if d else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if self.confusion.matrix[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls=None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls) -> float:
+        tn = self.total - self._tp(cls) - self._fp(cls) - self._fn(cls)
+        d = self._fp(cls) + tn
+        return float(self._fp(cls)) / d if d else 0.0
+
+    def matthews_correlation(self, cls) -> float:
+        tp, fp, fn = self._tp(cls), self._fp(cls), self._fn(cls)
+        tn = self.total - tp - fp - fn
+        num = tp * tn - fp * fn
+        den = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float(num) / den if den else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            "==========================Scores========================================",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "========================================================================",
+        ]
+        if self.top_n > 1:
+            lines.insert(2, f" Top {self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        return "\n".join(lines)

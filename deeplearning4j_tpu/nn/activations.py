@@ -1,0 +1,171 @@
+"""Activation functions.
+
+TPU-native equivalent of the ND4J ``IActivation``/``Activation`` enum surface the
+reference consumes everywhere (e.g. ``NeuralNetConfiguration.Builder.activation``,
+reference ``deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/NeuralNetConfiguration.java:604``).
+
+Unlike the reference — where each activation has a hand-written
+``backprop(in, epsilon)`` executed op-by-op over JNI — activations here are pure
+``jax.numpy`` functions fused by XLA into the surrounding computation, and their
+gradients come from AD. That removes the per-op device-dispatch boundary that
+dominates the reference's hot loop (SURVEY.md §3.1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Activation", "get_activation", "resolve_activation"]
+
+
+def _identity(x):
+    return x
+
+
+def _relu(x):
+    return jax.nn.relu(x)
+
+
+def _relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def _selu(x):
+    return jax.nn.selu(x)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+def _swish(x):
+    return jax.nn.silu(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximation via rational function, matching ND4J's
+    # ActivationRationalTanh formula.
+    a = jnp.abs(x)
+    p = 1.0 + a + x * x * (1.41645 + a * 0.052357)
+    return jnp.sign(x) * (1.0 - 1.0 / p) * 1.7159
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _softplus(x):
+    return jax.nn.softplus(x)
+
+
+def _softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+_ACTIVATIONS = {
+    "identity": _identity,
+    "linear": _identity,
+    "relu": _relu,
+    "relu6": _relu6,
+    "leakyrelu": _leakyrelu,
+    "elu": _elu,
+    "selu": _selu,
+    "gelu": _gelu,
+    "swish": _swish,
+    "silu": _swish,
+    "mish": _mish,
+    "sigmoid": _sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": _tanh,
+    "hardtanh": _hardtanh,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "softmax": _softmax,
+    "softplus": _softplus,
+    "softsign": _softsign,
+    "cube": _cube,
+    "thresholdedrelu": _thresholdedrelu,
+}
+
+
+class Activation:
+    """String-keyed activation registry mirroring ND4J's ``Activation`` enum values."""
+
+    CUBE = "cube"
+    ELU = "elu"
+    GELU = "gelu"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    IDENTITY = "identity"
+    LEAKYRELU = "leakyrelu"
+    MISH = "mish"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    RELU = "relu"
+    RELU6 = "relu6"
+    SELU = "selu"
+    SIGMOID = "sigmoid"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    TANH = "tanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    @staticmethod
+    def names():
+        return sorted(_ACTIVATIONS)
+
+
+def get_activation(name):
+    """Resolve an activation by name (case-insensitive) or pass callables through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+# Alias used by config code.
+resolve_activation = get_activation

@@ -1,0 +1,397 @@
+"""Configuration DSL: fluent builder → serializable network configurations.
+
+TPU-native equivalent of reference ``nn/conf/NeuralNetConfiguration.java`` (Builder
+:604, ListBuilder :215-324), ``MultiLayerConfiguration.java`` and
+``ComputationGraphConfiguration.java`` (SURVEY.md §2.1 "Config DSL").
+
+The reference attaches a full ``NeuralNetConfiguration`` (global + layer fields) to
+every layer; here global training settings live once in :class:`GlobalConfig` and
+per-layer configs override selectively — resolved at network init. JSON round-trip
+via :mod:`.serde` replaces Jackson.
+
+TPU-specific additions with no reference counterpart: ``dtype``/``compute_dtype``
+(bfloat16 MXU policy), and mesh/sharding hints consumed by
+``deeplearning4j_tpu.parallel``. The reference's ``WorkspaceMode``/``CacheMode``
+(manual memory reuse, SURVEY.md §2.8 item 3) are accepted for API parity but map
+to XLA buffer donation, which the jitted step does unconditionally.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from . import serde
+from .serde import register, to_json, from_json
+from .inputs import InputType, InputTypeConvolutional, InputTypeConvolutionalFlat
+from .layers import Layer, BaseLayer, FeedForwardLayer
+from .preprocessors import InputPreProcessor
+from ..updaters import (IUpdater, Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp,
+                        AdaGrad, AdaDelta, NoOp, AMSGrad, FixedSchedule,
+                        ExponentialSchedule, InverseSchedule, PolySchedule,
+                        SigmoidSchedule, StepSchedule, MapSchedule,
+                        WarmupCosineSchedule)
+from ..weights import (WeightInit, NormalDistribution, GaussianDistribution,
+                       UniformDistribution, ConstantDistribution,
+                       BinomialDistribution)
+
+# Register non-layer config dataclasses for serde round-trips.
+for _cls in (Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp, AdaGrad, AdaDelta, NoOp,
+             AMSGrad, FixedSchedule, ExponentialSchedule, InverseSchedule,
+             PolySchedule, SigmoidSchedule, StepSchedule, MapSchedule,
+             WarmupCosineSchedule, NormalDistribution, GaussianDistribution,
+             UniformDistribution, ConstantDistribution, BinomialDistribution):
+    register(_cls)
+
+
+class OptimizationAlgorithm:
+    """Reference ``nn/api/OptimizationAlgorithm.java``."""
+    STOCHASTIC_GRADIENT_DESCENT = "sgd"
+    LINE_GRADIENT_DESCENT = "line_gd"
+    CONJUGATE_GRADIENT = "cg"
+    LBFGS = "lbfgs"
+
+
+class GradientNormalization:
+    """Reference ``nn/conf/GradientNormalization.java``."""
+    None_ = "none"
+    RenormalizeL2PerLayer = "renormalize_l2_per_layer"
+    RenormalizeL2PerParamType = "renormalize_l2_per_param_type"
+    ClipElementWiseAbsoluteValue = "clip_elementwise_absolute_value"
+    ClipL2PerLayer = "clip_l2_per_layer"
+    ClipL2PerParamType = "clip_l2_per_param_type"
+
+
+class BackpropType:
+    Standard = "standard"
+    TruncatedBPTT = "tbptt"
+
+
+class WorkspaceMode:
+    """Accepted for parity (reference ``nn/conf/WorkspaceMode.java``); the jitted
+    step always uses XLA buffer donation, so these are hints only."""
+    NONE = "none"
+    SINGLE = "single"
+    SEPARATE = "separate"
+    ENABLED = "enabled"
+
+
+class CacheMode:
+    NONE = "none"
+    DEVICE = "device"
+    HOST = "host"
+
+
+@register
+@dataclasses.dataclass
+class GlobalConfig:
+    """Defaults applied to every layer unless overridden per-layer."""
+    seed: int = 12345
+    updater: Any = None                     # IUpdater; default Sgd(1e-1) at init
+    weight_init: str = WeightInit.XAVIER
+    dist: Any = None
+    activation: str = "sigmoid"
+    bias_init: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    dropout: Optional[float] = None          # retain prob, reference semantics
+    optimization_algo: str = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+    minimize: bool = True
+    max_num_line_search_iterations: int = 5
+    gradient_normalization: str = GradientNormalization.None_
+    gradient_normalization_threshold: float = 1.0
+    mini_batch: bool = True
+    # TPU-native dtype policy: params kept in `dtype`, matmul/conv compute in
+    # `compute_dtype` (bfloat16 targets the MXU; see /opt/skills guide).
+    dtype: str = "float32"
+    compute_dtype: str = "float32"
+    # parity-only knobs
+    training_workspace_mode: str = WorkspaceMode.ENABLED
+    inference_workspace_mode: str = WorkspaceMode.ENABLED
+    cache_mode: str = CacheMode.NONE
+
+
+@register
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Reference ``nn/conf/MultiLayerConfiguration.java``."""
+    global_conf: GlobalConfig = None
+    layers: List[Any] = dataclasses.field(default_factory=list)
+    input_preprocessors: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    input_type: Any = None
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = BackpropType.Standard
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    # ------------------------------------------------------------------
+    def preprocessor(self, idx) -> Optional[InputPreProcessor]:
+        return self.input_preprocessors.get(str(idx))
+
+    def to_json(self) -> str:
+        return to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        obj = from_json(s)
+        if not isinstance(obj, MultiLayerConfiguration):
+            raise ValueError("JSON does not describe a MultiLayerConfiguration")
+        return obj
+
+    def clone(self):
+        return copy.deepcopy(self)
+
+
+class ListBuilder:
+    """Reference ``NeuralNetConfiguration$ListBuilder`` (:215-324): collects layers,
+    then ``setInputType`` runs shape inference (nIn filling + preprocessor
+    insertion) and ``build`` emits a :class:`MultiLayerConfiguration`."""
+
+    def __init__(self, global_conf: GlobalConfig):
+        self._global = global_conf
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type = None
+        self._backprop = True
+        self._pretrain = False
+        self._backprop_type = BackpropType.Standard
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, idx_or_layer, layer=None) -> "ListBuilder":
+        if layer is None:
+            self._layers.append(idx_or_layer)
+        else:
+            idx = int(idx_or_layer)
+            while len(self._layers) <= idx:
+                self._layers.append(None)
+            self._layers[idx] = layer
+        return self
+
+    def input_preprocessor(self, idx, preproc) -> "ListBuilder":
+        self._preprocessors[int(idx)] = preproc
+        return self
+
+    inputPreProcessor = input_preprocessor
+
+    def set_input_type(self, input_type) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    setInputType = set_input_type
+
+    def backprop(self, flag: bool) -> "ListBuilder":
+        self._backprop = bool(flag)
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = bool(flag)
+        return self
+
+    def backprop_type(self, t) -> "ListBuilder":
+        self._backprop_type = t
+        return self
+
+    backpropType = backprop_type
+
+    def t_bptt_forward_length(self, n) -> "ListBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
+    tBPTTForwardLength = t_bptt_forward_length
+
+    def t_bptt_backward_length(self, n) -> "ListBuilder":
+        self._tbptt_back = int(n)
+        return self
+
+    tBPTTBackwardLength = t_bptt_backward_length
+
+    # ------------------------------------------------------------------
+    def build(self) -> MultiLayerConfiguration:
+        layers = [l for l in self._layers]
+        if any(l is None for l in layers):
+            raise ValueError("Gaps in layer list (indexed .layer(i, ...) left holes)")
+        preprocs = dict(self._preprocessors)
+        if self._input_type is not None:
+            # Shape inference pass, mirroring the reference's
+            # MultiLayerConfiguration.Builder#build setInputType handling.
+            it = self._input_type
+            if isinstance(it, InputTypeConvolutionalFlat):
+                # reference inserts FF->CNN preprocessor at layer 0 when needed
+                pass
+            for i, layer in enumerate(layers):
+                if i not in preprocs:
+                    p = layer.preprocessor_for(it)
+                    if p is not None:
+                        preprocs[i] = p
+                if i in preprocs:
+                    it = preprocs[i].get_output_type(it)
+                layer.set_n_in(it, override=False)
+                it = layer.get_output_type(i, it)
+        return MultiLayerConfiguration(
+            global_conf=self._global,
+            layers=layers,
+            input_preprocessors={str(k): v for k, v in preprocs.items()},
+            input_type=self._input_type,
+            backprop=self._backprop,
+            pretrain=self._pretrain,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+        )
+
+
+class Builder:
+    """Fluent global-config builder (reference ``NeuralNetConfiguration.Builder``,
+    ``NeuralNetConfiguration.java:604``). Both snake_case and reference-style
+    camelCase spellings are provided."""
+
+    def __init__(self):
+        self._conf = GlobalConfig()
+
+    # each setter returns self ------------------------------------------------
+    def seed(self, s):
+        self._conf.seed = int(s)
+        return self
+
+    def updater(self, u):
+        self._conf.updater = u
+        return self
+
+    def weight_init(self, w):
+        self._conf.weight_init = w
+        return self
+
+    weightInit = weight_init
+
+    def dist(self, d):
+        self._conf.dist = d
+        if self._conf.weight_init != WeightInit.DISTRIBUTION:
+            self._conf.weight_init = WeightInit.DISTRIBUTION
+        return self
+
+    def activation(self, a):
+        self._conf.activation = a
+        return self
+
+    def bias_init(self, b):
+        self._conf.bias_init = float(b)
+        return self
+
+    biasInit = bias_init
+
+    def l1(self, v):
+        self._conf.l1 = float(v)
+        return self
+
+    def l2(self, v):
+        self._conf.l2 = float(v)
+        return self
+
+    def l1_bias(self, v):
+        self._conf.l1_bias = float(v)
+        return self
+
+    def l2_bias(self, v):
+        self._conf.l2_bias = float(v)
+        return self
+
+    def drop_out(self, p):
+        self._conf.dropout = float(p)
+        return self
+
+    dropOut = drop_out
+    dropout = drop_out
+
+    def optimization_algo(self, o):
+        self._conf.optimization_algo = o
+        return self
+
+    optimizationAlgo = optimization_algo
+
+    def minimize(self, flag=True):
+        self._conf.minimize = bool(flag)
+        return self
+
+    def max_num_line_search_iterations(self, n):
+        self._conf.max_num_line_search_iterations = int(n)
+        return self
+
+    maxNumLineSearchIterations = max_num_line_search_iterations
+
+    def gradient_normalization(self, g):
+        self._conf.gradient_normalization = g
+        return self
+
+    gradientNormalization = gradient_normalization
+
+    def gradient_normalization_threshold(self, t):
+        self._conf.gradient_normalization_threshold = float(t)
+        return self
+
+    gradientNormalizationThreshold = gradient_normalization_threshold
+
+    def mini_batch(self, flag):
+        self._conf.mini_batch = bool(flag)
+        return self
+
+    miniBatch = mini_batch
+
+    def dtype(self, d):
+        self._conf.dtype = str(d)
+        return self
+
+    def compute_dtype(self, d):
+        self._conf.compute_dtype = str(d)
+        return self
+
+    def training_workspace_mode(self, m):
+        self._conf.training_workspace_mode = m
+        return self
+
+    trainingWorkspaceMode = training_workspace_mode
+
+    def inference_workspace_mode(self, m):
+        self._conf.inference_workspace_mode = m
+        return self
+
+    inferenceWorkspaceMode = inference_workspace_mode
+
+    def cache_mode(self, m):
+        self._conf.cache_mode = m
+        return self
+
+    cacheMode = cache_mode
+
+    # terminals ---------------------------------------------------------------
+    def list(self) -> ListBuilder:
+        if self._conf.updater is None:
+            self._conf.updater = Sgd(learning_rate=1e-1)
+        return ListBuilder(copy.deepcopy(self._conf))
+
+    def graph_builder(self):
+        if self._conf.updater is None:
+            self._conf.updater = Sgd(learning_rate=1e-1)
+        from .graph import GraphBuilder
+        return GraphBuilder(copy.deepcopy(self._conf))
+
+    graphBuilder = graph_builder
+
+    def build(self) -> GlobalConfig:
+        if self._conf.updater is None:
+            self._conf.updater = Sgd(learning_rate=1e-1)
+        return copy.deepcopy(self._conf)
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()`` (reference class of the
+    same name)."""
+
+    Builder = Builder
+
+    @staticmethod
+    def builder() -> Builder:
+        return Builder()

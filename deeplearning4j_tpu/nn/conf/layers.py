@@ -1,0 +1,665 @@
+"""Layer configuration classes.
+
+TPU-native equivalent of reference ``nn/conf/layers/`` (41 config classes,
+SURVEY.md §2.1 "Layer configs"): one dataclass per layer type, JSON-serializable
+via :mod:`..conf.serde`, with shape-inference hooks (``get_output_type``,
+``set_n_in``, ``preprocessor_for``) mirroring the reference's
+``Layer.getOutputType/setNIn/getPreProcessorForInputType`` used by
+``ListBuilder.setInputType`` (reference ``NeuralNetConfiguration.java:215-324``).
+
+Layer *implementations* (init/forward as pure JAX functions) live in
+``deeplearning4j_tpu.nn.layers`` and are looked up by config class name.
+
+Note on dropout: following the reference's 0.9.x semantics, ``dropout`` is the
+**retain probability** (1.0 = keep everything / disabled).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Tuple
+
+from .serde import register
+from .inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+                     InputTypeFeedForward, InputTypeRecurrent)
+from ..weights import WeightInit
+
+__all__ = [
+    "Layer", "BaseLayer", "FeedForwardLayer", "DenseLayer", "ConvolutionLayer",
+    "Convolution1DLayer", "SeparableConvolution2D", "Deconvolution2D",
+    "SubsamplingLayer", "Subsampling1DLayer", "PoolingType",
+    "Upsampling1D", "Upsampling2D", "ZeroPaddingLayer", "ZeroPadding1DLayer",
+    "Cropping2D", "SpaceToDepthLayer", "DepthwiseConvolution2D",
+    "BatchNormalization", "LocalResponseNormalization", "ActivationLayer",
+    "DropoutLayer", "EmbeddingLayer", "EmbeddingSequenceLayer", "LSTM", "GravesLSTM",
+    "GravesBidirectionalLSTM", "SimpleRnn", "Bidirectional", "LastTimeStep",
+    "OutputLayer", "RnnOutputLayer", "LossLayer", "CenterLossOutputLayer",
+    "AutoEncoder", "VariationalAutoencoder", "GlobalPoolingLayer",
+    "Yolo2OutputLayer", "FrozenLayer", "ConvolutionMode", "SelfAttentionLayer",
+]
+
+
+class ConvolutionMode:
+    """Reference ``nn/conf/ConvolutionMode.java``: Strict/Truncate/Same."""
+    Strict = "strict"
+    Truncate = "truncate"
+    Same = "same"
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return (int(v[0]), int(v[0]))
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv_out_size(in_size, k, s, p, d, mode):
+    """Output spatial size (reference ``util/ConvolutionUtils.getOutputSize``)."""
+    eff_k = (k - 1) * d + 1
+    if mode == ConvolutionMode.Same:
+        return int(math.ceil(in_size / s))
+    return (in_size - eff_k + 2 * p) // s + 1
+
+
+@register
+@dataclasses.dataclass
+class Layer:
+    """Base config: fields shared by every layer (reference ``nn/conf/layers/Layer.java``)."""
+    name: Optional[str] = None
+    dropout: Optional[float] = None  # retain probability, reference semantics
+
+    # shape inference hooks -------------------------------------------------
+    def get_output_type(self, index, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override=False):
+        pass
+
+    def preprocessor_for(self, input_type):
+        return None
+
+    def is_pretrain_layer(self):
+        return False
+
+    def initializer_keys(self):
+        return []
+
+
+@register
+@dataclasses.dataclass
+class BaseLayer(Layer):
+    """Layers with weights: activation/init/regularization/updater overrides
+    (reference ``nn/conf/layers/BaseLayer.java``)."""
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[Any] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    updater: Optional[Any] = None  # per-layer IUpdater override
+    weight_noise: Optional[Any] = None
+    constraints: Optional[List[Any]] = None
+
+
+@register
+@dataclasses.dataclass
+class FeedForwardLayer(BaseLayer):
+    """Reference ``nn/conf/layers/FeedForwardLayer.java``: has nIn/nOut."""
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+
+    def get_output_type(self, index, input_type):
+        return InputTypeFeedForward(self.n_out)
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.arity()
+
+    def preprocessor_for(self, input_type):
+        from .preprocessors import (CnnToFeedForwardPreProcessor,
+                                    RnnToFeedForwardPreProcessor)
+        if isinstance(input_type, (InputTypeConvolutional, InputTypeConvolutionalFlat)):
+            return CnnToFeedForwardPreProcessor(input_type.height, input_type.width,
+                                                input_type.channels)
+        if isinstance(input_type, InputTypeRecurrent):
+            return RnnToFeedForwardPreProcessor()
+        return None
+
+
+@register
+@dataclasses.dataclass
+class DenseLayer(FeedForwardLayer):
+    """Fully connected layer (reference ``nn/conf/layers/DenseLayer.java``)."""
+    has_bias: bool = True
+
+
+@register
+@dataclasses.dataclass
+class ConvolutionLayer(FeedForwardLayer):
+    """2-D convolution (reference ``nn/conf/layers/ConvolutionLayer.java``).
+
+    ``n_in`` = input channels, ``n_out`` = output channels. The reference's
+    cuDNN algo-mode knobs (``cudnnAlgoMode`` etc.) have no TPU meaning; XLA
+    picks conv algorithms. Kernel layout is HWIO internally (MXU-friendly).
+    """
+    kernel_size: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = ConvolutionMode.Truncate
+    has_bias: bool = True
+
+    def get_output_type(self, index, input_type):
+        if not isinstance(input_type, InputTypeConvolutional):
+            raise ValueError(f"ConvolutionLayer '{self.name}' needs convolutional "
+                             f"input, got {input_type}")
+        k, s, p, d = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding), _pair(self.dilation)
+        h = conv_out_size(input_type.height, k[0], s[0], p[0], d[0], self.convolution_mode)
+        w = conv_out_size(input_type.width, k[1], s[1], p[1], d[1], self.convolution_mode)
+        return InputTypeConvolutional(h, w, self.n_out)
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.channels
+
+    def preprocessor_for(self, input_type):
+        from .preprocessors import FeedForwardToCnnPreProcessor, RnnToCnnPreProcessor
+        if isinstance(input_type, InputTypeConvolutionalFlat):
+            return FeedForwardToCnnPreProcessor(input_type.height, input_type.width,
+                                                input_type.channels)
+        return None
+
+
+@register
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D convolution over [batch, channels, length] (reference
+    ``nn/conf/layers/Convolution1DLayer.java``)."""
+
+    def get_output_type(self, index, input_type):
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError("Convolution1DLayer needs recurrent input")
+        k, s, p, d = _pair(self.kernel_size)[0], _pair(self.stride)[0], _pair(self.padding)[0], _pair(self.dilation)[0]
+        t = input_type.timeseries_length
+        t_out = None if t is None else conv_out_size(t, k, s, p, d, self.convolution_mode)
+        return InputTypeRecurrent(self.n_out, t_out)
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.size
+
+    def preprocessor_for(self, input_type):
+        return None
+
+
+@register
+@dataclasses.dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    depth_multiplier: int = 1
+
+    def get_output_type(self, index, input_type):
+        out = super().get_output_type(index, input_type)
+        return out
+
+
+@register
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    depth_multiplier: int = 1
+
+
+@register
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution."""
+
+    def get_output_type(self, index, input_type):
+        k, s, p, d = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding), _pair(self.dilation)
+        if self.convolution_mode == ConvolutionMode.Same:
+            h = input_type.height * s[0]
+            w = input_type.width * s[1]
+        else:
+            h = s[0] * (input_type.height - 1) + (k[0] - 1) * d[0] + 1 - 2 * p[0]
+            w = s[1] * (input_type.width - 1) + (k[1] - 1) * d[1] + 1 - 2 * p[1]
+        return InputTypeConvolutional(h, w, self.n_out)
+
+
+@register
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Spatial pooling (reference ``nn/conf/layers/SubsamplingLayer.java``)."""
+    pooling_type: str = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = ConvolutionMode.Truncate
+    pnorm: Optional[int] = None
+    eps: float = 1e-8
+
+    def get_output_type(self, index, input_type):
+        if not isinstance(input_type, InputTypeConvolutional):
+            raise ValueError("SubsamplingLayer needs convolutional input")
+        k, s, p, d = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding), _pair(self.dilation)
+        h = conv_out_size(input_type.height, k[0], s[0], p[0], d[0], self.convolution_mode)
+        w = conv_out_size(input_type.width, k[1], s[1], p[1], d[1], self.convolution_mode)
+        return InputTypeConvolutional(h, w, input_type.channels)
+
+
+@register
+@dataclasses.dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    def get_output_type(self, index, input_type):
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError("Subsampling1DLayer needs recurrent input")
+        k, s, p, d = _pair(self.kernel_size)[0], _pair(self.stride)[0], _pair(self.padding)[0], _pair(self.dilation)[0]
+        t = input_type.timeseries_length
+        t_out = None if t is None else conv_out_size(t, k, s, p, d, self.convolution_mode)
+        return InputTypeRecurrent(input_type.size, t_out)
+
+
+@register
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    size: Tuple[int, int] = (2, 2)
+
+    def get_output_type(self, index, input_type):
+        s = _pair(self.size)
+        return InputTypeConvolutional(input_type.height * s[0], input_type.width * s[1],
+                                      input_type.channels)
+
+
+@register
+@dataclasses.dataclass
+class Upsampling1D(Layer):
+    size: int = 2
+
+    def get_output_type(self, index, input_type):
+        t = input_type.timeseries_length
+        return InputTypeRecurrent(input_type.size, None if t is None else t * int(self.size))
+
+
+@register
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """[top, bottom, left, right] padding (reference ``ZeroPaddingLayer.java``)."""
+    padding: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def _pads(self):
+        p = list(self.padding)
+        if len(p) == 2:
+            p = [p[0], p[0], p[1], p[1]]
+        return p
+
+    def get_output_type(self, index, input_type):
+        p = self._pads()
+        return InputTypeConvolutional(input_type.height + p[0] + p[1],
+                                      input_type.width + p[2] + p[3],
+                                      input_type.channels)
+
+
+@register
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    padding: Tuple[int, int] = (0, 0)
+
+    def get_output_type(self, index, input_type):
+        p = _pair(self.padding)
+        t = input_type.timeseries_length
+        return InputTypeRecurrent(input_type.size, None if t is None else t + p[0] + p[1])
+
+
+@register
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def _crops(self):
+        c = list(self.cropping)
+        if len(c) == 2:
+            c = [c[0], c[0], c[1], c[1]]
+        return c
+
+    def get_output_type(self, index, input_type):
+        c = self._crops()
+        return InputTypeConvolutional(input_type.height - c[0] - c[1],
+                                      input_type.width - c[2] - c[3],
+                                      input_type.channels)
+
+
+@register
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    block_size: int = 2
+
+    def get_output_type(self, index, input_type):
+        b = int(self.block_size)
+        return InputTypeConvolutional(input_type.height // b, input_type.width // b,
+                                      input_type.channels * b * b)
+
+
+@register
+@dataclasses.dataclass
+class BatchNormalization(FeedForwardLayer):
+    """Reference ``nn/conf/layers/BatchNormalization.java``. ``decay`` is the
+    running-stats momentum; gamma/beta trainable unless ``lock_gamma_beta``."""
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+
+    def get_output_type(self, index, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = (input_type.channels if isinstance(input_type, InputTypeConvolutional)
+                         else input_type.arity())
+        self.n_out = self.n_in
+
+    def preprocessor_for(self, input_type):
+        return None
+
+
+@register
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """Reference ``nn/conf/layers/LocalResponseNormalization.java``."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+
+@register
+@dataclasses.dataclass
+class ActivationLayer(BaseLayer):
+    pass
+
+
+@register
+@dataclasses.dataclass
+class DropoutLayer(FeedForwardLayer):
+    def get_output_type(self, index, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override=False):
+        pass
+
+    def preprocessor_for(self, input_type):
+        return None
+
+
+@register
+@dataclasses.dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index → vector lookup, one index per example
+    (reference ``nn/conf/layers/EmbeddingLayer.java``)."""
+    has_bias: bool = True
+
+
+@register
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Index sequence → vector sequence (added post-0.9 in the reference line;
+    included for NLP-model parity)."""
+    has_bias: bool = False
+
+    def get_output_type(self, index, input_type):
+        t = input_type.timeseries_length if isinstance(input_type, InputTypeRecurrent) else None
+        return InputTypeRecurrent(self.n_out, t)
+
+
+@register
+@dataclasses.dataclass
+class BaseRecurrentLayer(FeedForwardLayer):
+    def get_output_type(self, index, input_type):
+        t = input_type.timeseries_length if isinstance(input_type, InputTypeRecurrent) else None
+        return InputTypeRecurrent(self.n_out, t)
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.size
+
+    def preprocessor_for(self, input_type):
+        from .preprocessors import (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor)
+        if isinstance(input_type, InputTypeFeedForward):
+            return FeedForwardToRnnPreProcessor()
+        if isinstance(input_type, InputTypeConvolutional):
+            return CnnToRnnPreProcessor(input_type.height, input_type.width,
+                                        input_type.channels)
+        return None
+
+
+@register
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM, no peepholes (reference ``nn/conf/layers/LSTM.java``);
+    compiled as a fused-gate ``lax.scan`` on TPU (one [4H] gemm per step)."""
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+
+@register
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference ``GravesLSTM.java``,
+    ``LSTMHelpers.java:68``)."""
+    pass
+
+
+@register
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(GravesLSTM):
+    """Two independent GravesLSTMs run forward and backward over time, with
+    per-direction parameter sets; direction outputs are summed so the layer
+    output stays nOut-sized (reference ``GravesBidirectionalLSTM.java``)."""
+
+    def get_output_type(self, index, input_type):
+        t = input_type.timeseries_length if isinstance(input_type, InputTypeRecurrent) else None
+        return InputTypeRecurrent(self.n_out, t)
+
+
+@register
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    pass
+
+
+@register
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Wrapper running an inner recurrent layer in both directions.
+    ``mode``: concat | add | mul | ave (reference 1.0 line ``Bidirectional.java``)."""
+    inner: Optional[Any] = None
+    mode: str = "concat"
+
+    def get_output_type(self, index, input_type):
+        out = self.inner.get_output_type(index, input_type)
+        if self.mode == "concat":
+            out = InputTypeRecurrent(out.size * 2, out.timeseries_length)
+        return out
+
+    def set_n_in(self, input_type, override=False):
+        self.inner.set_n_in(input_type, override)
+
+    def preprocessor_for(self, input_type):
+        return self.inner.preprocessor_for(input_type)
+
+
+@register
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wrapper extracting the last (mask-aware) timestep of an inner RNN layer."""
+    inner: Optional[Any] = None
+
+    def get_output_type(self, index, input_type):
+        out = self.inner.get_output_type(index, input_type)
+        return InputTypeFeedForward(out.size)
+
+    def set_n_in(self, input_type, override=False):
+        self.inner.set_n_in(input_type, override)
+
+    def preprocessor_for(self, input_type):
+        return self.inner.preprocessor_for(input_type)
+
+
+@register
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseRecurrentLayer):
+    """Multi-head self-attention over a sequence — net-new vs the 0.9.x reference
+    (which has no attention, SURVEY.md §5 "Long-context"); included because
+    long-context/sequence-parallel support is first-class in the TPU build.
+    Supports ring-attention sequence parallelism (see ``parallel/sequence.py``)."""
+    num_heads: int = 4
+    head_dim: Optional[int] = None
+    causal: bool = True
+    dropout_rate: float = 0.0
+
+
+@register
+@dataclasses.dataclass
+class OutputLayer(FeedForwardLayer):
+    """Dense + loss (reference ``nn/conf/layers/OutputLayer.java``)."""
+    loss: str = "mcxent"
+    has_bias: bool = True
+
+
+@register
+@dataclasses.dataclass
+class RnnOutputLayer(OutputLayer):
+    def get_output_type(self, index, input_type):
+        t = input_type.timeseries_length if isinstance(input_type, InputTypeRecurrent) else None
+        return InputTypeRecurrent(self.n_out, t)
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.size
+
+    def preprocessor_for(self, input_type):
+        from .preprocessors import FeedForwardToRnnPreProcessor
+        if isinstance(input_type, InputTypeFeedForward):
+            return FeedForwardToRnnPreProcessor()
+        return None
+
+
+@register
+@dataclasses.dataclass
+class LossLayer(FeedForwardLayer):
+    """Loss without weights (reference ``nn/conf/layers/LossLayer.java``)."""
+    loss: str = "mcxent"
+
+    def get_output_type(self, index, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override=False):
+        pass
+
+
+@register
+@dataclasses.dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Reference ``nn/conf/layers/CenterLossOutputLayer.java``: softmax loss +
+    center loss with per-class feature centers updated by EMA."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+    gradient_check: bool = False
+
+
+@register
+@dataclasses.dataclass
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder pretrain layer (reference ``nn/conf/layers/AutoEncoder.java``)."""
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def is_pretrain_layer(self):
+        return True
+
+
+@register
+@dataclasses.dataclass
+class VariationalAutoencoder(FeedForwardLayer):
+    """Reference ``nn/conf/layers/variational/VariationalAutoencoder.java`` /
+    impl ``nn/layers/variational/VariationalAutoencoder.java`` (1163 LoC).
+
+    ``n_out`` = latent size. Forward (supervised use) emits the mean of q(z|x).
+    Pretraining maximizes the ELBO with ``num_samples`` MC samples.
+    """
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    pzx_activation: str = "identity"
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    num_samples: int = 1
+
+    def is_pretrain_layer(self):
+        return True
+
+
+class PoolingDimension:
+    pass
+
+
+@register
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Pool over spatial/time dims (reference ``nn/conf/layers/GlobalPoolingLayer.java``);
+    mask-aware for RNN input."""
+    pooling_type: str = PoolingType.MAX
+    pooling_dimensions: Optional[Tuple[int, ...]] = None
+    collapse_dimensions: bool = True
+    pnorm: int = 2
+
+    def get_output_type(self, index, input_type):
+        if isinstance(input_type, InputTypeConvolutional):
+            return InputTypeFeedForward(input_type.channels)
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputTypeFeedForward(input_type.size)
+        return input_type
+
+
+@register
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (reference ``nn/conf/layers/objdetect/Yolo2OutputLayer.java``,
+    impl ``nn/layers/objdetect/Yolo2OutputLayer.java`` 714 LoC).
+
+    ``boxes``: [[h,w], ...] anchor box priors in grid units.
+    Labels: [batch, 4 + C, gridH, gridW] as in the reference.
+    """
+    boxes: Optional[List[List[float]]] = None
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def get_output_type(self, index, input_type):
+        return input_type
+
+
+@register
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    """Wrapper marking the inner layer non-trainable (reference
+    ``nn/conf/layers/misc/FrozenLayer.java``); gradients are zeroed via
+    ``jax.lax.stop_gradient`` on the inner params."""
+    inner: Optional[Any] = None
+
+    def get_output_type(self, index, input_type):
+        return self.inner.get_output_type(index, input_type)
+
+    def set_n_in(self, input_type, override=False):
+        self.inner.set_n_in(input_type, override)
+
+    def preprocessor_for(self, input_type):
+        return self.inner.preprocessor_for(input_type)

@@ -1,0 +1,161 @@
+"""Input preprocessors: shape adapters between layer families.
+
+TPU-native equivalent of reference ``nn/conf/preprocessor/`` (12 classes,
+SURVEY.md §2.1 "Preprocessors"). Unlike the reference — which implements
+``preProcess`` and a hand-written ``backprop`` per adapter — these are pure
+reshape/transpose functions; AD provides the backward pass and XLA folds the
+reshapes into adjacent ops (usually free on TPU).
+
+Data conventions (TPU-native; differ from the reference's CUDA-era layouts):
+ - feed-forward activations: ``[batch, size]``
+ - recurrent activations:    ``[batch, time, size]``   (reference: [b, size, T])
+ - convolutional activations:``[batch, h, w, c]`` NHWC (reference: NCHW)
+Flattened orderings (e.g. CnnToFeedForward) keep the reference's channel-major
+(c, h, w) element order so flattened dense weights stay interchangeable with
+reference/Keras checkpoints.
+
+Preprocessors receive a mutable runtime ``ctx`` dict carrying static-shape facts
+(minibatch size, sequence length) that the reference stored as instance state.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .serde import register
+from .inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+                     InputTypeFeedForward, InputTypeRecurrent)
+
+__all__ = ["InputPreProcessor", "CnnToFeedForwardPreProcessor",
+           "FeedForwardToCnnPreProcessor", "RnnToFeedForwardPreProcessor",
+           "FeedForwardToRnnPreProcessor", "CnnToRnnPreProcessor",
+           "RnnToCnnPreProcessor", "ComposableInputPreProcessor"]
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def __call__(self, x, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def get_output_type(self, input_type):
+        raise NotImplementedError
+
+
+@register
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b,h,w,c] → [b, c*h*w] in reference channel-major order
+    (reference ``CnnToFeedForwardPreProcessor.java``)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, ctx):
+        b = x.shape[0]
+        return x.transpose(0, 3, 1, 2).reshape(b, -1)
+
+    def get_output_type(self, input_type):
+        return InputTypeFeedForward(input_type.arity())
+
+
+@register
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[b, c*h*w] (channel-major) → [b,h,w,c] (reference ``FeedForwardToCnnPreProcessor.java``)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, ctx):
+        b = x.shape[0]
+        return x.reshape(b, self.channels, self.height, self.width).transpose(0, 2, 3, 1)
+
+    def get_output_type(self, input_type):
+        return InputTypeConvolutional(self.height, self.width, self.channels)
+
+
+@register
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b,T,s] → [b*T, s] (reference ``RnnToFeedForwardPreProcessor.java``)."""
+
+    def __call__(self, x, ctx):
+        b, t, s = x.shape
+        ctx["minibatch"] = b
+        ctx["timesteps"] = t
+        return x.reshape(b * t, s)
+
+    def get_output_type(self, input_type):
+        return InputTypeFeedForward(input_type.size)
+
+
+@register
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[b*T, s] → [b,T,s] using ctx, or [b,s] → [b,1,s] when no sequence context
+    (reference ``FeedForwardToRnnPreProcessor.java``)."""
+
+    def __call__(self, x, ctx):
+        n, s = x.shape
+        b = ctx.get("minibatch")
+        t = ctx.get("timesteps")
+        if b is None or t is None or b * t != n:
+            return x.reshape(n, 1, s)
+        return x.reshape(b, t, s)
+
+    def get_output_type(self, input_type):
+        return InputTypeRecurrent(input_type.arity())
+
+
+@register
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[b*T,h,w,c] → [b,T,c*h*w] (reference ``CnnToRnnPreProcessor.java``)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, ctx):
+        n = x.shape[0]
+        b = ctx.get("minibatch", n)
+        t = max(n // max(b, 1), 1)
+        flat = x.transpose(0, 3, 1, 2).reshape(n, -1)
+        return flat.reshape(b, t, flat.shape[-1])
+
+    def get_output_type(self, input_type):
+        return InputTypeRecurrent(input_type.arity())
+
+
+@register
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[b,T,c*h*w] → [b*T,h,w,c] (reference ``RnnToCnnPreProcessor.java``)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, ctx):
+        b, t, s = x.shape
+        ctx["minibatch"] = b
+        ctx["timesteps"] = t
+        y = x.reshape(b * t, self.channels, self.height, self.width)
+        return y.transpose(0, 2, 3, 1)
+
+    def get_output_type(self, input_type):
+        return InputTypeConvolutional(self.height, self.width, self.channels)
+
+
+@register
+@dataclasses.dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    processors: Optional[list] = None
+
+    def __call__(self, x, ctx):
+        for p in self.processors or []:
+            x = p(x, ctx)
+        return x
+
+    def get_output_type(self, input_type):
+        for p in self.processors or []:
+            input_type = p.get_output_type(input_type)
+        return input_type

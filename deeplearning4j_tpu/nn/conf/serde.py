@@ -1,0 +1,75 @@
+"""JSON serde for configuration objects.
+
+TPU-native equivalent of the reference's Jackson-based config serialization
+(reference ``deeplearning4j-nn/.../nn/conf/serde/``, ``toJson/fromJson`` on
+``MultiLayerConfiguration``/``ComputationGraphConfiguration``). Every config
+dataclass registers here; objects round-trip through plain JSON dicts tagged
+with ``"@class"`` so saved models (``ModelSerializer``) are self-describing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register(cls):
+    """Class decorator: make a config dataclass JSON round-trippable."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def registered(name):
+    return _REGISTRY[name]
+
+
+def encode(obj) -> Any:
+    """Recursively encode dataclasses / containers into JSON-able structures."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            out[f.name] = encode(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:  # numpy scalar
+        return obj.item()
+    raise TypeError(f"Cannot encode {type(obj)} ({obj!r}) to config JSON")
+
+
+def decode(data) -> Any:
+    """Inverse of :func:`encode`."""
+    if isinstance(data, dict):
+        if "@class" in data:
+            d = dict(data)
+            name = d.pop("@class")
+            if name not in _REGISTRY:
+                raise ValueError(f"Unknown config class '{name}' in JSON "
+                                 f"(known: {sorted(_REGISTRY)})")
+            cls = _REGISTRY[name]
+            kwargs = {k: decode(v) for k, v in d.items()}
+            # tolerate forward-compat extra keys
+            names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: v for k, v in kwargs.items() if k in names}
+            obj = cls(**kwargs)
+            # restore tuple-ness where the field default or type hints suggest it
+            return obj
+        return {k: decode(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [decode(v) for v in data]
+    return data
+
+
+def to_json(obj, indent=2) -> str:
+    return json.dumps(encode(obj), indent=indent)
+
+
+def from_json(s: str):
+    return decode(json.loads(s))

@@ -1,0 +1,75 @@
+"""Multi-head self-attention layer.
+
+Net-new vs the 0.9.x reference (which has no attention layers — SURVEY.md §5
+"Long-context: absent"), included because long-context support is first-class in
+the TPU build. The layer is written so the sequence dimension can be sharded:
+under ``parallel.sequence`` the same parameters run blockwise ring attention
+across a mesh 'sp' axis (see ``deeplearning4j_tpu/parallel/sequence.py``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, implements
+
+
+def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False):
+    """q,k,v: [b, T, h, d]. Returns [b, T, h, d]. Scaled dot-product attention
+    with f32 softmax accumulation (bf16-safe)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(compute_dtype),
+                        k.astype(compute_dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        T, S = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if train and dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(compute_dtype),
+                      v.astype(compute_dtype), preferred_element_type=jnp.float32)
+
+
+@implements("SelfAttentionLayer")
+class SelfAttentionImpl(LayerImpl):
+    def _dims(self):
+        c = self.conf
+        h = c.num_heads
+        d = c.head_dim or (c.n_out // h)
+        return h, d
+
+    def init(self, rng):
+        c = self.conf
+        h, d = self._dims()
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        params = {
+            "Wq": self._init_w(k1, (c.n_in, h * d), c.n_in, h * d),
+            "Wk": self._init_w(k2, (c.n_in, h * d), c.n_in, h * d),
+            "Wv": self._init_w(k3, (c.n_in, h * d), c.n_in, h * d),
+            "Wo": self._init_w(k4, (h * d, c.n_out), h * d, c.n_out),
+            "b": self._init_b((c.n_out,)),
+        }
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        h, d = self._dims()
+        b, T, _ = x.shape
+        x = self.maybe_dropout(x, train, rng)
+        cd = self.compute_dtype
+        q = (x @ params["Wq"].astype(x.dtype)).reshape(b, T, h, d)
+        k = (x @ params["Wk"].astype(x.dtype)).reshape(b, T, h, d)
+        v = (x @ params["Wv"].astype(x.dtype)).reshape(b, T, h, d)
+        if mask is not None:
+            # zero out padded keys/values
+            m = mask.astype(q.dtype)[:, :, None, None]
+            k = k * m
+            v = v * m
+        o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train)
+        o = o.reshape(b, T, h * d)
+        y = o @ params["Wo"].astype(o.dtype) + params["b"].astype(o.dtype)
+        return self.activation(y).astype(self.dtype), state

@@ -1,0 +1,143 @@
+"""Layer implementation protocol and registry.
+
+TPU-native equivalent of the reference's ``Layer`` runtime interface
+(reference ``nn/api/Layer.java:38``: ``activate``/``backpropGradient``/``preOutput``)
+and the per-layer impl tree ``nn/layers/`` (SURVEY.md §2.1 "Layer impls").
+
+Central idiom shift (SURVEY.md §7 Phase 0): the reference dispatches every op over
+JNI and hand-writes ``backpropGradient`` per layer; here each layer is a *pure
+function* ``forward(params, state, x) -> (y, state)`` traced once into the jitted
+training step, and the backward pass is ``jax.grad`` of the whole step. There is no
+per-layer backprop code to keep in sync with forward — the cuDNN-helper
+pattern (``ConvolutionLayer.java:76`` reflective Cudnn*Helper loading) maps to XLA
+fusing + optional Pallas kernels registered per layer type in ``ops/``.
+
+Every impl exposes:
+ - ``init(rng) -> (params, state)``: params = trainable pytree ({"W": ..., "b": ...},
+   reference param-name parity), state = non-trainable (BN running stats, ...)
+ - ``forward(params, state, x, train, rng, mask, ctx) -> (y, new_state)``
+ - ``regularization(params) -> scalar`` (l1/l2 penalty contribution)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..activations import get_activation
+from ..weights import init_weight, WeightInit
+from ..conf.layers import BaseLayer
+
+_IMPL_REGISTRY: Dict[str, Type["LayerImpl"]] = {}
+
+
+def implements(*config_class_names):
+    def deco(cls):
+        for n in config_class_names:
+            _IMPL_REGISTRY[n] = cls
+        return cls
+    return deco
+
+
+def impl_for(conf, global_conf, input_type=None) -> "LayerImpl":
+    name = type(conf).__name__
+    if name not in _IMPL_REGISTRY:
+        raise ValueError(f"No layer implementation registered for config '{name}'")
+    return _IMPL_REGISTRY[name](conf, global_conf, input_type)
+
+
+def _resolved(conf, gc, field, default=None):
+    v = getattr(conf, field, None)
+    if v is None:
+        v = getattr(gc, field, None)
+    if v is None:
+        v = default
+    return v
+
+
+class LayerImpl:
+    """Base implementation; resolves per-layer vs global config fields."""
+
+    def __init__(self, conf, gc, input_type=None):
+        self.conf = conf
+        self.gc = gc
+        self.input_type = input_type
+        self.dtype = jnp.dtype(gc.dtype)
+        self.compute_dtype = jnp.dtype(gc.compute_dtype)
+        if isinstance(conf, BaseLayer):
+            self.activation_name = _resolved(conf, gc, "activation", "identity")
+            self.activation = get_activation(self.activation_name)
+            self.weight_init = _resolved(conf, gc, "weight_init", WeightInit.XAVIER)
+            self.dist = _resolved(conf, gc, "dist")
+            self.bias_init = float(_resolved(conf, gc, "bias_init", 0.0))
+            self.l1 = float(_resolved(conf, gc, "l1", 0.0))
+            self.l2 = float(_resolved(conf, gc, "l2", 0.0))
+            self.l1_bias = float(_resolved(conf, gc, "l1_bias", 0.0))
+            self.l2_bias = float(_resolved(conf, gc, "l2_bias", 0.0))
+        self.dropout_p = _resolved(conf, gc, "dropout")  # retain prob or None
+
+    # ------------------------------------------------------------------
+    def init(self, rng):
+        return {}, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _init_w(self, rng, shape, fan_in, fan_out):
+        return init_weight(rng, shape, fan_in, fan_out, self.weight_init, self.dist,
+                           self.dtype)
+
+    def _init_b(self, shape, value=None):
+        v = self.bias_init if value is None else value
+        return jnp.full(shape, v, self.dtype)
+
+    def maybe_dropout(self, x, train, rng):
+        """Inverted dropout on layer input; ``dropout`` is the retain probability
+        (reference 0.9.x semantics, ``BaseLayer.preOutput`` input dropout)."""
+        p = self.dropout_p
+        if not train or p is None or p >= 1.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, jnp.zeros_like(x))
+
+    def cast_in(self, *arrays):
+        """Cast to compute dtype (bfloat16 policy targets the MXU)."""
+        out = tuple(a.astype(self.compute_dtype) if a is not None else None
+                    for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    def regularization(self, params):
+        """L1/L2 penalty, matching reference ``BaseLayer.calcL1/calcL2``:
+        applied to weight params ("W"-like) and biases separately."""
+        if not params:
+            return 0.0
+        total = 0.0
+        for k, v in params.items():
+            if _is_bias_key(k):
+                if self.l1_bias:
+                    total = total + self.l1_bias * jnp.sum(jnp.abs(v))
+                if self.l2_bias:
+                    total = total + 0.5 * self.l2_bias * jnp.sum(v * v)
+            else:
+                if self.l1:
+                    total = total + self.l1 * jnp.sum(jnp.abs(v))
+                if self.l2:
+                    total = total + 0.5 * self.l2 * jnp.sum(v * v)
+        return total
+
+    def num_params(self, params):
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(params))
+
+
+def _is_bias_key(k: str) -> bool:
+    return k == "b" or k.endswith("_b") or k in ("beta",)
+
+
+class NoParamLayerImpl(LayerImpl):
+    def init(self, rng):
+        return {}, {}
+
+    def regularization(self, params):
+        return 0.0

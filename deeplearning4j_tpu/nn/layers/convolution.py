@@ -1,0 +1,238 @@
+"""Convolution family implementations.
+
+TPU-native equivalents of reference ``nn/layers/convolution/`` (ConvolutionLayer,
+ZeroPadding, Upsampling; cuDNN hook at ``ConvolutionLayer.java:76``). Convs run as
+``lax.conv_general_dilated`` in NHWC/HWIO — XLA tiles them onto the MXU; the
+reference's cuDNN algo-selection knobs have no equivalent because XLA owns
+algorithm choice. bfloat16 compute with f32 accumulation via
+``preferred_element_type``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import LayerImpl, NoParamLayerImpl, implements
+from ..conf.layers import ConvolutionMode, _pair
+
+_DN2D = ("NHWC", "HWIO", "NHWC")
+
+
+def conv_padding(mode, k, s, p, d):
+    """Per-dim (lo, hi) padding. Same → SAME semantics; Truncate/Strict → symmetric
+    explicit padding (reference ``ConvolutionUtils``)."""
+    if mode == ConvolutionMode.Same:
+        return "SAME"
+    return [(pi, pi) for pi in p]
+
+
+@implements("ConvolutionLayer")
+class Conv2DImpl(LayerImpl):
+    """z = conv(x, W) + b; W stored HWIO [kh, kw, cin, cout] (reference stores
+    [cout, cin, kh, kw]; layout chosen for XLA/TPU)."""
+
+    def init(self, rng):
+        c = self.conf
+        kh, kw = _pair(c.kernel_size)
+        fan_in = c.n_in * kh * kw
+        fan_out = c.n_out * kh * kw
+        params = {"W": self._init_w(rng, (kh, kw, c.n_in, c.n_out), fan_in, fan_out)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def _conv(self, x, w):
+        c = self.conf
+        k, s, p, d = (_pair(c.kernel_size), _pair(c.stride), _pair(c.padding),
+                      _pair(c.dilation))
+        return lax.conv_general_dilated(
+            x.astype(self.compute_dtype), w.astype(self.compute_dtype),
+            window_strides=s,
+            padding=conv_padding(c.convolution_mode, k, s, p, d),
+            rhs_dilation=d,
+            dimension_numbers=_DN2D,
+            preferred_element_type=jnp.float32)
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        z = self._conv(x, params["W"])
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("Convolution1DLayer")
+class Conv1DImpl(LayerImpl):
+    """1-D conv over [b, T, c] (reference ``Convolution1DLayer.java`` operates on
+    [b, c, T]; layout difference documented in conf.preprocessors)."""
+
+    def init(self, rng):
+        c = self.conf
+        k = _pair(c.kernel_size)[0]
+        fan_in = c.n_in * k
+        fan_out = c.n_out * k
+        params = {"W": self._init_w(rng, (k, c.n_in, c.n_out), fan_in, fan_out)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        x = self.maybe_dropout(x, train, rng)
+        k = _pair(c.kernel_size)[0]
+        s = _pair(c.stride)[0]
+        p = _pair(c.padding)[0]
+        d = _pair(c.dilation)[0]
+        pad = "SAME" if c.convolution_mode == ConvolutionMode.Same else [(p, p)]
+        z = lax.conv_general_dilated(
+            x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
+            window_strides=(s,), padding=pad, rhs_dilation=(d,),
+            dimension_numbers=("NHC", "HIO", "NHC"),
+            preferred_element_type=jnp.float32)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("Deconvolution2D")
+class Deconv2DImpl(Conv2DImpl):
+    """Transposed conv (reference ``Deconvolution2D``); implemented with
+    ``lax.conv_transpose``."""
+
+    def init(self, rng):
+        c = self.conf
+        kh, kw = _pair(c.kernel_size)
+        fan_in = c.n_in * kh * kw
+        fan_out = c.n_out * kh * kw
+        params = {"W": self._init_w(rng, (kh, kw, c.n_in, c.n_out), fan_in, fan_out)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        x = self.maybe_dropout(x, train, rng)
+        s = _pair(c.stride)
+        p = _pair(c.padding)
+        pad = ("SAME" if c.convolution_mode == ConvolutionMode.Same
+               else [(pi, pi) for pi in p])
+        z = lax.conv_transpose(
+            x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
+            strides=s, padding=pad, dimension_numbers=_DN2D,
+            preferred_element_type=jnp.float32)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("DepthwiseConvolution2D")
+class DepthwiseConv2DImpl(LayerImpl):
+    def init(self, rng):
+        c = self.conf
+        kh, kw = _pair(c.kernel_size)
+        m = getattr(c, "depth_multiplier", 1)
+        fan_in = kh * kw
+        params = {"W": self._init_w(rng, (kh, kw, 1, c.n_in * m), fan_in, fan_in * m)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_in * m,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        x = self.maybe_dropout(x, train, rng)
+        s, p, d = _pair(c.stride), _pair(c.padding), _pair(c.dilation)
+        pad = ("SAME" if c.convolution_mode == ConvolutionMode.Same
+               else [(pi, pi) for pi in p])
+        z = lax.conv_general_dilated(
+            x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
+            window_strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=_DN2D, feature_group_count=c.n_in,
+            preferred_element_type=jnp.float32)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("SeparableConvolution2D")
+class SeparableConv2DImpl(LayerImpl):
+    """Depthwise + pointwise (reference ``SeparableConvolution2D``)."""
+
+    def init(self, rng):
+        c = self.conf
+        kh, kw = _pair(c.kernel_size)
+        m = getattr(c, "depth_multiplier", 1)
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "dW": self._init_w(k1, (kh, kw, 1, c.n_in * m), kh * kw, kh * kw * m),
+            "pW": self._init_w(k2, (1, 1, c.n_in * m, c.n_out), c.n_in * m, c.n_out),
+        }
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        x = self.maybe_dropout(x, train, rng)
+        s, p, d = _pair(c.stride), _pair(c.padding), _pair(c.dilation)
+        pad = ("SAME" if c.convolution_mode == ConvolutionMode.Same
+               else [(pi, pi) for pi in p])
+        z = lax.conv_general_dilated(
+            x.astype(self.compute_dtype), params["dW"].astype(self.compute_dtype),
+            window_strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=_DN2D, feature_group_count=c.n_in,
+            preferred_element_type=jnp.float32)
+        z = lax.conv_general_dilated(
+            z.astype(self.compute_dtype), params["pW"].astype(self.compute_dtype),
+            window_strides=(1, 1), padding="VALID", dimension_numbers=_DN2D,
+            preferred_element_type=jnp.float32)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("ZeroPaddingLayer")
+class ZeroPaddingImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        t, b, l, r = self.conf._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@implements("ZeroPadding1DLayer")
+class ZeroPadding1DImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        l, r = _pair(self.conf.padding)
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
+
+
+@implements("Cropping2D")
+class Cropping2DImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        t, b, l, r = self.conf._crops()
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], state
+
+
+@implements("SpaceToDepthLayer")
+class SpaceToDepthImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        bsz = int(self.conf.block_size)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // bsz, bsz, w // bsz, bsz, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // bsz, w // bsz, bsz * bsz * c)
+        return x, state
+
+
+@implements("Upsampling2D")
+class Upsampling2DImpl(NoParamLayerImpl):
+    """Nearest-neighbor upsampling (reference ``Upsampling2D.java``)."""
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        sh, sw = _pair(self.conf.size)
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2), state
+
+
+@implements("Upsampling1D")
+class Upsampling1DImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        return jnp.repeat(x, int(self.conf.size), axis=1), state

@@ -1,0 +1,143 @@
+"""Feed-forward layer implementations: Dense, Activation, Dropout, Embedding,
+AutoEncoder.
+
+TPU-native equivalents of reference ``nn/layers/feedforward/`` +
+``nn/layers/BaseLayer.java`` (dense preOutput/activate) — gemms hit the MXU via a
+single fused XLA dot with bfloat16 compute / f32 accumulation when the dtype
+policy asks for it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, NoParamLayerImpl, implements
+
+
+def _dot(x, w, compute_dtype):
+    # accumulate in f32 on the MXU regardless of compute dtype
+    return jax.lax.dot_general(x.astype(compute_dtype), w.astype(compute_dtype),
+                               (((x.ndim - 1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@implements("DenseLayer")
+class DenseImpl(LayerImpl):
+    """Reference ``nn/layers/feedforward/dense/DenseLayer.java`` (via BaseLayer
+    preOutput: z = xW + b, ``nn/layers/BaseLayer.java``)."""
+
+    def init(self, rng):
+        c = self.conf
+        w = self._init_w(rng, (c.n_in, c.n_out), c.n_in, c.n_out)
+        params = {"W": w}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        z = _dot(x, params["W"], self.compute_dtype)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("ActivationLayer")
+class ActivationImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        return self.activation(x), state
+
+
+@implements("DropoutLayer")
+class DropoutImpl(NoParamLayerImpl):
+    """Reference ``nn/layers/DropoutLayer.java``; dropout = retain probability."""
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        return self.maybe_dropout(x, train, rng), state
+
+
+@implements("EmbeddingLayer")
+class EmbeddingImpl(LayerImpl):
+    """Reference ``nn/layers/feedforward/embedding/EmbeddingLayer.java``: input is
+    a column of integer indices [b] or one-hot [b, nIn]; output [b, nOut].
+    Lookup is a gather (no one-hot matmul) — efficient on TPU HBM."""
+
+    def init(self, rng):
+        c = self.conf
+        params = {"W": self._init_w(rng, (c.n_in, c.n_out), c.n_in, c.n_out)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        if x.ndim == 2 and x.shape[-1] == 1:
+            x = x[..., 0]
+        if x.ndim == 2:  # one-hot
+            idx = jnp.argmax(x, axis=-1)
+        else:
+            idx = x.astype(jnp.int32)
+        z = jnp.take(params["W"], idx, axis=0)
+        if "b" in params:
+            z = z + params["b"]
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("EmbeddingSequenceLayer")
+class EmbeddingSequenceImpl(LayerImpl):
+    """Index sequence [b, T] (or [b, T, 1]) → [b, T, nOut]."""
+
+    def init(self, rng):
+        c = self.conf
+        params = {"W": self._init_w(rng, (c.n_in, c.n_out), c.n_in, c.n_out)}
+        if getattr(c, "has_bias", False):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        if x.ndim == 3 and x.shape[-1] == 1:
+            x = x[..., 0]
+        idx = x.astype(jnp.int32)
+        z = jnp.take(params["W"], idx, axis=0)
+        if "b" in params:
+            z = z + params["b"]
+        return self.activation(z).astype(self.dtype), state
+
+
+@implements("AutoEncoder")
+class AutoEncoderImpl(LayerImpl):
+    """Denoising autoencoder (reference ``nn/layers/feedforward/autoencoder/AutoEncoder.java``).
+    Supervised forward = encoder only; ``pretrain_loss`` gives the reconstruction
+    objective with input corruption."""
+
+    def init(self, rng):
+        c = self.conf
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": self._init_w(k1, (c.n_in, c.n_out), c.n_in, c.n_out),
+            "b": self._init_b((c.n_out,)),
+            "vb": self._init_b((c.n_in,)),  # visible bias (reference param key "vb")
+        }
+        return params, {}
+
+    def encode(self, params, x):
+        return self.activation(_dot(x, params["W"], self.compute_dtype)
+                               + params["b"])
+
+    def decode(self, params, h):
+        return self.activation(_dot(h, params["W"].T, self.compute_dtype)
+                               + params["vb"])
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        return self.encode(params, x).astype(self.dtype), state
+
+    def pretrain_loss(self, params, x, rng):
+        from ..losses import get_loss
+        c = self.conf
+        if c.corruption_level and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - c.corruption_level, x.shape)
+            xc = jnp.where(keep, x, jnp.zeros_like(x))
+        else:
+            xc = x
+        recon = self.decode(params, self.encode(params, xc))
+        return get_loss(c.loss)(x, recon, "identity", None)

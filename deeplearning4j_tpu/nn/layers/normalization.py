@@ -1,0 +1,86 @@
+"""Normalization implementations: BatchNormalization, LocalResponseNormalization.
+
+TPU-native equivalents of reference ``nn/layers/normalization/{BatchNormalization,
+LocalResponseNormalization}.java`` (cuDNN helper hooks in the reference; here XLA
+fuses the normalization arithmetic into neighbors). Running mean/var live in the
+layer *state* pytree — the functional replacement for the reference's mutable
+mean/var params — and are updated only when ``train=True``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import LayerImpl, implements
+
+
+@implements("BatchNormalization")
+class BatchNormImpl(LayerImpl):
+    """Per-channel BN for [b, f] and NHWC [b, h, w, c] activations.
+    Params gamma/beta (reference keys), state mean/var with ``decay`` EMA
+    (reference ``BatchNormalization.java`` decay semantics:
+    running = decay * running + (1-decay) * batch)."""
+
+    def init(self, rng):
+        c = self.conf
+        n = c.n_out
+        params = {}
+        if not c.lock_gamma_beta:
+            params["gamma"] = jnp.full((n,), c.gamma, self.dtype)
+            params["beta"] = jnp.full((n,), c.beta, self.dtype)
+        state = {"mean": jnp.zeros((n,), jnp.float32),
+                 "var": jnp.ones((n,), jnp.float32)}
+        return params, state
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_state = {
+                "mean": c.decay * state["mean"] + (1 - c.decay) * mean,
+                "var": c.decay * state["var"] + (1 - c.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = 1.0 / jnp.sqrt(var + c.eps)
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if "gamma" in params:
+            y = y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+        else:
+            y = y * c.gamma + c.beta
+        return y, new_state
+
+    def regularization(self, params):
+        return 0.0  # reference: no l1/l2 on BN params by default
+
+
+@implements("LocalResponseNormalization")
+class LRNImpl(LayerImpl):
+    """Across-channel LRN on NHWC (reference ``LocalResponseNormalization.java``):
+    y = x / (k + alpha * sum_{j in window} x_j^2)^beta."""
+
+    def init(self, rng):
+        return {}, {}
+
+    def regularization(self, params):
+        return 0.0
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        half = int(c.n) // 2
+        sq = x * x
+        # sum over channel window via padded cumulative trick (static unroll of
+        # the small window; XLA fuses this into one elementwise kernel)
+        acc = jnp.zeros_like(sq)
+        ch = x.shape[-1]
+        for off in range(-half, half + 1):
+            if off == 0:
+                acc = acc + sq
+            elif off < 0:
+                acc = acc.at[..., :off].add(sq[..., -off:])
+            else:
+                acc = acc.at[..., off:].add(sq[..., :ch - off])
+        denom = jnp.power(c.k + c.alpha * acc, c.beta)
+        return x / denom, state

@@ -1,0 +1,101 @@
+"""Output layer implementations: OutputLayer, RnnOutputLayer, LossLayer,
+CenterLossOutputLayer.
+
+TPU-native equivalents of reference ``nn/layers/OutputLayer.java`` /
+``BaseOutputLayer.java`` (``computeScore``). An output layer is a dense projection
+plus a loss; ``loss_on`` evaluates the loss on *preoutput* so numerically fused
+softmax/sigmoid cross-entropy paths apply (see ``nn.losses``). The network's
+jitted train step calls ``loss_on``; ``forward`` gives inference activations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, NoParamLayerImpl, implements
+from .feedforward import _dot
+from ..losses import get_loss
+
+
+class _OutputBase(LayerImpl):
+    def preout(self, params, x):
+        z = _dot(x, params["W"], self.compute_dtype)
+        if "b" in params:
+            z = z + params["b"].astype(z.dtype)
+        return z
+
+    def init(self, rng):
+        c = self.conf
+        params = {"W": self._init_w(rng, (c.n_in, c.n_out), c.n_in, c.n_out)}
+        if getattr(c, "has_bias", True):
+            params["b"] = self._init_b((c.n_out,))
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        return self.activation(self.preout(params, x)).astype(self.dtype), state
+
+    def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):
+        x = self.maybe_dropout(x, train, rng)
+        z = self.preout(params, x)
+        return get_loss(self.conf.loss)(labels, z, self.activation_name, mask)
+
+
+@implements("OutputLayer")
+class OutputLayerImpl(_OutputBase):
+    pass
+
+
+@implements("RnnOutputLayer")
+class RnnOutputLayerImpl(_OutputBase):
+    """Per-timestep output over [b, T, nIn] (reference ``RnnOutputLayer.java``);
+    loss is mask-aware over [b, T]."""
+    pass
+
+
+@implements("LossLayer")
+class LossLayerImpl(NoParamLayerImpl):
+    """Loss without weights (reference ``nn/layers/LossLayer.java``)."""
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        return self.activation(x), state
+
+    def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):
+        return get_loss(self.conf.loss)(labels, x, self.activation_name, mask)
+
+
+@implements("CenterLossOutputLayer")
+class CenterLossOutputLayerImpl(_OutputBase):
+    """Softmax loss + lambda * center loss (reference
+    ``nn/layers/training/CenterLossOutputLayer.java``). Class centers are state,
+    EMA-updated toward batch feature means with rate ``alpha``."""
+
+    def init(self, rng):
+        params, _ = super().init(rng)
+        c = self.conf
+        state = {"centers": jnp.zeros((c.n_out, c.n_in), jnp.float32)}
+        return params, state
+
+    def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):
+        c = self.conf
+        z = self.preout(params, x)
+        base = get_loss(c.loss)(labels, z, self.activation_name, mask)
+        centers = state["centers"]
+        cls = jnp.argmax(labels, axis=-1)
+        diffs = x - centers[cls]
+        center_loss = 0.5 * jnp.mean(jnp.sum(diffs * diffs, axis=-1))
+        return base + c.lambda_ * center_loss
+
+    def update_state(self, state, x, labels):
+        """EMA center update (called outside AD by the train step)."""
+        c = self.conf
+        cls = jnp.argmax(labels, axis=-1)
+        onehot = jax.nn.one_hot(cls, c.n_out, dtype=jnp.float32)
+        counts = jnp.maximum(onehot.sum(axis=0), 1.0)[:, None]
+        batch_means = (onehot.T @ x.astype(jnp.float32)) / counts
+        present = (onehot.sum(axis=0) > 0)[:, None]
+        centers = state["centers"]
+        new_centers = jnp.where(present,
+                                centers + c.alpha * (batch_means - centers),
+                                centers)
+        return {"centers": new_centers}

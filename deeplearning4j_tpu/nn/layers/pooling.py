@@ -1,0 +1,115 @@
+"""Pooling implementations: Subsampling (spatial) and GlobalPooling.
+
+TPU-native equivalents of reference ``nn/layers/convolution/subsampling/`` and
+``nn/layers/pooling/GlobalPoolingLayer.java``. Windowed pools compile to
+``lax.reduce_window`` (VPU-friendly); global RNN pooling is mask-aware like the
+reference's ``MaskedReductionUtil``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .base import NoParamLayerImpl, implements
+from ..conf.layers import ConvolutionMode, PoolingType, _pair
+
+
+def _pool2d(x, kind, k, s, pad, pnorm=None, eps=1e-8):
+    dims = (1, k[0], k[1], 1)
+    strides = (1, s[0], s[1], 1)
+    if kind == PoolingType.MAX:
+        init = -jnp.inf
+        y = lax.reduce_window(x, init, lax.max, dims, strides, pad)
+        return y
+    if kind in (PoolingType.AVG, PoolingType.SUM):
+        y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+        if kind == PoolingType.SUM:
+            return y
+        if pad == "VALID":
+            return y / (k[0] * k[1])
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+        return y / jnp.maximum(counts, 1.0)
+    if kind == PoolingType.PNORM:
+        p = float(pnorm or 2)
+        y = lax.reduce_window(jnp.power(jnp.abs(x), p), 0.0, lax.add, dims, strides, pad)
+        return jnp.power(y + eps, 1.0 / p)
+    raise ValueError(f"Unknown pooling type {kind}")
+
+
+@implements("SubsamplingLayer")
+class SubsamplingImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        k, s, p = _pair(c.kernel_size), _pair(c.stride), _pair(c.padding)
+        if c.convolution_mode == ConvolutionMode.Same:
+            pad = "SAME"
+        elif p == (0, 0):
+            pad = "VALID"
+        else:
+            pad = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+        y = _pool2d(x, c.pooling_type, k, s, pad, c.pnorm, c.eps)
+        return y, state
+
+
+@implements("Subsampling1DLayer")
+class Subsampling1DImpl(NoParamLayerImpl):
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        k = _pair(c.kernel_size)[0]
+        s = _pair(c.stride)[0]
+        p = _pair(c.padding)[0]
+        if c.convolution_mode == ConvolutionMode.Same:
+            pad = "SAME"
+        elif p == 0:
+            pad = "VALID"
+        else:
+            pad = ((0, 0), (p, p), (0, 0))
+        x4 = x[:, :, None, :]  # [b, T, 1, c]
+        y = _pool2d(x4, c.pooling_type, (k, 1), (s, 1),
+                    pad if isinstance(pad, str) else ((0, 0), (p, p), (0, 0), (0, 0)),
+                    c.pnorm, c.eps)
+        return y[:, :, 0, :], state
+
+
+@implements("GlobalPoolingLayer")
+class GlobalPoolingImpl(NoParamLayerImpl):
+    """Pool over time ([b,T,s] → [b,s]) or space ([b,h,w,c] → [b,c]); mask-aware
+    over the time dimension (reference ``GlobalPoolingLayer.java`` +
+    ``MaskedReductionUtil``)."""
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        c = self.conf
+        kind = c.pooling_type
+        if x.ndim == 3:  # [b, T, s], mask [b, T]
+            axes = (1,)
+            if mask is not None:
+                m = mask.astype(x.dtype)[:, :, None]
+                if kind == PoolingType.MAX:
+                    big_neg = jnp.asarray(-1e30, x.dtype)
+                    return jnp.max(jnp.where(m > 0, x, big_neg), axis=1), state
+                if kind == PoolingType.SUM:
+                    return jnp.sum(x * m, axis=1), state
+                if kind == PoolingType.AVG:
+                    denom = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+                    return jnp.sum(x * m, axis=1) / denom, state
+                if kind == PoolingType.PNORM:
+                    p = float(c.pnorm)
+                    return jnp.power(jnp.sum(jnp.power(jnp.abs(x) * m, p), axis=1),
+                                     1.0 / p), state
+        elif x.ndim == 4:  # [b, h, w, c]
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPoolingLayer: unsupported rank {x.ndim}")
+
+        if kind == PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if kind == PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        if kind == PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if kind == PoolingType.PNORM:
+            p = float(c.pnorm)
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes), 1.0 / p), state
+        raise ValueError(f"Unknown pooling type {kind}")

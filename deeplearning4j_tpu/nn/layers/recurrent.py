@@ -1,0 +1,255 @@
+"""Recurrent layer implementations: LSTM, GravesLSTM, GravesBidirectionalLSTM,
+SimpleRnn, Bidirectional and LastTimeStep wrappers.
+
+TPU-native equivalents of reference ``nn/layers/recurrent/`` — the shared
+forward/backward math in ``LSTMHelpers.java:68`` (activateHelper) and the ifog
+block gemm (:206-212) become a ``lax.scan`` whose *input projection is hoisted*
+out of the loop: one big [b·T, nIn]×[nIn, 4H] gemm feeds the MXU, and the scan
+body only does the [b, H]×[H, 4H] recurrent gemm plus elementwise gate math.
+Backward-through-time is AD of the scan (no hand-written BPTT).
+
+Sequence layout is [batch, time, features] (reference: [b, features, T]).
+Gate order in the fused 4H dimension is i, f, o, g matching the reference's
+IFOG convention (``LSTMParamInitializer``). Param keys: "W" (input weights
+[nIn, 4H]), "RW" (recurrent [H, 4H]), "b" ([4H]); Graves peepholes "pi","pf","po".
+
+Streaming state (``rnnTimeStep``) flows through ``ctx``: the network places
+per-layer previous (h, c) under ``ctx['rnn_state_in'][layer_index]`` and collects
+``ctx['rnn_state_out']`` — the functional replacement for the reference's mutable
+``stateMap`` (``BaseRecurrentLayer.java``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import LayerImpl, implements, impl_for
+from ..activations import get_activation
+
+
+class _BaseLSTMImpl(LayerImpl):
+    peepholes = False
+
+    def init(self, rng):
+        c = self.conf
+        H = c.n_out
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {
+            "W": self._init_w(k1, (c.n_in, 4 * H), c.n_in, H),
+            "RW": self._init_w(k2, (H, 4 * H), H, H),
+            "b": self._init_b((4 * H,)),
+        }
+        # forget-gate bias init (reference LSTMParamInitializer sets f-gate
+        # slice of the bias to forgetGateBiasInit)
+        fb = getattr(c, "forget_gate_bias_init", 1.0)
+        params["b"] = params["b"].at[H:2 * H].set(fb)
+        if self.peepholes:
+            params["pi"] = jnp.zeros((H,), self.dtype)
+            params["pf"] = jnp.zeros((H,), self.dtype)
+            params["po"] = jnp.zeros((H,), self.dtype)
+        return params, {}
+
+    def _run(self, params, x, mask, h0c0, reverse=False):
+        c = self.conf
+        H = c.n_out
+        act = self.activation
+        gate_act = get_activation(getattr(c, "gate_activation", "sigmoid"))
+        b, T, _ = x.shape
+        if reverse:
+            x = jnp.flip(x, axis=1)
+            mask = None if mask is None else jnp.flip(mask, axis=1)
+        # hoisted input projection: [b*T, nIn] @ [nIn, 4H] on the MXU
+        xp = (x.reshape(b * T, -1).astype(self.compute_dtype)
+              @ params["W"].astype(self.compute_dtype)).astype(jnp.float32)
+        xp = xp.reshape(b, T, 4 * H) + params["b"].astype(jnp.float32)
+        if h0c0 is None:
+            h0 = jnp.zeros((b, H), jnp.float32)
+            c0 = jnp.zeros((b, H), jnp.float32)
+        else:
+            h0, c0 = h0c0
+        peep = ((params["pi"], params["pf"], params["po"])
+                if self.peepholes else None)
+        rw = params["RW"].astype(jnp.float32)
+
+        def step(carry, inp):
+            h, cc = carry
+            xp_t, m_t = inp
+            z = xp_t + h @ rw
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            if peep is not None:
+                zi = zi + cc * peep[0]
+                zf = zf + cc * peep[1]
+            i = gate_act(zi)
+            f = gate_act(zf)
+            g = act(zg)
+            c_new = f * cc + i * g
+            zo2 = zo + c_new * peep[2] if peep is not None else zo
+            o = gate_act(zo2)
+            h_new = o * act(c_new)
+            if m_t is not None:
+                mm = m_t[:, None].astype(h_new.dtype)
+                h_new = mm * h_new + (1 - mm) * h
+                c_new = mm * c_new + (1 - mm) * cc
+            return (h_new, c_new), h_new
+
+        xs = jnp.swapaxes(xp, 0, 1)
+        if mask is not None:
+            ms = jnp.swapaxes(mask, 0, 1)
+            (hT, cT), ys = lax.scan(step, (h0, c0), (xs, ms))
+        else:
+            (hT, cT), ys = lax.scan(lambda cr, xt: step(cr, (xt, None)), (h0, c0), xs)
+        y = jnp.swapaxes(ys, 0, 1)
+        if reverse:
+            y = jnp.flip(y, axis=1)
+        return y.astype(self.dtype), (hT, cT)
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        h0c0 = None
+        idx = getattr(self, "index", None)
+        if ctx is not None and idx is not None:
+            h0c0 = ctx.get("rnn_state_in", {}).get(idx)
+        y, hc = self._run(params, x, mask, h0c0)
+        if ctx is not None and idx is not None:
+            ctx.setdefault("rnn_state_out", {})[idx] = hc
+        return y, state
+
+
+@implements("LSTM")
+class LSTMImpl(_BaseLSTMImpl):
+    peepholes = False
+
+
+@implements("GravesLSTM")
+class GravesLSTMImpl(_BaseLSTMImpl):
+    peepholes = True
+
+
+@implements("GravesBidirectionalLSTM")
+class GravesBidirectionalLSTMImpl(_BaseLSTMImpl):
+    """Two param sets (suffix F/B, reference ``GravesBidirectionalLSTMParamInitializer``);
+    direction outputs are summed (output stays [b, T, nOut])."""
+    peepholes = True
+
+    def init(self, rng):
+        kf, kb = jax.random.split(rng)
+        pf, _ = super().init(kf)
+        pb, _ = super().init(kb)
+        params = {k + "F": v for k, v in pf.items()}
+        params.update({k + "B": v for k, v in pb.items()})
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        pf = {k[:-1]: v for k, v in params.items() if k.endswith("F")}
+        pb = {k[:-1]: v for k, v in params.items() if k.endswith("B")}
+        yf, _ = self._run(pf, x, mask, None)
+        yb, _ = self._run(pb, x, mask, None, reverse=True)
+        return yf + yb, state
+
+
+@implements("SimpleRnn")
+class SimpleRnnImpl(LayerImpl):
+    """h_t = act(x_t W + h_{t-1} RW + b) (post-0.9 reference ``SimpleRnn``)."""
+
+    def init(self, rng):
+        c = self.conf
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": self._init_w(k1, (c.n_in, c.n_out), c.n_in, c.n_out),
+            "RW": self._init_w(k2, (c.n_out, c.n_out), c.n_out, c.n_out),
+            "b": self._init_b((c.n_out,)),
+        }
+        return params, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        x = self.maybe_dropout(x, train, rng)
+        b, T, _ = x.shape
+        H = self.conf.n_out
+        xp = (x.reshape(b * T, -1).astype(self.compute_dtype)
+              @ params["W"].astype(self.compute_dtype)).astype(jnp.float32)
+        xp = xp.reshape(b, T, H) + params["b"].astype(jnp.float32)
+        rw = params["RW"].astype(jnp.float32)
+        act = self.activation
+
+        def step(h, inp):
+            xt, mt = inp
+            h_new = act(xt + h @ rw)
+            if mt is not None:
+                mm = mt[:, None].astype(h_new.dtype)
+                h_new = mm * h_new + (1 - mm) * h
+            return h_new, h_new
+
+        xs = jnp.swapaxes(xp, 0, 1)
+        h0 = jnp.zeros((b, H), jnp.float32)
+        if mask is not None:
+            ms = jnp.swapaxes(mask, 0, 1)
+            _, ys = lax.scan(step, h0, (xs, ms))
+        else:
+            _, ys = lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
+        return jnp.swapaxes(ys, 0, 1).astype(self.dtype), state
+
+
+class _WrapperImpl(LayerImpl):
+    def __init__(self, conf, gc, input_type=None):
+        super().__init__(conf, gc, input_type)
+        self.inner = impl_for(conf.inner, gc, input_type)
+
+    def regularization(self, params):
+        return self.inner.regularization(params)
+
+
+@implements("Bidirectional")
+class BidirectionalImpl(_WrapperImpl):
+    """Generic bidirectional wrapper (modes concat/add/mul/ave)."""
+
+    def init(self, rng):
+        kf, kb = jax.random.split(rng)
+        pf, sf = self.inner.init(kf)
+        pb, sb = self.inner.init(kb)
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        yf, sf = self.inner.forward(params["fwd"], state["fwd"], x, train=train,
+                                    rng=rng, mask=mask, ctx=None)
+        xr = jnp.flip(x, axis=1)
+        mr = None if mask is None else jnp.flip(mask, axis=1)
+        yb, sb = self.inner.forward(params["bwd"], state["bwd"], xr, train=train,
+                                    rng=rng, mask=mr, ctx=None)
+        yb = jnp.flip(yb, axis=1)
+        mode = self.conf.mode
+        if mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif mode == "add":
+            y = yf + yb
+        elif mode == "mul":
+            y = yf * yb
+        elif mode == "ave":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(f"Unknown Bidirectional mode {mode}")
+        return y, {"fwd": sf, "bwd": sb}
+
+    def regularization(self, params):
+        return (self.inner.regularization(params["fwd"])
+                + self.inner.regularization(params["bwd"]))
+
+
+@implements("LastTimeStep")
+class LastTimeStepImpl(_WrapperImpl):
+    """Mask-aware last-timestep extraction (reference ``LastTimeStepVertex`` /
+    ``LastTimeStep`` wrapper)."""
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        y, new_state = self.inner.forward(params, state, x, train=train, rng=rng,
+                                          mask=mask, ctx=ctx)
+        if mask is None:
+            out = y[:, -1, :]
+        else:
+            last = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            out = jnp.take_along_axis(y, last[:, None, None], axis=1)[:, 0, :]
+        return out, new_state

@@ -1,0 +1,34 @@
+"""Wrapper layer implementations: FrozenLayer.
+
+TPU-native equivalent of reference ``nn/layers/FrozenLayer.java``: the inner
+layer runs normally but its params receive no gradient — implemented with
+``jax.lax.stop_gradient`` instead of the reference's no-op updater trick.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import LayerImpl, implements, impl_for
+
+
+@implements("FrozenLayer")
+class FrozenImpl(LayerImpl):
+    def __init__(self, conf, gc, input_type=None):
+        super().__init__(conf, gc, input_type)
+        self.inner = impl_for(conf.inner, gc, input_type)
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.inner.forward(frozen, state, x, train=train, rng=rng,
+                                  mask=mask, ctx=ctx)
+
+    def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.inner.loss_on(frozen, state, x, labels, mask=mask, train=train,
+                                  rng=rng)
+
+    def regularization(self, params):
+        return 0.0

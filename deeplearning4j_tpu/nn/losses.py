@@ -1,0 +1,218 @@
+"""Loss functions.
+
+TPU-native equivalent of ND4J's ``ILossFunction`` implementations consumed by the
+reference's output layers (``nn/conf/layers/OutputLayer`` et al.; the enum lives in
+ND4J ``LossFunctions.LossFunction``). The reference computes ``computeScore`` and a
+hand-written ``computeGradient`` per loss; here each loss exposes only a score —
+gradients flow from AD of the jitted training step (SURVEY.md §7 Phase 0 idiom
+shift: trace/compile instead of op-by-op dispatch).
+
+Numerically sensitive combinations (softmax + MCXENT / NLL, sigmoid + XENT) are
+fused on logits via ``log_softmax`` / ``log_sigmoid`` so bfloat16/float32 TPU runs
+stay stable — the reference relies on float64 fallbacks instead.
+
+Conventions (matching the reference):
+ - ``labels`` and ``preoutput`` are ``[batch, ..., nOut]``.
+ - ``mask`` is ``None`` or broadcastable to per-example/per-timestep weighting
+   (reference: ``LossUtil.applyMask``).
+ - returned score is the *sum over examples / minibatch-size* (the reference's
+   ``computeScore(..., average=true)``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .activations import get_activation
+
+__all__ = ["LossFunction", "get_loss", "LossFunctions"]
+
+_EPS = 1e-7
+
+
+def _apply_activation(preout, activation):
+    return get_activation(activation)(preout)
+
+
+def _reduce(per_elem, mask):
+    """Sum loss over feature axis, apply mask, average over all leading axes.
+
+    ``per_elem``: [batch, ..., nOut] elementwise loss contributions.
+    ``mask``: None, [batch], [batch, T] (rnn), or broadcastable to per_elem[..., 0].
+    Average divides by minibatch (and, with a time mask, by active timesteps),
+    matching the reference's score-averaging semantics.
+    """
+    per_ex = jnp.sum(per_elem, axis=-1)  # [batch, ...]
+    if mask is not None:
+        mask = jnp.broadcast_to(mask.astype(per_ex.dtype), per_ex.shape)
+        per_ex = per_ex * mask
+    # Divide by minibatch size only (masked steps contribute 0 but do not shrink
+    # the denominator) — reference semantics: LossUtil.applyMask zeroes entries,
+    # computeScore(..., average=true) divides by minibatch.
+    batch = per_ex.shape[0] if per_ex.ndim > 0 else 1
+    return jnp.sum(per_ex) / max(batch, 1)
+
+
+# ---------------------------------------------------------------------------
+# Individual losses. Each: f(labels, preoutput, activation, mask) -> scalar
+# ---------------------------------------------------------------------------
+
+def _mse(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    return _reduce((out - labels) ** 2, mask)
+
+
+def _l2(labels, preout, activation, mask):
+    # L2 = un-averaged-over-features squared error (reference LossL2); same as MSE
+    # under our reduction conventions.
+    return _mse(labels, preout, activation, mask)
+
+
+def _mae(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    return _reduce(jnp.abs(out - labels), mask)
+
+
+def _mape(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    return _reduce(100.0 * jnp.abs((labels - out) / (labels + _EPS)), mask)
+
+
+def _msle(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    d = jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS))
+    return _reduce(d * d, mask)
+
+
+def _mcxent(labels, preout, activation, mask):
+    act = str(activation).lower()
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+        return _reduce(-labels * logp, mask)
+    out = _apply_activation(preout, activation)
+    return _reduce(-labels * jnp.log(jnp.clip(out, _EPS, 1.0)), mask)
+
+
+def _sparse_mcxent(labels, preout, activation, mask):
+    # labels: integer class indices [batch, ...]
+    labels = labels.astype(jnp.int32)
+    logp = jax.nn.log_softmax(preout, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return _reduce(-picked, mask)
+
+
+def _xent(labels, preout, activation, mask):
+    act = str(activation).lower()
+    if act == "sigmoid":
+        # stable: -(y*log σ(x) + (1-y)*log σ(-x))
+        per = -(labels * jax.nn.log_sigmoid(preout)
+                + (1.0 - labels) * jax.nn.log_sigmoid(-preout))
+        return _reduce(per, mask)
+    out = jnp.clip(_apply_activation(preout, activation), _EPS, 1.0 - _EPS)
+    per = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    return _reduce(per, mask)
+
+
+def _nll(labels, preout, activation, mask):
+    # Reference treats NEGATIVELOGLIKELIHOOD as MCXENT (LossNegativeLogLikelihood
+    # extends LossMCXENT).
+    return _mcxent(labels, preout, activation, mask)
+
+
+def _kld(labels, preout, activation, mask):
+    out = jnp.clip(_apply_activation(preout, activation), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    return _reduce(lab * (jnp.log(lab) - jnp.log(out)), mask)
+
+
+def _poisson(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    return _reduce(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask)
+
+
+def _cosine_proximity(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    dot = jnp.sum(labels * out, axis=-1, keepdims=True)
+    nl = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+    no = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    cos = dot / jnp.maximum(nl * no, _EPS)
+    return _reduce(-cos, mask)
+
+
+def _hinge(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    # labels in {-1, +1} (reference converts {0,1} labels upstream)
+    return _reduce(jnp.maximum(0.0, 1.0 - labels * out), mask)
+
+
+def _squared_hinge(labels, preout, activation, mask):
+    out = _apply_activation(preout, activation)
+    return _reduce(jnp.maximum(0.0, 1.0 - labels * out) ** 2, mask)
+
+
+def _l1(labels, preout, activation, mask):
+    return _mae(labels, preout, activation, mask)
+
+
+def _reconstruction_xent(labels, preout, activation, mask):
+    return _xent(labels, preout, activation, mask)
+
+
+_LOSSES = {
+    "mse": _mse,
+    "squared_loss": _mse,
+    "l2": _l2,
+    "l1": _l1,
+    "mean_absolute_error": _mae,
+    "mean_absolute_percentage_error": _mape,
+    "mean_squared_logarithmic_error": _msle,
+    "mcxent": _mcxent,
+    "sparse_mcxent": _sparse_mcxent,
+    "negativeloglikelihood": _nll,
+    "xent": _xent,
+    "reconstruction_crossentropy": _reconstruction_xent,
+    "kl_divergence": _kld,
+    "poisson": _poisson,
+    "cosine_proximity": _cosine_proximity,
+    "hinge": _hinge,
+    "squared_hinge": _squared_hinge,
+}
+
+
+class LossFunction:
+    """String-keyed registry mirroring ND4J ``LossFunctions.LossFunction``."""
+
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    XENT = "xent"
+    MCXENT = "mcxent"
+    SPARSE_MCXENT = "sparse_mcxent"
+    SQUARED_LOSS = "squared_loss"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    COSINE_PROXIMITY = "cosine_proximity"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mean_absolute_percentage_error"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "mean_squared_logarithmic_error"
+    POISSON = "poisson"
+
+    @staticmethod
+    def names():
+        return sorted(_LOSSES)
+
+
+LossFunctions = LossFunction  # reference-style alias
+
+
+def get_loss(name):
+    """Resolve a loss by name; callables (custom ILossFunction equivalents) pass through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(_LOSSES)}")
+    return _LOSSES[key]

@@ -1,0 +1,556 @@
+"""MultiLayerNetwork: sequential network container.
+
+TPU-native equivalent of reference ``nn/multilayer/MultiLayerNetwork.java``
+(3156 LoC; ``fit`` :1156, ``feedForwardToLayer`` :903, ``computeGradientAndScore``
+:2206, ``backprop`` :1267, TBPTT :1219).
+
+Architectural shift (SURVEY.md §7): the reference executes op-by-op over JNI with a
+mutable flattened param buffer (``:110/:601/:615``) and hand-written backprop; here
+the whole step — forward, loss, AD backward, gradient normalization, updater, and
+parameter update — is ONE jitted XLA computation with params/updater-state/layer-state
+donated (the functional realization of the reference's in-place
+``stepFunction.step``, ``StochasticGradientDescent.java:79``). Workspaces/CacheMode
+(§2.8 item 3) collapse into XLA buffer donation + executable caching, which jit
+gives us for free.
+
+Training state (BN running stats, RNN streaming state) is explicit: ``states``
+pytree and the TBPTT carry, replacing the reference's mutable layer fields.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .conf import (MultiLayerConfiguration, BackpropType, GradientNormalization)
+from .conf.inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+                          InputTypeRecurrent)
+from .layers import impl_for
+from .layers.recurrent import _BaseLSTMImpl
+from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
+from ..datasets.iterators import AsyncDataSetIterator
+from ..optimize.updater import NetworkUpdater, normalize_gradients
+
+log = logging.getLogger(__name__)
+
+_tm = jax.tree_util.tree_map
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.gc = conf.global_conf
+        self.impls = None
+        self.params = None          # {"0": {"W": ..., "b": ...}, ...}
+        self.states = None          # non-trainable layer state
+        self.updater = None         # NetworkUpdater
+        self.updater_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.listeners: List = []
+        self.score_ = float("nan")
+        self.last_batch_size = 0
+        self.last_etl_ms = 0.0
+        self._rng = None
+        self._jit_step = None
+        self._jit_tbptt_step = None
+        self._jit_output = {}
+        self._rnn_state = None      # streaming state for rnn_time_step
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None):
+        """Build layer impls and initialize parameters (reference ``init()`` :541)."""
+        layers = self.conf.layers
+        # resolve per-layer input types (best effort; None when unknown)
+        input_types = [None] * len(layers)
+        it = self.conf.input_type
+        if it is not None:
+            for i, lc in enumerate(layers):
+                pre = self.conf.preprocessor(i)
+                if pre is not None:
+                    it = pre.get_output_type(it)
+                input_types[i] = it
+                lc.set_n_in(it, override=False)
+                it = lc.get_output_type(i, it)
+        from .conf.layers import FeedForwardLayer, DropoutLayer, LossLayer
+        for i, lc in enumerate(layers):
+            inner = getattr(lc, "inner", None) or lc
+            if isinstance(inner, (DropoutLayer, LossLayer)):
+                continue  # nIn/nOut not required (pass-through layers)
+            if isinstance(inner, FeedForwardLayer):
+                if inner.n_out is None:
+                    raise ValueError(f"Layer {i} ({type(inner).__name__}): n_out "
+                                     f"is not set")
+                if inner.n_in is None:
+                    raise ValueError(
+                        f"Layer {i} ({type(inner).__name__}): n_in is not set — "
+                        f"set n_in explicitly or call set_input_type(...) on the "
+                        f"ListBuilder so it can be inferred")
+        self.impls = []
+        for i, lc in enumerate(layers):
+            impl = impl_for(lc, self.gc, input_types[i])
+            impl.index = i
+            self.impls.append(impl)
+        key = jax.random.PRNGKey(self.gc.seed)
+        self._rng, *layer_keys = jax.random.split(key, len(layers) + 1)
+        if params is not None:
+            self.params = params
+            self.states = {str(i): impl.init(layer_keys[i])[1]
+                           for i, impl in enumerate(self.impls)}
+        else:
+            self.params = {}
+            self.states = {}
+            for i, impl in enumerate(self.impls):
+                p, s = impl.init(layer_keys[i])
+                self.params[str(i)] = p
+                self.states[str(i)] = s
+        # one updater per layer: per-layer override or global default
+        layer_updaters = {}
+        for i, lc in enumerate(layers):
+            u = getattr(lc, "updater", None) or self.gc.updater
+            layer_updaters[str(i)] = u
+        self.updater = NetworkUpdater(layer_updaters)
+        self.updater_state = self.updater.init_state(self.params)
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _apply_layers(self, params, states, x, fmask, train, rng, upto=None,
+                      rnn_state_in=None):
+        """Run layers [0, upto). Returns (x, new_states, rnn_state_out)."""
+        n = len(self.impls)
+        end = n if upto is None else upto
+        keys = (jax.random.split(rng, end) if rng is not None else [None] * end)
+        ctx = {}
+        if rnn_state_in is not None:
+            ctx["rnn_state_in"] = rnn_state_in
+        new_states = dict(states)
+        for i in range(end):
+            pre = self.conf.preprocessor(i)
+            if pre is not None:
+                x = pre(x, ctx)
+            impl = self.impls[i]
+            x, ns = impl.forward(params[str(i)], states[str(i)], x, train=train,
+                                 rng=keys[i], mask=fmask, ctx=ctx)
+            new_states[str(i)] = ns
+        return x, new_states, ctx
+
+    def _adapt_input(self, f):
+        """User-facing convolutional input is NCHW (reference convention);
+        internally NHWC. Transpose once at the boundary."""
+        it = self.conf.input_type
+        if isinstance(it, InputTypeConvolutional) and f.ndim == 4:
+            # accept NCHW when channel dim matches conf
+            if f.shape[1] == it.channels and f.shape[2] == it.height:
+                return jnp.transpose(f, (0, 2, 3, 1))
+        return f
+
+    def _loss_fn(self, params, states, f, l, fm, lm, train, rng, rnn_state_in=None):
+        n = len(self.impls)
+        x, new_states, ctx = self._apply_layers(params, states, f, fm, train,
+                                                rng, upto=n - 1,
+                                                rnn_state_in=rnn_state_in)
+        out_impl = self.impls[-1]
+        pre = self.conf.preprocessor(n - 1)
+        if pre is not None:
+            x = pre(x, ctx)
+        mask = lm if lm is not None else (fm if x.ndim == 3 else None)
+        if not hasattr(out_impl, "loss_on"):
+            raise ValueError(f"Last layer {type(out_impl).__name__} is not an "
+                             f"output layer")
+        loss = out_impl.loss_on(params[str(n - 1)], states[str(n - 1)], x, l,
+                                mask=mask, train=train, rng=rng)
+        if hasattr(out_impl, "update_state"):
+            # e.g. CenterLossOutputLayer EMA centers — updated outside AD
+            xs = jax.lax.stop_gradient(x)
+            new_states[str(n - 1)] = out_impl.update_state(states[str(n - 1)],
+                                                           xs, l)
+        reg = 0.0
+        for i, impl in enumerate(self.impls):
+            reg = reg + impl.regularization(params[str(i)])
+        return loss + reg, (new_states, ctx.get("rnn_state_out"))
+
+    # ---------------------------------------------------------- train step
+    def _build_step(self, with_rnn_state):
+        gn_mode = self.gc.gradient_normalization
+        gn_thresh = self.gc.gradient_normalization_threshold
+        minimize = self.gc.minimize
+
+        def step(params, states, upd_state, iteration, rng, f, l, fm, lm,
+                 rnn_state_in=None):
+            f = self._adapt_input(f)
+
+            def loss_fn(p):
+                return self._loss_fn(p, states, f, l, fm, lm, True, rng,
+                                     rnn_state_in)
+
+            (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if not minimize:
+                grads = _tm(lambda g: -g, grads)
+            grads = normalize_gradients(grads, gn_mode, gn_thresh)
+            updates, new_upd = self.updater.apply(upd_state, grads, iteration)
+            new_params = jax.tree_util.tree_map(lambda p, u: p - u.astype(p.dtype),
+                                                params, updates)
+            if with_rnn_state:
+                rnn_out = _tm(jax.lax.stop_gradient, rnn_out) if rnn_out else rnn_out
+                return new_params, new_states, new_upd, loss, rnn_out
+            return new_params, new_states, new_upd, loss
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _ensure_step(self):
+        if self._jit_step is None:
+            self._jit_step = self._build_step(with_rnn_state=False)
+        return self._jit_step
+
+    def _ensure_tbptt_step(self):
+        if self._jit_tbptt_step is None:
+            self._jit_tbptt_step = self._build_step(with_rnn_state=True)
+        return self._jit_tbptt_step
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs=1):
+        """Train (reference ``fit(DataSetIterator)`` :1156). Accepts a DataSet,
+        a DataSetIterator, or (features, labels) arrays."""
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        if self.conf.pretrain and not getattr(self, "_pretrained", False):
+            self.pretrain(data)
+            self._pretrained = True
+        it = data
+        if isinstance(it, DataSetIterator) and not isinstance(it, AsyncDataSetIterator):
+            if it.async_supported():
+                it = AsyncDataSetIterator(it, queue_size=2)
+        for epoch in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            t_etl = time.perf_counter()
+            for ds in it:
+                self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                self._fit_batch(ds)
+                t_etl = time.perf_counter()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+        return self
+
+    def _fit_batch(self, ds: DataSet):
+        f = jnp.asarray(ds.features)
+        l = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        self.last_batch_size = int(f.shape[0])
+        if (self.conf.backprop_type == BackpropType.TruncatedBPTT and f.ndim == 3
+                and f.shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(f, l, fm, lm)
+            return
+        step = self._ensure_step()
+        it = jnp.asarray(self.iteration_count, jnp.int32)
+        self.params, self.states, self.updater_state, loss = step(
+            self.params, self.states, self.updater_state, it, self._next_rng(),
+            f, l, fm, lm)
+        self.score_ = loss
+        self.iteration_count += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+
+    def _fit_tbptt(self, f, l, fm, lm):
+        """Truncated BPTT (reference ``doTruncatedBPTT``): split time into
+        chunks of tbptt_fwd_length, carry RNN state (detached) across chunks.
+        Like the reference's practical behavior, the backward truncation equals
+        the forward chunk length; a differing ``tbptt_back_length`` is treated
+        as ``tbptt_fwd_length`` (warned once)."""
+        if (self.conf.tbptt_back_length != self.conf.tbptt_fwd_length
+                and not getattr(self, "_warned_tbptt", False)):
+            log.warning("tbptt_back_length=%d differs from tbptt_fwd_length=%d; "
+                        "backprop truncation uses the forward chunk length",
+                        self.conf.tbptt_back_length, self.conf.tbptt_fwd_length)
+            self._warned_tbptt = True
+        T = f.shape[1]
+        L = self.conf.tbptt_fwd_length
+        step = self._ensure_tbptt_step()
+        rnn_state = self._init_rnn_state(int(f.shape[0]))
+        for start in range(0, T, L):
+            sl = slice(start, min(start + L, T))
+            f_c = f[:, sl]
+            l_c = l[:, sl] if l.ndim == 3 else l
+            fm_c = None if fm is None else fm[:, sl]
+            lm_c = None if lm is None else lm[:, sl]
+            it = jnp.asarray(self.iteration_count, jnp.int32)
+            (self.params, self.states, self.updater_state, loss,
+             rnn_state) = step(self.params, self.states, self.updater_state, it,
+                               self._next_rng(), f_c, l_c, fm_c, lm_c, rnn_state)
+        self.score_ = loss
+        self.iteration_count += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+
+    def _init_rnn_state(self, batch):
+        state = {}
+        for i, impl in enumerate(self.impls):
+            if isinstance(impl, _BaseLSTMImpl):
+                H = impl.conf.n_out
+                state[i] = (jnp.zeros((batch, H), jnp.float32),
+                            jnp.zeros((batch, H), jnp.float32))
+        return state
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, iterator, epochs=1):
+        """Layerwise unsupervised pretraining (reference ``pretrain(iter)``
+        :1172): for each pretrain-capable layer (AutoEncoder, VAE), optimize its
+        ``pretrain_loss`` on that layer's input activations."""
+        for i, lc in enumerate(self.conf.layers):
+            if lc.is_pretrain_layer():
+                self.pretrain_layer(i, iterator, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, layer_idx, iterator, epochs=1):
+        """Reference ``pretrainLayer(int, DataSetIterator)``."""
+        impl = self.impls[layer_idx]
+        if not hasattr(impl, "pretrain_loss"):
+            raise ValueError(f"Layer {layer_idx} ({type(impl).__name__}) is not "
+                             f"a pretrainable layer")
+        key = str(layer_idx)
+        updater = self.updater.layer_updaters[key]
+
+        def step(layer_params, upd_state, feats, rng, it):
+            def loss_fn(p):
+                return impl.pretrain_loss(p, feats, rng)
+            loss, grads = jax.value_and_grad(loss_fn)(layer_params)
+            updates, new_upd = updater.apply(upd_state, grads, it)
+            new_params = _tm(lambda p, u: p - u.astype(p.dtype), layer_params,
+                             updates)
+            return new_params, new_upd, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        upd_state = updater.init_state(self.params[key])
+        it_count = 0
+        for _ in range(epochs):
+            for ds in iterator:
+                x = jnp.asarray(ds.features)
+                x = self._adapt_input(x)
+                if layer_idx > 0:
+                    x = self.feed_forward_to_layer(layer_idx - 1, x)
+                p, upd_state, loss = jstep(self.params[key], upd_state, x,
+                                           self._next_rng(),
+                                           jnp.asarray(it_count, jnp.int32))
+                self.params[key] = p
+                it_count += 1
+        self.score_ = loss
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, x, train=False, mask=None):
+        """Forward to activations of the last layer (reference ``output``).
+        ``mask`` is the features mask for sequence inputs — affects mask-aware
+        layers (bidirectional RNNs, global pooling) exactly as in training."""
+        x = jnp.asarray(x)
+        mask = None if mask is None else jnp.asarray(mask)
+        key = (bool(train), mask is not None)
+        if key not in self._jit_output:
+            def fwd(params, states, f, fm):
+                f = self._adapt_input(f)
+                y, _, _ = self._apply_layers(params, states, f, fm, train, None)
+                return y
+            # jax.jit itself specializes per shape/dtype; one callable per
+            # (train, has_mask) keeps the python-side cache bounded
+            self._jit_output[key] = jax.jit(fwd)
+        return self._jit_output[key](self.params, self.states, x, mask)
+
+    def feed_forward(self, x, train=False):
+        """All layer activations, eager (reference ``feedForward`` list)."""
+        x = jnp.asarray(x)
+        x = self._adapt_input(x)
+        acts = [x]
+        ctx = {}
+        for i, impl in enumerate(self.impls):
+            pre = self.conf.preprocessor(i)
+            if pre is not None:
+                x = pre(x, ctx)
+            x, _ = impl.forward(self.params[str(i)], self.states[str(i)], x,
+                                train=train, rng=None, mask=None, ctx=ctx)
+            acts.append(x)
+        return acts
+
+    feedForward = feed_forward
+
+    def feed_forward_to_layer(self, layer_idx, x, train=False):
+        """Reference ``feedForwardToLayer`` :903 (activation materialization
+        point — partial-graph execution)."""
+        x = jnp.asarray(x)
+        x = self._adapt_input(x)
+        ctx = {}
+        for i in range(layer_idx + 1):
+            pre = self.conf.preprocessor(i)
+            if pre is not None:
+                x = pre(x, ctx)
+            x, _ = self.impls[i].forward(self.params[str(i)], self.states[str(i)],
+                                         x, train=train, rng=None, mask=None,
+                                         ctx=ctx)
+        return x
+
+    feedForwardToLayer = feed_forward_to_layer
+
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference ``rnnTimeStep``)."""
+        x = jnp.asarray(x)
+        single_step = x.ndim == 2
+        if single_step:
+            x = x[:, None, :]
+        if self._rnn_state is None:
+            self._rnn_state = self._init_rnn_state(int(x.shape[0]))
+
+        def fwd(params, states, f, rnn_state):
+            y, _, ctx = self._apply_layers(params, states, f, None, False,
+                                           None, rnn_state_in=rnn_state)
+            return y, ctx.get("rnn_state_out")
+
+        y, self._rnn_state = jax.jit(fwd)(self.params, self.states, x,
+                                          self._rnn_state)
+        return y[:, -1, :] if single_step else y
+
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    rnnClearPreviousState = rnn_clear_previous_state
+
+    # ----------------------------------------------------------------- score
+    def score(self, ds: Optional[DataSet] = None, training=False):
+        """Loss (+reg) on a dataset (reference ``score(DataSet)``), or last
+        training score when called without arguments."""
+        if ds is None:
+            return float(self.score_)
+        f = jnp.asarray(ds.features)
+        l = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        f = self._adapt_input(f)
+        loss, _ = self._loss_fn(self.params, self.states, f, l, fm, lm,
+                                training, None)
+        return float(loss)
+
+    def compute_gradient_and_score(self, ds: DataSet):
+        """Reference ``computeGradientAndScore`` :2206 — returns (grads, score)
+        without updating params (used by gradient checks and external
+        optimizers)."""
+        f = self._adapt_input(jnp.asarray(ds.features))
+        l = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+
+        def loss_fn(p):
+            loss, _ = self._loss_fn(p, self.states, f, l, fm, lm, True, None)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(self.params)
+        self.score_ = loss
+        return grads, float(loss)
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, iterator):
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features, mask=ds.features_mask)
+            ev.eval(ds.labels, np.asarray(out),
+                    mask=ds.labels_mask if ds.labels_mask is not None
+                    else ds.features_mask)
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from ..eval.regression import RegressionEvaluation
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out))
+        return ev
+
+    # ------------------------------------------------------------ parameters
+    def param_table(self):
+        """{"0_W": array, ...} (reference ``paramTable()`` naming)."""
+        out = {}
+        for i in sorted(self.params, key=int):
+            for k, v in self.params[i].items():
+                out[f"{i}_{k}"] = v
+        return out
+
+    paramTable = param_table
+
+    def get_param(self, key):
+        i, k = key.split("_", 1)
+        return self.params[i][k]
+
+    def num_params(self) -> int:
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(self.params))
+
+    numParams = num_params
+
+    def params_flat(self) -> np.ndarray:
+        """Single flattened param vector, layer-major (reference's flattened
+        params buffer ``MultiLayerNetwork.java:110``)."""
+        chunks = []
+        for i in sorted(self.params, key=int):
+            for k in self.params[i]:
+                chunks.append(np.asarray(self.params[i][k]).ravel())
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_params_flat(self, vec):
+        vec = np.asarray(vec)
+        pos = 0
+        new = {}
+        for i in sorted(self.params, key=int):
+            new[i] = {}
+            for k, v in self.params[i].items():
+                n = int(np.prod(v.shape)) if v.shape else 1
+                new[i][k] = jnp.asarray(vec[pos:pos + n].reshape(v.shape),
+                                        dtype=v.dtype)
+                pos += n
+        if pos != vec.size:
+            raise ValueError(f"Param vector length {vec.size} != model {pos}")
+        self.params = new
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    setListeners = set_listeners
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    # ------------------------------------------------------------------ misc
+    def clone(self):
+        net = MultiLayerNetwork(self.conf.clone())
+        net.init()
+        net.params = _tm(lambda x: x, self.params)
+        net.states = _tm(lambda x: x, self.states)
+        net.updater_state = _tm(lambda x: x, self.updater_state)
+        return net
+
+    @property
+    def n_layers(self):
+        return len(self.conf.layers)
+
+    def summary(self) -> str:
+        lines = [f"{'idx':>3}  {'type':<28} {'params':>10}"]
+        for i, impl in enumerate(self.impls):
+            n = impl.num_params(self.params[str(i)])
+            lines.append(f"{i:>3}  {type(self.conf.layers[i]).__name__:<28} {n:>10}")
+        lines.append(f"Total params: {self.num_params()}")
+        return "\n".join(lines)

@@ -1,0 +1,363 @@
+"""Gradient updaters (optimizers) and learning-rate schedules.
+
+TPU-native equivalent of ND4J's ``IUpdater``/``GradientUpdater`` hierarchy that the
+reference's updater machinery delegates to (reference
+``deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/updater/UpdaterBlock.java:104``,
+``BaseMultiLayerUpdater.java``; SURVEY.md §2.1 "Updaters").
+
+Key design shift: the reference keeps ONE flat updater-state buffer with per-block
+views updated in place over JNI. Here updater state is a pytree mirroring the param
+pytree, and ``apply`` is a pure function ``(state, grads, iteration) ->
+(updates, new_state)`` executed inside the jitted training step with buffer
+donation — XLA gives us the in-place semantics the reference hand-engineered,
+plus the whole update fuses into the step executable.
+
+An updater returns the *update* to be subtracted from params (matching the
+reference's ``GradientUpdater.applyUpdater`` then ``stepFunction.step(params,
+update)`` split, ``StochasticGradientDescent.java:79``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "IUpdater", "Sgd", "Adam", "AdaMax", "Nadam", "Nesterovs", "RmsProp",
+    "AdaGrad", "AdaDelta", "NoOp", "AMSGrad",
+    "ISchedule", "FixedSchedule", "ExponentialSchedule", "InverseSchedule",
+    "PolySchedule", "SigmoidSchedule", "StepSchedule", "MapSchedule",
+    "WarmupCosineSchedule", "updater_from_dict", "schedule_from_dict",
+]
+
+_tm = jax.tree_util.tree_map
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules (reference: org.nd4j.linalg.schedule.ISchedule; the
+# 0.9.x LearningRatePolicy enum maps onto these)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ISchedule:
+    def value(self, iteration, epoch=0):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@sched"] = type(self).__name__
+        return d
+
+
+@dataclasses.dataclass
+class FixedSchedule(ISchedule):
+    value_: float = 1e-3
+
+    def value(self, iteration, epoch=0):
+        return self.value_
+
+
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value * jnp.power(self.gamma, iteration)
+
+
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value / jnp.power(1.0 + self.gamma * iteration, self.power)
+
+
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def value(self, iteration, epoch=0):
+        frac = jnp.minimum(iteration / float(self.max_iter), 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+    step_size: int = 100
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (iteration - self.step_size)))
+
+
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    initial_value: float = 1e-3
+    decay_rate: float = 0.1
+    step_size: int = 1000
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value * jnp.power(self.decay_rate,
+                                              jnp.floor(iteration / float(self.step_size)))
+
+
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant schedule keyed by iteration (jit-compatible)."""
+    values: Any = None  # dict {iteration: lr}
+
+    def value(self, iteration, epoch=0):
+        keys = sorted(int(k) for k in self.values)
+        lr = jnp.asarray(float(self.values[keys[0]]))
+        for k in keys[1:]:
+            lr = jnp.where(iteration >= k, float(self.values[k]), lr)
+        return lr
+
+
+@dataclasses.dataclass
+class WarmupCosineSchedule(ISchedule):
+    """Linear warmup then cosine decay — net-new (no reference equivalent),
+    standard for large-batch TPU training."""
+    peak_value: float = 1e-3
+    warmup_steps: int = 1000
+    total_steps: int = 100000
+    end_value: float = 0.0
+
+    def value(self, iteration, epoch=0):
+        warm = self.peak_value * (iteration / jnp.maximum(self.warmup_steps, 1))
+        frac = jnp.clip((iteration - self.warmup_steps)
+                        / jnp.maximum(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.end_value + 0.5 * (self.peak_value - self.end_value) * (1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(iteration < self.warmup_steps, warm, cos)
+
+
+def schedule_from_dict(d):
+    d = dict(d)
+    kind = d.pop("@sched")
+    cls = {c.__name__: c for c in (FixedSchedule, ExponentialSchedule, InverseSchedule,
+                                   PolySchedule, SigmoidSchedule, StepSchedule,
+                                   MapSchedule, WarmupCosineSchedule)}[kind]
+    return cls(**d)
+
+
+def _lr_at(updater, iteration):
+    if updater.lr_schedule is not None:
+        return updater.lr_schedule.value(iteration)
+    return updater.learning_rate
+
+
+# ---------------------------------------------------------------------------
+# Updaters
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class IUpdater:
+    """Base updater. Subclasses implement ``init_one``/``apply_one`` on a single
+    array; pytree mapping is handled here."""
+    learning_rate: float = 1e-3
+    lr_schedule: Optional[ISchedule] = None
+
+    # -- single-leaf ops ---------------------------------------------------
+    def init_one(self, p):
+        return ()
+
+    def apply_one(self, state, g, lr, t):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- pytree ops --------------------------------------------------------
+    def init_state(self, params):
+        return _tm(self.init_one, params)
+
+    def apply(self, state, grads, iteration):
+        lr = _lr_at(self, iteration)
+        t = iteration + 1  # bias-correction step count (1-based)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_s = treedef.flatten_up_to(state)
+        out = [self.apply_one(s, g, lr, t) for s, g in zip(flat_s, flat_g)]
+        updates = treedef.unflatten([u for u, _ in out])
+        new_state = treedef.unflatten([s for _, s in out])
+        return updates, new_state
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self):
+        d = {k: v for k, v in dataclasses.asdict(self).items() if k != "lr_schedule"}
+        d["@updater"] = type(self).__name__
+        if self.lr_schedule is not None:
+            d["lr_schedule"] = self.lr_schedule.to_dict()
+        return d
+
+
+@dataclasses.dataclass
+class NoOp(IUpdater):
+    def apply_one(self, state, g, lr, t):
+        return jnp.zeros_like(g), state
+
+
+@dataclasses.dataclass
+class Sgd(IUpdater):
+    def apply_one(self, state, g, lr, t):
+        return lr * g, state
+
+
+@dataclasses.dataclass
+class Nesterovs(IUpdater):
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init_one(self, p):
+        return jnp.zeros_like(p)
+
+    def apply_one(self, v, g, lr, t):
+        # Matches ND4J NesterovsUpdater: vNew = mu*v - lr*g;
+        # update = -(mu*vNew - (1+mu)... ) — ND4J uses
+        # update = mu*vPrev + (1+mu)*(-vNew)? Implemented as the standard
+        # "lookahead" form: update = -(mu * vNew - lr * g) ... simplified:
+        v_new = self.momentum * v - lr * g
+        update = -(self.momentum * v_new - lr * g)  # = lr*g*(1+mu) - mu^2*v ... lookahead step
+        return update, v_new
+
+
+@dataclasses.dataclass
+class Adam(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_one(self, state, g, lr, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * (g * g)
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        return lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@dataclasses.dataclass
+class AMSGrad(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_one(self, state, g, lr, t):
+        m, v, vmax = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * (g * g)
+        vmax = jnp.maximum(vmax, v)
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        return lr * mhat / (jnp.sqrt(vmax) + self.epsilon), (m, v, vmax)
+
+
+@dataclasses.dataclass
+class AdaMax(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_one(self, state, g, lr, t):
+        m, u = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        return lr * mhat / (u + self.epsilon), (m, u)
+
+
+@dataclasses.dataclass
+class Nadam(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_one(self, state, g, lr, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * (g * g)
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        nad = self.beta1 * mhat + (1 - self.beta1) * g / (1 - jnp.power(self.beta1, t))
+        return lr * nad / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@dataclasses.dataclass
+class RmsProp(IUpdater):
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return jnp.zeros_like(p)
+
+    def apply_one(self, cache, g, lr, t):
+        cache = self.rms_decay * cache + (1 - self.rms_decay) * (g * g)
+        return lr * g / (jnp.sqrt(cache) + self.epsilon), cache
+
+
+@dataclasses.dataclass
+class AdaGrad(IUpdater):
+    learning_rate: float = 1e-1
+    epsilon: float = 1e-6
+
+    def init_one(self, p):
+        return jnp.zeros_like(p)
+
+    def apply_one(self, hist, g, lr, t):
+        hist = hist + g * g
+        return lr * g / (jnp.sqrt(hist) + self.epsilon), hist
+
+
+@dataclasses.dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_one(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_one(self, state, g, lr, t):
+        msg, msdx = state
+        msg = self.rho * msg + (1 - self.rho) * (g * g)
+        dx = jnp.sqrt(msdx + self.epsilon) / jnp.sqrt(msg + self.epsilon) * g
+        msdx = self.rho * msdx + (1 - self.rho) * (dx * dx)
+        return dx, (msg, msdx)
+
+
+_UPDATERS = {c.__name__: c for c in (Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp,
+                                     AdaGrad, AdaDelta, NoOp, AMSGrad)}
+
+
+def updater_from_dict(d):
+    d = dict(d)
+    kind = d.pop("@updater")
+    sched = d.pop("lr_schedule", None)
+    u = _UPDATERS[kind](**d)
+    if sched is not None:
+        u.lr_schedule = schedule_from_dict(sched)
+    return u

@@ -1,0 +1,171 @@
+"""Weight initialization schemes.
+
+TPU-native equivalent of the reference's ``WeightInit`` enum and ``WeightInitUtil``
+(reference ``deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/weights/WeightInit.java``,
+``WeightInitUtil.java``). Uses ``jax.random`` PRNG keys (counter-based, reproducible
+across device meshes) instead of ND4J's global RNG.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["WeightInit", "Distribution", "NormalDistribution", "UniformDistribution",
+           "init_weight"]
+
+
+class WeightInit:
+    DISTRIBUTION = "distribution"
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    NORMAL = "normal"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    IDENTITY = "identity"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "var_scaling_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "var_scaling_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "var_scaling_uniform_fan_avg"
+
+
+@dataclasses.dataclass
+class Distribution:
+    """Base for WeightInit.DISTRIBUTION (reference ``nn/conf/distribution/``)."""
+
+    def sample(self, rng, shape, dtype):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@dist"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        kind = d.pop("@dist")
+        cls = {c.__name__: c for c in (NormalDistribution, UniformDistribution,
+                                       GaussianDistribution, ConstantDistribution,
+                                       BinomialDistribution)}[kind]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class NormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+# Reference has both GaussianDistribution and NormalDistribution (synonyms).
+@dataclasses.dataclass
+class GaussianDistribution(NormalDistribution):
+    pass
+
+
+@dataclasses.dataclass
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+
+
+@dataclasses.dataclass
+class ConstantDistribution(Distribution):
+    value: float = 0.0
+
+    def sample(self, rng, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass
+class BinomialDistribution(Distribution):
+    trials: int = 1
+    p: float = 0.5
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.binomial(rng, self.trials, self.p, shape).astype(dtype)
+
+
+def init_weight(rng, shape, fan_in, fan_out, scheme=WeightInit.XAVIER,
+                dist: Optional[Distribution] = None, dtype=jnp.float32):
+    """Initialize one weight tensor.
+
+    Formulas match reference ``WeightInitUtil.initWeights`` (e.g. XAVIER =
+    N(0, 2/(fanIn+fanOut)), RELU = N(0, 2/fanIn), SIGMOID_UNIFORM =
+    U(±4·sqrt(6/(fanIn+fanOut)))).
+    """
+    scheme = str(scheme).lower()
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    if scheme == WeightInit.DISTRIBUTION:
+        if dist is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
+        return dist.sample(rng, shape, dtype)
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if scheme == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == WeightInit.NORMAL:
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if scheme == WeightInit.LECUN_NORMAL:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme == WeightInit.UNIFORM:
+        a = math.sqrt(1.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == WeightInit.LECUN_UNIFORM:
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == WeightInit.XAVIER:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == WeightInit.XAVIER_UNIFORM:
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == WeightInit.XAVIER_FAN_IN:
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if scheme == WeightInit.XAVIER_LEGACY:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / (fan_in + fan_out))
+    if scheme == WeightInit.RELU:
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if scheme == WeightInit.RELU_UNIFORM:
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme.startswith("var_scaling"):
+        if scheme.endswith("fan_in"):
+            denom = fan_in
+        elif scheme.endswith("fan_out"):
+            denom = fan_out
+        else:
+            denom = 0.5 * (fan_in + fan_out)
+        if "normal" in scheme:
+            return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / denom)
+        a = math.sqrt(3.0 / denom)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

@@ -1,0 +1,143 @@
+"""Training listeners.
+
+TPU-native equivalents of reference ``optimize/api/IterationListener.java`` /
+``TrainingListener`` and the stock implementations in ``optimize/listeners/``
+(SURVEY.md §2.1 "Listeners"): ScoreIterationListener, PerformanceListener
+(samples/sec + batches/sec, ``PerformanceListener.java:19-23``),
+CollectScoresIterationListener, TimeIterationListener, EvaluativeListener,
+SleepyTrainingListener.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Listener bus contract. ``iteration_done`` fires once per minibatch with the
+    scalar score; epoch/forward/backward hooks mirror the reference's
+    TrainingListener."""
+
+    def iteration_done(self, model, iteration, score):
+        pass
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+
+IterationListener = TrainingListener  # reference naming alias
+
+
+class ScoreIterationListener(TrainingListener):
+    """Reference ``ScoreIterationListener``: log score every N iterations."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, float(score))
+
+
+class PerformanceListener(TrainingListener):
+    """Reference ``PerformanceListener.java:19-23``: per-N-iteration throughput
+    (samples/sec, batches/sec). ``last_samples_per_sec`` is the benchmark hook."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time = None
+        self._samples = 0
+        self._batches = 0
+        self.last_samples_per_sec = 0.0
+        self.last_batches_per_sec = 0.0
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        batch = getattr(model, "last_batch_size", 0)
+        self._samples += batch
+        self._batches += 1
+        if self._last_time is None:
+            self._last_time = now
+            self._samples = 0
+            self._batches = 0
+            return
+        if self._batches >= self.frequency:
+            dt = now - self._last_time
+            if dt > 0:
+                self.last_samples_per_sec = self._samples / dt
+                self.last_batches_per_sec = self._batches / dt
+                msg = (f"iteration {iteration}: {self.last_samples_per_sec:.1f} samples/sec, "
+                       f"{self.last_batches_per_sec:.2f} batches/sec")
+                if self.report_score:
+                    msg += f", score {float(score):.5f}"
+                log.info("%s", msg)
+            self._last_time = now
+            self._samples = 0
+            self._batches = 0
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Reference ``CollectScoresIterationListener``: record (iteration, score)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class TimeIterationListener(TrainingListener):
+    """Reference ``TimeIterationListener``: ETA logging."""
+
+    def __init__(self, iteration_count: int, frequency: int = 10):
+        self.start = time.time()
+        self.total = iteration_count
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.time() - self.start
+            per_it = elapsed / max(iteration, 1)
+            remaining = per_it * max(self.total - iteration, 0)
+            log.info("iteration %d/%d, ETA %.1fs", iteration, self.total, remaining)
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Reference ``SleepyTrainingListener``: throttle iterations (debug tool)."""
+
+    def __init__(self, sleep_ms: int = 0):
+        self.sleep_ms = sleep_ms
+
+    def iteration_done(self, model, iteration, score):
+        if self.sleep_ms:
+            time.sleep(self.sleep_ms / 1000.0)
+
+
+class EvaluativeListener(TrainingListener):
+    """Reference ``EvaluativeListener``: run evaluation every N iterations."""
+
+    def __init__(self, iterator, frequency: int = 100, evaluation_factory=None):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.evaluation_factory = evaluation_factory
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, score):
+        if iteration and iteration % self.frequency == 0:
+            self.last_evaluation = model.evaluate(self.iterator)
+            log.info("Evaluation at iteration %d:\n%s", iteration,
+                     self.last_evaluation.stats())

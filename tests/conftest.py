@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device CPU JAX backend.
+
+The driver tests sharding on a virtual CPU mesh (no multi-chip TPU hardware in
+CI); the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter start, so we
+override via jax.config before any backend is initialized.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
