@@ -1,0 +1,66 @@
+"""Benchmark harness: trains the flagship config on-device and prints ONE JSON
+line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+Measured config (BASELINE.json ``configs[0]``): LeNet MNIST MultiLayerNetwork,
+synthetic MNIST-shaped input (the reference's synthetic-benchmark pattern,
+``BenchmarkDataSetIterator.java``). Throughput accounting matches the
+reference's ``PerformanceListener`` (samples/sec).
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+ratio against the recorded target in BASELINE.json ``published`` when present,
+else 1.0.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from __graft_entry__ import _lenet
+
+    batch = 256
+    warmup, iters = 5, 30
+
+    net = _lenet()
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(rng.normal(size=(batch, 1, 28, 28)), jnp.float32)
+    l = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+
+    step = net._ensure_step()
+    params, states, upd = net.params, net.states, net.updater_state
+    key = jax.random.PRNGKey(0)
+    for i in range(warmup):
+        it = jnp.asarray(i, jnp.int32)
+        params, states, upd, loss = step(params, states, upd, it, key, f, l,
+                                         None, None)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + iters):
+        it = jnp.asarray(i, jnp.int32)
+        params, states, upd, loss = step(params, states, upd, it, key, f, l,
+                                         None, None)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    try:
+        with open("BASELINE.json") as fh:
+            published = json.load(fh).get("published", {})
+        base = published.get("lenet_mnist_images_per_sec")
+    except Exception:
+        base = None
+    vs = images_per_sec / base if base else 1.0
+    print(json.dumps({"metric": "lenet_mnist_images_per_sec",
+                      "value": round(images_per_sec, 1),
+                      "unit": "images/sec",
+                      "vs_baseline": round(vs, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,9 +14,11 @@ import jax.numpy as jnp
 from .base import LayerImpl, implements
 
 
-def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False):
+def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
+        key_mask=None):
     """q,k,v: [b, T, h, d]. Returns [b, T, h, d]. Scaled dot-product attention
-    with f32 softmax accumulation (bf16-safe)."""
+    with f32 softmax accumulation (bf16-safe). ``key_mask``: [b, S] with 1 for
+    real keys, 0 for padding — padded keys are excluded from the softmax."""
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(compute_dtype),
                         k.astype(compute_dtype),
@@ -26,6 +28,8 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False)
         T, S = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((T, S), bool))
         logits = jnp.where(mask, logits, -1e30)
+    if key_mask is not None:
+        logits = jnp.where(key_mask[:, None, None, :] > 0, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     if train and dropout_rate > 0.0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
@@ -64,12 +68,8 @@ class SelfAttentionImpl(LayerImpl):
         q = (x @ params["Wq"].astype(x.dtype)).reshape(b, T, h, d)
         k = (x @ params["Wk"].astype(x.dtype)).reshape(b, T, h, d)
         v = (x @ params["Wv"].astype(x.dtype)).reshape(b, T, h, d)
-        if mask is not None:
-            # zero out padded keys/values
-            m = mask.astype(q.dtype)[:, :, None, None]
-            k = k * m
-            v = v * m
-        o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train)
+        o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train,
+                key_mask=mask)
         o = o.reshape(b, T, h * d)
         y = o @ params["Wo"].astype(o.dtype) + params["b"].astype(o.dtype)
         return self.activation(y).astype(self.dtype), state

@@ -115,11 +115,19 @@ class Deconv2DImpl(Conv2DImpl):
         x = self.maybe_dropout(x, train, rng)
         s = _pair(c.stride)
         p = _pair(c.padding)
-        pad = ("SAME" if c.convolution_mode == ConvolutionMode.Same
-               else [(pi, pi) for pi in p])
+        d = _pair(c.dilation)
+        if c.convolution_mode == ConvolutionMode.Same:
+            pad = "SAME"
+        else:
+            # conv_transpose explicit pads are raw pads on the lhs-dilated
+            # input; deconv padding p means out = s(i-1) + (k-1)d + 1 - 2p,
+            # which needs per-side raw pad (k-1)d - p.
+            k = _pair(c.kernel_size)
+            pad = [((k[i] - 1) * d[i] - p[i], (k[i] - 1) * d[i] - p[i])
+                   for i in range(2)]
         z = lax.conv_transpose(
             x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
-            strides=s, padding=pad, dimension_numbers=_DN2D,
+            strides=s, padding=pad, rhs_dilation=d, dimension_numbers=_DN2D,
             preferred_element_type=jnp.float32)
         if "b" in params:
             z = z + params["b"].astype(z.dtype)

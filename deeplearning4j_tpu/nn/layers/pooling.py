@@ -65,11 +65,9 @@ class Subsampling1DImpl(NoParamLayerImpl):
         elif p == 0:
             pad = "VALID"
         else:
-            pad = ((0, 0), (p, p), (0, 0))
+            pad = ((0, 0), (p, p), (0, 0), (0, 0))
         x4 = x[:, :, None, :]  # [b, T, 1, c]
-        y = _pool2d(x4, c.pooling_type, (k, 1), (s, 1),
-                    pad if isinstance(pad, str) else ((0, 0), (p, p), (0, 0), (0, 0)),
-                    c.pnorm, c.eps)
+        y = _pool2d(x4, c.pooling_type, (k, 1), (s, 1), pad, c.pnorm, c.eps)
         return y[:, :, 0, :], state
 
 

@@ -31,6 +31,12 @@ from ..activations import get_activation
 class _BaseLSTMImpl(LayerImpl):
     peepholes = False
 
+    def init_stream_state(self, batch):
+        """Zero (h, c) carry for rnnTimeStep / TBPTT streaming."""
+        H = self.conf.n_out
+        return (jnp.zeros((batch, H), jnp.float32),
+                jnp.zeros((batch, H), jnp.float32))
+
     def init(self, rng):
         c = self.conf
         H = c.n_out
@@ -163,6 +169,9 @@ class SimpleRnnImpl(LayerImpl):
         }
         return params, {}
 
+    def init_stream_state(self, batch):
+        return jnp.zeros((batch, self.conf.n_out), jnp.float32)
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
         b, T, _ = x.shape
@@ -181,13 +190,20 @@ class SimpleRnnImpl(LayerImpl):
                 h_new = mm * h_new + (1 - mm) * h
             return h_new, h_new
 
+        idx = getattr(self, "index", None)
+        h0 = None
+        if ctx is not None and idx is not None:
+            h0 = ctx.get("rnn_state_in", {}).get(idx)
+        if h0 is None:
+            h0 = jnp.zeros((b, H), jnp.float32)
         xs = jnp.swapaxes(xp, 0, 1)
-        h0 = jnp.zeros((b, H), jnp.float32)
         if mask is not None:
             ms = jnp.swapaxes(mask, 0, 1)
-            _, ys = lax.scan(step, h0, (xs, ms))
+            hT, ys = lax.scan(step, h0, (xs, ms))
         else:
-            _, ys = lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
+            hT, ys = lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
+        if ctx is not None and idx is not None:
+            ctx.setdefault("rnn_state_out", {})[idx] = hT
         return jnp.swapaxes(ys, 0, 1).astype(self.dtype), state
 
 
@@ -211,12 +227,15 @@ class BidirectionalImpl(_WrapperImpl):
         return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        kf = kb = None
+        if rng is not None:
+            kf, kb = jax.random.split(rng)
         yf, sf = self.inner.forward(params["fwd"], state["fwd"], x, train=train,
-                                    rng=rng, mask=mask, ctx=None)
+                                    rng=kf, mask=mask, ctx=None)
         xr = jnp.flip(x, axis=1)
         mr = None if mask is None else jnp.flip(mask, axis=1)
         yb, sb = self.inner.forward(params["bwd"], state["bwd"], xr, train=train,
-                                    rng=rng, mask=mr, ctx=None)
+                                    rng=kb, mask=mr, ctx=None)
         yb = jnp.flip(yb, axis=1)
         mode = self.conf.mode
         if mode == "concat":

@@ -175,7 +175,11 @@ class MultiLayerNetwork:
         return loss + reg, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _build_step(self, with_rnn_state):
+    def _raw_step(self, with_rnn_state):
+        """The pure (unjitted) train-step function. ``_build_step`` jits it for
+        single-device training; ``deeplearning4j_tpu.parallel`` re-jits it with
+        explicit ``NamedSharding``s over a device mesh (SPMD data parallelism —
+        the reference's ParallelWrapper role, SURVEY.md §2.4/§7 Phase 3)."""
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
@@ -201,7 +205,10 @@ class MultiLayerNetwork:
                 return new_params, new_states, new_upd, loss, rnn_out
             return new_params, new_states, new_upd, loss
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        return step
+
+    def _build_step(self, with_rnn_state):
+        return jax.jit(self._raw_step(with_rnn_state), donate_argnums=(0, 2))
 
     def _ensure_step(self):
         if self._jit_step is None:
@@ -291,18 +298,19 @@ class MultiLayerNetwork:
             (self.params, self.states, self.updater_state, loss,
              rnn_state) = step(self.params, self.states, self.updater_state, it,
                                self._next_rng(), f_c, l_c, fm_c, lm_c, rnn_state)
+            # one iteration per TBPTT segment (reference increments
+            # iterationCount per segment, so Adam bias correction and lr
+            # schedules see every applied update)
+            self.iteration_count += 1
         self.score_ = loss
-        self.iteration_count += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
 
     def _init_rnn_state(self, batch):
         state = {}
         for i, impl in enumerate(self.impls):
-            if isinstance(impl, _BaseLSTMImpl):
-                H = impl.conf.n_out
-                state[i] = (jnp.zeros((batch, H), jnp.float32),
-                            jnp.zeros((batch, H), jnp.float32))
+            if hasattr(impl, "init_stream_state"):
+                state[i] = impl.init_stream_state(batch)
         return state
 
     # -------------------------------------------------------------- pretrain

@@ -116,10 +116,12 @@ class MapSchedule(ISchedule):
     values: Any = None  # dict {iteration: lr}
 
     def value(self, iteration, epoch=0):
-        keys = sorted(int(k) for k in self.values)
-        lr = jnp.asarray(float(self.values[keys[0]]))
+        # JSON round-trips stringify int keys; normalize before lookup
+        values = {int(k): float(v) for k, v in self.values.items()}
+        keys = sorted(values)
+        lr = jnp.asarray(values[keys[0]])
         for k in keys[1:]:
-            lr = jnp.where(iteration >= k, float(self.values[k]), lr)
+            lr = jnp.where(iteration >= k, values[k], lr)
         return lr
 
 

@@ -1,0 +1,80 @@
+"""Device-mesh sharding helpers — the SPMD substrate for data/model parallelism.
+
+TPU-native replacement for the reference's device-affinity machinery
+(``Nd4j.getAffinityManager()`` uses in ``ParallelWrapper.java:484`` and
+``MultiLayerNetwork.java:1161``): instead of pinning model replicas to devices
+from host threads, we declare a `jax.sharding.Mesh` and annotate the jitted
+train step's inputs with `NamedSharding`s; XLA's SPMD partitioner inserts the
+ICI collectives (psum for gradient all-reduce) that replace both parameter
+averaging and Aeron gradient broadcast (SURVEY.md §2.4 "Distributed
+communication backend").
+
+Mesh axis conventions used throughout the framework:
+  - ``data``     — batch (data parallelism; ParallelWrapper equivalent)
+  - ``model``    — tensor parallelism (net-new vs the reference, §2.4 note)
+  - ``sequence`` — sequence/context parallelism (ring attention, net-new)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axes: Sequence[str] = (DATA_AXIS,),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all) with named ``axes``.
+
+    ``shape`` gives the per-axis extents; by default all devices go on the
+    first axis and the rest get extent 1.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = [n] + [1] * (len(axes) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dim across ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
+    """Device_put a host batch with its leading dim split across ``axis``."""
+    return jax.device_put(x, batch_sharded(mesh, axis))
+
+
+def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):
+    """Jit a network's train step for synchronous data parallelism.
+
+    Equivalent role to the reference's ``ParallelWrapper`` AVERAGING mode with
+    ``averagingFrequency=1`` (``ParallelWrapper.java:551-562``) — except the
+    "averaging" is a single fused gradient ``psum`` over ICI emitted by the
+    SPMD partitioner, not a host-side barrier + parameter copy.
+
+    Returns a jitted ``step(params, states, upd_state, iteration, rng, f, l,
+    fm, lm)`` whose batch inputs must be sharded along ``axis`` (use
+    :func:`shard_batch`) and whose params/updater-state are replicated.
+    """
+    raw = net._raw_step(False)
+    repl = replicated(mesh)
+    data = batch_sharded(mesh, axis)
+    in_sh = (repl, repl, repl, repl, repl, data, data, data, data)
+    out_sh = (repl, repl, repl, repl)
+    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
+                   donate_argnums=(0, 2) if donate else ())
