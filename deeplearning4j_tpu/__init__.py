@@ -16,4 +16,6 @@ from .nn.weights import WeightInit
 from .nn.updaters import (Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp, AdaGrad,
                           AdaDelta, NoOp, AMSGrad)
 from .nn.multilayer import MultiLayerNetwork
+from .nn.graph import ComputationGraph
+from .nn.conf.graph import ComputationGraphConfiguration
 from .datasets.dataset import DataSet, MultiDataSet, DataSetIterator, ListDataSetIterator

@@ -1,0 +1,563 @@
+"""ComputationGraph configuration: DAG of layers and vertices.
+
+TPU-native equivalent of reference ``nn/conf/ComputationGraphConfiguration.java``
+(GraphBuilder) and the vertex config classes in ``nn/conf/graph/`` mirrored by
+runtime vertices in ``nn/graph/vertex/impl/`` (SURVEY.md §2.1 "Graph vertices":
+LayerVertex, MergeVertex, ElementWiseVertex, SubsetVertex, Stack/UnstackVertex,
+Scale/ShiftVertex, L2NormalizeVertex, L2Vertex, PreprocessorVertex,
+ReshapeVertex, PoolHelperVertex, rnn Last/DuplicateToTimeSeries vertices).
+
+Design shift: the reference splits each vertex into a config class and a
+runtime ``GraphVertex`` with hand-written ``doForward``/``doBackward``; here a
+vertex is ONE serializable dataclass whose ``forward(inputs, ctx)`` is a pure
+jnp function — the whole DAG is traced into a single jitted step and AD derives
+the backward pass, so there is no doBackward to maintain.
+
+Data layout conventions follow :mod:`.preprocessors` (NHWC conv, [b,T,s] rnn).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from .serde import register, to_json, from_json
+from .inputs import (InputTypeFeedForward, InputTypeRecurrent,
+                     InputTypeConvolutional, InputTypeConvolutionalFlat)
+from .layers import Layer
+
+__all__ = ["GraphVertexConf", "MergeVertex", "ElementWiseVertex", "SubsetVertex",
+           "StackVertex", "UnstackVertex", "ScaleVertex", "ShiftVertex",
+           "L2NormalizeVertex", "L2Vertex", "PreprocessorVertex",
+           "ReshapeVertex", "PoolHelperVertex", "LastTimeStepVertex",
+           "DuplicateToTimeSeriesVertex", "ComputationGraphConfiguration",
+           "GraphBuilder"]
+
+
+@dataclasses.dataclass
+class GraphVertexConf:
+    """Base non-layer vertex: pure function of its input activations."""
+
+    def n_inputs(self):  # expected input arity; None = any
+        return None
+
+    def forward(self, inputs: List, ctx: Dict) -> Any:
+        raise NotImplementedError
+
+    def propagate_mask(self, in_masks: List):
+        """Feature mask of this vertex's output given its inputs' masks
+        (reference ``GraphVertex.feedForwardMaskArrays``)."""
+        return in_masks[0] if in_masks else None
+
+    def get_output_type(self, input_types: List):
+        return input_types[0]
+
+
+@register
+@dataclasses.dataclass
+class MergeVertex(GraphVertexConf):
+    """Concatenate along the feature/channel axis (reference ``MergeVertex``).
+    FF/RNN: last axis; CNN (NHWC): channel axis = last axis too."""
+
+    def forward(self, inputs, ctx):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def propagate_mask(self, in_masks):
+        for m in in_masks:
+            if m is not None:
+                return m
+        return None
+
+    def get_output_type(self, input_types):
+        t0 = input_types[0]
+        if t0 is None:
+            return None
+        if isinstance(t0, InputTypeFeedForward):
+            return InputTypeFeedForward(sum(t.size for t in input_types))
+        if isinstance(t0, InputTypeRecurrent):
+            return InputTypeRecurrent(sum(t.size for t in input_types),
+                                      t0.timeseries_length)
+        if isinstance(t0, InputTypeConvolutional):
+            return InputTypeConvolutional(t0.height, t0.width,
+                                          sum(t.channels for t in input_types))
+        if isinstance(t0, InputTypeConvolutionalFlat):
+            return InputTypeFeedForward(sum(t.arity() for t in input_types))
+        raise ValueError(f"MergeVertex: unsupported input type {type(t0).__name__}")
+
+
+@register
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertexConf):
+    """Elementwise Add/Subtract/Product/Average/Max (reference
+    ``ElementWiseVertex.Op``)."""
+    op: str = "add"
+
+    def forward(self, inputs, ctx):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "average":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op '{self.op}'")
+
+
+@register
+@dataclasses.dataclass
+class SubsetVertex(GraphVertexConf):
+    """Feature-range subset [from, to] inclusive (reference ``SubsetVertex``)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def get_output_type(self, input_types):
+        t = input_types[0]
+        n = self.to_idx - self.from_idx + 1
+        if isinstance(t, InputTypeRecurrent):
+            return InputTypeRecurrent(n, t.timeseries_length)
+        if isinstance(t, InputTypeConvolutional):
+            return InputTypeConvolutional(t.height, t.width, n)
+        return InputTypeFeedForward(n)
+
+
+@register
+@dataclasses.dataclass
+class StackVertex(GraphVertexConf):
+    """Concatenate along the batch (minibatch) axis (reference ``StackVertex``)."""
+
+    def forward(self, inputs, ctx):
+        return jnp.concatenate(inputs, axis=0)
+
+    def propagate_mask(self, in_masks):
+        if all(m is None for m in in_masks):
+            return None
+        if any(m is None for m in in_masks):
+            raise ValueError("StackVertex: either all or no inputs must have "
+                             "feature masks")
+        return jnp.concatenate(in_masks, axis=0)
+
+
+@register
+@dataclasses.dataclass
+class UnstackVertex(GraphVertexConf):
+    """Inverse of StackVertex: take slice ``from_idx`` of ``stack_size`` equal
+    batch chunks (reference ``UnstackVertex``)."""
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step:(self.from_idx + 1) * step]
+
+    def propagate_mask(self, in_masks):
+        m = in_masks[0]
+        if m is None:
+            return None
+        step = m.shape[0] // self.stack_size
+        return m[self.from_idx * step:(self.from_idx + 1) * step]
+
+
+@register
+@dataclasses.dataclass
+class ScaleVertex(GraphVertexConf):
+    scale: float = 1.0
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return inputs[0] * self.scale
+
+
+@register
+@dataclasses.dataclass
+class ShiftVertex(GraphVertexConf):
+    shift: float = 0.0
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return inputs[0] + self.shift
+
+
+@register
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertexConf):
+    """x / ||x||_2 over all non-batch dims (reference ``L2NormalizeVertex``)."""
+    eps: float = 1e-8
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+        return x / (norm + self.eps)
+
+
+@register
+@dataclasses.dataclass
+class L2Vertex(GraphVertexConf):
+    """Pairwise L2 distance between two activations → [b, 1] (reference
+    ``L2Vertex``)."""
+    eps: float = 1e-8
+
+    def n_inputs(self):
+        return 2
+
+    def forward(self, inputs, ctx):
+        a, b = inputs
+        d = a - b
+        axes = tuple(range(1, d.ndim))
+        return jnp.sqrt(jnp.sum(d * d, axis=axes) + self.eps)[:, None]
+
+    def get_output_type(self, input_types):
+        return InputTypeFeedForward(1)
+
+
+@register
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertexConf):
+    """Wraps an InputPreProcessor as a standalone vertex (reference
+    ``PreprocessorVertex``)."""
+    preprocessor: Any = None
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return self.preprocessor(inputs[0], ctx)
+
+    def get_output_type(self, input_types):
+        return self.preprocessor.get_output_type(input_types[0])
+
+
+@register
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertexConf):
+    """Reshape to ``shape`` (batch dim preserved when shape[0] == -1;
+    reference ``ReshapeVertex``)."""
+    shape: Any = None
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return jnp.reshape(inputs[0], tuple(self.shape))
+
+
+@register
+@dataclasses.dataclass
+class PoolHelperVertex(GraphVertexConf):
+    """Strips the first row/column of a CNN activation — compatibility shim the
+    reference ships for badly-padded imported GoogLeNet models (reference
+    ``PoolHelperVertex``). NHWC here."""
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        return inputs[0][:, 1:, 1:, :]
+
+    def get_output_type(self, input_types):
+        t = input_types[0]
+        return InputTypeConvolutional(t.height - 1, t.width - 1, t.channels)
+
+
+@register
+@dataclasses.dataclass
+class LastTimeStepVertex(GraphVertexConf):
+    """[b,T,s] → [b,s] taking the last *unmasked* step. ``mask_input`` names the
+    network input whose mask applies (reference ``rnn/LastTimeStepVertex``)."""
+    mask_input: Optional[str] = None
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        x = inputs[0]
+        mask = (ctx or {}).get("input_masks", {}).get(self.mask_input)
+        if mask is None:
+            return x[:, -1, :]
+        last = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0, :]
+
+    def propagate_mask(self, in_masks):
+        return None  # output is [b, s]: the time dimension is gone
+
+    def get_output_type(self, input_types):
+        t = input_types[0]
+        return InputTypeFeedForward(t.size if isinstance(t, InputTypeRecurrent)
+                                    else t.arity())
+
+
+@register
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertexConf):
+    """[b,s] → [b,T,s], T taken from the named network input's time length
+    (reference ``rnn/DuplicateToTimeSeriesVertex``)."""
+    reference_input: Optional[str] = None
+
+    def n_inputs(self):
+        return 1
+
+    def forward(self, inputs, ctx):
+        x = inputs[0]
+        ref = (ctx or {}).get("inputs", {}).get(self.reference_input)
+        if ref is None:
+            raise ValueError(f"DuplicateToTimeSeriesVertex: reference input "
+                             f"'{self.reference_input}' not found")
+        T = ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[1]))
+
+    def get_output_type(self, input_types):
+        t = input_types[0]
+        return InputTypeRecurrent(t.arity())
+
+
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclasses.dataclass
+class ComputationGraphConfiguration:
+    """Reference ``nn/conf/ComputationGraphConfiguration.java``. ``vertices``
+    maps name → Layer or GraphVertexConf; ``vertex_inputs`` maps name → input
+    names (network inputs or other vertices)."""
+    global_conf: Any = None
+    network_inputs: List[str] = dataclasses.field(default_factory=list)
+    network_outputs: List[str] = dataclasses.field(default_factory=list)
+    vertices: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    vertex_inputs: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    input_preprocessors: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    input_types: Optional[List[Any]] = None
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    def topological_order(self) -> List[str]:
+        """Kahn topological sort of vertex names (reference caches this at init,
+        ``ComputationGraph.java:394``/``topologicalSortOrder()`` :1190)."""
+        indeg = {}
+        children = {n: [] for n in self.vertices}
+        for name, ins in self.vertex_inputs.items():
+            indeg[name] = 0
+            for i in ins:
+                if i in self.vertices:
+                    indeg[name] += 1
+                    children[i].append(name)
+                elif i not in self.network_inputs:
+                    raise ValueError(f"Vertex '{name}' input '{i}' is neither a "
+                                     f"vertex nor a network input")
+        ready = sorted(n for n in self.vertices if indeg.get(n, 0) == 0)
+        order = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for ch in children[n]:
+                indeg[ch] -= 1
+                if indeg[ch] == 0:
+                    ready.append(ch)
+        if len(order) != len(self.vertices):
+            cyc = set(self.vertices) - set(order)
+            raise ValueError(f"Cycle in computation graph involving {sorted(cyc)}")
+        return order
+
+    def infer_shapes(self) -> Dict[str, Any]:
+        """Propagate input types over the DAG: validate vertex arity,
+        auto-insert layer preprocessors (reference ``addPreProcessors``), fill
+        ``nIn``. Returns {vertex name → resolved InputType (or None)}. Used by
+        both ``GraphBuilder.build`` and ``ComputationGraph.init`` (from_json
+        configs arrive without resolved shapes)."""
+        types: Dict[str, Any] = {}
+        if self.input_types is not None:
+            if len(self.input_types) != len(self.network_inputs):
+                raise ValueError(f"{len(self.network_inputs)} inputs but "
+                                 f"{len(self.input_types)} input types")
+            types.update(zip(self.network_inputs, self.input_types))
+        for name in self.topological_order():
+            v = self.vertices[name]
+            in_types = [types.get(i) for i in self.vertex_inputs[name]]
+            if isinstance(v, Layer):
+                it = in_types[0] if in_types else None
+                if it is None:
+                    types[name] = None
+                    continue
+                if name not in self.input_preprocessors:
+                    p = v.preprocessor_for(it)
+                    if p is not None:
+                        self.input_preprocessors[name] = p
+                if name in self.input_preprocessors:
+                    it = self.input_preprocessors[name].get_output_type(it)
+                v.set_n_in(it, override=False)
+                types[name] = v.get_output_type(0, it)
+            else:
+                exp = v.n_inputs()
+                if exp is not None and len(self.vertex_inputs[name]) != exp:
+                    raise ValueError(f"Vertex '{name}' expects {exp} inputs, "
+                                     f"got {len(self.vertex_inputs[name])}")
+                types[name] = (None if any(t is None for t in in_types)
+                               else v.get_output_type(in_types))
+        return types
+
+    def to_json(self) -> str:
+        return to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        obj = from_json(s)
+        if not isinstance(obj, ComputationGraphConfiguration):
+            raise ValueError("JSON does not describe a ComputationGraphConfiguration")
+        return obj
+
+    def clone(self):
+        return copy.deepcopy(self)
+
+
+class GraphBuilder:
+    """Reference ``ComputationGraphConfiguration$GraphBuilder``: addInputs /
+    addLayer / addVertex / setOutputs / setInputTypes / build."""
+
+    def __init__(self, global_conf):
+        self._global = global_conf
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._vertices: Dict[str, Any] = {}
+        self._vertex_inputs: Dict[str, List[str]] = {}
+        self._preprocessors: Dict[str, Any] = {}
+        self._input_types = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def add_inputs(self, *names) -> "GraphBuilder":
+        for n in names:
+            if n in self._inputs or n in self._vertices:
+                raise ValueError(f"Duplicate input name '{n}'")
+            self._inputs.append(n)
+        return self
+
+    addInputs = add_inputs
+
+    def _check_name(self, name):
+        if name in self._vertices:
+            raise ValueError(f"Duplicate vertex name '{name}'")
+        if name in self._inputs:
+            raise ValueError(f"Vertex name '{name}' collides with a network input")
+
+    def add_layer(self, name, layer, *inputs, preprocessor=None) -> "GraphBuilder":
+        self._check_name(name)
+        ins = list(inputs)
+        if len(ins) > 1:
+            # reference auto-inserts a MergeVertex when a layer has >1 input
+            merge_name = f"{name}-merge"
+            self._vertices[merge_name] = MergeVertex()
+            self._vertex_inputs[merge_name] = ins
+            ins = [merge_name]
+        self._vertices[name] = layer
+        self._vertex_inputs[name] = ins
+        if preprocessor is not None:
+            self._preprocessors[name] = preprocessor
+        return self
+
+    addLayer = add_layer
+
+    def add_vertex(self, name, vertex, *inputs) -> "GraphBuilder":
+        self._check_name(name)
+        self._vertices[name] = vertex
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    addVertex = add_vertex
+
+    def set_outputs(self, *names) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    setOutputs = set_outputs
+
+    def set_input_types(self, *types) -> "GraphBuilder":
+        self._input_types = list(types)
+        return self
+
+    setInputTypes = set_input_types
+
+    def input_preprocessor(self, layer_name, preproc) -> "GraphBuilder":
+        self._preprocessors[layer_name] = preproc
+        return self
+
+    inputPreProcessor = input_preprocessor
+
+    def backprop_type(self, t) -> "GraphBuilder":
+        self._backprop_type = t
+        return self
+
+    backpropType = backprop_type
+
+    def t_bptt_forward_length(self, n) -> "GraphBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
+    tBPTTForwardLength = t_bptt_forward_length
+
+    def t_bptt_backward_length(self, n) -> "GraphBuilder":
+        self._tbptt_back = int(n)
+        return self
+
+    tBPTTBackwardLength = t_bptt_backward_length
+
+    # ------------------------------------------------------------------
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs:
+            raise ValueError("GraphBuilder: no network inputs (addInputs)")
+        if not self._outputs:
+            raise ValueError("GraphBuilder: no network outputs (setOutputs)")
+        for out in self._outputs:
+            if out not in self._vertices:
+                raise ValueError(f"Output '{out}' is not a vertex")
+        conf = ComputationGraphConfiguration(
+            global_conf=self._global,
+            network_inputs=list(self._inputs),
+            network_outputs=list(self._outputs),
+            vertices=dict(self._vertices),
+            vertex_inputs=dict(self._vertex_inputs),
+            input_preprocessors=dict(self._preprocessors),
+            input_types=self._input_types,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+        )
+        conf.infer_shapes()
+        return conf

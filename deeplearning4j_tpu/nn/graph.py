@@ -1,0 +1,408 @@
+"""ComputationGraph: DAG network container with multi-input/multi-output.
+
+TPU-native equivalent of reference ``nn/graph/ComputationGraph.java`` (3363 LoC;
+init/topo-sort :394/:1190, ``fit(DataSetIterator)`` :863,
+``fit(MultiDataSetIterator)`` :988, ``computeGradientAndScore`` :1298,
+``calcBackpropGradients(truncatedBPTT, externalEpsilons)`` :1629,
+``feedForward`` :1361-1440).
+
+As with MultiLayerNetwork, the architectural shift is whole-graph compilation:
+one jitted XLA computation covers forward over the cached topological order,
+loss on every output vertex, AD backward, gradient normalization, updater, and
+the parameter update, with params/updater state donated. External-errors
+training (the reference's externalEpsilons path, used to couple a graph to an
+outside loss) is ``fit_external_errors``: VJP of the outputs against caller
+epsilons inside the same jitted step.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .conf import GradientNormalization
+from .conf.graph import ComputationGraphConfiguration
+from .conf.layers import Layer
+from .conf.inputs import InputTypeConvolutional
+from .layers import impl_for
+from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
+                                ListDataSetIterator)
+from ..datasets.iterators import AsyncDataSetIterator
+from ..optimize.updater import NetworkUpdater, normalize_gradients
+
+log = logging.getLogger(__name__)
+_tm = jax.tree_util.tree_map
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.gc = conf.global_conf
+        self.topo: List[str] = conf.topological_order()
+        self.impls: Dict[str, object] = {}
+        self.params = None
+        self.states = None
+        self.updater = None
+        self.updater_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.listeners: List = []
+        self.score_ = float("nan")
+        self.last_etl_ms = 0.0
+        self._rng = None
+        self._jit_step = None
+        self._jit_ext_step = None
+        self._jit_output = {}
+        self._types = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None):
+        conf = self.conf
+        # shape inference (idempotent; from_json configs arrive unresolved)
+        types = conf.infer_shapes()
+        self._types = types
+
+        layer_names = [n for n in self.topo if isinstance(conf.vertices[n], Layer)]
+        key = jax.random.PRNGKey(self.gc.seed)
+        self._rng, *keys = jax.random.split(key, len(layer_names) + 1)
+        for name in layer_names:
+            in_name = conf.vertex_inputs[name][0] if conf.vertex_inputs[name] else None
+            it = types.get(in_name) if in_name else None
+            if name in conf.input_preprocessors and it is not None:
+                it = conf.input_preprocessors[name].get_output_type(it)
+            self.impls[name] = impl_for(conf.vertices[name], self.gc, it)
+            self.impls[name].index = name
+        if params is not None:
+            self.params = params
+            self.states = {n: self.impls[n].init(k)[1]
+                           for n, k in zip(layer_names, keys)}
+        else:
+            self.params, self.states = {}, {}
+            for name, k in zip(layer_names, keys):
+                p, s = self.impls[name].init(k)
+                self.params[name] = p
+                self.states[name] = s
+        layer_updaters = {}
+        for name in layer_names:
+            u = getattr(conf.vertices[name], "updater", None) or self.gc.updater
+            layer_updaters[name] = u
+        self.updater = NetworkUpdater(layer_updaters)
+        self.updater_state = self.updater.init_state(self.params)
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _adapt_inputs(self, inputs):
+        """User-facing conv inputs are NCHW; internal layout NHWC."""
+        out = []
+        its = self.conf.input_types or [None] * len(inputs)
+        for x, it in zip(inputs, its):
+            if (isinstance(it, InputTypeConvolutional) and x.ndim == 4
+                    and x.shape[1] == it.channels and x.shape[2] == it.height):
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            out.append(x)
+        return out
+
+    def _apply_graph(self, params, states, inputs, input_masks, train, rng,
+                     skip=()):
+        """Forward over the cached topo order. Returns (activations dict,
+        new_states, masks dict, ctx). ``skip``: vertex names not to execute
+        (the training loss path skips output-layer forwards; ``loss_on``
+        evaluates them on preoutput with fused softmax/xent)."""
+        conf = self.conf
+        acts: Dict[str, object] = dict(zip(conf.network_inputs, inputs))
+        masks = dict(zip(conf.network_inputs,
+                         input_masks or [None] * len(conf.network_inputs)))
+        ctx = {"inputs": acts, "input_masks": masks}
+        new_states = dict(states)
+        layer_names = [n for n in self.topo if n in self.impls]
+        keys = (dict(zip(layer_names, jax.random.split(rng, len(layer_names))))
+                if rng is not None and layer_names else {})
+        for name in self.topo:
+            if name in skip:
+                continue
+            v = conf.vertices[name]
+            in_names = conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            if isinstance(v, Layer):
+                x = xs[0]
+                pre = conf.input_preprocessors.get(name)
+                if pre is not None:
+                    x = pre(x, ctx)
+                # propagate the mask of the (single) input chain
+                m = masks.get(in_names[0])
+                impl = self.impls[name]
+                y, ns = impl.forward(params[name], states[name], x, train=train,
+                                     rng=keys.get(name), mask=m, ctx=ctx)
+                new_states[name] = ns
+                acts[name] = y
+                masks[name] = m
+            else:
+                acts[name] = v.forward(xs, ctx)
+                masks[name] = v.propagate_mask([masks.get(i) for i in in_names])
+        return acts, new_states, masks, ctx
+
+    def _loss_fn(self, params, states, inputs, labels, input_masks, label_masks,
+                 train, rng):
+        conf = self.conf
+        # skip output-layer forwards: loss_on consumes their *input*
+        # activations so the fused softmax/xent path applies to preoutput.
+        # Only safe when nothing downstream consumes the output activation.
+        consumed = {i for ins in conf.vertex_inputs.values() for i in ins}
+        out_set = frozenset(n for n in conf.network_outputs
+                            if hasattr(self.impls.get(n), "loss_on")
+                            and n not in consumed)
+        acts, new_states, masks, ctx = self._apply_graph(
+            params, states, inputs, input_masks, train, rng, skip=out_set)
+        total = 0.0
+        for out_name, lbl, lm in zip(conf.network_outputs, labels,
+                                     label_masks or [None] * len(labels)):
+            impl = self.impls.get(out_name)
+            if impl is None or not hasattr(impl, "loss_on"):
+                raise ValueError(f"Output vertex '{out_name}' is not an output "
+                                 f"layer — cannot compute training loss")
+            in_name = conf.vertex_inputs[out_name][0]
+            x = acts[in_name]
+            pre = conf.input_preprocessors.get(out_name)
+            if pre is not None:
+                x = pre(x, ctx)
+            mask = lm if lm is not None else (masks.get(in_name) if x.ndim == 3
+                                              else None)
+            total = total + impl.loss_on(params[out_name], states[out_name], x,
+                                         lbl, mask=mask, train=train, rng=rng)
+            if hasattr(impl, "update_state"):
+                xs = jax.lax.stop_gradient(x)
+                new_states[out_name] = impl.update_state(states[out_name], xs, lbl)
+        reg = 0.0
+        for name, impl in self.impls.items():
+            reg = reg + impl.regularization(params[name])
+        return total + reg, new_states
+
+    # ---------------------------------------------------------- train step
+    def _raw_step(self):
+        gn_mode = self.gc.gradient_normalization
+        gn_thresh = self.gc.gradient_normalization_threshold
+        minimize = self.gc.minimize
+
+        def step(params, states, upd_state, iteration, rng, inputs, labels,
+                 input_masks, label_masks):
+            inputs = self._adapt_inputs(inputs)
+
+            def loss_fn(p):
+                return self._loss_fn(p, states, inputs, labels, input_masks,
+                                     label_masks, True, rng)
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if not minimize:
+                grads = _tm(lambda g: -g, grads)
+            grads = normalize_gradients(grads, gn_mode, gn_thresh)
+            updates, new_upd = self.updater.apply(upd_state, grads, iteration)
+            new_params = _tm(lambda p, u: p - u.astype(p.dtype), params, updates)
+            return new_params, new_states, new_upd, loss
+
+        return step
+
+    def _ensure_step(self):
+        if self._jit_step is None:
+            self._jit_step = jax.jit(self._raw_step(), donate_argnums=(0, 2))
+        return self._jit_step
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs=1):
+        """Train. Accepts DataSet/MultiDataSet, an iterator of either, or
+        (features, labels) arrays (reference ``fit`` overloads :863/:988)."""
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = ListDataSetIterator([data])
+        it = data
+        if isinstance(it, DataSetIterator) and not isinstance(it, AsyncDataSetIterator):
+            if it.async_supported():
+                it = AsyncDataSetIterator(it, queue_size=2)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            t_etl = time.perf_counter()
+            for ds in it:
+                self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                self._fit_batch(ds)
+                t_etl = time.perf_counter()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+        return self
+
+    def _as_multi(self, ds):
+        if isinstance(ds, MultiDataSet):
+            return ds
+        return MultiDataSet([ds.features], [ds.labels],
+                            None if ds.features_mask is None else [ds.features_mask],
+                            None if ds.labels_mask is None else [ds.labels_mask])
+
+    def _fit_batch(self, ds):
+        mds = self._as_multi(ds)
+        inputs = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
+        fms = (None if mds.features_masks is None
+               else tuple(None if m is None else jnp.asarray(m)
+                          for m in mds.features_masks))
+        lms = (None if mds.labels_masks is None
+               else tuple(None if m is None else jnp.asarray(m)
+                          for m in mds.labels_masks))
+        step = self._ensure_step()
+        it = jnp.asarray(self.iteration_count, jnp.int32)
+        self.params, self.states, self.updater_state, loss = step(
+            self.params, self.states, self.updater_state, it, self._next_rng(),
+            inputs, labels, fms, lms)
+        self.score_ = loss
+        self.iteration_count += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+        self.last_batch_size = int(inputs[0].shape[0])
+
+    # ------------------------------------------------- external errors path
+    def fit_external_errors(self, inputs, epsilons):
+        """Reference external-epsilons training (``calcBackpropGradients``
+        :1629 with externalEpsilons): apply d(outputs)·epsilons through VJP and
+        update params. ``epsilons`` aligns with ``network_outputs``."""
+        inputs = tuple(jnp.asarray(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+        epsilons = tuple(jnp.asarray(e) for e in (epsilons if isinstance(epsilons, (list, tuple)) else [epsilons]))
+        if self._jit_ext_step is None:
+            gn_mode = self.gc.gradient_normalization
+            gn_thresh = self.gc.gradient_normalization_threshold
+
+            def ext_step(params, states, upd_state, iteration, xs, eps):
+                xs = self._adapt_inputs(xs)
+
+                def out_fn(p):
+                    acts, _, _, _ = self._apply_graph(p, states, xs, None, True, None)
+                    outs = []
+                    for name in self.conf.network_outputs:
+                        outs.append(acts[name])
+                    return tuple(outs)
+
+                _, vjp = jax.vjp(out_fn, params)
+                grads = vjp(eps)[0]
+                grads = normalize_gradients(grads, gn_mode, gn_thresh)
+                updates, new_upd = self.updater.apply(upd_state, grads, iteration)
+                new_params = _tm(lambda p, u: p - u.astype(p.dtype), params, updates)
+                return new_params, new_upd
+
+            self._jit_ext_step = jax.jit(ext_step, donate_argnums=(0, 2))
+        it = jnp.asarray(self.iteration_count, jnp.int32)
+        self.params, self.updater_state = self._jit_ext_step(
+            self.params, self.states, self.updater_state, it, inputs, epsilons)
+        self.iteration_count += 1
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, *inputs, train=False, masks=None):
+        """Activations of all output vertices (reference ``output``). Returns a
+        single array when the graph has one output."""
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        ms = (None if masks is None
+              else tuple(None if m is None else jnp.asarray(m) for m in masks))
+        key = (bool(train), ms is not None)
+        if key not in self._jit_output:
+            def fwd(params, states, xs, ms):
+                xs = self._adapt_inputs(xs)
+                acts, _, _, _ = self._apply_graph(params, states, xs, ms, train, None)
+                return tuple(acts[n] for n in self.conf.network_outputs)
+            self._jit_output[key] = jax.jit(fwd)
+        outs = self._jit_output[key](self.params, self.states, xs, ms)
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def feed_forward(self, *inputs, train=False):
+        """All vertex activations as a dict (reference ``feedForward`` map)."""
+        xs = self._adapt_inputs([jnp.asarray(x) for x in inputs])
+        acts, _, _, _ = self._apply_graph(self.params, self.states, xs, None,
+                                          train, None)
+        return acts
+
+    feedForward = feed_forward
+
+    # ----------------------------------------------------------------- score
+    def score(self, ds=None, training=False):
+        if ds is None:
+            return float(self.score_)
+        mds = self._as_multi(ds)
+        inputs = self._adapt_inputs([jnp.asarray(f) for f in mds.features])
+        labels = [jnp.asarray(l) for l in mds.labels]
+        fms = (None if mds.features_masks is None
+               else [None if m is None else jnp.asarray(m) for m in mds.features_masks])
+        lms = (None if mds.labels_masks is None
+               else [None if m is None else jnp.asarray(m) for m in mds.labels_masks])
+        loss, _ = self._loss_fn(self.params, self.states, inputs, labels, fms,
+                                lms, training, None)
+        return float(loss)
+
+    def compute_gradient_and_score(self, ds):
+        mds = self._as_multi(ds)
+        inputs = self._adapt_inputs([jnp.asarray(f) for f in mds.features])
+        labels = [jnp.asarray(l) for l in mds.labels]
+
+        def loss_fn(p):
+            loss, _ = self._loss_fn(p, self.states, inputs, labels, None, None,
+                                    True, None)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(self.params)
+        self.score_ = loss
+        return grads, float(loss)
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, iterator, output_idx=0):
+        """Classification evaluation on output ``output_idx`` (reference
+        ``evaluate``; accepts DataSet or MultiDataSet iterators)."""
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        for ds in iterator:
+            mds = self._as_multi(ds)
+            outs = self.output(*mds.features, masks=mds.features_masks)
+            out = outs[output_idx] if isinstance(outs, list) else outs
+            lm = (None if mds.labels_masks is None
+                  else mds.labels_masks[output_idx])
+            if lm is None and mds.features_masks is not None:
+                lm = mds.features_masks[0]
+            ev.eval(mds.labels[output_idx], np.asarray(out), mask=lm)
+        return ev
+
+    # ------------------------------------------------------------ parameters
+    def param_table(self):
+        out = {}
+        for name in self.topo:
+            if name in self.params:
+                for k, v in self.params[name].items():
+                    out[f"{name}_{k}"] = v
+        return out
+
+    paramTable = param_table
+
+    def num_params(self) -> int:
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(self.params))
+
+    numParams = num_params
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    setListeners = set_listeners
+
+    def summary(self) -> str:
+        lines = [f"{'vertex':<32} {'type':<28} {'params':>10}"]
+        for name in self.topo:
+            v = self.conf.vertices[name]
+            n = (self.impls[name].num_params(self.params[name])
+                 if name in self.impls else 0)
+            lines.append(f"{name:<32} {type(v).__name__:<28} {n:>10}")
+        lines.append(f"Total params: {self.num_params()}")
+        return "\n".join(lines)

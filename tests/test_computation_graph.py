@@ -1,0 +1,310 @@
+"""ComputationGraph tests — DAG container parity with the reference
+(``ComputationGraph.java``): topo sort, multi-input/multi-output training,
+vertices, serde round-trip, external errors."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, ComputationGraph,
+                                InputType, DataSet, MultiDataSet,
+                                ListDataSetIterator, Adam, Sgd)
+from deeplearning4j_tpu.nn.conf.graph import (ComputationGraphConfiguration,
+                                              MergeVertex, ElementWiseVertex,
+                                              SubsetVertex, StackVertex,
+                                              UnstackVertex, ScaleVertex,
+                                              ShiftVertex, L2NormalizeVertex,
+                                              L2Vertex, ReshapeVertex,
+                                              LastTimeStepVertex,
+                                              DuplicateToTimeSeriesVertex)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                               ConvolutionLayer, LSTM,
+                                               RnnOutputLayer, SubsamplingLayer)
+
+
+def _mlp_graph():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "d0")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build())
+
+
+def _data(n=32, n_in=8, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return DataSet(x, y)
+
+
+class TestBasics:
+    def test_fit_reduces_score(self):
+        net = ComputationGraph(_mlp_graph()).init()
+        ds = _data()
+        s0 = net.score(ds)
+        net.fit(ListDataSetIterator([ds]), epochs=30)
+        assert net.score(ds) < s0 * 0.7
+
+    def test_output_shape_and_softmax(self):
+        net = ComputationGraph(_mlp_graph()).init()
+        out = np.asarray(net.output(_data().features))
+        assert out.shape == (32, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_topo_order_cycle_detection(self):
+        conf = ComputationGraphConfiguration(
+            network_inputs=["in"],
+            network_outputs=["a"],
+            vertices={"a": ScaleVertex(scale=1.0), "b": ScaleVertex(scale=1.0)},
+            vertex_inputs={"a": ["b"], "b": ["a"]})
+        with pytest.raises(ValueError, match="[Cc]ycle"):
+            conf.topological_order()
+
+    def test_n_in_inference_through_merge(self):
+        conf = (NeuralNetConfiguration.builder().updater(Sgd(learning_rate=0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=4, activation="relu"), "in")
+                .add_layer("b", DenseLayer(n_out=5, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "a", "b")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        # auto-inserted merge: out's nIn = 4 + 5
+        assert conf.vertices["out"].n_in == 9
+        net = ComputationGraph(conf).init()
+        out = np.asarray(net.output(np.random.randn(3, 8).astype(np.float32)))
+        assert out.shape == (3, 2)
+
+
+class TestMultiInOut:
+    def test_two_inputs_two_outputs(self):
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=1e-2))
+                .graph_builder()
+                .add_inputs("inA", "inB")
+                .add_layer("dA", DenseLayer(n_out=8, activation="relu"), "inA")
+                .add_layer("dB", DenseLayer(n_out=8, activation="relu"), "inB")
+                .add_vertex("merged", MergeVertex(), "dA", "dB")
+                .add_layer("outA", OutputLayer(n_out=2, activation="softmax",
+                                               loss="mcxent"), "merged")
+                .add_layer("outB", OutputLayer(n_out=1, activation="identity",
+                                               loss="mse"), "merged")
+                .set_outputs("outA", "outB")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(6))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        xa = rng.normal(size=(16, 4)).astype(np.float32)
+        xb = rng.normal(size=(16, 6)).astype(np.float32)
+        ya = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        yb = rng.normal(size=(16, 1)).astype(np.float32)
+        mds = MultiDataSet([xa, xb], [ya, yb])
+        s0 = net.score(mds)
+        net.fit(ListDataSetIterator([mds]), epochs=40)
+        assert net.score(mds) < s0
+        outs = net.output(xa, xb)
+        assert outs[0].shape == (16, 2) and outs[1].shape == (16, 1)
+
+
+class TestVertices:
+    def _run_vertex(self, vertex, *inputs, n_inputs=1):
+        """Forward a bare vertex function on arrays."""
+        import jax.numpy as jnp
+        return np.asarray(vertex.forward([jnp.asarray(x) for x in inputs], {}))
+
+    def test_elementwise_ops(self):
+        a = np.array([[1., 2.], [3., 4.]], np.float32)
+        b = np.array([[5., 1.], [2., 8.]], np.float32)
+        assert np.allclose(self._run_vertex(ElementWiseVertex(op="add"), a, b), a + b)
+        assert np.allclose(self._run_vertex(ElementWiseVertex(op="subtract"), a, b), a - b)
+        assert np.allclose(self._run_vertex(ElementWiseVertex(op="product"), a, b), a * b)
+        assert np.allclose(self._run_vertex(ElementWiseVertex(op="average"), a, b), (a + b) / 2)
+        assert np.allclose(self._run_vertex(ElementWiseVertex(op="max"), a, b), np.maximum(a, b))
+
+    def test_subset_stack_unstack(self):
+        x = np.random.randn(4, 10).astype(np.float32)
+        sub = self._run_vertex(SubsetVertex(from_idx=2, to_idx=5), x)
+        assert np.allclose(sub, x[:, 2:6])
+        y = np.random.randn(4, 10).astype(np.float32)
+        st = self._run_vertex(StackVertex(), x, y)
+        assert st.shape == (8, 10)
+        un = self._run_vertex(UnstackVertex(from_idx=1, stack_size=2), st)
+        assert np.allclose(un, y)
+
+    def test_scale_shift_l2(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(self._run_vertex(ScaleVertex(scale=2.5), x), 2.5 * x)
+        assert np.allclose(self._run_vertex(ShiftVertex(shift=1.5), x), x + 1.5)
+        n = self._run_vertex(L2NormalizeVertex(), x)
+        np.testing.assert_allclose(np.linalg.norm(n, axis=1), 1.0, rtol=1e-4)
+        y = np.random.randn(3, 4).astype(np.float32)
+        d = self._run_vertex(L2Vertex(), x, y)
+        expect = np.linalg.norm(x - y, axis=1)[:, None]
+        np.testing.assert_allclose(d, expect, rtol=1e-4)
+
+    def test_reshape(self):
+        x = np.random.randn(2, 12).astype(np.float32)
+        r = self._run_vertex(ReshapeVertex(shape=(-1, 3, 4)), x)
+        assert r.shape == (2, 3, 4)
+
+
+class TestRnnVertices:
+    def test_last_timestep_and_duplicate(self):
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(learning_rate=1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=8, activation="tanh"), "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"), "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(5))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(6, 7, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        net.fit(ListDataSetIterator([ds]), epochs=25)
+        assert net.score(ds) < s0
+        assert np.asarray(net.output(x)).shape == (6, 2)
+
+    def test_seq2seq_duplicate_vertex(self):
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(learning_rate=1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("enc", LSTM(n_out=8, activation="tanh"), "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"), "enc")
+                .add_vertex("dup", DuplicateToTimeSeriesVertex(reference_input="in"),
+                            "last")
+                .add_layer("dec", LSTM(n_out=8, activation="tanh"), "dup")
+                .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                                 loss="mcxent"), "dec")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        x = np.random.randn(2, 5, 4).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 5, 3)
+
+
+class TestCnnGraph:
+    def test_conv_branch_merge(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("c3", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                                  convolution_mode="same",
+                                                  activation="relu"), "in")
+                .add_layer("c5", ConvolutionLayer(n_out=4, kernel_size=(5, 5),
+                                                  convolution_mode="same",
+                                                  activation="relu"), "in")
+                .add_vertex("cat", MergeVertex(), "c3", "c5")
+                .add_layer("pool", SubsamplingLayer(kernel_size=(2, 2),
+                                                    stride=(2, 2)), "cat")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "pool")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 1))
+                .build())
+        net = ComputationGraph(conf).init()
+        # inception-style merge: channels 4+4=8, pooled 4x4 → dense nIn 128
+        assert conf.vertices["out"].n_in == 8 * 4 * 4
+        x = np.random.randn(2, 1, 8, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score(DataSet(x, y)))
+
+
+class TestSerde:
+    def test_json_round_trip(self):
+        conf = _mlp_graph()
+        s = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(s)
+        net = ComputationGraph(conf2).init()
+        out = np.asarray(net.output(np.random.randn(2, 8).astype(np.float32)))
+        assert out.shape == (2, 3)
+
+    def test_params_identical_same_seed(self):
+        n1 = ComputationGraph(_mlp_graph()).init()
+        n2 = ComputationGraph(_mlp_graph()).init()
+        for k in n1.params:
+            for p in n1.params[k]:
+                np.testing.assert_array_equal(np.asarray(n1.params[k][p]),
+                                              np.asarray(n2.params[k][p]))
+
+
+class TestMasksAndPreprocessors:
+    def test_rnn_dense_rnnoutput_preprocessor_ctx(self):
+        # rnn → dense (RnnToFf) → RnnOutputLayer (FfToRnn): the output-layer
+        # preprocessor must see the shared ctx (minibatch/timesteps)
+        conf = (NeuralNetConfiguration.builder().seed(2)
+                .updater(Adam(learning_rate=1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=6, activation="tanh"), "in")
+                .add_layer("d", DenseLayer(n_out=4, activation="relu"), "lstm")
+                .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                                 loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        x = np.random.randn(4, 5, 3).astype(np.float32)
+        y = np.zeros((4, 5, 2), np.float32)
+        y[..., 0] = 1.0
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        net.fit(ds)
+        assert np.isfinite(net.score(ds))
+        assert np.asarray(net.output(x)).shape == (4, 5, 2)
+
+    def test_stack_unstack_mask_propagation(self):
+        import jax.numpy as jnp
+        sv = StackVertex()
+        m1 = jnp.ones((2, 5))
+        m2 = jnp.zeros((2, 5))
+        out = np.asarray(sv.propagate_mask([m1, m2]))
+        assert out.shape == (4, 5)
+        uv = UnstackVertex(from_idx=1, stack_size=2)
+        back = np.asarray(uv.propagate_mask([jnp.asarray(out)]))
+        assert np.allclose(back, np.zeros((2, 5)))
+
+    def test_vertex_name_collision_rejected(self):
+        gb = (NeuralNetConfiguration.builder().updater(Sgd(learning_rate=0.1))
+              .graph_builder().add_inputs("in"))
+        with pytest.raises(ValueError, match="collides"):
+            gb.add_vertex("in", ScaleVertex(scale=2.0), "in")
+
+
+class TestExternalErrors:
+    def test_external_epsilon_step_updates_params(self):
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .updater(Sgd(learning_rate=0.5))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=4, activation="identity"), "in")
+                .set_outputs("d")
+                .set_input_types(InputType.feed_forward(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        before = np.asarray(net.params["d"]["W"]).copy()
+        x = np.random.randn(5, 3).astype(np.float32)
+        eps = np.ones((5, 4), np.float32)
+        net.fit_external_errors(x, eps)
+        after = np.asarray(net.params["d"]["W"])
+        assert not np.allclose(before, after)
+        # SGD with external eps: dL/dW = x^T @ eps
+        expect = before - 0.5 * (x.T @ eps)
+        np.testing.assert_allclose(after, expect, rtol=1e-4, atol=1e-5)
