@@ -1,0 +1,12 @@
+"""Evaluation suite (reference ``deeplearning4j-nn/.../eval/``, 5904 LoC:
+Evaluation, EvaluationBinary, EvaluationCalibration, ROC family,
+RegressionEvaluation — SURVEY.md §2.1)."""
+from .evaluation import Evaluation, ConfusionMatrix
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCBinary, ROCMultiClass, RocCurve, PrecisionRecallCurve
+from .binary import EvaluationBinary
+from .calibration import EvaluationCalibration
+
+__all__ = ["Evaluation", "ConfusionMatrix", "RegressionEvaluation", "ROC",
+           "ROCBinary", "ROCMultiClass", "RocCurve", "PrecisionRecallCurve",
+           "EvaluationBinary", "EvaluationCalibration"]

@@ -1,0 +1,211 @@
+"""ROC / AUC evaluation.
+
+TPU-native equivalent of reference ``deeplearning4j-nn/.../eval/ROC.java``,
+``ROCBinary.java``, ``ROCMultiClass.java`` (SURVEY.md §2.1 "Evaluation"): exact
+mode (threshold_steps=0 — every distinct score is a threshold, trapezoidal AUC)
+and thresholded mode (fixed threshold grid), matching the reference's two modes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten_masked(labels, predictions, mask):
+    labels = np.asarray(labels, dtype=np.float64)
+    predictions = np.asarray(predictions, dtype=np.float64)
+    if labels.ndim == 3:
+        b, t, c = labels.shape
+        labels = labels.reshape(b * t, c)
+        predictions = predictions.reshape(b * t, c)
+        if mask is not None:
+            m = np.asarray(mask).reshape(b * t) > 0
+            labels, predictions = labels[m], predictions[m]
+    elif mask is not None:
+        m = np.asarray(mask).ravel() > 0
+        labels, predictions = labels[m], predictions[m]
+    return labels, predictions
+
+
+def _auc(x: np.ndarray, y: np.ndarray) -> float:
+    """Trapezoidal area under the curve, points already in sweep order
+    (descending threshold → x ascending; vertical segments contribute 0)."""
+    return float(np.trapezoid(y, x))
+
+
+def _sweep_counts(scores: np.ndarray, truth: np.ndarray, threshold_steps: int):
+    """(thresholds, tp, fp) for a descending-threshold sweep with ``>=``
+    semantics. O(N log N): sort scores descending, cumulative-sum positives
+    (the reference's exact-mode ROC.java strategy), never materializing an
+    N×N threshold matrix. Endpoints: +inf (nothing positive) first, -inf
+    (everything positive) last."""
+    order = np.argsort(-scores, kind="stable")
+    s_sorted = scores[order]
+    t_sorted = truth[order] > 0
+    cum_tp = np.cumsum(t_sorted)
+    cum_fp = np.cumsum(~t_sorted)
+    if threshold_steps > 0:
+        thresholds = np.linspace(0.0, 1.0, threshold_steps + 1)[::-1]
+    else:
+        thresholds = np.unique(scores)[::-1]
+    thresholds = np.concatenate([[np.inf], thresholds, [-np.inf]])
+    # number of scores >= t  ==  position found by searchsorted on -s_sorted
+    counts = np.searchsorted(-s_sorted, -thresholds, side="right")
+    tp = np.where(counts > 0, cum_tp[np.maximum(counts - 1, 0)], 0)
+    fp = np.where(counts > 0, cum_fp[np.maximum(counts - 1, 0)], 0)
+    return thresholds, tp.astype(np.float64), fp.astype(np.float64)
+
+
+def _roc_curve(scores: np.ndarray, truth: np.ndarray,
+               threshold_steps: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(thresholds, fpr, tpr). Exact mode when threshold_steps == 0."""
+    p = truth.sum()
+    n = len(truth) - p
+    thresholds, tp, fp = _sweep_counts(scores, truth, threshold_steps)
+    tpr = tp / p if p else np.zeros_like(tp)
+    fpr = fp / n if n else np.zeros_like(fp)
+    return thresholds, fpr, tpr
+
+
+def _pr_curve(scores: np.ndarray, truth: np.ndarray,
+              threshold_steps: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(thresholds, recall, precision). The +inf start point pins
+    (recall 0, precision 1) by convention."""
+    p = truth.sum()
+    thresholds, tp, fp = _sweep_counts(scores, truth, threshold_steps)
+    pred_pos = tp + fp
+    precision = np.where(pred_pos > 0, tp / np.maximum(pred_pos, 1), 1.0)
+    recall = tp / p if p else np.zeros_like(tp)
+    return thresholds, recall, precision
+
+
+class RocCurve:
+    def __init__(self, thresholds, fpr, tpr):
+        self.thresholds = thresholds
+        self.fpr = fpr
+        self.tpr = tpr
+
+    def calculate_auc(self) -> float:
+        return _auc(self.fpr, self.tpr)
+
+    calculateAUC = calculate_auc
+
+
+class PrecisionRecallCurve:
+    def __init__(self, thresholds, recall, precision):
+        self.thresholds = thresholds
+        self.recall = recall
+        self.precision = precision
+
+    def calculate_auprc(self) -> float:
+        return _auc(self.recall, self.precision)
+
+    calculateAUPRC = calculate_auprc
+
+
+class ROC:
+    """Binary ROC. Accepts single-column probabilities (positive class) or
+    2-column one-hot/softmax output (column 1 = positive), like the reference.
+    ``threshold_steps=0`` → exact mode."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._scores: List[np.ndarray] = []
+        self._truth: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _flatten_masked(labels, predictions, mask)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            truth = labels[:, 1]
+            scores = predictions[:, 1]
+        else:
+            truth = labels.ravel()
+            scores = predictions.ravel()
+        self._truth.append(truth)
+        self._scores.append(scores)
+
+    def _collect(self):
+        if not self._scores:
+            return np.zeros(0), np.zeros(0)
+        return np.concatenate(self._scores), np.concatenate(self._truth)
+
+    def get_roc_curve(self) -> RocCurve:
+        scores, truth = self._collect()
+        return RocCurve(*_roc_curve(scores, truth, self.threshold_steps))
+
+    getRocCurve = get_roc_curve
+
+    def get_precision_recall_curve(self) -> PrecisionRecallCurve:
+        scores, truth = self._collect()
+        return PrecisionRecallCurve(*_pr_curve(scores, truth,
+                                               self.threshold_steps))
+
+    getPrecisionRecallCurve = get_precision_recall_curve
+
+    def calculate_auc(self) -> float:
+        return self.get_roc_curve().calculate_auc()
+
+    calculateAUC = calculate_auc
+
+    def calculate_auprc(self) -> float:
+        return self.get_precision_recall_curve().calculate_auprc()
+
+    calculateAUPRC = calculate_auprc
+
+
+class ROCBinary:
+    """Per-output independent binary ROC (reference ``ROCBinary.java``) for
+    multi-label sigmoid outputs [n, L]."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._per_label: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _flatten_masked(labels, predictions, mask)
+        n_labels = labels.shape[1]
+        if self._per_label is None:
+            self._per_label = [ROC(self.threshold_steps) for _ in range(n_labels)]
+        for i in range(n_labels):
+            self._per_label[i].eval(labels[:, i], predictions[:, i])
+
+    def num_labels(self) -> int:
+        return 0 if self._per_label is None else len(self._per_label)
+
+    def calculate_auc(self, label_idx: int) -> float:
+        return self._per_label[label_idx].calculate_auc()
+
+    calculateAUC = calculate_auc
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._per_label]))
+
+    calculateAverageAUC = calculate_average_auc
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class on softmax output (reference
+    ``ROCMultiClass.java``)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._per_class: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _flatten_masked(labels, predictions, mask)
+        n_classes = labels.shape[1]
+        if self._per_class is None:
+            self._per_class = [ROC(self.threshold_steps) for _ in range(n_classes)]
+        for i in range(n_classes):
+            self._per_class[i].eval(labels[:, i], predictions[:, i])
+
+    def calculate_auc(self, class_idx: int) -> float:
+        return self._per_class[class_idx].calculate_auc()
+
+    calculateAUC = calculate_auc
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._per_class]))
+
+    calculateAverageAUC = calculate_average_auc

@@ -131,6 +131,15 @@ class LayerImpl:
         return sum(int(v.size) for v in jax.tree_util.tree_leaves(params))
 
 
+def acc_dtype(compute_dtype):
+    """MXU accumulation dtype for dots/convs: f32 when computing in a
+    sub-32-bit dtype (bf16/f16 → f32 accumulation on the MXU), otherwise the
+    compute dtype itself — forcing f32 accumulation under f64 compute would
+    silently truncate, breaking the f64 gradient-check path."""
+    cd = jnp.dtype(compute_dtype)
+    return jnp.dtype(jnp.float32) if cd.itemsize < 4 else cd
+
+
 def _is_bias_key(k: str) -> bool:
     return k == "b" or k.endswith("_b") or k in ("beta",)
 

@@ -11,14 +11,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import LayerImpl, NoParamLayerImpl, implements
+from .base import LayerImpl, NoParamLayerImpl, implements, acc_dtype
 
 
 def _dot(x, w, compute_dtype):
-    # accumulate in f32 on the MXU regardless of compute dtype
+    # low-precision compute accumulates in f32 on the MXU (see acc_dtype)
     return jax.lax.dot_general(x.astype(compute_dtype), w.astype(compute_dtype),
                                (((x.ndim - 1,), (0,)), ((), ())),
-                               preferred_element_type=jnp.float32)
+                               preferred_element_type=acc_dtype(compute_dtype))
 
 
 @implements("DenseLayer")

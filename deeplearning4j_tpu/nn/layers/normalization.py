@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .base import LayerImpl, implements
+from .base import LayerImpl, implements, acc_dtype
 
 
 @implements("BatchNormalization")
@@ -27,16 +27,18 @@ class BatchNormImpl(LayerImpl):
         if not c.lock_gamma_beta:
             params["gamma"] = jnp.full((n,), c.gamma, self.dtype)
             params["beta"] = jnp.full((n,), c.beta, self.dtype)
-        state = {"mean": jnp.zeros((n,), jnp.float32),
-                 "var": jnp.ones((n,), jnp.float32)}
+        sd = acc_dtype(self.compute_dtype)  # stats precision
+        state = {"mean": jnp.zeros((n,), sd),
+                 "var": jnp.ones((n,), sd)}
         return params, state
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         c = self.conf
+        sd = acc_dtype(self.compute_dtype)
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
         if train:
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            mean = jnp.mean(x.astype(sd), axis=axes)
+            var = jnp.var(x.astype(sd), axis=axes)
             new_state = {
                 "mean": c.decay * state["mean"] + (1 - c.decay) * mean,
                 "var": c.decay * state["var"] + (1 - c.decay) * var,

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .base import LayerImpl, implements, impl_for
+from .base import LayerImpl, implements, impl_for, acc_dtype
 from ..activations import get_activation
 
 
@@ -34,8 +34,9 @@ class _BaseLSTMImpl(LayerImpl):
     def init_stream_state(self, batch):
         """Zero (h, c) carry for rnnTimeStep / TBPTT streaming."""
         H = self.conf.n_out
-        return (jnp.zeros((batch, H), jnp.float32),
-                jnp.zeros((batch, H), jnp.float32))
+        ad = acc_dtype(self.compute_dtype)
+        return (jnp.zeros((batch, H), ad),
+                jnp.zeros((batch, H), ad))
 
     def init(self, rng):
         c = self.conf
@@ -65,18 +66,19 @@ class _BaseLSTMImpl(LayerImpl):
         if reverse:
             x = jnp.flip(x, axis=1)
             mask = None if mask is None else jnp.flip(mask, axis=1)
+        ad = acc_dtype(self.compute_dtype)
         # hoisted input projection: [b*T, nIn] @ [nIn, 4H] on the MXU
         xp = (x.reshape(b * T, -1).astype(self.compute_dtype)
-              @ params["W"].astype(self.compute_dtype)).astype(jnp.float32)
-        xp = xp.reshape(b, T, 4 * H) + params["b"].astype(jnp.float32)
+              @ params["W"].astype(self.compute_dtype)).astype(ad)
+        xp = xp.reshape(b, T, 4 * H) + params["b"].astype(ad)
         if h0c0 is None:
-            h0 = jnp.zeros((b, H), jnp.float32)
-            c0 = jnp.zeros((b, H), jnp.float32)
+            h0 = jnp.zeros((b, H), ad)
+            c0 = jnp.zeros((b, H), ad)
         else:
             h0, c0 = h0c0
         peep = ((params["pi"], params["pf"], params["po"])
                 if self.peepholes else None)
-        rw = params["RW"].astype(jnp.float32)
+        rw = params["RW"].astype(ad)
 
         def step(carry, inp):
             h, cc = carry
@@ -170,16 +172,18 @@ class SimpleRnnImpl(LayerImpl):
         return params, {}
 
     def init_stream_state(self, batch):
-        return jnp.zeros((batch, self.conf.n_out), jnp.float32)
+        return jnp.zeros((batch, self.conf.n_out),
+                         acc_dtype(self.compute_dtype))
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
         b, T, _ = x.shape
         H = self.conf.n_out
+        ad = acc_dtype(self.compute_dtype)
         xp = (x.reshape(b * T, -1).astype(self.compute_dtype)
-              @ params["W"].astype(self.compute_dtype)).astype(jnp.float32)
-        xp = xp.reshape(b, T, H) + params["b"].astype(jnp.float32)
-        rw = params["RW"].astype(jnp.float32)
+              @ params["W"].astype(self.compute_dtype)).astype(ad)
+        xp = xp.reshape(b, T, H) + params["b"].astype(ad)
+        rw = params["RW"].astype(ad)
         act = self.activation
 
         def step(h, inp):
@@ -195,7 +199,7 @@ class SimpleRnnImpl(LayerImpl):
         if ctx is not None and idx is not None:
             h0 = ctx.get("rnn_state_in", {}).get(idx)
         if h0 is None:
-            h0 = jnp.zeros((b, H), jnp.float32)
+            h0 = jnp.zeros((b, H), ad)
         xs = jnp.swapaxes(xp, 0, 1)
         if mask is not None:
             ms = jnp.swapaxes(mask, 0, 1)

@@ -1,0 +1,263 @@
+"""Transfer learning: fine-tune, freeze, and surgically edit trained networks.
+
+TPU-native equivalent of reference ``nn/transferlearning/`` (3 files;
+``TransferLearning.Builder``: ``fineTuneConfiguration`` :73,
+``setFeatureExtractor`` :84, ``nOutReplace`` :98, add/remove layers;
+``TransferLearningHelper`` featurization). Params of retained layers are carried
+over; edited layers are re-initialized; frozen layers are wrapped in
+``FrozenLayer`` (gradient stop), exactly the reference's freezing mechanism
+translated to ``jax.lax.stop_gradient``.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .conf import GlobalConfig, MultiLayerConfiguration
+from .conf.layers import FeedForwardLayer, FrozenLayer, Layer
+from .multilayer import MultiLayerNetwork
+from ..datasets.dataset import DataSet
+
+_tm = jax.tree_util.tree_map
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global-config overrides applied during transfer (reference
+    ``FineTuneConfiguration.java``). Only non-None fields are applied."""
+    seed: Optional[int] = None
+    updater: Optional[Any] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    class Builder:
+        def __init__(self):
+            self._c = FineTuneConfiguration()
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+
+            def setter(v):
+                if not hasattr(self._c, name):
+                    raise AttributeError(f"FineTuneConfiguration has no field "
+                                         f"'{name}'")
+                setattr(self._c, name, v)
+                return self
+            return setter
+
+        def build(self):
+            return self._c
+
+    @staticmethod
+    def builder():
+        return FineTuneConfiguration.Builder()
+
+    def apply_to(self, gc: GlobalConfig) -> GlobalConfig:
+        gc = copy.deepcopy(gc)
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None:
+                setattr(gc, f.name, v)
+        return gc
+
+
+class TransferLearning:
+    """Namespace mirroring the reference's ``TransferLearning.Builder``."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen_till = -1
+            self._n_out_replace: Dict[int, tuple] = {}
+            self._remove_from: Optional[int] = None
+            self._added: List[Layer] = []
+            self._input_type = net.conf.input_type
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference ``setFeatureExtractor``)."""
+            self._frozen_till = int(layer_idx)
+            return self
+
+        setFeatureExtractor = set_feature_extractor
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            self._n_out_replace[int(layer_idx)] = (int(n_out), weight_init)
+            return self
+
+        nOutReplace = n_out_replace
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        removeOutputLayer = remove_output_layer
+
+        def remove_layers_from_output(self, n: int):
+            total = len(self._net.conf.layers)
+            self._remove_from = total - int(n)
+            return self
+
+        removeLayersFromOutput = remove_layers_from_output
+
+        def add_layer(self, layer: Layer):
+            self._added.append(layer)
+            return self
+
+        addLayer = add_layer
+
+        def set_input_type(self, it):
+            self._input_type = it
+            return self
+
+        setInputType = set_input_type
+
+        # --------------------------------------------------------------
+        def build(self) -> MultiLayerNetwork:
+            old_conf = self._net.conf
+            gc = old_conf.global_conf
+            if self._fine_tune is not None:
+                gc = self._fine_tune.apply_to(gc)
+
+            layers = [copy.deepcopy(l) for l in old_conf.layers]
+            keep = len(layers) if self._remove_from is None else self._remove_from
+            layers = layers[:keep]
+            reinit = set()  # indices whose params must be re-initialized
+
+            for idx, (n_out, w_init) in sorted(self._n_out_replace.items()):
+                lc = layers[idx]
+                inner = getattr(lc, "inner", None) or lc
+                if not isinstance(inner, FeedForwardLayer):
+                    raise ValueError(f"nOutReplace on layer {idx} "
+                                     f"({type(inner).__name__}): not a "
+                                     f"FeedForwardLayer")
+                inner.n_out = n_out
+                if w_init is not None:
+                    inner.weight_init = w_init
+                reinit.add(idx)
+                # next layer's nIn changes → must also re-init (reference
+                # nOutReplace cascades to the following layer)
+                if idx + 1 < len(layers):
+                    nxt = getattr(layers[idx + 1], "inner", None) or layers[idx + 1]
+                    if isinstance(nxt, FeedForwardLayer):
+                        nxt.n_in = n_out
+                        reinit.add(idx + 1)
+
+            n_old = len(layers)
+            layers.extend(copy.deepcopy(l) for l in self._added)
+            reinit.update(range(n_old, len(layers)))
+
+            # freeze [0..frozen_till]
+            if self._frozen_till >= 0:
+                for i in range(min(self._frozen_till + 1, len(layers))):
+                    if not isinstance(layers[i], FrozenLayer):
+                        layers[i] = FrozenLayer(inner=layers[i])
+
+            preprocs = {k: v for k, v in old_conf.input_preprocessors.items()
+                        if int(k) < len(layers)}
+            new_conf = MultiLayerConfiguration(
+                global_conf=gc, layers=layers,
+                input_preprocessors=preprocs,
+                input_type=self._input_type,
+                backprop=old_conf.backprop, pretrain=False,
+                backprop_type=old_conf.backprop_type,
+                tbptt_fwd_length=old_conf.tbptt_fwd_length,
+                tbptt_back_length=old_conf.tbptt_back_length)
+            # re-run shape inference for appended layers
+            if self._input_type is not None:
+                it = self._input_type
+                for i, lc in enumerate(layers):
+                    pre = new_conf.preprocessor(i)
+                    if pre is None:
+                        p = lc.preprocessor_for(it)
+                        if p is not None:
+                            new_conf.input_preprocessors[str(i)] = p
+                            pre = p
+                    if pre is not None:
+                        it = pre.get_output_type(it)
+                    lc.set_n_in(it, override=False)
+                    it = lc.get_output_type(i, it)
+
+            new_net = MultiLayerNetwork(new_conf).init()
+            # carry over params of retained, unedited layers
+            for i in range(len(layers)):
+                if i < len(old_conf.layers) and i not in reinit:
+                    old_p = self._net.params.get(str(i))
+                    if old_p:
+                        new_net.params[str(i)] = _tm(lambda x: x, old_p)
+                    old_s = self._net.states.get(str(i))
+                    if old_s:
+                        new_net.states[str(i)] = _tm(lambda x: x, old_s)
+            new_net.updater_state = new_net.updater.init_state(new_net.params)
+            return new_net
+
+    GraphBuilder = None  # ComputationGraph transfer: see graph_transfer below
+
+
+class TransferLearningHelper:
+    """Featurize once through the frozen block, then train only the unfrozen
+    tail (reference ``TransferLearningHelper.java``)."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_till: int):
+        self.orig = net
+        self.frozen_till = int(frozen_till)
+        # build the unfrozen tail as its own network
+        conf = net.conf
+        tail_layers = [copy.deepcopy(l) for l in conf.layers[frozen_till + 1:]]
+        preprocs = {}
+        for k, v in conf.input_preprocessors.items():
+            idx = int(k) - (frozen_till + 1)
+            if idx >= 0:
+                preprocs[str(idx)] = v
+        tail_conf = MultiLayerConfiguration(
+            global_conf=conf.global_conf, layers=tail_layers,
+            input_preprocessors=preprocs, input_type=None,
+            backprop=conf.backprop, pretrain=False,
+            backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length)
+        self.tail = MultiLayerNetwork(tail_conf).init()
+        for i in range(len(tail_layers)):
+            src = str(i + frozen_till + 1)
+            if net.params.get(src):
+                self.tail.params[str(i)] = _tm(lambda x: x, net.params[src])
+            if net.states.get(src):
+                self.tail.states[str(i)] = _tm(lambda x: x, net.states[src])
+        self.tail.updater_state = self.tail.updater.init_state(self.tail.params)
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        import numpy as np
+        acts = self.orig.feed_forward_to_layer(self.frozen_till, ds.features)
+        return DataSet(np.asarray(acts), ds.labels,
+                       features_mask=ds.features_mask, labels_mask=ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet):
+        self.tail.fit(ds)
+        return self
+
+    fitFeaturized = fit_featurized
+
+    def output_from_featurized(self, features):
+        return self.tail.output(features)
+
+    outputFromFeaturized = output_from_featurized
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self.tail
+
+    unfrozenMLN = unfrozen_mln

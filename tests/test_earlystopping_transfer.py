@@ -1,0 +1,186 @@
+"""Early stopping + transfer learning tests (reference
+``earlystopping/trainer/BaseEarlyStoppingTrainer.java:76`` loop and
+``nn/transferlearning/TransferLearning.java`` builder semantics)."""
+import numpy as np
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                Adam, Sgd, DataSet, ListDataSetIterator,
+                                TransferLearning, FineTuneConfiguration,
+                                TransferLearningHelper)
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, DataSetLossCalculator,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition, InMemoryModelSaver, LocalFileModelSaver,
+    TerminationReason)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer, FrozenLayer
+from deeplearning4j_tpu.nn.losses import LossFunction
+
+
+def _net(seed=7, lr=1e-2):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=lr)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(DenseLayer(n_out=8, n_in=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iter(n=32, seed=0, batch=16):
+    rng = np.random.default_rng(seed)
+    f = rng.normal(size=(n, 4)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ListDataSetIterator([DataSet(f, l)], batch_size=batch)
+
+
+# ------------------------------------------------------------- early stopping
+def test_early_stopping_max_epochs():
+    net = _net()
+    es = (EarlyStoppingConfiguration.builder()
+          .score_calculator(DataSetLossCalculator(_iter(seed=99)))
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+          .model_saver(InMemoryModelSaver())
+          .build())
+    result = EarlyStoppingTrainer(es, net, _iter()).fit()
+    assert result.termination_reason == TerminationReason.EpochTerminationCondition
+    assert result.total_epochs == 3
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 3
+
+
+def test_early_stopping_score_improvement_patience():
+    net = _net(lr=0.0)  # lr=0 → no improvement ever
+    es = (EarlyStoppingConfiguration.builder()
+          .score_calculator(DataSetLossCalculator(_iter(seed=99)))
+          .epoch_termination_conditions(
+              ScoreImprovementEpochTerminationCondition(patience=2),
+              MaxEpochsTerminationCondition(50))
+          .build())
+    result = EarlyStoppingTrainer(es, net, _iter()).fit()
+    assert result.termination_reason == TerminationReason.EpochTerminationCondition
+    assert result.total_epochs <= 5  # best at 0, patience 2 → stops well before 50
+
+
+def test_early_stopping_divergence_guard():
+    net = _net()
+    es = (EarlyStoppingConfiguration.builder()
+          .score_calculator(DataSetLossCalculator(_iter(seed=99)))
+          .iteration_termination_conditions(
+              MaxScoreIterationTerminationCondition(1e-12))
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+          .build())
+    result = EarlyStoppingTrainer(es, net, _iter()).fit()
+    assert result.termination_reason == TerminationReason.IterationTerminationCondition
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    net = _net()
+    es = (EarlyStoppingConfiguration.builder()
+          .score_calculator(DataSetLossCalculator(_iter(seed=99)))
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+          .model_saver(LocalFileModelSaver(str(tmp_path)))
+          .build())
+    result = EarlyStoppingTrainer(es, net, _iter()).fit()
+    best = result.best_model
+    assert best is not None
+    x = np.random.default_rng(1).normal(size=(4, 4)).astype(np.float32)
+    assert np.asarray(best.output(x)).shape == (4, 3)
+
+
+# ----------------------------------------------------------- transfer learning
+def test_transfer_freeze_keeps_frozen_params():
+    net = _net()
+    net.fit(next(iter(_iter())))
+    tl = (TransferLearning.Builder(net)
+          .fine_tune_configuration(FineTuneConfiguration.builder()
+                                   .updater(Sgd(learning_rate=0.5)).build())
+          .set_feature_extractor(0)
+          .build())
+    assert isinstance(tl.conf.layers[0], FrozenLayer)
+    w0_before = np.asarray(tl.params["0"]["W"]).copy()
+    w1_before = np.asarray(tl.params["1"]["W"]).copy()
+    tl.fit(next(iter(_iter(seed=5))))
+    np.testing.assert_array_equal(w0_before, np.asarray(tl.params["0"]["W"]))
+    assert not np.allclose(w1_before, np.asarray(tl.params["1"]["W"]))
+
+
+def test_transfer_nout_replace_cascades():
+    net = _net()
+    tl = (TransferLearning.Builder(net)
+          .n_out_replace(1, 12)
+          .build())
+    inner1 = tl.conf.layers[1]
+    assert inner1.n_out == 12
+    assert tl.conf.layers[2].n_in == 12
+    assert np.asarray(tl.params["1"]["W"]).shape == (8, 12)
+    assert np.asarray(tl.params["2"]["W"]).shape == (12, 3)
+    # layer 0 params carried over from the original net
+    np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]),
+                                  np.asarray(tl.params["0"]["W"]))
+    tl.fit(next(iter(_iter())))  # trains fine after surgery
+
+
+def test_transfer_remove_and_add_layers():
+    net = _net()
+    tl = (TransferLearning.Builder(net)
+          .remove_output_layer()
+          .add_layer(OutputLayer(n_in=8, n_out=5, activation="softmax",
+                                 loss=LossFunction.MCXENT))
+          .build())
+    assert len(tl.conf.layers) == 3
+    x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+    assert np.asarray(tl.output(x)).shape == (4, 5)
+
+
+def test_transfer_helper_featurize():
+    net = _net()
+    helper = TransferLearningHelper(net, frozen_till=0)
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+    feat = helper.featurize(ds)
+    assert feat.features.shape == (8, 8)
+    helper.fit_featurized(feat)
+    out = helper.output_from_featurized(feat.features)
+    assert np.asarray(out).shape == (8, 3)
+    # featurized forward == full forward
+    full = np.asarray(net.feed_forward_to_layer(0, ds.features))
+    np.testing.assert_allclose(feat.features, full, rtol=1e-6)
+
+
+def test_score_improvement_maximized_metric():
+    # accuracy rising must NOT trigger patience (review finding)
+    cond = ScoreImprovementEpochTerminationCondition(patience=2)
+    cond.minimize = False
+    cond.initialize()
+    for epoch, acc in enumerate([0.5, 0.6, 0.7, 0.8, 0.9]):
+        assert not cond.terminate(epoch, acc)
+    # plateau for > patience epochs → terminate
+    assert not cond.terminate(5, 0.9)
+    assert cond.terminate(7, 0.9)
+
+
+def test_epoch_conditions_not_fed_training_loss(monkeypatch):
+    # with evaluate_every_n_epochs=2, score-based conditions must not see the
+    # raw training loss on off epochs (review finding)
+    from deeplearning4j_tpu.earlystopping import BestScoreEpochTerminationCondition
+    net = _net()
+    seen = []
+
+    class SpyCond(BestScoreEpochTerminationCondition):
+        def terminate(self, epoch, score):
+            seen.append((epoch, score))
+            return False
+
+    calc = DataSetLossCalculator(_iter(seed=99))
+    es = (EarlyStoppingConfiguration.builder()
+          .score_calculator(calc)
+          .epoch_termination_conditions(SpyCond(-1.0),
+                                        MaxEpochsTerminationCondition(4))
+          .evaluate_every_n_epochs(2)
+          .build())
+    EarlyStoppingTrainer(es, net, _iter()).fit()
+    # SpyCond only saw epochs 0 and 2 (the evaluated ones)
+    assert [e for e, _ in seen] == [0, 2]

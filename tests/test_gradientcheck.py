@@ -1,0 +1,106 @@
+"""Gradient checks — the test backbone (reference SURVEY.md §4 item 1,
+``GradientCheckUtil.java:112``): central difference vs analytic for every layer
+family, run in f64 on the CPU backend (the reference's double-precision rule)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                InputType, Sgd, DataSet)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                               ConvolutionLayer, SubsamplingLayer,
+                                               BatchNormalization, LSTM,
+                                               RnnOutputLayer, PoolingType)
+from deeplearning4j_tpu.nn.gradientcheck import (GradientCheckUtil,
+                                                 double_precision)
+from deeplearning4j_tpu.nn.losses import LossFunction
+
+
+def _f64_builder():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345).updater(Sgd(learning_rate=1.0))
+            .dtype("float64").compute_dtype("float64"))
+
+
+def _onehot(rng, n, c):
+    return np.eye(c)[rng.integers(0, c, n)].astype(np.float64)
+
+
+def test_dense_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh").l2(0.01)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=5))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(6, 4)), _onehot(rng, 6, 3))
+        assert GradientCheckUtil.check_gradients(net, ds, print_results=True)
+
+
+def test_cnn_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1)))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss=LossFunction.MCXENT))
+                .set_input_type(InputType.convolutional(6, 6, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        ds = DataSet(rng.normal(size=(4, 1, 6, 6)), _onehot(rng, 4, 2))
+        assert GradientCheckUtil.check_gradients(net, ds, max_per_param=20,
+                                                 print_results=True)
+
+
+def test_batchnorm_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6))
+                .layer(BatchNormalization(n_in=6, n_out=6))
+                .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                                   loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        ds = DataSet(rng.normal(size=(8, 4)), _onehot(rng, 8, 3))
+        assert GradientCheckUtil.check_gradients(net, ds, max_per_param=20,
+                                                 print_results=True)
+
+
+def test_lstm_gradients():
+    with double_precision():
+        conf = (_f64_builder()
+                .list()
+                .layer(LSTM(n_in=3, n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax",
+                                      loss=LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(3)
+        T = 4
+        f = rng.normal(size=(3, T, 3))
+        l = np.stack([_onehot(rng, T, 2) for _ in range(3)])
+        ds = DataSet(f, l)
+        assert GradientCheckUtil.check_gradients(net, ds, max_per_param=15,
+                                                 print_results=True)
+
+
+def test_f32_net_rejected():
+    conf = (NeuralNetConfiguration.builder().updater(Sgd(learning_rate=1.0))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=5, activation="tanh"))
+            .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(6, 4)).astype(np.float32),
+                 _onehot(rng, 6, 3).astype(np.float32))
+    with pytest.raises(ValueError, match="float64"):
+        GradientCheckUtil.check_gradients(net, ds)

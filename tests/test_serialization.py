@@ -1,0 +1,125 @@
+"""ModelSerializer round-trip tests (reference test pattern: SURVEY.md §4 item 3
+serialization regression tests; format from ``util/ModelSerializer.java:37-41``)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                ComputationGraph, InputType, Adam, DataSet,
+                                ModelSerializer, NormalizerStandardize)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                               ConvolutionLayer, SubsamplingLayer,
+                                               PoolingType)
+from deeplearning4j_tpu.nn.losses import LossFunction
+
+
+def _mln():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=1e-3)).activation("relu")
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ds(n=16, nin=8, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    f = rng.normal(size=(n, nin)).astype(np.float32)
+    l = np.eye(nout, dtype=np.float32)[rng.integers(0, nout, n)]
+    return DataSet(f, l)
+
+
+def test_mln_roundtrip_exact_resume(tmp_path):
+    net = _mln()
+    ds = _ds()
+    net.fit(ds)  # builds updater state (Adam moments)
+    path = str(tmp_path / "model.bin")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore_multi_layer_network(path)
+
+    # params identical
+    for k in net.params:
+        for p in net.params[k]:
+            np.testing.assert_array_equal(np.asarray(net.params[k][p]),
+                                          np.asarray(restored.params[k][p]))
+    assert restored.iteration_count == net.iteration_count
+
+    # exact resume: one more step on each must produce identical params
+    ds2 = _ds(seed=1)
+    net.fit(ds2)
+    restored.fit(ds2)
+    for k in net.params:
+        for p in net.params[k]:
+            np.testing.assert_allclose(np.asarray(net.params[k][p]),
+                                       np.asarray(restored.params[k][p]),
+                                       rtol=1e-6)
+
+
+def test_mln_outputs_match_after_restore(tmp_path):
+    net = _mln()
+    ds = _ds()
+    net.fit(ds)
+    path = str(tmp_path / "model.bin")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore_model(path)
+    x = _ds(seed=3).features
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(restored.output(x)), rtol=1e-6)
+
+
+def test_cg_roundtrip(tmp_path):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).updater(Adam(learning_rate=1e-3))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=8, n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    ds = _ds()
+    net.fit(ds)
+    path = str(tmp_path / "cg.bin")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore_computation_graph(path)
+    x = _ds(seed=4).features
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(restored.output(x)), rtol=1e-6)
+
+
+def test_wrong_type_raises(tmp_path):
+    net = _mln()
+    path = str(tmp_path / "model.bin")
+    ModelSerializer.write_model(net, path)
+    with pytest.raises(ValueError):
+        ModelSerializer.restore_computation_graph(path)
+
+
+def test_normalizer_roundtrip(tmp_path):
+    net = _mln()
+    ds = _ds()
+    norm = NormalizerStandardize().fit(ds)
+    path = str(tmp_path / "model.bin")
+    ModelSerializer.write_model(net, path, normalizer=norm)
+    restored_norm = ModelSerializer.restore_normalizer(path)
+    np.testing.assert_allclose(norm.mean, restored_norm.mean)
+    np.testing.assert_allclose(norm.std, restored_norm.std)
+    ds2 = _ds(seed=9)
+    a = norm._apply(ds2.features.copy())
+    b = restored_norm._apply(ds2.features.copy())
+    np.testing.assert_allclose(a, b)
+
+
+def test_normalizer_time_series_per_feature():
+    # stats are per feature, independent of sequence length (review finding)
+    rng = np.random.default_rng(0)
+    f10 = rng.normal(loc=3.0, size=(32, 10, 8)).astype(np.float32)
+    norm = NormalizerStandardize().fit(DataSet(f10, None))
+    assert norm.mean.shape == (8,)
+    f5 = rng.normal(size=(32, 5, 8)).astype(np.float32)  # different seq length
+    out = norm._apply(f5)
+    assert out.shape == f5.shape
+    # round trip
+    np.testing.assert_allclose(norm._invert(out), f5, rtol=1e-4, atol=1e-4)
