@@ -1,0 +1,31 @@
+"""Parallelism & distribution (reference ``deeplearning4j-scaleout/``,
+SURVEY.md §2.4): mesh/sharding substrate, ParallelWrapper (sync + local-SGD
+data parallelism), ParallelInference, gradient accumulation/encoding,
+TrainingMaster SPI with the collective masters, plus TPU-first extensions —
+tensor parallelism and ring/Ulysses sequence parallelism."""
+from .sharding import (DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS, make_mesh,
+                       replicated, batch_sharded, shard_batch,
+                       data_parallel_step)
+from .wrapper import ParallelWrapper, TrainingMode
+from .inference import ParallelInference, InferenceMode
+from .accumulation import (GradientsAccumulator, EncodedGradientsAccumulator,
+                           EncodingHandler, threshold_encode, threshold_decode)
+from .distributed import (TrainingMaster, ParameterAveragingTrainingMaster,
+                          SharedTrainingMaster, DistributedMultiLayerNetwork,
+                          DistributedComputationGraph, SparkDl4jMultiLayer,
+                          SparkComputationGraph, initialize_distributed)
+from .sequence import ring_attention, ulysses_attention, full_attention
+from .tensor import megatron_rules, tensor_parallel_step, param_shardings
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SEQUENCE_AXIS", "make_mesh", "replicated",
+    "batch_sharded", "shard_batch", "data_parallel_step",
+    "ParallelWrapper", "TrainingMode", "ParallelInference", "InferenceMode",
+    "GradientsAccumulator", "EncodedGradientsAccumulator", "EncodingHandler",
+    "threshold_encode", "threshold_decode",
+    "TrainingMaster", "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
+    "DistributedMultiLayerNetwork", "DistributedComputationGraph",
+    "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",
+    "ring_attention", "ulysses_attention", "full_attention",
+    "megatron_rules", "tensor_parallel_step", "param_shardings",
+]

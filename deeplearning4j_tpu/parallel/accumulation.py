@@ -1,0 +1,149 @@
+"""Gradient accumulation + Strom-style threshold encoding.
+
+TPU-native equivalent of reference ``optimize/solvers/accumulation/``
+(``EncodedGradientsAccumulator.java:33`` with ``EncodingHandler.java:136-178``:
+``Nd4j.getExecutioner().thresholdEncode/bitmapEncode``, adaptive threshold,
+residual kept in the accumulator).
+
+On-TPU the reference's quantized-update broadcast is unnecessary — gradient
+all-reduce rides ICI as one fused ``psum`` (SURVEY.md §2.4 "Distributed
+communication backend") — so inside a slice the accumulator is a no-op seam.
+The encoding survives for the **DCN / cross-slice** path, where bandwidth is
+the reference's 2017-Ethernet situation all over again: updates crossing slices
+can be threshold-encoded exactly like the reference's wire format. A native C++
+codec (ops/native) plugs in behind the same functions when built.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+
+
+def threshold_encode(grad: np.ndarray, threshold: float
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sparsify: indices where |g| >= threshold, values quantized to
+    ±threshold (the reference's 1-bit-per-significant-element scheme;
+    ``EncodingHandler.java:136``). Returns (int32 indices, int8 signs)."""
+    flat = grad.ravel()
+    idx = np.flatnonzero(np.abs(flat) >= threshold).astype(np.int32)
+    signs = np.sign(flat[idx]).astype(np.int8)
+    return idx, signs
+
+
+def threshold_decode(idx: np.ndarray, signs: np.ndarray, threshold: float,
+                     shape) -> np.ndarray:
+    """Densify an encoded update (reference ``thresholdDecode``)."""
+    out = np.zeros(int(np.prod(shape)), np.float32)
+    out[idx] = signs.astype(np.float32) * threshold
+    return out.reshape(shape)
+
+
+def encode_residual(grad: np.ndarray, threshold: float
+                    ) -> Tuple[Tuple[np.ndarray, np.ndarray], np.ndarray]:
+    """Encode and return the residual kept locally for the next round
+    (reference keeps sub-threshold mass in the accumulator)."""
+    idx, signs = threshold_encode(grad, threshold)
+    residual = grad.copy().ravel()
+    residual[idx] -= signs.astype(np.float32) * threshold
+    return (idx, signs), residual.reshape(grad.shape)
+
+
+class EncodingHandler:
+    """Adaptive threshold controller (reference ``EncodingHandler``): the
+    threshold shrinks when too little of the update is transmitted and grows
+    when the encoding gets dense, targeting ``target_sparsity``."""
+
+    def __init__(self, initial_threshold: float = 1e-3,
+                 min_threshold: float = 1e-5,
+                 target_sparsity: float = 1e-2,
+                 adaptation: float = 1.2):
+        self.threshold = float(initial_threshold)
+        self.min_threshold = float(min_threshold)
+        self.target_sparsity = float(target_sparsity)
+        self.adaptation = float(adaptation)
+        self.iterations = 0
+
+    def encode(self, grad: np.ndarray):
+        used = self.threshold  # adaptation applies to the NEXT round
+        (idx, signs), residual = encode_residual(grad, used)
+        density = len(idx) / max(grad.size, 1)
+        if density > 2 * self.target_sparsity:
+            self.threshold *= self.adaptation
+        elif density < 0.5 * self.target_sparsity:
+            self.threshold = max(self.threshold / self.adaptation,
+                                 self.min_threshold)
+        self.iterations += 1
+        return (idx, signs, used), residual
+
+
+class GradientsAccumulator:
+    """SPI seam (reference ``GradientsAccumulator``): receives local updates,
+    hands back the aggregate to apply. The base implementation is the ICI
+    identity (all-reduce happens inside the jitted step)."""
+
+    def store_update(self, grads):
+        return grads
+
+    storeUpdate = store_update
+
+    def apply_update(self, grads):
+        return grads
+
+    applyUpdate = apply_update
+
+    def reset(self):
+        pass
+
+
+class EncodedGradientsAccumulator(GradientsAccumulator):
+    """Host-side residual accumulator for updates that must cross DCN
+    (reference ``EncodedGradientsAccumulator``): each ``store_update`` call
+    threshold-encodes the gradient pytree per-leaf, keeps the residual, and
+    returns the decoded (quantized) update — what a peer slice would apply.
+    """
+
+    def __init__(self, initial_threshold: float = 1e-3, **handler_kw):
+        self._handlers: Dict[str, EncodingHandler] = {}
+        self._residual: Dict[str, np.ndarray] = {}
+        self._kw = dict(initial_threshold=initial_threshold, **handler_kw)
+        self.last_encoded = None  # {path: (idx, signs, threshold)} — wire form
+
+    def _handler(self, path) -> EncodingHandler:
+        if path not in self._handlers:
+            self._handlers[path] = EncodingHandler(**self._kw)
+        return self._handlers[path]
+
+    def store_update(self, grads):
+        leaves = jax.tree_util.tree_flatten_with_path(grads)[0]
+        encoded = {}
+        decoded = {}
+        for keypath, leaf in leaves:
+            path = jax.tree_util.keystr(keypath)
+            g = np.asarray(leaf, np.float32)
+            if path in self._residual:
+                g = g + self._residual[path]
+            (idx, signs, thr), residual = self._handler(path).encode(g)
+            self._residual[path] = residual
+            encoded[path] = (idx, signs, thr)
+            decoded[path] = threshold_decode(idx, signs, thr, g.shape)
+        self.last_encoded = encoded
+        # rebuild pytree with decoded leaves
+        flat_vals = [decoded[jax.tree_util.keystr(kp)] for kp, _ in leaves]
+        treedef = jax.tree_util.tree_structure(grads)
+        return jax.tree_util.tree_unflatten(treedef, flat_vals)
+
+    storeUpdate = store_update
+
+    def encoded_bytes(self) -> int:
+        """Wire size of the last encoding (index + sign bytes)."""
+        if not self.last_encoded:
+            return 0
+        return sum(idx.nbytes + signs.nbytes
+                   for idx, signs, _ in self.last_encoded.values())
+
+    def reset(self):
+        self._residual.clear()
+        self._handlers.clear()
+        self.last_encoded = None

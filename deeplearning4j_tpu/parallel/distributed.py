@@ -1,0 +1,200 @@
+"""Distributed training: TrainingMaster SPI + multi-host collective design.
+
+TPU-native equivalent of the reference's Spark layer (SURVEY.md §2.4):
+``TrainingMaster`` SPI (``spark/dl4j-spark/.../spark/api/TrainingMaster.java:28``),
+``ParameterAveragingTrainingMaster`` (sync DP, ``impl/paramavg/...:308``),
+``SharedTrainingMaster`` (async quantized gradient sharing over Aeron,
+``dl4j-spark-parameterserver/.../SharedTrainingMaster.java:55``) and the
+user-facing ``SparkDl4jMultiLayer`` facade (``impl/multilayer/...:214``).
+
+Architecture shift: the reference's control plane (driver serializes the model
+to executors each averaging round; Aeron UDP data plane for encoded updates)
+collapses into JAX's multi-controller SPMD model — every host runs the SAME
+program, ``jax.distributed.initialize`` forms the cluster, the global mesh
+spans hosts, and the gradient ``psum`` rides ICI within a slice and DCN across
+slices. There is no parameter broadcast step: compiled-once params live
+sharded/replicated on device. The TrainingMaster seam is retained so user code
+written against the reference's API maps 1:1.
+
+Multi-host bring-up (real cluster):
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    master = ParameterAveragingTrainingMaster(batch_size_per_worker=...,
+                                              averaging_frequency=1)
+    DistributedMultiLayerNetwork(net, master).fit(iterator)
+Single-process testing uses the same code on a virtual device mesh.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+import jax
+
+from .sharding import DATA_AXIS, make_mesh
+from .wrapper import ParallelWrapper, TrainingMode
+from .accumulation import EncodedGradientsAccumulator
+
+log = logging.getLogger(__name__)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None):
+    """Form the multi-host cluster (replaces the reference's
+    ``VoidParameterServer.init`` Aeron mesh handshake,
+    ``SharedTrainingMaster.java:469``). No-op when single-process."""
+    if coordinator_address is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+class TrainingMaster:
+    """SPI (reference ``TrainingMaster.java:28``): how distributed fitting is
+    executed. Implementations configure mesh + step strategy."""
+
+    def execute_training(self, net, iterator):
+        raise NotImplementedError
+
+    executeTraining = execute_training
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Sync DP (reference ``ParameterAveragingTrainingMaster``): averaging
+    every iteration == fused gradient all-reduce; ``averaging_frequency > 1``
+    == local SGD with periodic param+updater averaging. ``aggregation_depth``
+    (the reference's tree-aggregation knob) is obsolete — XLA picks the
+    reduction topology on ICI/DCN."""
+
+    class Builder:
+        def __init__(self, batch_size_per_worker: int = 32):
+            self._batch = batch_size_per_worker
+            self._freq = 1
+            self._workers = None
+
+        def averaging_frequency(self, n):
+            self._freq = int(n)
+            return self
+
+        averagingFrequency = averaging_frequency
+
+        def batch_size_per_worker(self, n):
+            self._batch = int(n)
+            return self
+
+        batchSizePerWorker = batch_size_per_worker
+
+        def workers(self, n):
+            self._workers = int(n)
+            return self
+
+        def build(self):
+            return ParameterAveragingTrainingMaster(
+                batch_size_per_worker=self._batch,
+                averaging_frequency=self._freq, workers=self._workers)
+
+    def __init__(self, batch_size_per_worker: int = 32,
+                 averaging_frequency: int = 1, workers: Optional[int] = None):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.averaging_frequency = averaging_frequency
+        self.workers = workers
+
+    def execute_training(self, net, iterator):
+        pw = (ParallelWrapper.Builder(net)
+              .workers(self.workers or len(jax.devices()))
+              .averaging_frequency(self.averaging_frequency)
+              .training_mode(TrainingMode.AVERAGING)
+              .build())
+        pw.fit(iterator)
+        return pw
+
+
+class SharedTrainingMaster(TrainingMaster):
+    """Async quantized-update sharing (reference ``SharedTrainingMaster``):
+    within a slice this degenerates to the same fused all-reduce (ICI makes
+    compression pointless — SURVEY.md §2.4 note); the threshold/accumulator
+    knobs are kept and drive the DCN codec when updates cross slices."""
+
+    class Builder:
+        def __init__(self, threshold: float = 1e-3):
+            self._threshold = threshold
+            self._batch = 32
+            self._workers = None
+
+        def threshold(self, t):
+            self._threshold = float(t)
+            return self
+
+        def batch_size_per_worker(self, n):
+            self._batch = int(n)
+            return self
+
+        batchSizePerWorker = batch_size_per_worker
+
+        def workers(self, n):
+            self._workers = int(n)
+            return self
+
+        def build(self):
+            return SharedTrainingMaster(threshold=self._threshold,
+                                        batch_size_per_worker=self._batch,
+                                        workers=self._workers)
+
+    def __init__(self, threshold: float = 1e-3,
+                 batch_size_per_worker: int = 32,
+                 workers: Optional[int] = None):
+        self.threshold = threshold
+        self.batch_size_per_worker = batch_size_per_worker
+        self.workers = workers
+        self.accumulator = EncodedGradientsAccumulator(
+            initial_threshold=threshold)
+
+    def execute_training(self, net, iterator):
+        pw = (ParallelWrapper.Builder(net)
+              .workers(self.workers or len(jax.devices()))
+              .training_mode(TrainingMode.SHARED_GRADIENTS)
+              .gradients_accumulator(self.accumulator)
+              .build())
+        pw.fit(iterator)
+        return pw
+
+
+class DistributedMultiLayerNetwork:
+    """User-facing facade (reference ``SparkDl4jMultiLayer``:
+    ``fit(JavaRDD<DataSet>)`` :214 → ``trainingMaster.executeTraining``)."""
+
+    def __init__(self, net, training_master: TrainingMaster):
+        self.net = net
+        self.training_master = training_master
+
+    def fit(self, iterator, epochs: int = 1):
+        for _ in range(epochs):
+            self.training_master.execute_training(self.net, iterator)
+        return self.net
+
+    def evaluate(self, iterator):
+        return self.net.evaluate(iterator)
+
+    def calculate_score(self, iterator, average: bool = True):
+        """Reference ``calculateScore`` :332."""
+        total, n = 0.0, 0
+        for ds in iterator:
+            b = ds.num_examples()
+            total += self.net.score(ds) * b
+            n += b
+        return total / n if (average and n) else total
+
+    calculateScore = calculate_score
+
+
+SparkDl4jMultiLayer = DistributedMultiLayerNetwork  # reference-name alias
+
+
+class DistributedComputationGraph(DistributedMultiLayerNetwork):
+    """Reference ``SparkComputationGraph`` counterpart."""
+
+
+SparkComputationGraph = DistributedComputationGraph

@@ -1,0 +1,169 @@
+"""ParallelInference: multi-device inference serving.
+
+TPU-native equivalent of reference ``ParallelInference.java:32``
+(``InferenceMode.SEQUENTIAL/BATCHED`` ``inference/InferenceMode.java:7-8``,
+``observers/BatchedInferenceObservable.java``): instead of per-device model
+replicas fed by observer threads, ONE jitted forward with the batch dim sharded
+over the mesh serves every device; BATCHED mode keeps the reference's
+accumulate-then-flush behavior for many small concurrent requests.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .sharding import DATA_AXIS, make_mesh, replicated, batch_sharded
+
+class InferenceMode:
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+    INPLACE = "inplace"
+
+
+class ParallelInference:
+    class Builder:
+        def __init__(self, net):
+            self._net = net
+            self._mode = InferenceMode.BATCHED
+            self._batch_limit = 64
+            self._queue_limit = 64
+            self._workers = None
+
+        def inference_mode(self, mode):
+            self._mode = mode
+            return self
+
+        inferenceMode = inference_mode
+
+        def batch_limit(self, n):
+            self._batch_limit = int(n)
+            return self
+
+        batchLimit = batch_limit
+
+        def queue_limit(self, n):
+            self._queue_limit = int(n)
+            return self
+
+        queueLimit = queue_limit
+
+        def workers(self, n):
+            self._workers = int(n)
+            return self
+
+        def build(self):
+            return ParallelInference(self._net, mode=self._mode,
+                                     batch_limit=self._batch_limit,
+                                     queue_limit=self._queue_limit,
+                                     workers=self._workers)
+
+    def __init__(self, net, mode: str = InferenceMode.BATCHED,
+                 batch_limit: int = 64, queue_limit: int = 64,
+                 workers: Optional[int] = None, mesh=None,
+                 flush_after_ms: float = 10.0):
+        self.net = net
+        devices = jax.devices()
+        if workers is not None and workers < len(devices):
+            devices = devices[:workers]
+        self.mesh = mesh if mesh is not None else make_mesh(devices,
+                                                            axes=(DATA_AXIS,))
+        self.n_devices = int(np.prod(self.mesh.devices.shape))
+        self.mode = mode
+        self.batch_limit = batch_limit
+        self.queue_limit = queue_limit
+        self.flush_after_ms = float(flush_after_ms)
+        self._jit_fwd = None
+        self._lock = threading.Lock()
+        self._pending: List = []  # (features, future)
+        self._flush_timer = None
+
+    # ------------------------------------------------------------------
+    def _forward(self, x):
+        """Sharded forward: pad the batch to a device multiple, run one SPMD
+        forward, strip padding."""
+        net = self.net
+        if self._jit_fwd is None:
+            def fwd(params, states, f):
+                f = net._adapt_input(f)
+                y, _, _ = net._apply_layers(params, states, f, None, False, None)
+                return y
+            repl = replicated(self.mesh)
+            data = batch_sharded(self.mesh)
+            self._jit_fwd = jax.jit(fwd, in_shardings=(repl, repl, data),
+                                    out_shardings=data)
+            net.params = jax.device_put(net.params, repl)
+            net.states = jax.device_put(net.states, repl)
+        b = x.shape[0]
+        pad = (-b) % self.n_devices
+        if pad:
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+        xs = jax.device_put(jnp.asarray(x), batch_sharded(self.mesh))
+        y = np.asarray(self._jit_fwd(self.net.params, self.net.states, xs))
+        return y[:b]
+
+    def output(self, x):
+        """Synchronous inference (reference ``output``). SEQUENTIAL mode runs
+        the request immediately; BATCHED coalesces concurrent ``submit``s —
+        a direct ``output`` call always flushes."""
+        x = np.asarray(x, np.float32)
+        if self.mode == InferenceMode.BATCHED:
+            self.flush()
+        return self._forward(x)
+
+    # ----------------------------------------------------- async batched path
+    def submit(self, x) -> Future:
+        """Queue a request; BATCHED mode flushes when ``batch_limit`` examples
+        accumulate, or after ``flush_after_ms`` so a lone partial batch never
+        starves (reference BatchedInferenceObservable drains whatever is
+        queued)."""
+        x = np.asarray(x, np.float32)
+        fut: Future = Future()
+        with self._lock:
+            self._pending.append((x, fut))
+            total = sum(arr.shape[0] for arr, _ in self._pending)
+            if (self.mode != InferenceMode.BATCHED
+                    or total >= self.batch_limit
+                    or len(self._pending) >= self.queue_limit):
+                pending, self._pending = self._pending, []
+                self._cancel_timer_locked()
+            else:
+                pending = None
+                if self._flush_timer is None:
+                    self._flush_timer = threading.Timer(
+                        self.flush_after_ms / 1e3, self.flush)
+                    self._flush_timer.daemon = True
+                    self._flush_timer.start()
+        if pending:
+            self._run_batch(pending)
+        return fut
+
+    def _cancel_timer_locked(self):
+        if self._flush_timer is not None:
+            self._flush_timer.cancel()
+            self._flush_timer = None
+
+    def flush(self):
+        with self._lock:
+            pending, self._pending = self._pending, []
+            self._cancel_timer_locked()
+        if pending:
+            self._run_batch(pending)
+
+    def _run_batch(self, pending):
+        xs = np.concatenate([p for p, _ in pending], axis=0)
+        try:
+            ys = self._forward(xs)
+            pos = 0
+            for x, fut in pending:
+                n = x.shape[0]
+                fut.set_result(ys[pos:pos + n])
+                pos += n
+        except Exception as e:
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(e)

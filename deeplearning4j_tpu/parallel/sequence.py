@@ -1,0 +1,135 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Net-new capability vs the 0.9.x reference (SURVEY.md §5: "Long-context /
+sequence parallelism: absent" — the reference handles long sequences only
+temporally via TBPTT), made first-class here because long-context training is
+a core requirement of the TPU build.
+
+Two standard schemes over the mesh ``sequence`` axis:
+ - :func:`ring_attention` — blockwise attention with online (flash-style)
+   softmax; K/V blocks rotate around the ring via ``ppermute`` so every device
+   sees every key block while holding only its own sequence shard. Memory per
+   device is O(T/n), comm rides neighbor links (ICI-friendly).
+ - :func:`ulysses_attention` — all-to-all swaps sequence sharding for head
+   sharding, runs dense local attention on full sequences for h/n heads, then
+   swaps back. Fewer round-trips when head count ≥ devices.
+
+Both are exact (same math as full attention, up to fp reassociation).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .sharding import SEQUENCE_AXIS, pvary
+
+_NEG = -1e30
+
+
+def _ring_inner(q, k, v, axis: str, causal: bool, scale: float):
+    """Per-device body. q,k,v: [b, Tl, h, d] local shards."""
+    n = lax.psum(1, axis)
+    p = lax.axis_index(axis)
+    b, Tl, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    # accumulators are device-varying state (shard_map vma typing)
+    m = pvary(jnp.full((b, h, Tl), _NEG, jnp.float32), (axis,))
+    l = pvary(jnp.zeros((b, h, Tl), jnp.float32), (axis,))
+    acc = pvary(jnp.zeros((b, Tl, h, d), jnp.float32), (axis,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    iota_q = jnp.arange(Tl)
+
+    def body(i, carry):
+        m, l, acc, k, v = carry
+        blk = (p - i) % n  # which global block this device currently holds
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32)) * scale
+        if causal:
+            q_idx = p * Tl + iota_q               # global query positions
+            k_idx = blk * Tl + iota_q             # global key positions
+            mask = q_idx[:, None] >= k_idx[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new[..., None])
+        l = l * corr + pexp.sum(axis=-1)
+        acc = (acc * jnp.transpose(corr, (0, 2, 1))[..., None]
+               + jnp.einsum("bhqk,bkhd->bqhd", pexp, v.astype(jnp.float32)))
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return m_new, l, acc, k, v
+
+    m, l, acc, k, v = lax.fori_loop(0, n, body, (m, l, acc, k, v))
+    out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                   causal: bool = False):
+    """Exact attention with the sequence dim sharded over ``axis``.
+
+    q, k, v: [b, T, h, d] global arrays (T divisible by the axis size).
+    Returns [b, T, h, d] with the same sharding.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+    spec = P(None, axis, None, None)
+    fn = shard_map(partial(_ring_inner, axis=axis, causal=causal, scale=scale),
+                   mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_inner(q, k, v, axis: str, causal: bool, scale: float):
+    """All-to-all: [b, Tl, h, d] → [b, T, h/n, d] → local dense attention →
+    back. Head count must be divisible by the axis size."""
+
+    def seq_to_heads(x):
+        # split heads across devices, gather full sequence
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    if causal:
+        T = s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                      causal: bool = False):
+    """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
+    q, k, v: [b, T, h, d]; h divisible by the axis size."""
+    d = q.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+    spec = P(None, axis, None, None)
+    fn = shard_map(partial(_ulysses_inner, axis=axis, causal=causal,
+                           scale=scale),
+                   mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Single-device reference (testing oracle)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / float(d) ** 0.5
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

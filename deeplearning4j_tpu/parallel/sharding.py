@@ -78,3 +78,12 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):
     out_sh = (repl, repl, repl, repl)
     return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                    donate_argnums=(0, 2) if donate else ())
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` as device-varying over ``axis_names`` inside shard_map
+    (vma typing). Wraps ``lax.pcast(..., to='varying')`` with a fallback to
+    the older ``lax.pvary`` name."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    return jax.lax.pvary(x, tuple(axis_names))

@@ -1,0 +1,131 @@
+"""Tensor (model) parallelism: param sharding rules over the ``model`` axis.
+
+Net-new vs the reference (SURVEY.md §2.4: only data parallelism exists there);
+included because the mesh design makes TP nearly free to express: annotate
+parameter shardings, jit the SAME train step, and XLA's SPMD partitioner
+inserts the all-gathers/reduce-scatters.
+
+``megatron_rules`` gives the classic pairing for MLP stacks: even layers split
+the output dim (column parallel), odd layers split the input dim (row
+parallel), so activations stay sharded between the pair and only one collective
+per pair is needed.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding import DATA_AXIS, MODEL_AXIS, batch_sharded, replicated
+
+
+def megatron_rules(net, axis: str = MODEL_AXIS) -> Dict[str, P]:
+    """Alternating column/row parallel specs for the network's dense-family
+    params: {param_path_regex: PartitionSpec}. Layer index parity decides the
+    split dim; biases follow their weight's output sharding."""
+    rules: Dict[str, P] = {}
+    for i, _ in enumerate(net.conf.layers):
+        col = (i % 2 == 0)
+        if col:
+            rules[rf"^{i}/W$"] = P(None, axis)
+            rules[rf"^{i}/b$"] = P(axis)
+        else:
+            rules[rf"^{i}/W$"] = P(axis, None)
+            rules[rf"^{i}/b$"] = P()
+    return rules
+
+
+def _spec_for(path: str, rules: Dict[str, P]) -> P:
+    for pat, spec in rules.items():
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def param_shardings(params, mesh: Mesh, rules: Dict[str, P]):
+    """NamedSharding pytree for ``params`` from path-regex rules."""
+    def one(keypath, leaf):
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in keypath)
+        spec = _spec_for(path, rules)
+        # drop axes that don't divide the dim (falls back to replication)
+        dims = np.shape(leaf)
+        cleaned = []
+        for d, s in zip(dims, tuple(spec) + (None,) * (len(dims) - len(tuple(spec)))):
+            if s is None:
+                cleaned.append(None)
+            else:
+                size = mesh.shape[s]
+                cleaned.append(s if d % size == 0 else None)
+        return NamedSharding(mesh, P(*cleaned))
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def tensor_parallel_step(net, mesh: Mesh, rules: Optional[Dict[str, P]] = None,
+                         donate: bool = True):
+    """Jit the network's train step with TP param shardings (+DP over the
+    ``data`` axis when present in the mesh). Returns (step, place) where
+    ``place(net)`` device_puts the model state according to the rules."""
+    if rules is None:
+        rules = megatron_rules(net)
+    raw = net._raw_step(False)
+    p_sh = param_shardings(net.params, mesh, rules)
+    # updater state mirrors its param's sharding (Adam moments etc.)
+    upd_sh = _mirror_updater_shardings(net, mesh, rules)
+    repl = replicated(mesh)
+    data = (batch_sharded(mesh) if DATA_AXIS in mesh.axis_names else repl)
+    in_sh = (p_sh, repl, upd_sh, repl, repl, data, data, None, None)
+    out_sh = (p_sh, repl, upd_sh, repl)
+
+    step = jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
+                   donate_argnums=(0, 2) if donate else ())
+
+    def place(model):
+        model.params = jax.device_put(model.params, p_sh)
+        model.states = jax.device_put(model.states, repl)
+        model.updater_state = jax.device_put(model.updater_state, upd_sh)
+
+    return step, place
+
+
+def _mirror_updater_shardings(net, mesh, rules):
+    """Updater state entries shaped like a param inherit that param's sharding
+    (Adam moments etc. must shard WITH their param, or TP's optimizer-state
+    memory saving is silently lost); everything else is replicated.
+
+    Updater-state keypaths look like ``layer/param/slot`` (e.g. ``0/W/0`` for
+    Adam's first moment) or ``layer/param`` for single-slot updaters, so the
+    param name is searched among ALL path segments, not just the last."""
+    p_sh_flat = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(net.params)[0]:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in keypath)
+        p_sh_flat[(path, np.shape(leaf))] = NamedSharding(
+            mesh, _clean_spec(_spec_for(path, rules), np.shape(leaf), mesh))
+
+    def one(keypath, leaf):
+        parts = [str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath]
+        shape = np.shape(leaf)
+        for (ppath, pshape), sh in p_sh_flat.items():
+            psegs = ppath.split("/")
+            # same layer key, same shape, and the param name appears on the
+            # state leaf's path (tuple slots append a trailing index segment)
+            if (shape == pshape and parts and psegs
+                    and parts[0] == psegs[0] and psegs[-1] in parts[1:]):
+                return sh
+        return NamedSharding(mesh, P())
+    return jax.tree_util.tree_map_with_path(one, net.updater_state)
+
+
+def _clean_spec(spec, dims, mesh):
+    cleaned = []
+    for d, s in zip(dims, tuple(spec) + (None,) * (len(dims) - len(tuple(spec)))):
+        if s is None or d % mesh.shape[s] != 0:
+            cleaned.append(None)
+        else:
+            cleaned.append(s)
+    return P(*cleaned)

@@ -1,0 +1,138 @@
+"""Dataset layer tests: IDX parsing, fetchers, record readers, normalizer use
+(reference test families in ``deeplearning4j-core/src/test/.../datasets/``)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import (read_idx, write_idx,
+                                                  MnistDataFetcher,
+                                                  CifarDataFetcher,
+                                                  IrisDataFetcher)
+from deeplearning4j_tpu.datasets.impl import (MnistDataSetIterator,
+                                              IrisDataSetIterator,
+                                              CifarDataSetIterator)
+from deeplearning4j_tpu.datasets.records import (CSVRecordReader,
+                                                 CollectionRecordReader,
+                                                 CSVSequenceRecordReader,
+                                                 RecordReaderDataSetIterator,
+                                                 SequenceRecordReaderDataSetIterator)
+
+
+def test_idx_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, size=(10, 28, 28)).astype(np.uint8)
+    p = str(tmp_path / "imgs-idx3-ubyte")
+    write_idx(p, arr)
+    np.testing.assert_array_equal(read_idx(p), arr)
+    pg = str(tmp_path / "imgs-idx3-ubyte.gz")
+    write_idx(pg, arr)
+    np.testing.assert_array_equal(read_idx(pg), arr)
+
+
+def test_mnist_fetcher_reads_real_idx_files(tmp_path, monkeypatch):
+    # lay out genuine IDX files → fetcher must read them, not synthesize
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    d = tmp_path / "mnist"
+    os.makedirs(d)
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 255, size=(50, 28, 28)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=(50,)).astype(np.uint8)
+    write_idx(str(d / "train-images-idx3-ubyte"), imgs)
+    write_idx(str(d / "train-labels-idx1-ubyte"), labels)
+    f = MnistDataFetcher(train=True)
+    assert not f.is_synthetic
+    assert f.features.shape == (50, 784)
+    np.testing.assert_allclose(f.features[0],
+                               imgs[0].reshape(-1).astype(np.float32) / 255.0)
+    assert np.argmax(f.labels[3]) == labels[3]
+
+
+def test_mnist_synthetic_fallback_and_iterator():
+    it = MnistDataSetIterator(batch=32, num_examples=128)
+    assert it.fetcher.is_synthetic
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].features.shape == (32, 784)
+    assert batches[0].labels.shape == (32, 10)
+    # deterministic across instantiations
+    it2 = MnistDataSetIterator(batch=32, num_examples=128)
+    np.testing.assert_array_equal(batches[0].features,
+                                  next(iter(it2)).features)
+
+
+def test_iris_iterator_trains():
+    from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                    Adam)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    it = IrisDataSetIterator(batch=50)
+    assert sum(ds.num_examples() for ds in it) == 150
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Adam(learning_rate=0.05)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=30)
+    ev = net.evaluate(IrisDataSetIterator(batch=150))
+    assert ev.accuracy() > 0.8  # iris is nearly separable
+
+
+def test_cifar_iterator_shapes():
+    it = CifarDataSetIterator(batch=16, num_examples=64)
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 3, 32, 32)
+    assert ds.labels.shape == (16, 10)
+
+
+def test_cifar_reads_binary_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    d = tmp_path / "cifar10"
+    os.makedirs(d)
+    rng = np.random.default_rng(2)
+    for i in range(1, 6):
+        rec = np.zeros((20, 3073), np.uint8)
+        rec[:, 0] = rng.integers(0, 10, 20)
+        rec[:, 1:] = rng.integers(0, 255, (20, 3072))
+        with open(d / f"data_batch_{i}.bin", "wb") as fh:
+            fh.write(rec.tobytes())
+    f = CifarDataFetcher(train=True)
+    assert not f.is_synthetic
+    assert f.features.shape == (100, 3, 32, 32)
+
+
+def test_csv_record_reader_classification(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b,label\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,1\n")
+    reader = CSVRecordReader(str(p), skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch_size=2, label_index=2,
+                                     num_classes=3)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(batches[0].labels, [[1, 0, 0], [0, 1, 0]])
+
+
+def test_record_reader_regression():
+    reader = CollectionRecordReader([[1.0, 2.0, 10.0], [3.0, 4.0, 20.0]])
+    it = RecordReaderDataSetIterator(reader, batch_size=2, label_index=2,
+                                     regression=True)
+    ds = next(iter(it))
+    np.testing.assert_allclose(ds.labels, [[10.0], [20.0]])
+
+
+def test_sequence_record_reader_padding(tmp_path):
+    p1 = tmp_path / "s1.csv"
+    p1.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,0\n")
+    p2 = tmp_path / "s2.csv"
+    p2.write_text("7.0,8.0,1\n")
+    reader = CSVSequenceRecordReader([str(p1), str(p2)])
+    it = SequenceRecordReaderDataSetIterator(reader, batch_size=2,
+                                             num_classes=2, label_index=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 3, 2)
+    assert ds.labels.shape == (2, 3, 2)
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 0, 0]])
+    np.testing.assert_allclose(ds.features[1, 0], [7.0, 8.0])
