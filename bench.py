@@ -1,14 +1,17 @@
-"""Benchmark harness: trains the flagship config on-device and prints ONE JSON
-line ``{"metric", "value", "unit", "vs_baseline"}``.
+"""Benchmark harness: prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}``.
 
-Measured config (BASELINE.json ``configs[0]``): LeNet MNIST MultiLayerNetwork,
-synthetic MNIST-shaped input (the reference's synthetic-benchmark pattern,
-``BenchmarkDataSetIterator.java``). Throughput accounting matches the
-reference's ``PerformanceListener`` (samples/sec).
+Measured config — the BASELINE.json north star: ResNet50 (deeplearning4j-zoo
+ComputationGraph architecture) training on synthetic ImageNet-shaped input
+(the reference's ``BenchmarkDataSetIterator`` pattern), images/sec on one
+chip. The whole train step (forward, AD backward, updater, param update) is a
+single jitted XLA computation; params in f32, matmul/conv compute in bfloat16
+on the MXU with f32 accumulation.
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
-ratio against the recorded target in BASELINE.json ``published`` when present,
-else 1.0.
+Throughput accounting matches the reference's ``PerformanceListener``
+(samples/sec). The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is the ratio against ``published`` in BASELINE.json when
+present, else 1.0.
 """
 from __future__ import annotations
 
@@ -21,30 +24,36 @@ import numpy as np
 def main():
     import jax
     import jax.numpy as jnp
-    from __graft_entry__ import _lenet
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    batch = 256
-    warmup, iters = 5, 30
+    batch = 64
+    warmup, iters = 3, 10
 
-    net = _lenet()
+    model = ResNet50(num_classes=1000)
+    conf = model.conf()
+    conf.global_conf.compute_dtype = "bfloat16"  # MXU path, f32 accumulation
+    net = ComputationGraph(conf).init()
+
     rng = np.random.default_rng(0)
-    f = jnp.asarray(rng.normal(size=(batch, 1, 28, 28)), jnp.float32)
-    l = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    f = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)), jnp.float32)
+    l = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000,
+                                                                batch)])
 
     step = net._ensure_step()
     params, states, upd = net.params, net.states, net.updater_state
     key = jax.random.PRNGKey(0)
     for i in range(warmup):
         it = jnp.asarray(i, jnp.int32)
-        params, states, upd, loss = step(params, states, upd, it, key, f, l,
-                                         None, None)
+        params, states, upd, loss = step(params, states, upd, it, key, (f,),
+                                         (l,), None, None)
     loss.block_until_ready()
 
     t0 = time.perf_counter()
     for i in range(warmup, warmup + iters):
         it = jnp.asarray(i, jnp.int32)
-        params, states, upd, loss = step(params, states, upd, it, key, f, l,
-                                         None, None)
+        params, states, upd, loss = step(params, states, upd, it, key, (f,),
+                                         (l,), None, None)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
 
@@ -52,11 +61,11 @@ def main():
     try:
         with open("BASELINE.json") as fh:
             published = json.load(fh).get("published", {})
-        base = published.get("lenet_mnist_images_per_sec")
+        base = published.get("resnet50_imagenet_images_per_sec")
     except Exception:
         base = None
     vs = images_per_sec / base if base else 1.0
-    print(json.dumps({"metric": "lenet_mnist_images_per_sec",
+    print(json.dumps({"metric": "resnet50_imagenet_images_per_sec",
                       "value": round(images_per_sec, 1),
                       "unit": "images/sec",
                       "vs_baseline": round(vs, 3)}))

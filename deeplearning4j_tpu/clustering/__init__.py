@@ -1,0 +1,9 @@
+"""Nearest neighbors & clustering (reference nearestneighbors-parent + core
+t-SNE — SURVEY.md §2.7/§2.2): VPTree, KDTree, QuadTree, SpTree, K-Means,
+exact + Barnes-Hut t-SNE."""
+from .trees import VPTree, KDTree, QuadTree, SpTree
+from .kmeans import KMeansClustering, ClusterSet, Cluster
+from .tsne import Tsne, BarnesHutTsne
+
+__all__ = ["VPTree", "KDTree", "QuadTree", "SpTree", "KMeansClustering",
+           "ClusterSet", "Cluster", "Tsne", "BarnesHutTsne"]

@@ -1,0 +1,115 @@
+"""K-Means clustering with jitted assignment/update steps.
+
+TPU-native equivalent of reference
+``clustering/kmeans/KMeansClustering.java`` + cluster strategies
+(``clustering/algorithm/``): Lloyd iterations where the O(n·k·d) distance
+matrix + argmin and the centroid reduction run as one jitted XLA computation
+(the reference loops point-by-point in Java over ND4J ops).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _assign_update(points, centroids):
+    """(assignments, new centroids, inertia) — one Lloyd iteration."""
+    d2 = (jnp.sum(points ** 2, axis=1)[:, None]
+          - 2.0 * points @ centroids.T
+          + jnp.sum(centroids ** 2, axis=1)[None, :])
+    assign = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    k = centroids.shape[0]
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)       # [n, k]
+    counts = onehot.sum(axis=0)                                   # [k]
+    sums = onehot.T @ points                                      # [k, d]
+    new_centroids = jnp.where(counts[:, None] > 0,
+                              sums / jnp.maximum(counts[:, None], 1.0),
+                              centroids)
+    return assign, new_centroids, inertia
+
+
+class Cluster:
+    def __init__(self, center: np.ndarray, points: np.ndarray,
+                 indices: np.ndarray):
+        self.center = center
+        self.points = points
+        self.indices = indices
+
+
+class ClusterSet:
+    def __init__(self, centroids: np.ndarray, assignments: np.ndarray,
+                 points: np.ndarray, inertia: float):
+        self.centroids = centroids
+        self.assignments = assignments
+        self.points = points
+        self.inertia = inertia
+
+    def get_clusters(self):
+        out = []
+        for i in range(len(self.centroids)):
+            sel = np.flatnonzero(self.assignments == i)
+            out.append(Cluster(self.centroids[i], self.points[sel], sel))
+        return out
+
+    getClusters = get_clusters
+
+    def nearest_cluster(self, point) -> int:
+        d = np.linalg.norm(self.centroids - np.asarray(point), axis=1)
+        return int(np.argmin(d))
+
+    nearestCluster = nearest_cluster
+
+
+class KMeansClustering:
+    """Reference ``KMeansClustering.setup(k, maxIterations, distance)``."""
+
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 123):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance: str = "euclidean", seed: int = 123):
+        if distance not in ("euclidean", "sqeuclidean"):
+            raise ValueError("Only euclidean distance is supported")
+        return KMeansClustering(k, max_iterations, seed=seed)
+
+    def apply_to(self, points) -> ClusterSet:
+        """Run Lloyd's algorithm (k-means++ init)."""
+        x = np.asarray(points, np.float32)
+        rng = np.random.default_rng(self.seed)
+        centroids = self._kmeans_pp_init(x, rng)
+        xj = jnp.asarray(x)
+        cj = jnp.asarray(centroids)
+        prev_inertia = np.inf
+        for _ in range(self.max_iterations):
+            assign, cj, inertia = _assign_update(xj, cj)
+            inertia = float(inertia)
+            if abs(prev_inertia - inertia) <= self.tol * max(abs(inertia), 1.0):
+                break
+            prev_inertia = inertia
+        return ClusterSet(np.asarray(cj), np.asarray(assign), x, inertia)
+
+    applyTo = apply_to
+
+    def _kmeans_pp_init(self, x, rng) -> np.ndarray:
+        n = len(x)
+        centroids = [x[rng.integers(0, n)]]
+        for _ in range(1, self.k):
+            d2 = np.min([np.sum((x - c) ** 2, axis=1) for c in centroids],
+                        axis=0)
+            total = d2.sum()
+            if total <= 0:  # all remaining points coincide with centroids
+                centroids.append(x[rng.integers(0, n)])
+                continue
+            centroids.append(x[rng.choice(n, p=d2 / total)])
+        return np.stack(centroids)

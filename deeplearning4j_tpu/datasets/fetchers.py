@@ -37,7 +37,12 @@ IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
 
 def read_idx(path: str) -> np.ndarray:
     """Parse an IDX file (optionally .gz) — the MNIST container format
-    (reference ``MnistManager``/``MnistDbFile``)."""
+    (reference ``MnistManager``/``MnistDbFile``). Uncompressed u8 files go
+    through the native parser (ops/libdl4jtpu.so) when built."""
+    from ..ops import native as _native
+    fast = _native.idx_read(path)
+    if fast is not None:
+        return fast
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         zero1, zero2, dtype_code, ndim = struct.unpack("BBBB", f.read(4))

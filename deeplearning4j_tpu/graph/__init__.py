@@ -1,0 +1,9 @@
+"""Graph embeddings (reference ``deeplearning4j-graph`` — SURVEY.md §2.7):
+graph API, loaders, random-walk iterators, DeepWalk, GraphVectors."""
+from .api import Graph, Vertex, Edge
+from .loaders import GraphLoader
+from .walks import RandomWalkIterator, WeightedRandomWalkIterator
+from .deepwalk import DeepWalk, GraphVectors
+
+__all__ = ["Graph", "Vertex", "Edge", "GraphLoader", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors"]

@@ -1,0 +1,50 @@
+"""Random-walk iterators (reference ``graph/iterator/RandomWalkIterator.java``
+and ``WeightedRandomWalkGraphIteratorProvider``): uniform and edge-weighted
+walks, with NoEdgeHandling semantics (self-loop on dead ends)."""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from .api import Graph
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from every vertex."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 123,
+                 walks_per_vertex: int = 1):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.walks_per_vertex = walks_per_vertex
+
+    def _next_vertex(self, rng, current: int) -> int:
+        nbrs = self.graph.get_connected_vertices(current)
+        if not nbrs:
+            return current  # SELF_LOOP_ON_DISCONNECTED
+        return int(nbrs[rng.integers(0, len(nbrs))])
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.walks_per_vertex):
+            for start in range(self.graph.num_vertices()):
+                walk = [start]
+                cur = start
+                for _ in range(self.walk_length - 1):
+                    cur = self._next_vertex(rng, cur)
+                    walk.append(cur)
+                yield walk
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional transition probabilities."""
+
+    def _next_vertex(self, rng, current: int) -> int:
+        nbrs = self.graph.get_connected_with_weights(current)
+        if not nbrs:
+            return current
+        weights = np.asarray([w for _, w in nbrs], np.float64)
+        p = weights / weights.sum()
+        return int(nbrs[rng.choice(len(nbrs), p=p)][0])

@@ -1,0 +1,4 @@
+"""Keras model import (reference ``deeplearning4j-modelimport`` — SURVEY.md §2.6)."""
+from .model_import import KerasModelImport, KerasLayerMapper
+
+__all__ = ["KerasModelImport", "KerasLayerMapper"]

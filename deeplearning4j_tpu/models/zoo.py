@@ -1,0 +1,570 @@
+"""Model zoo: standard architectures as config builders.
+
+TPU-native equivalent of reference ``deeplearning4j-zoo/`` (SURVEY.md §2.7):
+``ZooModel`` abstract (``zoo/ZooModel.java:40-51``), ``ModelSelector``, and the
+model set — LeNet, SimpleCNN, AlexNet, VGG16/19, GoogLeNet, ResNet50
+(``zoo/model/ResNet50.java:33``, conv/identity blocks :127-212),
+InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM.
+
+Pretrained weights: the reference auto-downloads + checksums; this build runs
+with zero egress, so ``init_pretrained`` loads a ModelSerializer zip from the
+local data dir (``DL4J_TPU_DATA_DIR/zoo/<name>.bin``) and raises with the
+expected path otherwise.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..nn.conf import NeuralNetConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import (ConvolutionLayer, SubsamplingLayer, DenseLayer,
+                              OutputLayer, BatchNormalization,
+                              LocalResponseNormalization, DropoutLayer,
+                              GlobalPoolingLayer, ActivationLayer, LSTM,
+                              GravesLSTM, RnnOutputLayer, PoolingType,
+                              ConvolutionMode)
+from ..nn.conf.graph import ElementWiseVertex, MergeVertex, ScaleVertex
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.graph import ComputationGraph
+from ..nn.updaters import Adam, Nesterovs
+from ..nn.weights import WeightInit
+
+
+class ZooModel:
+    """Base (reference ``ZooModel.java:40``): ``init()`` builds a fresh net;
+    ``init_pretrained()`` restores weights from the local zoo dir."""
+
+    name: str = "zoo_model"
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape: Optional[Tuple[int, int, int]] = None):
+        self.num_classes = num_classes
+        self.seed = seed
+        if input_shape is not None:
+            self.input_shape = input_shape
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        conf = self.conf()
+        from ..nn.conf import MultiLayerConfiguration
+        if isinstance(conf, MultiLayerConfiguration):
+            return MultiLayerNetwork(conf).init()
+        return ComputationGraph(conf).init()
+
+    def pretrained_path(self) -> str:
+        from ..datasets.fetchers import data_dir
+        return os.path.join(data_dir(), "zoo", f"{self.name}.bin")
+
+    def init_pretrained(self):
+        path = self.pretrained_path()
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"No pretrained weights for {self.name}: expected a "
+                f"ModelSerializer zip at {path} (no network egress — place "
+                f"the file there manually)")
+        from ..utils.model_serializer import ModelSerializer
+        return ModelSerializer.restore_model(path)
+
+    initPretrained = init_pretrained
+
+    def _builder(self, updater=None, activation="relu",
+                 weight_init=WeightInit.RELU):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(updater or Adam(learning_rate=1e-3))
+                .activation(activation)
+                .weight_init(weight_init))
+
+    def _inception(self, g, name, inp, c1, r3, c3, r5, c5, pp):
+        """GoogLeNet-style inception module (shared by GoogLeNet and
+        FaceNetNN4Small2): 1x1 / 3x3-reduce+3x3 / 5x5-reduce+5x5 / pool+proj
+        branches merged on the channel axis."""
+        same = ConvolutionMode.Same
+        g.add_layer(f"{name}-1x1", ConvolutionLayer(n_out=c1, kernel_size=(1, 1),
+                                                    convolution_mode=same), inp)
+        g.add_layer(f"{name}-3x3r", ConvolutionLayer(n_out=r3, kernel_size=(1, 1),
+                                                     convolution_mode=same), inp)
+        g.add_layer(f"{name}-3x3", ConvolutionLayer(n_out=c3, kernel_size=(3, 3),
+                                                    convolution_mode=same),
+                    f"{name}-3x3r")
+        g.add_layer(f"{name}-5x5r", ConvolutionLayer(n_out=r5, kernel_size=(1, 1),
+                                                     convolution_mode=same), inp)
+        g.add_layer(f"{name}-5x5", ConvolutionLayer(n_out=c5, kernel_size=(5, 5),
+                                                    convolution_mode=same),
+                    f"{name}-5x5r")
+        g.add_layer(f"{name}-pool", SubsamplingLayer(
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(1, 1),
+            convolution_mode=same), inp)
+        g.add_layer(f"{name}-poolproj", ConvolutionLayer(
+            n_out=pp, kernel_size=(1, 1), convolution_mode=same), f"{name}-pool")
+        g.add_vertex(f"{name}", MergeVertex(), f"{name}-1x1", f"{name}-3x3",
+                     f"{name}-5x5", f"{name}-poolproj")
+        return name
+
+
+# --------------------------------------------------------------------- LeNet
+class LeNet(ZooModel):
+    """Reference ``zoo/model/LeNet.java``: 28×28×c → conv20-5 → max2 →
+    conv50-5 → max2 → dense500 → softmax."""
+
+    name = "lenet"
+    input_shape = (1, 28, 28)
+
+    def __init__(self, num_classes: int = 10, seed: int = 123, **kw):
+        super().__init__(num_classes, seed, **kw)
+
+    def conf(self):
+        c, h, w = self.input_shape
+        return (self._builder()
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                        stride=(1, 1), activation="identity"))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                        stride=(1, 1), activation="identity"))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+# ----------------------------------------------------------------- SimpleCNN
+class SimpleCNN(ZooModel):
+    """Reference ``zoo/model/SimpleCNN.java``: compact 48×48 CNN."""
+
+    name = "simplecnn"
+    input_shape = (3, 48, 48)
+
+    def __init__(self, num_classes: int = 10, seed: int = 123, **kw):
+        super().__init__(num_classes, seed, **kw)
+
+    def conf(self):
+        c, h, w = self.input_shape
+        same = ConvolutionMode.Same
+        return (self._builder()
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                        convolution_mode=same))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer(activation="relu"))
+                .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                        convolution_mode=same))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer(activation="relu"))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=64, kernel_size=(3, 3),
+                                        convolution_mode=same))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer(activation="relu"))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(DropoutLayer(dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+# ------------------------------------------------------------------- AlexNet
+class AlexNet(ZooModel):
+    """Reference ``zoo/model/AlexNet.java`` (one-tower variant): 224×224×3."""
+
+    name = "alexnet"
+    input_shape = (3, 224, 224)
+
+    def conf(self):
+        c, h, w = self.input_shape
+        return (self._builder(updater=Nesterovs(learning_rate=1e-2,
+                                                momentum=0.9))
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                        stride=(4, 4), padding=(3, 3)))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                        stride=(1, 1), padding=(2, 2)))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        padding=(1, 1)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        padding=(1, 1)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                        padding=(1, 1)))
+                .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+# ----------------------------------------------------------------- VGG 16/19
+class VGG16(ZooModel):
+    """Reference ``zoo/model/VGG16.java``: conv stacks (2,2,3,3,3) + FC4096×2."""
+
+    name = "vgg16"
+    input_shape = (3, 224, 224)
+    block_convs = (2, 2, 3, 3, 3)
+
+    def conf(self):
+        c, h, w = self.input_shape
+        widths = (64, 128, 256, 512, 512)
+        b = self._builder().list()
+        for width, n_convs in zip(widths, self.block_convs):
+            for _ in range(n_convs):
+                b.layer(ConvolutionLayer(n_out=width, kernel_size=(3, 3),
+                                         convolution_mode=ConvolutionMode.Same))
+            b.layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                     kernel_size=(2, 2), stride=(2, 2)))
+        return (b.layer(DenseLayer(n_out=4096))
+                .layer(DenseLayer(n_out=4096))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class VGG19(VGG16):
+    """Reference ``zoo/model/VGG19.java``: conv stacks (2,2,4,4,4)."""
+
+    name = "vgg19"
+    block_convs = (2, 2, 4, 4, 4)
+
+
+# ------------------------------------------------------------------ GoogLeNet
+class GoogLeNet(ZooModel):
+    """Reference ``zoo/model/GoogLeNet.java`` (Inception v1): stem + 9
+    inception modules + global average pooling."""
+
+    name = "googlenet"
+    input_shape = (3, 224, 224)
+
+    # (1x1, 3x3 reduce, 3x3, 5x5 reduce, 5x5, pool proj) per module
+    MODULES = [
+        ("3a", 64, 96, 128, 16, 32, 32),
+        ("3b", 128, 128, 192, 32, 96, 64),
+        ("4a", 192, 96, 208, 16, 48, 64),
+        ("4b", 160, 112, 224, 24, 64, 64),
+        ("4c", 128, 128, 256, 24, 64, 64),
+        ("4d", 112, 144, 288, 32, 64, 64),
+        ("4e", 256, 160, 320, 32, 128, 128),
+        ("5a", 256, 160, 320, 32, 128, 128),
+        ("5b", 384, 192, 384, 48, 128, 128),
+    ]
+    POOL_AFTER = {"3b", "4e"}
+
+    def conf(self):
+        c, h, w = self.input_shape
+        same = ConvolutionMode.Same
+        g = (self._builder().graph_builder()
+             .add_inputs("input")
+             .add_layer("stem-conv", ConvolutionLayer(
+                 n_out=64, kernel_size=(7, 7), stride=(2, 2),
+                 convolution_mode=same), "input")
+             .add_layer("stem-pool", SubsamplingLayer(
+                 pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                 stride=(2, 2), convolution_mode=same), "stem-conv")
+             .add_layer("stem-lrn", LocalResponseNormalization(), "stem-pool")
+             .add_layer("stem-conv2", ConvolutionLayer(
+                 n_out=64, kernel_size=(1, 1), convolution_mode=same),
+                 "stem-lrn")
+             .add_layer("stem-conv3", ConvolutionLayer(
+                 n_out=192, kernel_size=(3, 3), convolution_mode=same),
+                 "stem-conv2")
+             .add_layer("stem-lrn2", LocalResponseNormalization(), "stem-conv3")
+             .add_layer("stem-pool2", SubsamplingLayer(
+                 pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                 stride=(2, 2), convolution_mode=same), "stem-lrn2"))
+        prev = "stem-pool2"
+        for mod in self.MODULES:
+            name, c1, r3, c3, r5, c5, pp = mod
+            prev = self._inception(g, f"inc{name}", prev, c1, r3, c3, r5, c5, pp)
+            if name in self.POOL_AFTER:
+                g.add_layer(f"pool-{name}", SubsamplingLayer(
+                    pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                    stride=(2, 2), convolution_mode=same), prev)
+                prev = f"pool-{name}"
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG), prev)
+        g.add_layer("dropout", DropoutLayer(dropout=0.6), "gap")
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation="softmax", loss="mcxent"),
+                    "dropout")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+
+# -------------------------------------------------------------------- ResNet50
+class ResNet50(ZooModel):
+    """Reference ``zoo/model/ResNet50.java:33`` (conv/identity blocks
+    :127-212): stem conv7/2 → [3, 4, 6, 3] bottleneck stages → global avg
+    pool → softmax."""
+
+    name = "resnet50"
+    input_shape = (3, 224, 224)
+    STAGES = ((3, 64), (4, 128), (6, 256), (3, 512))
+
+    def _conv_bn(self, g, name, inp, n_out, k, stride=(1, 1),
+                 activation="relu"):
+        same = ConvolutionMode.Same
+        g.add_layer(f"{name}-conv", ConvolutionLayer(
+            n_out=n_out, kernel_size=k, stride=stride, convolution_mode=same,
+            activation="identity", has_bias=False), inp)
+        g.add_layer(f"{name}-bn", BatchNormalization(), f"{name}-conv")
+        if activation == "identity":
+            return f"{name}-bn"
+        g.add_layer(f"{name}-act", ActivationLayer(activation=activation),
+                    f"{name}-bn")
+        return f"{name}-act"
+
+    def _bottleneck(self, g, name, inp, width, stride, project):
+        """conv_block (with projection shortcut) or identity_block
+        (reference ResNet50.java convBlock :127 / identityBlock :170)."""
+        a = self._conv_bn(g, f"{name}-a", inp, width, (1, 1), stride)
+        b = self._conv_bn(g, f"{name}-b", a, width, (3, 3))
+        c = self._conv_bn(g, f"{name}-c", b, 4 * width, (1, 1),
+                          activation="identity")
+        if project:
+            shortcut = self._conv_bn(g, f"{name}-sc", inp, 4 * width, (1, 1),
+                                     stride, activation="identity")
+        else:
+            shortcut = inp
+        g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), c, shortcut)
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"),
+                    f"{name}-add")
+        return name
+
+    def conf(self):
+        c, h, w = self.input_shape
+        same = ConvolutionMode.Same
+        g = (self._builder().graph_builder()
+             .add_inputs("input")
+             .add_layer("stem-conv", ConvolutionLayer(
+                 n_out=64, kernel_size=(7, 7), stride=(2, 2),
+                 convolution_mode=same, activation="identity", has_bias=False),
+                 "input")
+             .add_layer("stem-bn", BatchNormalization(), "stem-conv")
+             .add_layer("stem-act", ActivationLayer(activation="relu"),
+                        "stem-bn")
+             .add_layer("stem-pool", SubsamplingLayer(
+                 pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                 stride=(2, 2), convolution_mode=same), "stem-act"))
+        prev = "stem-pool"
+        for si, (blocks, width) in enumerate(self.STAGES):
+            for bi in range(blocks):
+                stride = (2, 2) if (bi == 0 and si > 0) else (1, 1)
+                prev = self._bottleneck(g, f"s{si}b{bi}", prev, width, stride,
+                                        project=(bi == 0))
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    prev)
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation="softmax", loss="mcxent"),
+                    "gap")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+
+# --------------------------------------------------------- InceptionResNetV1
+class InceptionResNetV1(ZooModel):
+    """Reference ``zoo/model/InceptionResNetV1.java``: stem + residual
+    inception blocks A×5, B×10, C×5 with reductions (block counts
+    configurable; defaults match the reference)."""
+
+    name = "inceptionresnetv1"
+    input_shape = (3, 160, 160)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 blocks_a: int = 5, blocks_b: int = 10, blocks_c: int = 5,
+                 **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.blocks = (blocks_a, blocks_b, blocks_c)
+
+    def _conv(self, g, name, inp, n_out, k, stride=(1, 1)):
+        g.add_layer(name, ConvolutionLayer(
+            n_out=n_out, kernel_size=k, stride=stride,
+            convolution_mode=ConvolutionMode.Same), inp)
+        return name
+
+    def _res_block(self, g, name, inp, branches, n_channels, scale):
+        """Residual inception block: parallel conv branches → merge → 1×1
+        projection back to n_channels → residual scaling (reference block
+        scales: A 0.17, B 0.10, C 0.20) → add to input → relu."""
+        outs = []
+        for i, branch in enumerate(branches):
+            prev = inp
+            for j, (n_out, k) in enumerate(branch):
+                prev = self._conv(g, f"{name}-br{i}-{j}", prev, n_out, k)
+            outs.append(prev)
+        g.add_vertex(f"{name}-merge", MergeVertex(), *outs)
+        g.add_layer(f"{name}-proj", ConvolutionLayer(
+            n_out=n_channels, kernel_size=(1, 1), activation="identity",
+            convolution_mode=ConvolutionMode.Same), f"{name}-merge")
+        g.add_vertex(f"{name}-scale", ScaleVertex(scale=scale), f"{name}-proj")
+        g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), inp,
+                     f"{name}-scale")
+        g.add_layer(name, ActivationLayer(activation="relu"), f"{name}-add")
+        return name
+
+    def conf(self):
+        c, h, w = self.input_shape
+        same = ConvolutionMode.Same
+        g = (self._builder().graph_builder().add_inputs("input"))
+        prev = self._conv(g, "stem1", "input", 32, (3, 3), (2, 2))
+        prev = self._conv(g, "stem2", prev, 64, (3, 3))
+        g.add_layer("stem-pool", SubsamplingLayer(
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=same), prev)
+        prev = self._conv(g, "stem3", "stem-pool", 80, (1, 1))
+        prev = self._conv(g, "stem4", prev, 192, (3, 3))
+        prev = self._conv(g, "stem5", prev, 256, (3, 3), (2, 2))
+        a, b, cc = self.blocks
+        for i in range(a):  # block35 (A)
+            prev = self._res_block(g, f"A{i}", prev,
+                                   [[(32, (1, 1))],
+                                    [(32, (1, 1)), (32, (3, 3))],
+                                    [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]],
+                                   256, 0.17)
+        g.add_layer("redA-pool", SubsamplingLayer(
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=same), prev)
+        prev = self._conv(g, "redA-conv", "redA-pool", 896, (1, 1))
+        for i in range(b):  # block17 (B)
+            prev = self._res_block(g, f"B{i}", prev,
+                                   [[(128, (1, 1))],
+                                    [(128, (1, 1)), (128, (1, 7)),
+                                     (128, (7, 1))]],
+                                   896, 0.10)
+        g.add_layer("redB-pool", SubsamplingLayer(
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=same), prev)
+        prev = self._conv(g, "redB-conv", "redB-pool", 1792, (1, 1))
+        for i in range(cc):  # block8 (C)
+            prev = self._res_block(g, f"C{i}", prev,
+                                   [[(192, (1, 1))],
+                                    [(192, (1, 1)), (192, (1, 3)),
+                                     (192, (3, 1))]],
+                                   1792, 0.20)
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    prev)
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation="softmax", loss="mcxent"),
+                    "gap")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+
+# ------------------------------------------------------------ FaceNetNN4Small2
+class FaceNetNN4Small2(ZooModel):
+    """Reference ``zoo/model/FaceNetNN4Small2.java``: compact inception
+    embedding net with an L2-normalized embedding trained via center loss
+    (reference uses triplet/center-loss variants; center loss here)."""
+
+    name = "facenetnn4small2"
+    input_shape = (3, 96, 96)
+
+    def __init__(self, num_classes: int = 1000, embedding_size: int = 128,
+                 seed: int = 123, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.embedding_size = embedding_size
+
+    def conf(self):
+        from ..nn.conf.layers import CenterLossOutputLayer
+        c, h, w = self.input_shape
+        same = ConvolutionMode.Same
+        g = (self._builder().graph_builder()
+             .add_inputs("input")
+             .add_layer("conv1", ConvolutionLayer(
+                 n_out=64, kernel_size=(7, 7), stride=(2, 2),
+                 convolution_mode=same), "input")
+             .add_layer("pool1", SubsamplingLayer(
+                 pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                 stride=(2, 2), convolution_mode=same), "conv1")
+             .add_layer("lrn1", LocalResponseNormalization(), "pool1")
+             .add_layer("conv2", ConvolutionLayer(
+                 n_out=64, kernel_size=(1, 1), convolution_mode=same), "lrn1")
+             .add_layer("conv3", ConvolutionLayer(
+                 n_out=192, kernel_size=(3, 3), convolution_mode=same),
+                 "conv2")
+             .add_layer("lrn2", LocalResponseNormalization(), "conv3")
+             .add_layer("pool2", SubsamplingLayer(
+                 pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                 stride=(2, 2), convolution_mode=same), "lrn2"))
+        # two inception-style modules (shared ZooModel._inception wiring)
+        prev = "pool2"
+        for name, (c1, r3, c3, r5, c5, pp) in (
+                ("inc1", (64, 96, 128, 16, 32, 32)),
+                ("inc2", (64, 96, 128, 32, 64, 64))):
+            prev = self._inception(g, name, prev, c1, r3, c3, r5, c5, pp)
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                    prev)
+        g.add_layer("embedding", DenseLayer(n_out=self.embedding_size,
+                                            activation="identity"), "gap")
+        g.add_layer("output", CenterLossOutputLayer(
+            n_in=self.embedding_size, n_out=self.num_classes,
+            activation="softmax", loss="mcxent"), "embedding")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+
+# -------------------------------------------------------- TextGenerationLSTM
+class TextGenerationLSTM(ZooModel):
+    """Reference ``zoo/model/TextGenerationLSTM.java``: char-level 2×LSTM(256)
+    + per-step softmax, TBPTT-capable."""
+
+    name = "textgenlstm"
+
+    def __init__(self, total_unique_characters: Optional[int] = None,
+                 num_classes: Optional[int] = None, seed: int = 123,
+                 lstm_size: int = 256, **kw):
+        n = total_unique_characters if total_unique_characters is not None \
+            else (num_classes if num_classes is not None else 47)
+        super().__init__(n, seed, **kw)
+        self.lstm_size = lstm_size
+
+    def conf(self):
+        n = self.num_classes
+        return (self._builder(activation="tanh",
+                              weight_init=WeightInit.XAVIER)
+                .list()
+                .layer(GravesLSTM(n_in=n, n_out=self.lstm_size,
+                                  activation="tanh"))
+                .layer(GravesLSTM(n_in=self.lstm_size, n_out=self.lstm_size,
+                                  activation="tanh"))
+                .layer(RnnOutputLayer(n_in=self.lstm_size, n_out=n,
+                                      activation="softmax", loss="mcxent"))
+                .build())
+
+
+# -------------------------------------------------------------- ModelSelector
+ZOO = {m.name: m for m in (LeNet, SimpleCNN, AlexNet, VGG16, VGG19, GoogLeNet,
+                           ResNet50, InceptionResNetV1, FaceNetNN4Small2,
+                           TextGenerationLSTM)}
+
+
+class ModelSelector:
+    """Reference ``zoo/ModelSelector.java``: select zoo models by name."""
+
+    @staticmethod
+    def select(name: str, **kwargs) -> ZooModel:
+        key = name.lower()
+        if key not in ZOO:
+            raise ValueError(f"Unknown zoo model '{name}' "
+                             f"(known: {sorted(ZOO)})")
+        return ZOO[key](**kwargs)

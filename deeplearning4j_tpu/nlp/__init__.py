@@ -1,0 +1,21 @@
+"""NLP embeddings (reference ``deeplearning4j-nlp-parent`` — SURVEY.md §2.5):
+SequenceVectors engine, Word2Vec/CBOW, ParagraphVectors, GloVe, vocab +
+Huffman, tokenization pipeline, word-vector serialization."""
+from .text import (SentenceIterator, CollectionSentenceIterator,
+                   BasicLineIterator, Tokenizer, TokenizerFactory,
+                   DefaultTokenizerFactory, NGramTokenizerFactory,
+                   TokenPreProcess, CommonPreprocessor, LowCasePreProcessor,
+                   StopWords)
+from .vocab import VocabCache, VocabWord, SequenceElement, Huffman, build_vocab
+from .sequencevectors import SequenceVectors, InMemoryLookupTable
+from .word2vec import Word2Vec, CBOW, ParagraphVectors
+from .glove import Glove
+from .serializer import WordVectorSerializer, StaticWordVectors
+
+__all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
+           "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",
+           "NGramTokenizerFactory", "TokenPreProcess", "CommonPreprocessor",
+           "LowCasePreProcessor", "StopWords", "VocabCache", "VocabWord",
+           "SequenceElement", "Huffman", "build_vocab", "SequenceVectors",
+           "InMemoryLookupTable", "Word2Vec", "CBOW", "ParagraphVectors",
+           "Glove", "WordVectorSerializer", "StaticWordVectors"]

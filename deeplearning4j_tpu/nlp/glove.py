@@ -1,0 +1,196 @@
+"""GloVe: co-occurrence counting + AdaGrad-weighted least squares.
+
+TPU-native equivalent of reference ``models/glove/Glove.java`` (429 LoC +
+``glove/count/`` co-occurrence machinery): host-side co-occurrence dict over
+windows, then jitted batched AdaGrad updates of the factorization
+``w_i·w̃_j + b_i + b̃_j ≈ log X_ij`` with the f(X) weighting.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .vocab import VocabCache, build_vocab
+from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
+                   SentenceIterator, TokenizerFactory)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+    """One AdaGrad batch: J = f(x) (w_i·wc_j + b_i + bc_j − log x)²."""
+    wi = w[rows]
+    wj = wc[cols]
+    diff = (jnp.sum(wi * wj, axis=-1) + b[rows] + bc[cols] - logx)  # [B]
+    g = fx * diff                                                   # [B]
+    gwi = g[:, None] * wj
+    gwj = g[:, None] * wi
+    gbi = g
+    gbj = g
+    # AdaGrad accumulators
+    hw = hw.at[rows].add(gwi * gwi)
+    hwc = hwc.at[cols].add(gwj * gwj)
+    hb = hb.at[rows].add(gbi * gbi)
+    hbc = hbc.at[cols].add(gbj * gbj)
+    w = w.at[rows].add(-lr * gwi / jnp.sqrt(hw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * gwj / jnp.sqrt(hwc[cols] + 1e-8))
+    b = b.at[rows].add(-lr * gbi / jnp.sqrt(hb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * gbj / jnp.sqrt(hbc[cols] + 1e-8))
+    loss = 0.5 * jnp.sum(fx * diff * diff)
+    return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+
+class Glove:
+    """Reference ``Glove.java`` Builder surface (subset) + fit/query."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iterator = None
+            self._tokenizer = DefaultTokenizerFactory()
+
+        def layer_size(self, n):
+            self._kw["vector_length"] = int(n)
+            return self
+
+        layerSize = layer_size
+
+        def window_size(self, n):
+            self._kw["window"] = int(n)
+            return self
+
+        windowSize = window_size
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = int(n)
+            return self
+
+        minWordFrequency = min_word_frequency
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        learningRate = learning_rate
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def x_max(self, v):
+            self._kw["x_max"] = float(v)
+            return self
+
+        xMax = x_max
+
+        def alpha(self, v):
+            self._kw["alpha"] = float(v)
+            return self
+
+        def iterate(self, it: SentenceIterator):
+            self._iterator = it
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._tokenizer = tf
+            return self
+
+        tokenizerFactory = tokenizer_factory
+
+        def build(self) -> "Glove":
+            g = Glove(**self._kw)
+            g._iterator = self._iterator
+            g._tokenizer = self._tokenizer
+            return g
+
+    @staticmethod
+    def builder():
+        return Glove.Builder()
+
+    def __init__(self, vector_length: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, learning_rate: float = 0.05,
+                 epochs: int = 5, x_max: float = 100.0, alpha: float = 0.75,
+                 batch_size: int = 4096, seed: int = 123):
+        self.vector_length = vector_length
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None
+        self._iterator = None
+        self._tokenizer = DefaultTokenizerFactory()
+
+    def _sentences(self):
+        for s in self._iterator:
+            yield self._tokenizer.create(s).get_tokens()
+
+    def fit(self, sentences: Optional[Sequence[str]] = None):
+        if sentences is not None:
+            self._iterator = CollectionSentenceIterator(sentences)
+        seqs = list(self._sentences())
+        self.vocab = build_vocab(seqs, self.min_word_frequency,
+                                 build_huffman=False)
+        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        for seq in seqs:
+            idxs = [self.vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, i in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    # distance-weighted count, symmetric (GloVe convention)
+                    cooc[(i, idxs[j])] += 1.0 / off
+                    cooc[(idxs[j], i)] += 1.0 / off
+        n = self.vocab.num_words()
+        d = self.vector_length
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((n, d)) - 0.5) / d, jnp.float32)
+        wc = jnp.asarray((rng.random((n, d)) - 0.5) / d, jnp.float32)
+        b = jnp.zeros((n,), jnp.float32)
+        bc = jnp.zeros((n,), jnp.float32)
+        hw = jnp.ones((n, d), jnp.float32)
+        hwc = jnp.ones((n, d), jnp.float32)
+        hb = jnp.ones((n,), jnp.float32)
+        hbc = jnp.ones((n,), jnp.float32)
+
+        pairs = np.asarray(list(cooc.keys()), np.int32)
+        counts = np.asarray(list(cooc.values()), np.float32)
+        logx = np.log(counts)
+        fx = np.minimum((counts / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        B = self.batch_size
+        for _ in range(self.epochs):
+            order = rng.permutation(len(pairs))
+            for s in range(0, len(order), B):
+                sel = order[s:s + B]
+                (w, wc, b, bc, hw, hwc, hb, hbc, _) = _glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]),
+                    jnp.float32(self.learning_rate))
+        # final vectors: w + wc (GloVe paper recommendation)
+        self.syn0 = np.asarray(w) + np.asarray(wc)
+        return self
+
+    # ----------------------------------------------------------------- query
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word) if self.vocab else -1
+        return None if i < 0 else self.syn0[i]
+
+    getWordVector = word_vector
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.word_vector(a), self.word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = max(np.linalg.norm(va) * np.linalg.norm(vb), 1e-9)
+        return float(va @ vb / denom)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import LayerImpl, implements, acc_dtype
+from .base import LayerImpl, implements, acc_dtype, pet_dtype
 
 
 def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
@@ -22,7 +22,7 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(compute_dtype),
                         k.astype(compute_dtype),
-                        preferred_element_type=acc_dtype(compute_dtype))
+                        preferred_element_type=pet_dtype(compute_dtype))
     logits = logits / jnp.sqrt(jnp.asarray(d, jnp.float32))
     if causal:
         T, S = logits.shape[-2], logits.shape[-1]
@@ -35,7 +35,7 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(compute_dtype),
-                      v.astype(compute_dtype), preferred_element_type=acc_dtype(compute_dtype))
+                      v.astype(compute_dtype), preferred_element_type=pet_dtype(compute_dtype))
 
 
 @implements("SelfAttentionLayer")

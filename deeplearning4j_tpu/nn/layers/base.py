@@ -132,12 +132,22 @@ class LayerImpl:
 
 
 def acc_dtype(compute_dtype):
-    """MXU accumulation dtype for dots/convs: f32 when computing in a
-    sub-32-bit dtype (bf16/f16 → f32 accumulation on the MXU), otherwise the
-    compute dtype itself — forcing f32 accumulation under f64 compute would
-    silently truncate, breaking the f64 gradient-check path."""
+    """Accumulator/stats dtype: f32 when computing in a sub-32-bit dtype
+    (bf16/f16), otherwise the compute dtype itself — forcing f32 under f64
+    compute would silently truncate, breaking the f64 gradient-check path.
+    Used for BN statistics, RNN carries and softmax accumulation."""
     cd = jnp.dtype(compute_dtype)
     return jnp.dtype(jnp.float32) if cd.itemsize < 4 else cd
+
+
+def pet_dtype(compute_dtype):
+    """``preferred_element_type`` for dots/convs. For sub-32-bit compute the
+    answer is None: XLA's TPU MXU already accumulates bf16 operands in f32
+    internally, and requesting an f32 *output* breaks the conv-transpose
+    dtype rule under AD (cotangent f32 vs operand bf16). For f32/f64 compute
+    the compute dtype itself keeps results exact."""
+    cd = jnp.dtype(compute_dtype)
+    return None if cd.itemsize < 4 else cd
 
 
 def _is_bias_key(k: str) -> bool:

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .base import LayerImpl, NoParamLayerImpl, implements, acc_dtype
+from .base import LayerImpl, NoParamLayerImpl, implements, acc_dtype, pet_dtype
 from ..conf.layers import ConvolutionMode, _pair
 
 _DN2D = ("NHWC", "HWIO", "NHWC")
@@ -52,7 +52,7 @@ class Conv2DImpl(LayerImpl):
             padding=conv_padding(c.convolution_mode, k, s, p, d),
             rhs_dilation=d,
             dimension_numbers=_DN2D,
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
@@ -89,7 +89,7 @@ class Conv1DImpl(LayerImpl):
             x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
             window_strides=(s,), padding=pad, rhs_dilation=(d,),
             dimension_numbers=("NHC", "HIO", "NHC"),
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
         return self.activation(z).astype(self.dtype), state
@@ -128,7 +128,7 @@ class Deconv2DImpl(Conv2DImpl):
         z = lax.conv_transpose(
             x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
             strides=s, padding=pad, rhs_dilation=d, dimension_numbers=_DN2D,
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
         return self.activation(z).astype(self.dtype), state
@@ -156,7 +156,7 @@ class DepthwiseConv2DImpl(LayerImpl):
             x.astype(self.compute_dtype), params["W"].astype(self.compute_dtype),
             window_strides=s, padding=pad, rhs_dilation=d,
             dimension_numbers=_DN2D, feature_group_count=c.n_in,
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
         return self.activation(z).astype(self.dtype), state
@@ -189,11 +189,11 @@ class SeparableConv2DImpl(LayerImpl):
             x.astype(self.compute_dtype), params["dW"].astype(self.compute_dtype),
             window_strides=s, padding=pad, rhs_dilation=d,
             dimension_numbers=_DN2D, feature_group_count=c.n_in,
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
         z = lax.conv_general_dilated(
             z.astype(self.compute_dtype), params["pW"].astype(self.compute_dtype),
             window_strides=(1, 1), padding="VALID", dimension_numbers=_DN2D,
-            preferred_element_type=acc_dtype(self.compute_dtype))
+            preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
         return self.activation(z).astype(self.dtype), state

@@ -11,14 +11,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import LayerImpl, NoParamLayerImpl, implements, acc_dtype
+from .base import LayerImpl, NoParamLayerImpl, implements, acc_dtype, pet_dtype
 
 
 def _dot(x, w, compute_dtype):
     # low-precision compute accumulates in f32 on the MXU (see acc_dtype)
     return jax.lax.dot_general(x.astype(compute_dtype), w.astype(compute_dtype),
                                (((x.ndim - 1,), (0,)), ((), ())),
-                               preferred_element_type=acc_dtype(compute_dtype))
+                               preferred_element_type=pet_dtype(compute_dtype))
 
 
 @implements("DenseLayer")

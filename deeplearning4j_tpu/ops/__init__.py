@@ -1,0 +1,6 @@
+"""Host-side native ops + bindings (reference libnd4j host-op seam —
+SURVEY.md §2.8). Device compute is XLA; this package covers the host data
+plane: gradient wire codec, fast dataset parsers."""
+from . import native
+
+__all__ = ["native"]

@@ -1,0 +1,193 @@
+"""ctypes bindings for the native host-runtime library.
+
+TPU-native equivalent of the reference's JNI seam to libnd4j host ops
+(SURVEY.md §2.8 item 1): gradient wire codec (thresholdEncode/bitmapEncode —
+``EncodingHandler.java:136-178``), IDX parsing, CSV parsing. Pure-numpy
+fallbacks keep everything working when the library isn't built; ``make -C
+native`` produces ``libdl4jtpu.so`` beside this module and the fast paths
+activate automatically.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "libdl4jtpu.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    lib.threshold_encode_f32.restype = ctypes.c_int64
+    lib.threshold_encode_f32.argtypes = [f32p, ctypes.c_int64, ctypes.c_float,
+                                         i32p, i8p, f32p]
+    lib.threshold_decode_f32.restype = None
+    lib.threshold_decode_f32.argtypes = [i32p, i8p, ctypes.c_int64,
+                                         ctypes.c_float, f32p, ctypes.c_int64]
+    lib.bitmap_encode_f32.restype = ctypes.c_int64
+    lib.bitmap_encode_f32.argtypes = [f32p, ctypes.c_int64, ctypes.c_float,
+                                      u32p, f32p]
+    lib.bitmap_decode_f32.restype = None
+    lib.bitmap_decode_f32.argtypes = [u32p, ctypes.c_int64, ctypes.c_float,
+                                      f32p]
+    lib.idx_read_header.restype = ctypes.c_int
+    lib.idx_read_header.argtypes = [ctypes.c_char_p, i32p, i32p, i64p]
+    lib.idx_read_u8.restype = ctypes.c_int
+    lib.idx_read_u8.argtypes = [ctypes.c_char_p, u8p, ctypes.c_int64]
+    lib.csv_parse_f32.restype = ctypes.c_int64
+    lib.csv_parse_f32.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                  ctypes.c_int64, f32p, ctypes.c_int64, i64p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ------------------------------------------------------------ gradient codec
+def threshold_encode(grad: np.ndarray, threshold: float
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(indices, signs, residual) — native when built, numpy fallback."""
+    g = np.ascontiguousarray(grad, np.float32).ravel()
+    lib = _load()
+    if lib is None:
+        idx = np.flatnonzero(np.abs(g) >= threshold).astype(np.int32)
+        signs = np.sign(g[idx]).astype(np.int8)
+        residual = g.copy()
+        residual[idx] -= signs.astype(np.float32) * threshold
+        return idx, signs, residual.reshape(grad.shape)
+    idx = np.empty(g.size, np.int32)
+    signs = np.empty(g.size, np.int8)
+    residual = np.empty(g.size, np.float32)
+    k = lib.threshold_encode_f32(_ptr(g, ctypes.c_float), g.size,
+                                 ctypes.c_float(threshold),
+                                 _ptr(idx, ctypes.c_int32),
+                                 _ptr(signs, ctypes.c_int8),
+                                 _ptr(residual, ctypes.c_float))
+    return idx[:k].copy(), signs[:k].copy(), residual.reshape(grad.shape)
+
+
+def threshold_decode(idx: np.ndarray, signs: np.ndarray, threshold: float,
+                     shape) -> np.ndarray:
+    n = int(np.prod(shape))
+    lib = _load()
+    if lib is None:
+        out = np.zeros(n, np.float32)
+        out[idx] = signs.astype(np.float32) * threshold
+        return out.reshape(shape)
+    idx = np.ascontiguousarray(idx, np.int32)
+    signs = np.ascontiguousarray(signs, np.int8)
+    out = np.empty(n, np.float32)
+    lib.threshold_decode_f32(_ptr(idx, ctypes.c_int32),
+                             _ptr(signs, ctypes.c_int8), idx.size,
+                             ctypes.c_float(threshold),
+                             _ptr(out, ctypes.c_float), n)
+    return out.reshape(shape)
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float
+                  ) -> Tuple[np.ndarray, int, np.ndarray]:
+    """(bitmap u32 words, nonzero count, residual) — 2 bits/element wire
+    format (reference bitmapEncode)."""
+    g = np.ascontiguousarray(grad, np.float32).ravel()
+    words = (g.size + 15) // 16
+    lib = _load()
+    if lib is None:
+        bitmap = np.zeros(words, np.uint32)
+        residual = g.copy()
+        pos = g >= threshold
+        neg = g <= -threshold
+        codes = np.where(pos, 1, np.where(neg, 2, 0)).astype(np.uint32)
+        residual[pos] -= threshold
+        residual[neg] += threshold
+        for i in np.flatnonzero(codes):
+            bitmap[i // 16] |= codes[i] << ((i % 16) * 2)
+        return bitmap, int(pos.sum() + neg.sum()), residual.reshape(grad.shape)
+    bitmap = np.empty(words, np.uint32)
+    residual = np.empty(g.size, np.float32)
+    k = lib.bitmap_encode_f32(_ptr(g, ctypes.c_float), g.size,
+                              ctypes.c_float(threshold),
+                              _ptr(bitmap, ctypes.c_uint32),
+                              _ptr(residual, ctypes.c_float))
+    return bitmap, int(k), residual.reshape(grad.shape)
+
+
+def bitmap_decode(bitmap: np.ndarray, n: int, threshold: float) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        out = np.zeros(n, np.float32)
+        for i in range(n):
+            code = (int(bitmap[i // 16]) >> ((i % 16) * 2)) & 3
+            out[i] = threshold if code == 1 else (-threshold if code == 2
+                                                  else 0.0)
+        return out
+    bitmap = np.ascontiguousarray(bitmap, np.uint32)
+    out = np.empty(n, np.float32)
+    lib.bitmap_decode_f32(_ptr(bitmap, ctypes.c_uint32), n,
+                          ctypes.c_float(threshold),
+                          _ptr(out, ctypes.c_float))
+    return out
+
+
+# ------------------------------------------------------------------- parsers
+def idx_read(path: str) -> Optional[np.ndarray]:
+    """Native IDX read for uncompressed u8 files; None → caller should use
+    the Python parser (gz files, other dtypes)."""
+    lib = _load()
+    if lib is None or path.endswith(".gz"):
+        return None
+    dtype_code = ctypes.c_int32()
+    ndim = ctypes.c_int32()
+    dims = (ctypes.c_int64 * 8)()
+    rc = lib.idx_read_header(path.encode(), ctypes.byref(dtype_code),
+                             ctypes.byref(ndim), dims)
+    if rc != 0 or dtype_code.value != 0x08:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape))
+    out = np.empty(n, np.uint8)
+    if lib.idx_read_u8(path.encode(), _ptr(out, ctypes.c_uint8), n) != 0:
+        return None
+    return out.reshape(shape)
+
+
+def csv_read_f32(path: str, delimiter: str = ",",
+                 skip_lines: int = 0) -> Optional[np.ndarray]:
+    """Native float CSV parse → [rows, cols] array; None when the library is
+    absent or the file has non-numeric fields."""
+    lib = _load()
+    if lib is None:
+        return None
+    cols = ctypes.c_int64()
+    rows = lib.csv_parse_f32(path.encode(), ctypes.c_char(delimiter.encode()),
+                             skip_lines, None, 0, ctypes.byref(cols))
+    if rows < 0:
+        return None
+    out = np.empty(rows * cols.value, np.float32)
+    rows2 = lib.csv_parse_f32(path.encode(), ctypes.c_char(delimiter.encode()),
+                              skip_lines, _ptr(out, ctypes.c_float), out.size,
+                              ctypes.byref(cols))
+    if rows2 != rows:
+        return None
+    return out.reshape(rows, cols.value)

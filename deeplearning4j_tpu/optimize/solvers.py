@@ -1,0 +1,248 @@
+"""Full-batch convex optimizers: Solver dispatch, LBFGS, CG, line search.
+
+TPU-native equivalent of reference ``optimize/`` (SURVEY.md §2.1
+"Optimization"): ``Solver`` dispatch (``Solver.java:64`` → LBFGS :68, LineGD
+:71, CG :74, SGD :77), ``BaseOptimizer.gradientAndScore``,
+``BackTrackLineSearch``, termination conditions (``EpsTermination``,
+``Norm2Termination``).
+
+The minibatch SGD path lives in the network fit loop (the reference's
+``StochasticGradientDescent``); these full-batch optimizers serve the same
+niche as the reference's: small problems, fine-tuning, scientific workloads.
+The loss+gradient evaluation is ONE jitted XLA computation over flattened
+params; the line-search/direction logic runs on host (cheap scalar work).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.conf import OptimizationAlgorithm
+
+log = logging.getLogger(__name__)
+
+
+def _flatten_params(params):
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    vec = np.concatenate([np.asarray(l, np.float64).ravel()
+                          for _, l in leaves]) if leaves else np.zeros(0)
+    meta = [(kp, np.shape(l), np.asarray(l).dtype) for kp, l in leaves]
+    treedef = jax.tree_util.tree_structure(params)
+    return vec, meta, treedef
+
+
+def _unflatten_params(vec, meta, treedef):
+    out = []
+    pos = 0
+    for _, shape, dtype in meta:
+        n = int(np.prod(shape)) if shape else 1
+        out.append(jnp.asarray(vec[pos:pos + n].reshape(shape), dtype=dtype))
+        pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (reference ``BackTrackLineSearch.java``)."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_iterations: int = 20):
+        self.c1 = c1
+        self.shrink = shrink
+        self.max_iterations = max_iterations
+
+    def search(self, f, x, fx, gx, direction, step0: float = 1.0
+               ) -> Tuple[float, float]:
+        """Returns (step, f(x + step*d)). Falls back to the smallest step."""
+        slope = float(gx @ direction)
+        if slope >= 0:  # not a descent direction — caller should reset
+            return 0.0, fx
+        step = step0
+        for _ in range(self.max_iterations):
+            fnew = f(x + step * direction)
+            if fnew <= fx + self.c1 * step * slope:
+                return step, fnew
+            step *= self.shrink
+        return step, f(x + step * direction)
+
+
+class BaseOptimizer:
+    """Shared machinery: jitted loss/grad over flattened params."""
+
+    def __init__(self, net, ds, max_iterations: int = 100, tol: float = 1e-8):
+        from ..nn.gradientcheck import _loss_at
+        self.net = net
+        self.max_iterations = max_iterations
+        self.tol = tol
+        vec, self._meta, self._treedef = _flatten_params(net.params)
+        self._x0 = vec
+
+        def loss_on_tree(p):
+            return _loss_at(net, p, ds)
+
+        self._loss_tree = jax.jit(loss_on_tree)
+        self._grad_tree = jax.jit(jax.value_and_grad(loss_on_tree))
+
+    def f(self, x: np.ndarray) -> float:
+        return float(self._loss_tree(_unflatten_params(x, self._meta,
+                                                       self._treedef)))
+
+    def f_g(self, x: np.ndarray) -> Tuple[float, np.ndarray]:
+        val, g = self._grad_tree(_unflatten_params(x, self._meta,
+                                                   self._treedef))
+        gvec = np.concatenate([np.asarray(l, np.float64).ravel()
+                               for l in jax.tree_util.tree_leaves(g)])
+        return float(val), gvec
+
+    def _commit(self, x):
+        self.net.params = _unflatten_params(x, self._meta, self._treedef)
+
+    def optimize(self) -> bool:
+        raise NotImplementedError
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent + line search (reference ``LineGradientDescent``)."""
+
+    def optimize(self) -> bool:
+        x = self._x0.copy()
+        ls = BackTrackLineSearch()
+        fx, g = self.f_g(x)
+        for it in range(self.max_iterations):
+            d = -g
+            step, fnew = ls.search(self.f, x, fx, g, d)
+            if step == 0.0 or abs(fx - fnew) < self.tol:
+                break
+            x = x + step * d
+            fx, g = self.f_g(x)
+        self._commit(x)
+        self.net.score_ = fx
+        return True
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Polak-Ribière+ nonlinear CG (reference ``ConjugateGradient``)."""
+
+    def optimize(self) -> bool:
+        x = self._x0.copy()
+        ls = BackTrackLineSearch()
+        fx, g = self.f_g(x)
+        d = -g
+        for it in range(self.max_iterations):
+            step, fnew = ls.search(self.f, x, fx, g, d)
+            if step == 0.0:
+                d = -g  # restart with steepest descent
+                step, fnew = ls.search(self.f, x, fx, g, d)
+                if step == 0.0:
+                    break
+            x = x + step * d
+            fprev, gprev = fx, g
+            fx, g = self.f_g(x)
+            if abs(fprev - fx) < self.tol:
+                break
+            beta = max(0.0, float(g @ (g - gprev) / max(gprev @ gprev, 1e-300)))
+            d = -g + beta * d
+        self._commit(x)
+        self.net.score_ = fx
+        return True
+
+
+class LBFGS(BaseOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference ``LBFGS``)."""
+
+    def __init__(self, net, ds, max_iterations: int = 100, tol: float = 1e-8,
+                 m: int = 10):
+        super().__init__(net, ds, max_iterations, tol)
+        self.m = m
+
+    def optimize(self) -> bool:
+        x = self._x0.copy()
+        ls = BackTrackLineSearch()
+        fx, g = self.f_g(x)
+        s_hist: List[np.ndarray] = []
+        y_hist: List[np.ndarray] = []
+        for it in range(self.max_iterations):
+            # two-loop recursion
+            q = g.copy()
+            alphas = []
+            for s, y in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / max(float(y @ s), 1e-300)
+                a = rho * float(s @ q)
+                alphas.append((a, rho))
+                q -= a * y
+            if y_hist:
+                y_last, s_last = y_hist[-1], s_hist[-1]
+                gamma = float(s_last @ y_last) / max(float(y_last @ y_last),
+                                                     1e-300)
+                q *= gamma
+            for (a, rho), s, y in zip(reversed(alphas), s_hist, y_hist):
+                b = rho * float(y @ q)
+                q += (a - b) * s
+            d = -q
+            step, fnew = ls.search(self.f, x, fx, g, d,
+                                   step0=1.0 if y_hist else
+                                   min(1.0, 1.0 / max(np.abs(g).sum(), 1e-12)))
+            if step == 0.0:
+                break
+            x_new = x + step * d
+            f_new, g_new = self.f_g(x_new)
+            s_hist.append(x_new - x)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            converged = abs(fx - f_new) < self.tol
+            x, fx, g = x_new, f_new, g_new
+            if converged:
+                break
+        self._commit(x)
+        self.net.score_ = fx
+        return True
+
+
+class Solver:
+    """Dispatch facade (reference ``Solver.java:43``; algo switch :64-77)."""
+
+    class Builder:
+        def __init__(self):
+            self._net = None
+            self._max_iterations = 100
+
+        def model(self, net):
+            self._net = net
+            return self
+
+        def max_iterations(self, n):
+            self._max_iterations = int(n)
+            return self
+
+        maxIterations = max_iterations
+
+        def build(self):
+            return Solver(self._net, self._max_iterations)
+
+    @staticmethod
+    def builder():
+        return Solver.Builder()
+
+    def __init__(self, net, max_iterations: int = 100):
+        self.net = net
+        self.max_iterations = max_iterations
+
+    def optimize(self, ds) -> bool:
+        """Full-batch optimization of the net on ``ds`` with the configured
+        algorithm; SGD falls through to the network's minibatch fit."""
+        algo = self.net.gc.optimization_algo
+        if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            self.net.fit(ds)
+            return True
+        cls = {OptimizationAlgorithm.LBFGS: LBFGS,
+               OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
+               OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent}
+        if algo not in cls:
+            raise ValueError(f"Unknown optimization algorithm '{algo}'")
+        return cls[algo](self.net, ds,
+                         max_iterations=self.max_iterations).optimize()

@@ -21,33 +21,33 @@ import numpy as np
 import jax
 
 
+from ..ops import native as _native
+
+
 def threshold_encode(grad: np.ndarray, threshold: float
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Sparsify: indices where |g| >= threshold, values quantized to
     ±threshold (the reference's 1-bit-per-significant-element scheme;
-    ``EncodingHandler.java:136``). Returns (int32 indices, int8 signs)."""
-    flat = grad.ravel()
-    idx = np.flatnonzero(np.abs(flat) >= threshold).astype(np.int32)
-    signs = np.sign(flat[idx]).astype(np.int8)
+    ``EncodingHandler.java:136``). Returns (int32 indices, int8 signs).
+    Uses the native codec (ops/libdl4jtpu.so) when built."""
+    idx, signs, _ = _native.threshold_encode(np.asarray(grad, np.float32),
+                                             threshold)
     return idx, signs
 
 
 def threshold_decode(idx: np.ndarray, signs: np.ndarray, threshold: float,
                      shape) -> np.ndarray:
     """Densify an encoded update (reference ``thresholdDecode``)."""
-    out = np.zeros(int(np.prod(shape)), np.float32)
-    out[idx] = signs.astype(np.float32) * threshold
-    return out.reshape(shape)
+    return _native.threshold_decode(idx, signs, threshold, shape)
 
 
 def encode_residual(grad: np.ndarray, threshold: float
                     ) -> Tuple[Tuple[np.ndarray, np.ndarray], np.ndarray]:
     """Encode and return the residual kept locally for the next round
     (reference keeps sub-threshold mass in the accumulator)."""
-    idx, signs = threshold_encode(grad, threshold)
-    residual = grad.copy().ravel()
-    residual[idx] -= signs.astype(np.float32) * threshold
-    return (idx, signs), residual.reshape(grad.shape)
+    idx, signs, residual = _native.threshold_encode(
+        np.asarray(grad, np.float32), threshold)
+    return (idx, signs), residual
 
 
 class EncodingHandler:

@@ -1,0 +1,9 @@
+"""Observability/UI (reference ``deeplearning4j-ui-parent`` — SURVEY.md §2.7):
+StatsListener, StatsStorage backends, training UI web server, remote router."""
+from .stats import (StatsListener, StatsReport, StatsStorage,
+                    InMemoryStatsStorage, FileStatsStorage, SqliteStatsStorage)
+from .server import UIServer, RemoteUIStatsStorageRouter
+
+__all__ = ["StatsListener", "StatsReport", "StatsStorage",
+           "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+           "UIServer", "RemoteUIStatsStorageRouter"]

@@ -1,0 +1,191 @@
+"""Training UI server.
+
+TPU-native equivalent of reference ``deeplearning4j-play``
+(``PlayUIServer.java:51``, train module overview/model tabs, remote receiver
+``RemoteReceiverModule``): a stdlib ``http.server`` serving
+ - ``/``                     — overview page (score chart, throughput, params)
+ - ``/train/sessions``       — JSON session list
+ - ``/train/overview?sid=``  — JSON score/updates series for charts
+ - ``/train/model?sid=``     — JSON per-parameter stats (histograms, norms)
+ - POST ``/remote``          — remote StatsReport receiver (the reference's
+   remote listener posting seam)
+
+No Play/SBE/webjars: the data API is plain JSON and the page is a single
+self-contained HTML document with inline SVG charts.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .stats import StatsStorage, StatsReport, InMemoryStatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j-tpu training</title>
+<style>body{font-family:sans-serif;margin:2em}h1{font-size:1.3em}
+.chart{border:1px solid #ccc;margin:1em 0}td,th{padding:2px 8px;text-align:right}
+th{background:#eee}</style></head>
+<body><h1>Training overview</h1>
+<div id="meta"></div>
+<svg id="score" class="chart" width="800" height="240"></svg>
+<table id="params"></table>
+<script>
+async function refresh(){
+  const sessions = await (await fetch('/train/sessions')).json();
+  if(!sessions.length){setTimeout(refresh,2000);return;}
+  const sid = sessions[sessions.length-1];
+  const ov = await (await fetch('/train/overview?sid='+sid)).json();
+  document.getElementById('meta').textContent =
+    'session '+sid+' — '+ov.iterations.length+' iterations, last score '+
+    (ov.scores.length?ov.scores[ov.scores.length-1].toFixed(5):'n/a');
+  const svg = document.getElementById('score');
+  svg.innerHTML='';
+  if(ov.scores.length>1){
+    const xs=ov.iterations, ys=ov.scores;
+    const xmin=Math.min(...xs), xmax=Math.max(...xs);
+    const ymin=Math.min(...ys), ymax=Math.max(...ys);
+    const pts=xs.map((x,i)=>((x-xmin)/(xmax-xmin||1)*780+10)+','+
+      (230-(ys[i]-ymin)/(ymax-ymin||1)*220)).join(' ');
+    svg.innerHTML='<polyline fill="none" stroke="#07c" points="'+pts+'"/>';
+  }
+  const model = await (await fetch('/train/model?sid='+sid)).json();
+  let html='<tr><th>param</th><th>norm2</th><th>mean</th><th>stdev</th></tr>';
+  for(const [name,st] of Object.entries(model.params||{})){
+    html+='<tr><td style="text-align:left">'+name+'</td><td>'+
+      (st.norm2||0).toFixed(4)+'</td><td>'+(st.mean!==undefined?st.mean.toFixed(5):'')+
+      '</td><td>'+(st.stdev!==undefined?st.stdev.toFixed(5):'')+'</td></tr>';
+  }
+  document.getElementById('params').innerHTML=html;
+  setTimeout(refresh,2000);
+}
+refresh();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None  # set by server factory
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        payload = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        if url.path in ("/", "/train", "/train/overview.html"):
+            payload = _PAGE.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if url.path == "/train/sessions":
+            self._json(self.storage.list_session_ids())
+            return
+        if url.path == "/train/overview":
+            sid = q.get("sid", [None])[0] or self._latest_session()
+            ups = self.storage.get_all_updates(sid) if sid else []
+            self._json({"iterations": [u.iteration for u in ups],
+                        "scores": [u.score for u in ups],
+                        "durations_ms": [u.duration_ms for u in ups]})
+            return
+        if url.path == "/train/model":
+            sid = q.get("sid", [None])[0] or self._latest_session()
+            latest = self.storage.get_latest_update(sid) if sid else None
+            self._json({"params": latest.param_stats if latest else {},
+                        "updates": latest.update_stats if latest else {}})
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        if urlparse(self.path).path != "/remote":
+            self._json({"error": "not found"}, 404)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode("utf-8")
+        try:
+            self.storage.put_update(StatsReport.from_json(body))
+            self._json({"status": "ok"})
+        except Exception as e:  # malformed report
+            self._json({"error": str(e)}, 400)
+
+    def _latest_session(self):
+        ids = self.storage.list_session_ids()
+        return ids[-1] if ids else None
+
+
+class UIServer:
+    """Reference ``UIServer.getInstance()`` / ``attach(statsStorage)``."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storage: StatsStorage = InMemoryStatsStorage()
+        self._httpd = None
+        self._thread = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    getInstance = get_instance
+
+    def attach(self, storage: StatsStorage):
+        self.storage = storage
+        if self._httpd is not None:
+            self._httpd.RequestHandlerClass.storage = storage
+        return self
+
+    def start(self, port: Optional[int] = None) -> int:
+        """Start serving; returns the bound port (0 → ephemeral)."""
+        if self._httpd is not None:
+            return self.port
+        if port is not None:
+            self.port = port
+        handler = type("BoundHandler", (_Handler,), {"storage": self.storage})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    detach = stop
+
+
+class RemoteUIStatsStorageRouter:
+    """Client for POSTing reports to a remote UI server (reference
+    ``RemoteUIStatsStorageRouter`` + ``RemoteReceiverModule``)."""
+
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+
+    def put_update(self, report: StatsReport):
+        import urllib.request
+        req = urllib.request.Request(
+            self.address + "/remote", data=report.to_json().encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    putUpdate = put_update

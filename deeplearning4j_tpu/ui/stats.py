@@ -1,0 +1,236 @@
+"""Training stats collection + storage.
+
+TPU-native equivalent of reference ``deeplearning4j-ui-model`` (SURVEY.md §2.7):
+``StatsListener`` (``BaseStatsListener.java:44``, ``iterationDone`` :286-307 —
+score, param/gradient/update histograms & norms, memory, timing per iteration),
+the ``StatsStorage`` SPI (``deeplearning4j-core/.../api/storage/``) and the
+in-memory / file / sqlite backends (``ui/storage/``). The reference's SBE
+binary codecs are replaced by JSON records — the wire format matters only to
+its Java frontend; the information content is preserved.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+
+
+# ---------------------------------------------------------------- stat record
+def _array_stats(arr: np.ndarray, bins: int = 20) -> Dict[str, Any]:
+    a = np.asarray(arr, np.float64).ravel()
+    if a.size == 0:
+        return {}
+    hist, edges = np.histogram(a, bins=bins)
+    return {"mean": float(a.mean()), "stdev": float(a.std()),
+            "min": float(a.min()), "max": float(a.max()),
+            "norm2": float(np.linalg.norm(a)),
+            "mean_magnitude": float(np.abs(a).mean()),
+            "histogram": hist.tolist(),
+            "histogram_edges": [float(edges[0]), float(edges[-1])]}
+
+
+class StatsReport:
+    """One iteration's stats (reference ``StatsReport``/SBE payload)."""
+
+    def __init__(self, session_id: str, worker_id: str, iteration: int,
+                 timestamp: float, score: float,
+                 param_stats: Dict[str, Dict], update_stats: Dict[str, Dict],
+                 duration_ms: float, memory_bytes: Optional[int] = None):
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.iteration = iteration
+        self.timestamp = timestamp
+        self.score = score
+        self.param_stats = param_stats
+        self.update_stats = update_stats
+        self.duration_ms = duration_ms
+        self.memory_bytes = memory_bytes
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+    @staticmethod
+    def from_json(s: str) -> "StatsReport":
+        d = json.loads(s)
+        return StatsReport(**d)
+
+
+# -------------------------------------------------------------------- storage
+class StatsStorage:
+    """SPI (reference ``api/storage/StatsStorage.java``)."""
+
+    def put_update(self, report: StatsReport):
+        raise NotImplementedError
+
+    putUpdate = put_update
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    listSessionIDs = list_session_ids
+
+    def get_all_updates(self, session_id: str) -> List[StatsReport]:
+        raise NotImplementedError
+
+    getAllUpdates = get_all_updates
+
+    def get_latest_update(self, session_id: str) -> Optional[StatsReport]:
+        ups = self.get_all_updates(session_id)
+        return ups[-1] if ups else None
+
+    getLatestUpdate = get_latest_update
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference ``ui/storage/InMemoryStatsStorage``."""
+
+    def __init__(self):
+        self._updates: Dict[str, List[StatsReport]] = {}
+        self._lock = threading.Lock()
+
+    def put_update(self, report: StatsReport):
+        with self._lock:
+            self._updates.setdefault(report.session_id, []).append(report)
+
+    putUpdate = put_update
+
+    def list_session_ids(self):
+        return list(self._updates)
+
+    listSessionIDs = list_session_ids
+
+    def get_all_updates(self, session_id):
+        return list(self._updates.get(session_id, []))
+
+    getAllUpdates = get_all_updates
+
+
+class FileStatsStorage(StatsStorage):
+    """JSON-lines file storage (reference ``FileStatsStorage`` is MapDB; same
+    durability contract: every update is persisted and reloadable)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def put_update(self, report: StatsReport):
+        with self._lock:
+            self._fh.write(report.to_json() + "\n")
+            self._fh.flush()
+
+    putUpdate = put_update
+
+    def _read_all(self) -> List[StatsReport]:
+        with self._lock:
+            self._fh.flush()
+        out = []
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(StatsReport.from_json(line))
+        return out
+
+    def list_session_ids(self):
+        return sorted({r.session_id for r in self._read_all()})
+
+    listSessionIDs = list_session_ids
+
+    def get_all_updates(self, session_id):
+        return [r for r in self._read_all() if r.session_id == session_id]
+
+    getAllUpdates = get_all_updates
+
+    def close(self):
+        self._fh.close()
+
+
+class SqliteStatsStorage(StatsStorage):
+    """Reference ``ui/storage/sqlite/J7FileStatsStorage`` counterpart."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS updates (session_id TEXT, "
+            "iteration INTEGER, payload TEXT)")
+        self._conn.commit()
+
+    def put_update(self, report: StatsReport):
+        with self._lock:
+            self._conn.execute("INSERT INTO updates VALUES (?, ?, ?)",
+                               (report.session_id, report.iteration,
+                                report.to_json()))
+            self._conn.commit()
+
+    putUpdate = put_update
+
+    def list_session_ids(self):
+        cur = self._conn.execute("SELECT DISTINCT session_id FROM updates")
+        return [r[0] for r in cur.fetchall()]
+
+    listSessionIDs = list_session_ids
+
+    def get_all_updates(self, session_id):
+        cur = self._conn.execute(
+            "SELECT payload FROM updates WHERE session_id=? ORDER BY iteration",
+            (session_id,))
+        return [StatsReport.from_json(r[0]) for r in cur.fetchall()]
+
+    getAllUpdates = get_all_updates
+
+    def close(self):
+        self._conn.close()
+
+
+# ------------------------------------------------------------------- listener
+class StatsListener(TrainingListener):
+    """Reference ``BaseStatsListener.java:286`` iterationDone: collect score +
+    per-param statistics into a StatsStorage every ``frequency`` iterations."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "worker0",
+                 collect_histograms: bool = True):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session_{int(time.time() * 1e3)}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self._last_time = None
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        duration = 0.0 if self._last_time is None else (now - self._last_time) * 1e3
+        self._last_time = now
+        params = {}
+        updates = {}
+        table = model.param_table()
+        for name, arr in table.items():
+            a = np.asarray(arr)
+            params[name] = _array_stats(a) if self.collect_histograms else {
+                "norm2": float(np.linalg.norm(a))}
+            if self._prev_params is not None and name in self._prev_params:
+                delta = a - self._prev_params[name]
+                updates[name] = (_array_stats(delta) if self.collect_histograms
+                                 else {"norm2": float(np.linalg.norm(delta))})
+        self._prev_params = {k: np.asarray(v).copy() for k, v in table.items()}
+        report = StatsReport(self.session_id, self.worker_id, int(iteration),
+                             time.time(), float(score), params, updates,
+                             duration)
+        self.storage.put_update(report)

@@ -1,0 +1,173 @@
+// Native host-side runtime components for deeplearning4j_tpu.
+//
+// TPU-native equivalent of the reference's host/native support layer
+// (SURVEY.md §2.8): where the reference reaches libnd4j via JNI for
+// threshold/bitmap gradient encoding (EncodingHandler.java:136-178 →
+// Nd4j.getExecutioner().thresholdEncode) and JavaCPP-native file parsing,
+// this library provides the same hot host-side ops as a C ABI consumed via
+// ctypes (deeplearning4j_tpu/ops/native.py). Device compute stays in XLA;
+// this covers the host data plane: gradient wire codec (DCN path), IDX/CIFAR
+// dataset parsing, CSV records.
+//
+// Build: make -C native   (g++ -O3 -shared; no external dependencies)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+
+// ----------------------------------------------------------- gradient codec
+// Strom-style threshold encoding: indices of |g| >= threshold, ±1 signs,
+// residual = g - sign*threshold at encoded positions (else g).
+// Returns the number of encoded elements (<= n).
+int64_t threshold_encode_f32(const float* grad, int64_t n, float threshold,
+                             int32_t* idx_out, int8_t* signs_out,
+                             float* residual_out) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grad[i];
+        if (g >= threshold) {
+            idx_out[k] = (int32_t)i;
+            signs_out[k] = 1;
+            residual_out[i] = g - threshold;
+            ++k;
+        } else if (g <= -threshold) {
+            idx_out[k] = (int32_t)i;
+            signs_out[k] = -1;
+            residual_out[i] = g + threshold;
+            ++k;
+        } else {
+            residual_out[i] = g;
+        }
+    }
+    return k;
+}
+
+void threshold_decode_f32(const int32_t* idx, const int8_t* signs, int64_t k,
+                          float threshold, float* out, int64_t n) {
+    memset(out, 0, (size_t)n * sizeof(float));
+    for (int64_t i = 0; i < k; ++i) {
+        out[idx[i]] = threshold * (float)signs[i];
+    }
+}
+
+// Bitmap encoding (reference bitmapEncode): 2 bits per element
+// (0: zero, 1: +threshold, 2: -threshold). out must hold (n+15)/16 u32 words.
+int64_t bitmap_encode_f32(const float* grad, int64_t n, float threshold,
+                          uint32_t* bitmap_out, float* residual_out) {
+    int64_t words = (n + 15) / 16;
+    memset(bitmap_out, 0, (size_t)words * sizeof(uint32_t));
+    int64_t nonzero = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grad[i];
+        uint32_t code = 0;
+        if (g >= threshold) {
+            code = 1; residual_out[i] = g - threshold; ++nonzero;
+        } else if (g <= -threshold) {
+            code = 2; residual_out[i] = g + threshold; ++nonzero;
+        } else {
+            residual_out[i] = g;
+        }
+        bitmap_out[i / 16] |= code << ((i % 16) * 2);
+    }
+    return nonzero;
+}
+
+void bitmap_decode_f32(const uint32_t* bitmap, int64_t n, float threshold,
+                       float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t code = (bitmap[i / 16] >> ((i % 16) * 2)) & 3u;
+        out[i] = code == 1 ? threshold : (code == 2 ? -threshold : 0.0f);
+    }
+}
+
+// --------------------------------------------------------------- IDX parser
+// Reads an (uncompressed) IDX file. Returns 0 on success, negative on error.
+// dims must hold up to 8 entries; *ndim and dims are filled from the header.
+static uint32_t read_be32(const unsigned char* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+         | ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+int idx_read_header(const char* path, int32_t* dtype_code, int32_t* ndim,
+                    int64_t* dims) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[4];
+    if (fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0) {
+        fclose(f);
+        return -2;
+    }
+    *dtype_code = hdr[2];
+    *ndim = hdr[3];
+    if (*ndim > 8) { fclose(f); return -3; }
+    unsigned char dimbuf[4];
+    for (int i = 0; i < *ndim; ++i) {
+        if (fread(dimbuf, 1, 4, f) != 4) { fclose(f); return -4; }
+        dims[i] = (int64_t)read_be32(dimbuf);
+    }
+    fclose(f);
+    return 0;
+}
+
+// Reads the payload of a u8 IDX file into out (size n). Returns 0 on success.
+int idx_read_u8(const char* path, uint8_t* out, int64_t n) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[4];
+    if (fread(hdr, 1, 4, f) != 4) { fclose(f); return -2; }
+    int ndim = hdr[3];
+    if (fseek(f, 4 + 4 * ndim, SEEK_SET) != 0) { fclose(f); return -3; }
+    size_t got = fread(out, 1, (size_t)n, f);
+    fclose(f);
+    return got == (size_t)n ? 0 : -4;
+}
+
+// ---------------------------------------------------------------- CSV parser
+// Parses a CSV of floats. Returns number of rows (>=0) or negative error.
+// On first call pass out=NULL to probe rows/cols (written to *cols_out and
+// return value); then call again with a buffer of rows*cols floats.
+int64_t csv_parse_f32(const char* path, char delim, int64_t skip_lines,
+                      float* out, int64_t capacity, int64_t* cols_out) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    char* line = NULL;
+    size_t cap = 0;
+    ssize_t len;
+    int64_t row = 0, cols = -1, written = 0;
+    for (int64_t s = 0; s < skip_lines; ++s) {
+        if (getline(&line, &cap, f) < 0) break;
+    }
+    while ((len = getline(&line, &cap, f)) >= 0) {
+        if (len == 0 || line[0] == '\n') continue;
+        int64_t c = 0;
+        char* p = line;
+        while (*p && *p != '\n') {
+            char* end = p;
+            float v = strtof(p, &end);
+            if (end == p) { // not a number
+                free(line); fclose(f); return -2;
+            }
+            if (out) {
+                if (written >= capacity) { free(line); fclose(f); return -3; }
+                out[written++] = v;
+            }
+            ++c;
+            p = end;
+            if (*p == delim) ++p;
+        }
+        if (cols < 0) cols = c;
+        else if (c != cols) { free(line); fclose(f); return -4; }
+        ++row;
+    }
+    free(line);
+    fclose(f);
+    if (cols_out) *cols_out = cols < 0 ? 0 : cols;
+    return row;
+}
+
+}  // extern "C"

@@ -1,0 +1,167 @@
+"""Graph embeddings + clustering/nearest-neighbor tests (reference families:
+deeplearning4j-graph tests, nearestneighbor-core tests, t-SNE)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (Graph, GraphLoader, RandomWalkIterator,
+                                      WeightedRandomWalkIterator, DeepWalk)
+from deeplearning4j_tpu.clustering import (VPTree, KDTree, QuadTree, SpTree,
+                                           KMeansClustering, Tsne,
+                                           BarnesHutTsne)
+
+
+# ---------------------------------------------------------------------- graph
+def _two_cliques(k=6):
+    """Two k-cliques joined by one bridge edge."""
+    g = Graph(2 * k)
+    for a in range(k):
+        for b in range(a + 1, k):
+            g.add_edge(a, b)
+            g.add_edge(k + a, k + b)
+    g.add_edge(0, k)
+    return g
+
+
+def test_graph_api_and_walks():
+    g = _two_cliques(4)
+    assert g.num_vertices() == 8
+    assert set(g.get_connected_vertices(1)) == {0, 2, 3}
+    walks = list(RandomWalkIterator(g, walk_length=5, seed=0))
+    assert len(walks) == 8
+    assert all(len(w) == 5 for w in walks)
+    # every step follows an edge
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertices(a) or a == b
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3, directed=False)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.01)
+    it = WeightedRandomWalkIterator(g, walk_length=2, seed=1,
+                                    walks_per_vertex=50)
+    seconds = [w[1] for w in it if w[0] == 0]
+    assert seconds.count(1) > seconds.count(2)
+
+
+def test_graph_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0 1\n1 2\n2 0\n")
+    g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 3)
+    assert set(g.get_connected_vertices(0)) == {1, 2}
+    pw = tmp_path / "weighted.csv"
+    pw.write_text("0,1,2.5\n1,2,1.0\n")
+    gw = GraphLoader.load_weighted_edge_list_file(str(pw), 3)
+    assert gw.get_connected_with_weights(0) == [(1, 2.5)]
+
+
+def test_deepwalk_clusters_cliques():
+    g = _two_cliques(6)
+    dw = (DeepWalk.builder().vector_size(16).window_size(3).walk_length(20)
+          .walks_per_vertex(8).epochs(3).seed(2).build())
+    gv = dw.fit(g)
+    # same-clique similarity beats cross-clique (excluding bridge vertices)
+    within = gv.similarity(1, 2)
+    across = gv.similarity(1, 8)
+    assert within > across, (within, across)
+    assert gv.get_vertex_vector(3).shape == (16,)
+
+
+# ----------------------------------------------------------------------- trees
+def _blobs(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(n // 2, 3)) + np.array([5, 0, 0])
+    b = rng.normal(size=(n // 2, 3)) - np.array([5, 0, 0])
+    return np.concatenate([a, b])
+
+
+def test_vptree_matches_bruteforce():
+    pts = _blobs(80)
+    tree = VPTree(pts)
+    q = pts[7] + 0.1
+    idxs, dists = tree.search(q, 5)
+    brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+    assert set(idxs) == set(brute.tolist())
+    assert dists == sorted(dists)
+
+
+def test_vptree_cosine():
+    pts = np.asarray([[1, 0], [0.9, 0.1], [0, 1.0], [-1, 0]], np.float64)
+    tree = VPTree(pts, distance="cosine")
+    idxs, _ = tree.search(np.array([1.0, 0.05]), 2)
+    assert set(idxs) == {0, 1}
+
+
+def test_kdtree_matches_bruteforce():
+    pts = _blobs(70, seed=1)
+    tree = KDTree(pts)
+    q = np.array([4.0, 0.5, -0.5])
+    idxs, dists = tree.knn(q, 4)
+    brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:4]
+    assert set(idxs) == set(brute.tolist())
+    nn_idx, nn_d = tree.nn(q)
+    assert nn_idx == brute[0]
+
+
+def test_sptree_center_of_mass():
+    pts = _blobs(50, seed=2)
+    tree = SpTree(pts)
+    np.testing.assert_allclose(tree.root.com, pts.mean(axis=0), atol=1e-9)
+    assert tree.root.mass == 50
+    with pytest.raises(ValueError):
+        QuadTree(pts)  # 3-D points rejected
+
+
+# ---------------------------------------------------------------------- kmeans
+def test_kmeans_separates_blobs():
+    pts = _blobs(100, seed=3)
+    cs = KMeansClustering.setup(2, max_iterations=50).apply_to(pts)
+    a = set(cs.assignments[:50].tolist())
+    b = set(cs.assignments[50:].tolist())
+    assert len(a) == 1 and len(b) == 1 and a != b
+    clusters = cs.get_clusters()
+    assert sum(len(c.indices) for c in clusters) == 100
+    assert cs.nearest_cluster([5, 0, 0]) == cs.assignments[0]
+
+
+# ------------------------------------------------------------------------ tsne
+def test_tsne_exact_separates_blobs():
+    pts = _blobs(60, seed=4)
+    emb = Tsne(perplexity=10, n_iter=300, seed=4).fit_transform(pts)
+    assert emb.shape == (60, 2)
+    ca = emb[:30].mean(axis=0)
+    cb = emb[30:].mean(axis=0)
+    spread_a = np.linalg.norm(emb[:30] - ca, axis=1).mean()
+    assert np.linalg.norm(ca - cb) > 2 * spread_a
+
+
+def test_tsne_barnes_hut_separates_blobs():
+    pts = _blobs(60, seed=5)
+    emb = BarnesHutTsne(theta=0.5, perplexity=10, n_iter=400,
+                        seed=5).fit_transform(pts)
+    assert emb.shape == (60, 2)
+    ca = emb[:30].mean(axis=0)
+    cb = emb[30:].mean(axis=0)
+    spread_a = np.linalg.norm(emb[:30] - ca, axis=1).mean()
+    assert np.linalg.norm(ca - cb) > 2 * spread_a
+    # 5-NN label purity: embedding preserves cluster structure
+    lab = np.array([0] * 30 + [1] * 30)
+    purity = 0.0
+    for i in range(60):
+        d = np.linalg.norm(emb - emb[i], axis=1)
+        d[i] = np.inf
+        purity += (lab[np.argsort(d)[:5]] == lab[i]).mean()
+    assert purity / 60 > 0.9
+
+
+def test_sptree_duplicate_points_no_recursion():
+    # >MAX_LEAF coincident points must not blow the stack (review finding)
+    tree = SpTree(np.zeros((20, 2)))
+    assert tree.root.mass == 20
+
+
+def test_kmeans_duplicate_points_no_crash():
+    # all-identical points must not crash k-means++ (review finding)
+    cs = KMeansClustering.setup(2, max_iterations=5).apply_to(np.zeros((10, 2)))
+    assert len(cs.centroids) == 2

@@ -1,0 +1,267 @@
+"""Keras import golden-file tests (reference pattern: 23 test classes
+deserializing stored Keras 1/2 HDF5 models — SURVEY.md §4 item 8).
+
+No TensorFlow in this image, so fixtures are written in the genuine Keras 2
+HDF5 full-model layout (``model_config`` JSON attr + ``model_weights`` groups
+with ``layer_names``/``weight_names`` attrs) and outputs are cross-checked
+against a NumPy forward-pass oracle implementing Keras semantics directly.
+"""
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.model_import import KerasModelImport
+
+
+# ----------------------------------------------------------- fixture writing
+def _write_keras_h5(path, model_config, layer_weights, training_config=None):
+    """layer_weights: {layer_name: {weight_name: array}} (weight_name like
+    'dense_1/kernel:0')."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode("utf-8")
+        f.attrs["keras_version"] = b"2.2.4"
+        f.attrs["backend"] = b"tensorflow"
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config).encode("utf-8")
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [n.encode("utf-8") for n in layer_weights]
+        for lname, weights in layer_weights.items():
+            grp = mw.create_group(lname)
+            grp.attrs["weight_names"] = [w.encode("utf-8") for w in weights]
+            for wname, arr in weights.items():
+                grp.create_dataset(wname, data=arr)
+
+
+def _seq_config(layers):
+    return {"class_name": "Sequential",
+            "config": {"name": "sequential", "layers": layers}}
+
+
+# ------------------------------------------------------------------- oracles
+def _np_dense(x, k, b, act):
+    z = x @ k + b
+    if act == "relu":
+        return np.maximum(z, 0)
+    if act == "softmax":
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+    if act == "tanh":
+        return np.tanh(z)
+    return z
+
+
+def test_sequential_mlp_import_matches_oracle(tmp_path):
+    rng = np.random.default_rng(0)
+    k1 = rng.normal(size=(8, 16)).astype(np.float32)
+    b1 = rng.normal(size=(16,)).astype(np.float32)
+    k2 = rng.normal(size=(16, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    cfg = _seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "units": 16, "activation": "relu",
+                    "use_bias": True, "batch_input_shape": [None, 8]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "units": 3, "activation": "softmax",
+                    "use_bias": True}},
+    ])
+    path = str(tmp_path / "mlp.h5")
+    _write_keras_h5(path, cfg, {
+        "dense_1": {"dense_1/kernel:0": k1, "dense_1/bias:0": b1},
+        "dense_2": {"dense_2/kernel:0": k2, "dense_2/bias:0": b2},
+    }, training_config={"loss": "categorical_crossentropy"})
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    expected = _np_dense(_np_dense(x, k1, b1, "relu"), k2, b2, "softmax")
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected, rtol=1e-5,
+                               atol=1e-6)
+    assert type(net.conf.layers[-1]).__name__ == "OutputLayer"
+    assert net.conf.layers[-1].loss == "mcxent"
+
+
+def test_sequential_cnn_import_matches_oracle(tmp_path):
+    rng = np.random.default_rng(1)
+    kconv = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)  # HWIO
+    bconv = rng.normal(size=(4,)).astype(np.float32)
+    kd = rng.normal(size=(4 * 4 * 4, 3)).astype(np.float32)
+    bd = rng.normal(size=(3,)).astype(np.float32)
+    cfg = _seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "conv", "filters": 4, "kernel_size": [3, 3],
+                    "strides": [1, 1], "padding": "same", "activation": "relu",
+                    "use_bias": True,
+                    "batch_input_shape": [None, 8, 8, 2]}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                    "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "flatten"}},
+        {"class_name": "Dense",
+         "config": {"name": "dense", "units": 3, "activation": "softmax",
+                    "use_bias": True}},
+    ])
+    path = str(tmp_path / "cnn.h5")
+    _write_keras_h5(path, cfg, {
+        "conv": {"conv/kernel:0": kconv, "conv/bias:0": bconv},
+        "dense": {"dense/kernel:0": kd, "dense/bias:0": bd},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    # oracle with scipy-free conv: brute force NHWC SAME conv
+    x_nhwc = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)
+    pad = np.pad(x_nhwc, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    conv = np.zeros((2, 8, 8, 4), np.float32)
+    for i in range(8):
+        for j in range(8):
+            patch = pad[:, i:i + 3, j:j + 3, :]
+            conv[:, i, j, :] = np.tensordot(patch, kconv, axes=([1, 2, 3],
+                                                                [0, 1, 2]))
+    conv = np.maximum(conv + bconv, 0)
+    pool = conv.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))
+    flat = pool.reshape(2, -1)
+    expected = _np_dense(flat, kd, bd, "softmax")
+
+    x_nchw = np.transpose(x_nhwc, (0, 3, 1, 2))  # our user-facing layout
+    out = np.asarray(net.output(x_nchw))
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_lstm_gate_reorder(tmp_path):
+    """LSTM import must permute Keras (i,f,c,o) gates to our (i,f,o,g)."""
+    rng = np.random.default_rng(2)
+    IN, H, T = 3, 4, 6
+    kernel = rng.normal(size=(IN, 4 * H)).astype(np.float32)
+    rkernel = rng.normal(size=(H, 4 * H)).astype(np.float32)
+    bias = rng.normal(size=(4 * H,)).astype(np.float32)
+    cfg = _seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "lstm", "units": H, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": True,
+                    "batch_input_shape": [None, T, IN]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense", "units": 2, "activation": "softmax",
+                    "use_bias": True}},
+    ])
+    kd = rng.normal(size=(H, 2)).astype(np.float32)
+    bd = rng.normal(size=(2,)).astype(np.float32)
+    path = str(tmp_path / "lstm.h5")
+    _write_keras_h5(path, cfg, {
+        "lstm": {"lstm/kernel:0": kernel, "lstm/recurrent_kernel:0": rkernel,
+                 "lstm/bias:0": bias},
+        "dense": {"dense/kernel:0": kd, "dense/bias:0": bd},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    # NumPy oracle implementing Keras LSTM gate order exactly
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    x = rng.normal(size=(2, T, IN)).astype(np.float32)
+    h = np.zeros((2, H), np.float32)
+    c = np.zeros((2, H), np.float32)
+    hs = []
+    for t in range(T):
+        z = x[:, t] @ kernel + h @ rkernel + bias
+        i = sigmoid(z[:, 0:H])
+        fg = sigmoid(z[:, H:2 * H])
+        g = np.tanh(z[:, 2 * H:3 * H])
+        o = sigmoid(z[:, 3 * H:4 * H])
+        c = fg * c + i * g
+        h = o * np.tanh(c)
+        hs.append(h.copy())
+    seq = np.stack(hs, axis=1)
+    expected = _np_dense(seq.reshape(-1, H), kd, bd, "softmax").reshape(2, T, 2)
+
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_model_with_add(tmp_path):
+    """Functional API: two dense branches merged with Add → ComputationGraph."""
+    rng = np.random.default_rng(3)
+    k1 = rng.normal(size=(6, 8)).astype(np.float32)
+    b1 = np.zeros(8, np.float32)
+    k2 = rng.normal(size=(6, 8)).astype(np.float32)
+    b2 = np.zeros(8, np.float32)
+    ko = rng.normal(size=(8, 2)).astype(np.float32)
+    bo = np.zeros(2, np.float32)
+    cfg = {"class_name": "Model", "config": {
+        "name": "model",
+        "layers": [
+            {"class_name": "InputLayer", "name": "input_1",
+             "config": {"name": "input_1",
+                        "batch_input_shape": [None, 6]},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "name": "branch_a",
+             "config": {"name": "branch_a", "units": 8, "activation": "relu",
+                        "use_bias": True},
+             "inbound_nodes": [[["input_1", 0, 0, {}]]]},
+            {"class_name": "Dense", "name": "branch_b",
+             "config": {"name": "branch_b", "units": 8, "activation": "relu",
+                        "use_bias": True},
+             "inbound_nodes": [[["input_1", 0, 0, {}]]]},
+            {"class_name": "Add", "name": "add",
+             "config": {"name": "add"},
+             "inbound_nodes": [[["branch_a", 0, 0, {}],
+                                ["branch_b", 0, 0, {}]]]},
+            {"class_name": "Dense", "name": "out",
+             "config": {"name": "out", "units": 2, "activation": "softmax",
+                        "use_bias": True},
+             "inbound_nodes": [[["add", 0, 0, {}]]]},
+        ],
+        "input_layers": [["input_1", 0, 0]],
+        "output_layers": [["out", 0, 0]],
+    }}
+    path = str(tmp_path / "func.h5")
+    _write_keras_h5(path, cfg, {
+        "branch_a": {"branch_a/kernel:0": k1, "branch_a/bias:0": b1},
+        "branch_b": {"branch_b/kernel:0": k2, "branch_b/bias:0": b2},
+        "out": {"out/kernel:0": ko, "out/bias:0": bo},
+    }, training_config={"loss": "categorical_crossentropy"})
+
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    a = np.maximum(x @ k1 + b1, 0)
+    b = np.maximum(x @ k2 + b2, 0)
+    expected = _np_dense(a + b, ko, bo, "softmax")
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_imported_model_is_trainable(tmp_path):
+    rng = np.random.default_rng(4)
+    cfg = _seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 8, "activation": "tanh",
+                    "use_bias": True, "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "units": 3, "activation": "softmax",
+                    "use_bias": True}},
+    ])
+    path = str(tmp_path / "train.h5")
+    _write_keras_h5(path, cfg, {
+        "d1": {"d1/kernel:0": rng.normal(size=(4, 8)).astype(np.float32),
+               "d1/bias:0": np.zeros(8, np.float32)},
+        "d2": {"d2/kernel:0": rng.normal(size=(8, 3)).astype(np.float32),
+               "d2/bias:0": np.zeros(3, np.float32)},
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    from deeplearning4j_tpu import DataSet
+    f = rng.normal(size=(16, 4)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    s0 = net.score(DataSet(f, l))
+    for _ in range(5):
+        net.fit(DataSet(f, l))
+    assert net.score(DataSet(f, l)) < s0
+
+
+def test_unsupported_layer_raises(tmp_path):
+    cfg = _seq_config([
+        {"class_name": "Lambda", "config": {"name": "weird"}},
+    ])
+    path = str(tmp_path / "bad.h5")
+    _write_keras_h5(path, cfg, {})
+    with pytest.raises(ValueError, match="Unsupported Keras layer"):
+        KerasModelImport.import_keras_sequential_model_and_weights(path)

@@ -1,0 +1,85 @@
+"""Native C++ ops vs Python-path cross-validation (the reference's
+native-vs-builtin test pattern, SURVEY.md §4 item 4). Skips gracefully when
+the library isn't built; CI builds it via `make -C native`."""
+import subprocess
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    if not native.available():
+        rc = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                            capture_output=True)
+        if rc.returncode != 0 or not native.available():
+            pytest.skip("native library not built and no toolchain")
+
+
+def test_native_is_loaded():
+    assert native.available()
+
+
+def test_threshold_codec_native_vs_python():
+    rng = np.random.default_rng(0)
+    g = (rng.normal(size=10_000) * 0.01).astype(np.float32)
+    thr = 0.01
+    idx_n, signs_n, res_n = native.threshold_encode(g, thr)
+    # python oracle
+    idx_p = np.flatnonzero(np.abs(g) >= thr).astype(np.int32)
+    signs_p = np.sign(g[idx_p]).astype(np.int8)
+    np.testing.assert_array_equal(idx_n, idx_p)
+    np.testing.assert_array_equal(signs_n, signs_p)
+    dec = native.threshold_decode(idx_n, signs_n, thr, g.shape)
+    np.testing.assert_allclose(dec + res_n, g, atol=1e-7)
+
+
+def test_bitmap_codec_roundtrip():
+    rng = np.random.default_rng(1)
+    g = (rng.normal(size=1000) * 0.05).astype(np.float32)
+    thr = 0.05
+    bitmap, k, res = native.bitmap_encode(g, thr)
+    dec = native.bitmap_decode(bitmap, g.size, thr)
+    assert k == int((np.abs(g) >= thr).sum())
+    np.testing.assert_allclose(dec + res, g, atol=1e-7)
+    # wire size: 2 bits/element
+    assert bitmap.nbytes == ((g.size + 15) // 16) * 4
+
+
+def test_native_idx_matches_python(tmp_path):
+    from deeplearning4j_tpu.datasets.fetchers import read_idx, write_idx
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 255, size=(20, 28, 28)).astype(np.uint8)
+    p = str(tmp_path / "imgs-idx3-ubyte")
+    write_idx(p, arr)
+    fast = native.idx_read(p)
+    assert fast is not None
+    np.testing.assert_array_equal(fast, arr)
+    np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_native_csv_matches_python(tmp_path):
+    p = tmp_path / "data.csv"
+    rng = np.random.default_rng(3)
+    rows = rng.normal(size=(50, 4)).astype(np.float32)
+    p.write_text("h1,h2,h3,h4\n" + "\n".join(
+        ",".join(f"{v:.6f}" for v in r) for r in rows))
+    out = native.csv_read_f32(str(p), skip_lines=1)
+    assert out is not None
+    np.testing.assert_allclose(out, rows, atol=1e-6)
+
+
+def test_accumulator_uses_native_path():
+    from deeplearning4j_tpu.parallel import EncodedGradientsAccumulator
+    acc = EncodedGradientsAccumulator(initial_threshold=0.05)
+    rng = np.random.default_rng(4)
+    grads = {"0": {"W": (rng.normal(size=(32, 32)) * 0.1).astype(np.float32)}}
+    decoded = acc.store_update(grads)
+    residual = list(acc._residual.values())[0]
+    np.testing.assert_allclose(np.asarray(decoded["0"]["W"]) + residual,
+                               grads["0"]["W"], atol=1e-6)

@@ -1,0 +1,150 @@
+"""NLP tests (reference family: 78 test classes in deeplearning4j-nlp —
+tokenization, vocab/Huffman, Word2Vec convergence, ParagraphVectors, GloVe,
+serialization round trips)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (DefaultTokenizerFactory, CommonPreprocessor,
+                                    CollectionSentenceIterator, VocabCache,
+                                    Huffman, build_vocab, Word2Vec, CBOW,
+                                    ParagraphVectors, Glove,
+                                    WordVectorSerializer)
+
+
+def _topic_corpus(n=300, seed=0):
+    """Two topics with disjoint vocab; embeddings must cluster by topic."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache", "bus"]
+    sentences = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        sentences.append(" ".join(rng.choice(topic, size=6)))
+    return sentences
+
+
+# ------------------------------------------------------------------ pipeline
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    tokens = tf.create("Hello, World! 123 foo-bar").get_tokens()
+    assert "hello" in tokens and "world" in tokens
+    assert all("," not in t and "!" not in t for t in tokens)
+
+
+def test_vocab_counts_and_min_frequency():
+    seqs = [["a", "b", "a"], ["a", "c"]]
+    cache = build_vocab(seqs, min_word_frequency=2, build_huffman=False)
+    assert cache.contains_word("a")
+    assert not cache.contains_word("b")  # freq 1 < 2
+    assert cache.word_frequency("a") == 3
+    assert cache.index_of("a") == 0  # most frequent first
+
+
+def test_huffman_codes_prefix_free():
+    seqs = [["w%d" % i] * (i + 1) for i in range(8)]
+    cache = build_vocab(seqs, build_huffman=True)
+    codes = {}
+    for w in cache.vocab_words():
+        assert len(w.codes) == len(w.points)
+        codes[w.word] = "".join(map(str, w.codes))
+    vals = list(codes.values())
+    for i, a in enumerate(vals):  # prefix-free property
+        for j, b in enumerate(vals):
+            if i != j:
+                assert not b.startswith(a)
+    # frequent words get shorter codes
+    assert len(codes["w7"]) <= len(codes["w0"])
+
+
+# ------------------------------------------------------------------ word2vec
+def test_word2vec_hs_topic_clustering():
+    w2v = (Word2Vec.builder().layer_size(24).window_size(3)
+           .min_word_frequency(1).epochs(3).seed(1).build())
+    w2v.fit(_topic_corpus())
+    assert w2v.has_word("cat")
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "gpu")
+    assert within > across + 0.2, (within, across)
+
+
+def test_word2vec_negative_sampling():
+    w2v = (Word2Vec.builder().layer_size(24).window_size(3)
+           .negative_sample(5).epochs(3).seed(2).build())
+    w2v.fit(_topic_corpus())
+    assert w2v.similarity("ram", "disk") > w2v.similarity("ram", "sheep") + 0.2
+
+
+def test_cbow_learns_topics():
+    w2v = (Word2Vec.builder().layer_size(24).window_size(3).epochs(3)
+           .elements_learning_algorithm("CBOW").seed(3).build())
+    assert isinstance(w2v, CBOW)
+    w2v.fit(_topic_corpus())
+    assert w2v.similarity("cow", "goat") > w2v.similarity("cow", "cache") + 0.2
+
+
+def test_words_nearest():
+    w2v = (Word2Vec.builder().layer_size(24).window_size(3).epochs(3)
+           .seed(4).build())
+    w2v.fit(_topic_corpus())
+    nearest = w2v.words_nearest("cat", 5)
+    animals = {"dog", "horse", "cow", "sheep", "goat"}
+    assert len(set(nearest[:3]) & animals) >= 2
+
+
+# ----------------------------------------------------------- paragraphvectors
+def test_paragraph_vectors_label_prediction():
+    rng = np.random.default_rng(5)
+    animals = ["cat", "dog", "horse", "cow"]
+    tech = ["cpu", "gpu", "ram", "disk"]
+    docs = []
+    for i in range(60):
+        docs.append((f"animal_{i}", " ".join(rng.choice(animals, 8))))
+        docs.append((f"tech_{i}", " ".join(rng.choice(tech, 8))))
+    pv = (ParagraphVectors.builder().layer_size(24).window_size(4)
+          .epochs(3).seed(5).build())
+    pv.fit_labelled(docs)
+    assert pv.doc_vector("animal_0") is not None
+    s_animal = pv.similarity_to_label("dog horse cat", "animal_0")
+    s_tech = pv.similarity_to_label("dog horse cat", "tech_0")
+    assert s_animal > s_tech
+
+
+# --------------------------------------------------------------------- glove
+def test_glove_topic_clustering():
+    g = (Glove.builder().layer_size(16).window_size(4).epochs(20)
+         .learning_rate(0.1).build())
+    g.fit(_topic_corpus(400))
+    assert g.similarity("cat", "dog") > g.similarity("cat", "cpu") + 0.2
+
+
+# ------------------------------------------------------------- serialization
+def test_word_vector_text_roundtrip(tmp_path):
+    w2v = (Word2Vec.builder().layer_size(8).epochs(1).seed(6).build())
+    w2v.fit(["alpha beta gamma", "beta gamma delta", "alpha delta"])
+    path = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, path)
+    loaded = WordVectorSerializer.read_word_vectors(path)
+    for w in ("alpha", "beta", "gamma", "delta"):
+        assert loaded.has_word(w)
+        np.testing.assert_allclose(loaded.word_vector(w),
+                                   w2v.word_vector(w), atol=1e-5)
+
+
+def test_word_vector_binary_roundtrip(tmp_path):
+    w2v = (Word2Vec.builder().layer_size(8).epochs(1).seed(7).build())
+    w2v.fit(["one two three", "two three four"])
+    path = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(w2v, path)
+    loaded = WordVectorSerializer.read_binary(path)
+    np.testing.assert_allclose(loaded.word_vector("two"),
+                               w2v.word_vector("two"), rtol=1e-6)
+    assert loaded.words_nearest("two", 2)
+
+
+def test_negative_sampling_disables_hs_by_default():
+    # direct construction with negative>0 must not also run HS (review finding)
+    from deeplearning4j_tpu.nlp import SequenceVectors
+    assert SequenceVectors(negative=5).use_hs is False
+    assert SequenceVectors().use_hs is True
+    assert SequenceVectors(negative=5, use_hierarchic_softmax=True).use_hs is True

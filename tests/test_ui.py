@@ -1,0 +1,96 @@
+"""UI/observability tests (reference ui-model + play module families)."""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd, DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import (StatsListener, StatsReport,
+                                   InMemoryStatsStorage, FileStatsStorage,
+                                   SqliteStatsStorage, UIServer,
+                                   RemoteUIStatsStorageRouter)
+
+
+def _train_with(storage, iters=5):
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    lst = StatsListener(storage, session_id="s1")
+    net.set_listeners(lst)
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+    for _ in range(iters):
+        net.fit(ds)
+    return net
+
+
+def test_stats_listener_collects_reports():
+    storage = InMemoryStatsStorage()
+    _train_with(storage, iters=4)
+    assert storage.list_session_ids() == ["s1"]
+    ups = storage.get_all_updates("s1")
+    assert len(ups) == 4
+    r = ups[-1]
+    assert np.isfinite(r.score)
+    assert "0_W" in r.param_stats
+    assert "norm2" in r.param_stats["0_W"]
+    assert "histogram" in r.param_stats["0_W"]
+    # update stats present from the second report on
+    assert "0_W" in ups[1].update_stats
+
+
+def test_file_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    _train_with(storage, iters=3)
+    storage.close()
+    reloaded = FileStatsStorage(path)
+    assert len(reloaded.get_all_updates("s1")) == 3
+    reloaded.close()
+
+
+def test_sqlite_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.db")
+    storage = SqliteStatsStorage(path)
+    _train_with(storage, iters=3)
+    storage.close()
+    reloaded = SqliteStatsStorage(path)
+    ups = reloaded.get_all_updates("s1")
+    assert len(ups) == 3
+    assert ups[0].iteration < ups[-1].iteration
+    reloaded.close()
+
+
+def test_ui_server_endpoints_and_remote_post():
+    storage = InMemoryStatsStorage()
+    server = UIServer()
+    server.attach(storage)
+    port = server.start(0)
+    try:
+        _train_with(storage, iters=3)
+        base = f"http://127.0.0.1:{port}"
+        sessions = json.loads(urllib.request.urlopen(base + "/train/sessions",
+                                                     timeout=10).read())
+        assert sessions == ["s1"]
+        ov = json.loads(urllib.request.urlopen(
+            base + "/train/overview?sid=s1", timeout=10).read())
+        assert len(ov["scores"]) == 3
+        model = json.loads(urllib.request.urlopen(
+            base + "/train/model?sid=s1", timeout=10).read())
+        assert "0_W" in model["params"]
+        page = urllib.request.urlopen(base + "/", timeout=10).read()
+        assert b"Training overview" in page
+        # remote posting path
+        router = RemoteUIStatsStorageRouter(base)
+        router.put_update(StatsReport("remote_session", "w0", 0, 0.0, 1.23,
+                                      {}, {}, 0.0))
+        assert "remote_session" in storage.list_session_ids()
+    finally:
+        server.stop()
