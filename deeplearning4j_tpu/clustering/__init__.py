@@ -4,6 +4,8 @@ exact + Barnes-Hut t-SNE."""
 from .trees import VPTree, KDTree, QuadTree, SpTree
 from .kmeans import KMeansClustering, ClusterSet, Cluster
 from .tsne import Tsne, BarnesHutTsne
+from .server import NearestNeighborsServer, NearestNeighborsClient
 
 __all__ = ["VPTree", "KDTree", "QuadTree", "SpTree", "KMeansClustering",
-           "ClusterSet", "Cluster", "Tsne", "BarnesHutTsne"]
+           "ClusterSet", "Cluster", "Tsne", "BarnesHutTsne", "NearestNeighborsServer",
+           "NearestNeighborsClient"]

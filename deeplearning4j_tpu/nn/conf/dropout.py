@@ -1,0 +1,207 @@
+"""IDropout implementations + weight noise + parameter constraints.
+
+TPU-native equivalents of reference ``nn/conf/dropout/`` (Dropout,
+AlphaDropout, GaussianDropout, GaussianNoise), ``nn/conf/weightnoise/``
+(DropConnect, WeightNoise) and ``nn/conf/constraint/`` (MaxNorm, MinMaxNorm,
+NonNegative, UnitNorm) — SURVEY.md §2.1 "Regularization & noise".
+
+Dropout objects transform ACTIVATIONS during training; weight-noise objects
+transform WEIGHTS during the forward pass; constraints project PARAMS after
+each update. All are pure functions applied inside the jitted train step.
+Plain floats remain accepted wherever a Dropout is expected (retain
+probability — reference 0.9.x semantics).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .serde import register
+
+
+# ------------------------------------------------------------------ dropout
+@register
+@dataclasses.dataclass
+class Dropout:
+    """Inverted dropout; ``p`` = retain probability (reference semantics)."""
+    p: float = 0.5
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.p >= 1.0:
+            return x
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(keep, x / self.p, jnp.zeros_like(x))
+
+
+@register
+@dataclasses.dataclass
+class AlphaDropout:
+    """SELU-preserving dropout (reference ``AlphaDropout``): dropped units go
+    to alpha' and the output is affinely corrected to keep self-normalizing
+    statistics. ``p`` = retain probability."""
+    p: float = 0.95
+
+    ALPHA = 1.6732632423543772
+    SCALE = 1.0507009873554805
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.p >= 1.0:
+            return x
+        alpha_p = -self.ALPHA * self.SCALE
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        a = (self.p + alpha_p ** 2 * self.p * (1 - self.p)) ** -0.5
+        b = -a * alpha_p * (1 - self.p)
+        return a * jnp.where(keep, x, alpha_p) + b
+
+
+@register
+@dataclasses.dataclass
+class GaussianDropout:
+    """Multiplicative 1+N(0, rate/(1-rate)) noise (reference
+    ``GaussianDropout``)."""
+    rate: float = 0.5
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.rate <= 0:
+            return x
+        std = math.sqrt(self.rate / (1.0 - self.rate))
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))
+
+
+@register
+@dataclasses.dataclass
+class GaussianNoise:
+    """Additive N(0, stddev) noise (reference ``GaussianNoise``)."""
+    stddev: float = 0.1
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.stddev <= 0:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+def resolve_dropout(spec):
+    """float (retain prob) → Dropout; IDropout objects pass through."""
+    if spec is None:
+        return None
+    if isinstance(spec, (int, float)):
+        return Dropout(p=float(spec)) if spec < 1.0 else None
+    return spec
+
+
+# -------------------------------------------------------------- weight noise
+@register
+@dataclasses.dataclass
+class DropConnect:
+    """Per-weight Bernoulli masking during forward (reference ``DropConnect``);
+    ``p`` = retain probability."""
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply_to_weights(self, w, key, rng, train):
+        if not train or rng is None:
+            return w
+        if key.startswith("b") and not self.apply_to_bias:
+            return w
+        keep = jax.random.bernoulli(rng, self.p, w.shape)
+        return jnp.where(keep, w / self.p, jnp.zeros_like(w))
+
+
+@register
+@dataclasses.dataclass
+class WeightNoise:
+    """Additive/multiplicative gaussian weight noise (reference
+    ``WeightNoise`` with a distribution)."""
+    stddev: float = 0.01
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def apply_to_weights(self, w, key, rng, train):
+        if not train or rng is None:
+            return w
+        if key.startswith("b") and not self.apply_to_bias:
+            return w
+        noise = self.stddev * jax.random.normal(rng, w.shape, w.dtype)
+        return w + noise if self.additive else w * (1.0 + noise)
+
+
+# --------------------------------------------------------------- constraints
+class BaseConstraint:
+    """Projected onto params after each update (reference
+    ``BaseConstraint.applyConstraint``); weights only unless
+    ``apply_to_bias``."""
+    apply_to_bias = False
+
+    def applies_to(self, key: str) -> bool:
+        is_bias = key == "b" or key.endswith("_b") or key == "beta"
+        return self.apply_to_bias or not is_bias
+
+    def project(self, w):
+        raise NotImplementedError
+
+    @staticmethod
+    def _axes_for(w):
+        # norm over input dims, per output unit (last axis)
+        return tuple(range(w.ndim - 1)) if w.ndim > 1 else (0,)
+
+
+@register
+@dataclasses.dataclass
+class MaxNormConstraint(BaseConstraint):
+    """Clip per-unit L2 norm to ``max_norm`` (reference ``MaxNormConstraint``)."""
+    max_norm: float = 2.0
+
+    def project(self, w):
+        axes = self._axes_for(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norm, 1e-8))
+        return w * scale
+
+
+@register
+@dataclasses.dataclass
+class MinMaxNormConstraint(BaseConstraint):
+    """Force per-unit norms into [min, max] with strength ``rate``
+    (reference ``MinMaxNormConstraint``)."""
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def project(self, w):
+        axes = self._axes_for(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+        clipped = jnp.clip(norm, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1 - self.rate) * norm
+        return w * target / jnp.maximum(norm, 1e-8)
+
+
+@register
+@dataclasses.dataclass
+class NonNegativeConstraint(BaseConstraint):
+    def project(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+@register
+@dataclasses.dataclass
+class UnitNormConstraint(BaseConstraint):
+    def project(self, w):
+        axes = self._axes_for(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+        return w / jnp.maximum(norm, 1e-8)
+
+
+def apply_constraints(constraints, layer_params):
+    """Project one layer's params through its constraint list."""
+    if not constraints:
+        return layer_params
+    out = dict(layer_params)
+    for c in constraints:
+        for k, v in out.items():
+            if c.applies_to(k):
+                out[k] = c.project(v)
+    return out

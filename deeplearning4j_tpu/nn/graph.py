@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .conf import GradientNormalization
+from .conf import BackpropType, GradientNormalization
 from .conf.graph import ComputationGraphConfiguration
 from .conf.layers import Layer
 from .conf.inputs import InputTypeConvolutional
@@ -107,16 +107,19 @@ class ComputationGraph:
         return out
 
     def _apply_graph(self, params, states, inputs, input_masks, train, rng,
-                     skip=()):
+                     skip=(), rnn_state_in=None):
         """Forward over the cached topo order. Returns (activations dict,
         new_states, masks dict, ctx). ``skip``: vertex names not to execute
         (the training loss path skips output-layer forwards; ``loss_on``
-        evaluates them on preoutput with fused softmax/xent)."""
+        evaluates them on preoutput with fused softmax/xent).
+        ``rnn_state_in``: {layer name → carry} for TBPTT/streaming."""
         conf = self.conf
         acts: Dict[str, object] = dict(zip(conf.network_inputs, inputs))
         masks = dict(zip(conf.network_inputs,
                          input_masks or [None] * len(conf.network_inputs)))
         ctx = {"inputs": acts, "input_masks": masks}
+        if rnn_state_in is not None:
+            ctx["rnn_state_in"] = rnn_state_in
         new_states = dict(states)
         layer_names = [n for n in self.topo if n in self.impls]
         keys = (dict(zip(layer_names, jax.random.split(rng, len(layer_names))))
@@ -135,7 +138,8 @@ class ComputationGraph:
                 # propagate the mask of the (single) input chain
                 m = masks.get(in_names[0])
                 impl = self.impls[name]
-                y, ns = impl.forward(params[name], states[name], x, train=train,
+                p_n = impl.noised_params(params[name], train, keys.get(name))
+                y, ns = impl.forward(p_n, states[name], x, train=train,
                                      rng=keys.get(name), mask=m, ctx=ctx)
                 new_states[name] = ns
                 acts[name] = y
@@ -146,7 +150,7 @@ class ComputationGraph:
         return acts, new_states, masks, ctx
 
     def _loss_fn(self, params, states, inputs, labels, input_masks, label_masks,
-                 train, rng):
+                 train, rng, rnn_state_in=None):
         conf = self.conf
         # skip output-layer forwards: loss_on consumes their *input*
         # activations so the fused softmax/xent path applies to preoutput.
@@ -156,7 +160,8 @@ class ComputationGraph:
                             if hasattr(self.impls.get(n), "loss_on")
                             and n not in consumed)
         acts, new_states, masks, ctx = self._apply_graph(
-            params, states, inputs, input_masks, train, rng, skip=out_set)
+            params, states, inputs, input_masks, train, rng, skip=out_set,
+            rnn_state_in=rnn_state_in)
         total = 0.0
         for out_name, lbl, lm in zip(conf.network_outputs, labels,
                                      label_masks or [None] * len(labels)):
@@ -179,37 +184,66 @@ class ComputationGraph:
         reg = 0.0
         for name, impl in self.impls.items():
             reg = reg + impl.regularization(params[name])
-        return total + reg, new_states
+        return total + reg, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _raw_step(self):
+    def _raw_step(self, with_rnn_state=False):
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
 
         def step(params, states, upd_state, iteration, rng, inputs, labels,
-                 input_masks, label_masks):
+                 input_masks, label_masks, rnn_state_in=None):
             inputs = self._adapt_inputs(inputs)
 
             def loss_fn(p):
                 return self._loss_fn(p, states, inputs, labels, input_masks,
-                                     label_masks, True, rng)
+                                     label_masks, True, rng, rnn_state_in)
 
-            (loss, new_states), grads = jax.value_and_grad(
+            (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
             grads = normalize_gradients(grads, gn_mode, gn_thresh)
             updates, new_upd = self.updater.apply(upd_state, grads, iteration)
             new_params = _tm(lambda p, u: p - u.astype(p.dtype), params, updates)
+            new_params = self._apply_constraints(new_params)
+            if with_rnn_state:
+                rnn_out = (_tm(jax.lax.stop_gradient, rnn_out)
+                           if rnn_out else rnn_out)
+                return new_params, new_states, new_upd, loss, rnn_out
             return new_params, new_states, new_upd, loss
 
         return step
+
+    def _apply_constraints(self, params):
+        from .conf.dropout import apply_constraints
+        out = dict(params)
+        for name in self.impls:
+            lc = self.conf.vertices[name]
+            cons = getattr(lc, "constraints", None) or \
+                getattr(getattr(lc, "inner", None), "constraints", None)
+            if cons:
+                out[name] = apply_constraints(cons, params[name])
+        return out
 
     def _ensure_step(self):
         if self._jit_step is None:
             self._jit_step = jax.jit(self._raw_step(), donate_argnums=(0, 2))
         return self._jit_step
+
+    def _ensure_tbptt_step(self):
+        if getattr(self, "_jit_tbptt_step", None) is None:
+            self._jit_tbptt_step = jax.jit(self._raw_step(with_rnn_state=True),
+                                           donate_argnums=(0, 2))
+        return self._jit_tbptt_step
+
+    def _init_rnn_state(self, batch):
+        state = {}
+        for name, impl in self.impls.items():
+            if hasattr(impl, "init_stream_state"):
+                state[name] = impl.init_stream_state(batch)
+        return state
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
@@ -257,6 +291,11 @@ class ComputationGraph:
         lms = (None if mds.labels_masks is None
                else tuple(None if m is None else jnp.asarray(m)
                           for m in mds.labels_masks))
+        if (self.conf.backprop_type == BackpropType.TruncatedBPTT
+                and all(x.ndim == 3 for x in inputs)
+                and inputs[0].shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(inputs, labels, fms, lms)
+            return
         step = self._ensure_step()
         it = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.states, self.updater_state, loss = step(
@@ -267,6 +306,65 @@ class ComputationGraph:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
         self.last_batch_size = int(inputs[0].shape[0])
+
+    def _fit_tbptt(self, inputs, labels, fms, lms):
+        """Truncated BPTT over the DAG (reference CG ``doTruncatedBPTT``):
+        time is chunked to ``tbptt_fwd_length``; per-recurrent-vertex (h, c)
+        carries are detached between chunks."""
+        T = int(inputs[0].shape[1])
+        L = self.conf.tbptt_fwd_length
+        step = self._ensure_tbptt_step()
+        rnn_state = self._init_rnn_state(int(inputs[0].shape[0]))
+        loss = jnp.asarray(float("nan"))
+        for start in range(0, T, L):
+            sl = slice(start, min(start + L, T))
+            f_c = tuple(x[:, sl] for x in inputs)
+            l_c = tuple(l[:, sl] if l.ndim == 3 else l for l in labels)
+            fm_c = (None if fms is None
+                    else tuple(None if m is None else m[:, sl] for m in fms))
+            lm_c = (None if lms is None
+                    else tuple(None if m is None else m[:, sl] for m in lms))
+            it = jnp.asarray(self.iteration_count, jnp.int32)
+            (self.params, self.states, self.updater_state, loss,
+             rnn_state) = step(self.params, self.states, self.updater_state,
+                               it, self._next_rng(), f_c, l_c, fm_c, lm_c,
+                               rnn_state)
+            self.iteration_count += 1
+        self.score_ = loss
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+
+    # ------------------------------------------------------------- streaming
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference over the DAG (reference CG
+        ``rnnTimeStep``)."""
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        single_step = xs[0].ndim == 2
+        if single_step:
+            xs = tuple(x[:, None, :] for x in xs)
+        if getattr(self, "_rnn_state", None) is None:
+            self._rnn_state = self._init_rnn_state(int(xs[0].shape[0]))
+
+        def fwd(params, states, fs, rnn_state):
+            fs = self._adapt_inputs(fs)
+            acts, _, _, ctx = self._apply_graph(params, states, fs, None,
+                                                False, None,
+                                                rnn_state_in=rnn_state)
+            outs = tuple(acts[n] for n in self.conf.network_outputs)
+            return outs, ctx.get("rnn_state_out")
+
+        outs, self._rnn_state = jax.jit(fwd)(self.params, self.states, xs,
+                                             self._rnn_state)
+        if single_step:
+            outs = tuple(o[:, -1, :] if o.ndim == 3 else o for o in outs)
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    rnnClearPreviousState = rnn_clear_previous_state
 
     # ------------------------------------------------- external errors path
     def fit_external_errors(self, inputs, epsilons):

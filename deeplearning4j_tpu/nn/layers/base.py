@@ -75,7 +75,11 @@ class LayerImpl:
             self.l2 = float(_resolved(conf, gc, "l2", 0.0))
             self.l1_bias = float(_resolved(conf, gc, "l1_bias", 0.0))
             self.l2_bias = float(_resolved(conf, gc, "l2_bias", 0.0))
-        self.dropout_p = _resolved(conf, gc, "dropout")  # retain prob or None
+        from ..conf.dropout import resolve_dropout
+        # float (retain prob) or IDropout object → unified apply() object
+        self.dropout_p = _resolved(conf, gc, "dropout")
+        self.dropout_obj = resolve_dropout(self.dropout_p)
+        self.weight_noise = getattr(conf, "weight_noise", None)
 
     # ------------------------------------------------------------------
     def init(self, rng):
@@ -94,13 +98,25 @@ class LayerImpl:
         return jnp.full(shape, v, self.dtype)
 
     def maybe_dropout(self, x, train, rng):
-        """Inverted dropout on layer input; ``dropout`` is the retain probability
-        (reference 0.9.x semantics, ``BaseLayer.preOutput`` input dropout)."""
-        p = self.dropout_p
-        if not train or p is None or p >= 1.0 or rng is None:
+        """Input dropout/noise (reference ``BaseLayer.preOutput`` input
+        dropout). Accepts the float retain-probability shorthand or any
+        IDropout object (Dropout, AlphaDropout, GaussianDropout,
+        GaussianNoise)."""
+        if self.dropout_obj is None or not train or rng is None:
             return x
-        keep = jax.random.bernoulli(rng, p, x.shape)
-        return jnp.where(keep, x / p, jnp.zeros_like(x))
+        return self.dropout_obj.apply(x, rng, train)
+
+    def noised_params(self, params, train, rng):
+        """Apply weight noise (DropConnect/WeightNoise) for this forward pass
+        (reference ``weightnoise`` applied on param views per iteration)."""
+        wn = self.weight_noise
+        if wn is None or not train or rng is None or not params:
+            return params
+        out = {}
+        for i, (k, v) in enumerate(params.items()):
+            out[k] = wn.apply_to_weights(v, k, jax.random.fold_in(rng, i),
+                                         train)
+        return out
 
     def cast_in(self, *arrays):
         """Cast to compute dtype (bfloat16 policy targets the MXU)."""

@@ -134,7 +134,8 @@ class MultiLayerNetwork:
             if pre is not None:
                 x = pre(x, ctx)
             impl = self.impls[i]
-            x, ns = impl.forward(params[str(i)], states[str(i)], x, train=train,
+            p_i = impl.noised_params(params[str(i)], train, keys[i])
+            x, ns = impl.forward(p_i, states[str(i)], x, train=train,
                                  rng=keys[i], mask=fmask, ctx=ctx)
             new_states[str(i)] = ns
         return x, new_states, ctx
@@ -200,12 +201,25 @@ class MultiLayerNetwork:
             updates, new_upd = self.updater.apply(upd_state, grads, iteration)
             new_params = jax.tree_util.tree_map(lambda p, u: p - u.astype(p.dtype),
                                                 params, updates)
+            new_params = self._apply_constraints(new_params)
             if with_rnn_state:
                 rnn_out = _tm(jax.lax.stop_gradient, rnn_out) if rnn_out else rnn_out
                 return new_params, new_states, new_upd, loss, rnn_out
             return new_params, new_states, new_upd, loss
 
         return step
+
+    def _apply_constraints(self, params):
+        """Per-layer parameter constraints after each update (reference
+        ``BaseConstraint.applyConstraint`` timing)."""
+        from .conf.dropout import apply_constraints
+        out = dict(params)
+        for i, lc in enumerate(self.conf.layers):
+            cons = getattr(lc, "constraints", None) or \
+                getattr(getattr(lc, "inner", None), "constraints", None)
+            if cons:
+                out[str(i)] = apply_constraints(cons, params[str(i)])
+        return out
 
     def _build_step(self, with_rnn_state):
         return jax.jit(self._raw_step(with_rnn_state), donate_argnums=(0, 2))

@@ -308,3 +308,47 @@ class TestExternalErrors:
         # SGD with external eps: dL/dW = x^T @ eps
         expect = before - 0.5 * (x.T @ eps)
         np.testing.assert_allclose(after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_cg_tbptt_and_rnn_time_step():
+    """TBPTT chunking + streaming parity on the DAG container (reference CG
+    doTruncatedBPTT / rnnTimeStep)."""
+    import jax
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+    from deeplearning4j_tpu import DataSet, Sgd
+    import numpy as np
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", LSTM(n_in=5, n_out=8, activation="tanh"), "in")
+            .add_layer("out", RnnOutputLayer(n_in=8, n_out=3,
+                                             activation="softmax",
+                                             loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .build())
+    conf.backprop_type = BackpropType.TruncatedBPTT
+    conf.tbptt_fwd_length = 4
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    T = 12
+    f = rng.normal(size=(2, T, 5)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, T))]
+    it0 = net.iteration_count
+    net.fit(DataSet(f, l))
+    # 12 timesteps / 4 per chunk = 3 TBPTT segments = 3 iterations
+    assert net.iteration_count - it0 == 3
+    assert np.isfinite(float(net.score_))
+
+    # streaming: step-by-step output equals full-sequence output
+    net.rnn_clear_previous_state()
+    full = np.asarray(net.output(f))
+    step_outs = []
+    for t in range(T):
+        step_outs.append(np.asarray(net.rnn_time_step(f[:, t, :])))
+    stepped = np.stack(step_outs, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)

@@ -165,3 +165,20 @@ def test_kmeans_duplicate_points_no_crash():
     # all-identical points must not crash k-means++ (review finding)
     cs = KMeansClustering.setup(2, max_iterations=5).apply_to(np.zeros((10, 2)))
     assert len(cs.centroids) == 2
+
+
+def test_nearest_neighbors_server_roundtrip():
+    from deeplearning4j_tpu.clustering import (NearestNeighborsServer,
+                                               NearestNeighborsClient)
+    pts = _blobs(40, seed=7)
+    server = NearestNeighborsServer(pts)
+    port = server.start(0)
+    try:
+        client = NearestNeighborsClient(f"http://127.0.0.1:{port}")
+        res = client.knn(index=3, k=4)
+        assert len(res["results"]) == 4
+        assert res["results"][0]["index"] == 3  # itself at distance 0
+        res2 = client.knn_new(pts[5] + 0.01, k=3)
+        assert res2["results"][0]["index"] == 5
+    finally:
+        server.stop()

@@ -1,0 +1,122 @@
+"""Dropout family / weight noise / constraints tests (reference
+``nn/conf/dropout``, ``weightnoise``, ``constraint`` families)."""
+import numpy as np
+import pytest
+import jax
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                Sgd, DataSet)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.dropout import (Dropout, AlphaDropout,
+                                                GaussianDropout, GaussianNoise,
+                                                DropConnect, WeightNoise,
+                                                MaxNormConstraint,
+                                                NonNegativeConstraint,
+                                                UnitNormConstraint,
+                                                MinMaxNormConstraint)
+
+
+def _net(layer0_kwargs=None, lr=0.1):
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(learning_rate=lr)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=12, **(layer0_kwargs or {})))
+            .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ds(seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet(rng.normal(size=(16, 6)).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+
+
+# ------------------------------------------------------------ dropout objects
+@pytest.mark.parametrize("obj", [Dropout(0.8), AlphaDropout(0.9),
+                                 GaussianDropout(0.3), GaussianNoise(0.2)])
+def test_dropout_objects_train_vs_inference(obj):
+    rng = jax.random.PRNGKey(0)
+    x = jax.numpy.ones((64, 32))
+    y_train = np.asarray(obj.apply(x, rng, True))
+    y_infer = np.asarray(obj.apply(x, None, False))
+    np.testing.assert_array_equal(y_infer, np.asarray(x))  # identity at infer
+    assert not np.allclose(y_train, np.asarray(x))          # noise at train
+
+
+def test_dropout_preserves_expectation():
+    obj = Dropout(0.5)
+    rng = jax.random.PRNGKey(1)
+    x = jax.numpy.ones((200, 200))
+    y = np.asarray(obj.apply(x, rng, True))
+    assert abs(y.mean() - 1.0) < 0.02  # inverted dropout keeps E[x]
+
+
+def test_network_trains_with_dropout_objects():
+    net = _net({"dropout": None})
+    net.conf.layers[1].dropout = AlphaDropout(0.9)
+    net = MultiLayerNetwork(net.conf).init()
+    ds = _ds()
+    s0 = net.score(ds)
+    for _ in range(10):
+        net.fit(ds)
+    assert net.score(ds) < s0
+
+
+# --------------------------------------------------------------- weight noise
+def test_dropconnect_changes_training_path_only():
+    net = _net({"weight_noise": DropConnect(p=0.7)})
+    ds = _ds()
+    out1 = np.asarray(net.output(ds.features))
+    out2 = np.asarray(net.output(ds.features))
+    np.testing.assert_array_equal(out1, out2)  # inference deterministic
+    net.fit(ds)  # training applies masking without error
+    assert np.isfinite(float(net.score_))
+
+
+def test_weight_noise_trains():
+    net = _net({"weight_noise": WeightNoise(stddev=0.05)})
+    ds = _ds()
+    s0 = net.score(ds)
+    for _ in range(10):
+        net.fit(ds)
+    assert net.score(ds) < s0
+
+
+# ---------------------------------------------------------------- constraints
+def test_max_norm_constraint_enforced():
+    net = _net({"constraints": [MaxNormConstraint(max_norm=0.5)]}, lr=1.0)
+    ds = _ds()
+    for _ in range(5):
+        net.fit(ds)
+    W = np.asarray(net.params["0"]["W"])
+    col_norms = np.linalg.norm(W, axis=0)
+    assert np.all(col_norms <= 0.5 + 1e-5)
+    # bias unconstrained by default
+    assert "b" in net.params["0"]
+
+
+def test_non_negative_constraint():
+    net = _net({"constraints": [NonNegativeConstraint()]}, lr=0.5)
+    ds = _ds()
+    for _ in range(3):
+        net.fit(ds)
+    assert np.all(np.asarray(net.params["0"]["W"]) >= 0.0)
+
+
+def test_unit_norm_constraint():
+    net = _net({"constraints": [UnitNormConstraint()]})
+    net.fit(_ds())
+    col_norms = np.linalg.norm(np.asarray(net.params["0"]["W"]), axis=0)
+    np.testing.assert_allclose(col_norms, 1.0, rtol=1e-5)
+
+
+def test_min_max_norm_constraint():
+    net = _net({"constraints": [MinMaxNormConstraint(min_norm=0.3,
+                                                     max_norm=0.6)]}, lr=1.0)
+    for _ in range(5):
+        net.fit(_ds())
+    col_norms = np.linalg.norm(np.asarray(net.params["0"]["W"]), axis=0)
+    assert np.all(col_norms <= 0.6 + 1e-5)
+    assert np.all(col_norms >= 0.3 - 1e-5)
