@@ -65,8 +65,10 @@ class DataSet:
             return np.concatenate(xs, axis=0)
         return DataSet(cat([d.features for d in datasets]),
                        cat([d.labels for d in datasets]),
-                       cat([d.features_mask for d in datasets]),
-                       cat([d.labels_mask for d in datasets]))
+                       _cat_masks([d.features_mask for d in datasets],
+                                  [d.features for d in datasets]),
+                       _cat_masks([d.labels_mask for d in datasets],
+                                  [d.labels for d in datasets]))
 
     def batch_by(self, batch_size: int) -> List["DataSet"]:
         n = self.num_examples()
@@ -98,11 +100,56 @@ class MultiDataSet:
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])
 
+    @staticmethod
+    def merge(datasets: Sequence["MultiDataSet"]) -> "MultiDataSet":
+        """Concatenate example-wise, stream by stream (ND4J
+        ``MultiDataSet.merge`` role). Mixed mask presence across the merged
+        sets synthesizes all-ones masks for the unmasked ones — dropping the
+        mask stream entirely would silently train on padding."""
+        def cat_streams(streams):
+            if any(s is None for s in streams):
+                return None
+            n = len(streams[0])
+            return [np.concatenate([s[i] for s in streams], axis=0)
+                    for i in range(n)]
+
+        def cat_mask_streams(mask_lists, data_lists):
+            if all(m is None for m in mask_lists):
+                return None
+            n = len(data_lists[0])
+            out = []
+            for i in range(n):
+                masks = [None if ml is None else ml[i] for ml in mask_lists]
+                data = [dl[i] for dl in data_lists]
+                out.append(_cat_masks(masks, data))
+            return out
+
+        return MultiDataSet(
+            cat_streams([d.features for d in datasets]),
+            cat_streams([d.labels for d in datasets]),
+            cat_mask_streams([d.features_masks for d in datasets],
+                             [d.features for d in datasets]),
+            cat_mask_streams([d.labels_masks for d in datasets],
+                             [d.labels for d in datasets]))
+
 
 def _as_list(x):
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x]
+
+
+def _cat_masks(masks, data):
+    """Concatenate per-example masks; when presence is mixed, missing masks
+    become all-ones shaped after their data's leading mask dims (so merged
+    batches don't lose masking for the sets that have it)."""
+    if all(m is None for m in masks):
+        return None
+    ndim = next(m.ndim for m in masks if m is not None)
+    filled = [m if m is not None else np.ones(np.asarray(d).shape[:ndim],
+                                              np.float32)
+              for m, d in zip(masks, data)]
+    return np.concatenate(filled, axis=0)
 
 
 class DataSetIterator:

@@ -472,8 +472,12 @@ def _ends_recurrent(layers) -> bool:
         if t in ("LSTM", "GravesLSTM", "SimpleRnn", "GravesBidirectionalLSTM",
                  "Bidirectional", "EmbeddingSequenceLayer"):
             rec = True
-        elif t in ("LastTimeStep", "GlobalPoolingLayer", "DenseLayer",
-                   "ConvolutionLayer", "SubsamplingLayer"):
+        elif t in ("LastTimeStep", "GlobalPoolingLayer", "ConvolutionLayer",
+                   "SubsamplingLayer"):
+            # DenseLayer deliberately NOT here: Keras Dense on 3D input applies
+            # per-timestep, so LSTM(return_sequences)->Dense keeps the time
+            # axis and the head must stay RnnOutputLayer (reference KerasLstm/
+            # RnnOutputLayer pairing)
             rec = False
     return rec
 

@@ -344,17 +344,19 @@ class ComputationGraph:
             xs = tuple(x[:, None, :] for x in xs)
         if getattr(self, "_rnn_state", None) is None:
             self._rnn_state = self._init_rnn_state(int(xs[0].shape[0]))
-
-        def fwd(params, states, fs, rnn_state):
-            fs = self._adapt_inputs(fs)
-            acts, _, _, ctx = self._apply_graph(params, states, fs, None,
-                                                False, None,
-                                                rnn_state_in=rnn_state)
-            outs = tuple(acts[n] for n in self.conf.network_outputs)
-            return outs, ctx.get("rnn_state_out")
-
-        outs, self._rnn_state = jax.jit(fwd)(self.params, self.states, xs,
-                                             self._rnn_state)
+        if getattr(self, "_jit_rnn_step", None) is None:
+            # cached on self: a fresh closure per call would recompile every
+            # streaming step (jit still specializes per input shape)
+            def fwd(params, states, fs, rnn_state):
+                fs = self._adapt_inputs(fs)
+                acts, _, _, ctx = self._apply_graph(params, states, fs, None,
+                                                    False, None,
+                                                    rnn_state_in=rnn_state)
+                outs = tuple(acts[n] for n in self.conf.network_outputs)
+                return outs, ctx.get("rnn_state_out")
+            self._jit_rnn_step = jax.jit(fwd)
+        outs, self._rnn_state = self._jit_rnn_step(self.params, self.states, xs,
+                                                   self._rnn_state)
         if single_step:
             outs = tuple(o[:, -1, :] if o.ndim == 3 else o for o in outs)
         return outs[0] if len(outs) == 1 else list(outs)
@@ -432,14 +434,28 @@ class ComputationGraph:
         if ds is None:
             return float(self.score_)
         mds = self._as_multi(ds)
-        inputs = self._adapt_inputs([jnp.asarray(f) for f in mds.features])
-        labels = [jnp.asarray(l) for l in mds.labels]
+        inputs = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
         fms = (None if mds.features_masks is None
-               else [None if m is None else jnp.asarray(m) for m in mds.features_masks])
+               else tuple(None if m is None else jnp.asarray(m)
+                          for m in mds.features_masks))
         lms = (None if mds.labels_masks is None
-               else [None if m is None else jnp.asarray(m) for m in mds.labels_masks])
-        loss, _ = self._loss_fn(self.params, self.states, inputs, labels, fms,
-                                lms, training, None)
+               else tuple(None if m is None else jnp.asarray(m)
+                          for m in mds.labels_masks))
+        key = (bool(training), fms is not None, lms is not None)
+        if not hasattr(self, "_jit_score"):
+            self._jit_score = {}
+        if key not in self._jit_score:
+            # jitted: early stopping / evaluative listeners call score every
+            # epoch — eager per-batch tracing would dominate evaluation on TPU
+            def score_fn(params, states, inputs, labels, fms, lms):
+                xs = self._adapt_inputs(inputs)
+                loss, _ = self._loss_fn(params, states, xs, labels, fms,
+                                        lms, training, None)
+                return loss
+            self._jit_score[key] = jax.jit(score_fn)
+        loss = self._jit_score[key](self.params, self.states, inputs, labels,
+                                    fms, lms)
         return float(loss)
 
     def compute_gradient_and_score(self, ds):

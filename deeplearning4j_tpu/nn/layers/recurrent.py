@@ -28,6 +28,23 @@ from .base import LayerImpl, implements, impl_for, acc_dtype
 from ..activations import get_activation
 
 
+def _match_vma(z, ref):
+    """Give a fresh scan-carry init the shard_map varying-axes type of ``ref``.
+
+    Under ``shard_map`` (ParallelWrapper local-SGD), batch inputs are
+    device-varying while a ``jnp.zeros`` carry init is not; ``lax.scan``
+    rejects the carry-type mismatch. Outside shard_map this is a no-op."""
+    try:
+        want = set(jax.typeof(ref).vma) - set(jax.typeof(z).vma)
+    except Exception:
+        return z
+    if not want:
+        return z
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(z, tuple(want), to="varying")
+    return jax.lax.pvary(z, tuple(want))
+
+
 class _BaseLSTMImpl(LayerImpl):
     peepholes = False
 
@@ -76,6 +93,7 @@ class _BaseLSTMImpl(LayerImpl):
             c0 = jnp.zeros((b, H), ad)
         else:
             h0, c0 = h0c0
+        h0, c0 = _match_vma(h0, xp), _match_vma(c0, xp)
         peep = ((params["pi"], params["pf"], params["po"])
                 if self.peepholes else None)
         rw = params["RW"].astype(ad)
@@ -200,6 +218,7 @@ class SimpleRnnImpl(LayerImpl):
             h0 = ctx.get("rnn_state_in", {}).get(idx)
         if h0 is None:
             h0 = jnp.zeros((b, H), ad)
+        h0 = _match_vma(h0, xp)
         xs = jnp.swapaxes(xp, 0, 1)
         if mask is not None:
             ms = jnp.swapaxes(mask, 0, 1)

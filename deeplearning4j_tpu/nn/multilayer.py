@@ -432,14 +432,16 @@ class MultiLayerNetwork:
             x = x[:, None, :]
         if self._rnn_state is None:
             self._rnn_state = self._init_rnn_state(int(x.shape[0]))
-
-        def fwd(params, states, f, rnn_state):
-            y, _, ctx = self._apply_layers(params, states, f, None, False,
-                                           None, rnn_state_in=rnn_state)
-            return y, ctx.get("rnn_state_out")
-
-        y, self._rnn_state = jax.jit(fwd)(self.params, self.states, x,
-                                          self._rnn_state)
+        if getattr(self, "_jit_rnn_step", None) is None:
+            # cached on self: jit re-traces per input shape, but a fresh
+            # closure per call would recompile every streaming step
+            def fwd(params, states, f, rnn_state):
+                y, _, ctx = self._apply_layers(params, states, f, None, False,
+                                               None, rnn_state_in=rnn_state)
+                return y, ctx.get("rnn_state_out")
+            self._jit_rnn_step = jax.jit(fwd)
+        y, self._rnn_state = self._jit_rnn_step(self.params, self.states, x,
+                                                self._rnn_state)
         return y[:, -1, :] if single_step else y
 
     rnnTimeStep = rnn_time_step
@@ -459,9 +461,20 @@ class MultiLayerNetwork:
         l = jnp.asarray(ds.labels)
         fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-        f = self._adapt_input(f)
-        loss, _ = self._loss_fn(self.params, self.states, f, l, fm, lm,
-                                training, None)
+        key = (bool(training), fm is not None, lm is not None)
+        if not hasattr(self, "_jit_score"):
+            self._jit_score = {}
+        if key not in self._jit_score:
+            # jitted: early stopping / evaluative listeners call this every
+            # epoch over the full validation set — eager tracing per batch
+            # would make evaluation the epoch bottleneck on TPU
+            def score_fn(params, states, f, l, fm, lm):
+                f2 = self._adapt_input(f)
+                loss, _ = self._loss_fn(params, states, f2, l, fm, lm,
+                                        training, None)
+                return loss
+            self._jit_score[key] = jax.jit(score_fn)
+        loss = self._jit_score[key](self.params, self.states, f, l, fm, lm)
         return float(loss)
 
     def compute_gradient_and_score(self, ds: DataSet):

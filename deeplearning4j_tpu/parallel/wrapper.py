@@ -32,7 +32,8 @@ from jax import shard_map
 from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
                        shard_batch, data_parallel_step, pvary)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
-from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
+from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
+                                ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
 
 log = logging.getLogger(__name__)
@@ -130,6 +131,10 @@ class ParallelWrapper:
         self._sync_step = None
         self._local_sgd_step = None
         self.averaging_ms = 0.0
+        # ComputationGraph steps take tuples of input/label streams (its
+        # _raw_step zips network_inputs with the inputs arg); bare arrays
+        # would be iterated along the batch axis — row 0 only
+        self._is_graph = hasattr(net, "_as_multi")
 
     # ------------------------------------------------------------------
     def _ensure_sync_step(self):
@@ -147,18 +152,21 @@ class ParallelWrapper:
         raw = net._raw_step(False)
         N = self.averaging_frequency
 
-        def local_run(params, states, upd, it0, rng, fs, ls):
-            # runs per-device under shard_map: fs/ls [N, b_local, ...]
+        def local_run(params, states, upd, it0, rng, fs, ls, fms, lms):
+            # runs per-device under shard_map: fs/ls/fms/lms [N, b_local, ...]
             dev = jax.lax.axis_index(DATA_AXIS)
             rng = jax.random.fold_in(rng, dev)
 
             def body(i, carry):
                 params, states, upd, _ = carry
-                f = jax.lax.dynamic_index_in_dim(fs, i, keepdims=False)
-                l = jax.lax.dynamic_index_in_dim(ls, i, keepdims=False)
+                # tree_map: arrays (MLN) or stream tuples (CG); None masks
+                # are empty pytrees and pass through
+                idx = lambda a: jax.lax.dynamic_index_in_dim(a, i,
+                                                             keepdims=False)
+                f, l, fm, lm = (_tm(idx, t) for t in (fs, ls, fms, lms))
                 k = jax.random.fold_in(rng, i)
                 params, states, upd, loss = raw(params, states, upd, it0 + i,
-                                                k, f, l, None, None)
+                                                k, f, l, fm, lm)
                 return params, states, upd, loss
 
             # mark the carry as device-varying: replicas diverge locally
@@ -177,7 +185,8 @@ class ParallelWrapper:
         repl = P()
         data = P(None, DATA_AXIS)  # [N, global_b, ...] split on batch dim
         fn = shard_map(local_run, mesh=mesh,
-                       in_specs=(repl, repl, repl, repl, repl, data, data),
+                       in_specs=(repl, repl, repl, repl, repl, data, data,
+                                 data, data),
                        out_specs=(repl, repl, repl, repl))
         self._local_sgd_step = jax.jit(fn, donate_argnums=(0, 2))
         return self._local_sgd_step
@@ -211,17 +220,53 @@ class ParallelWrapper:
 
     def _fit_sync(self, it):
         """AVERAGING freq=1 / SHARED_GRADIENTS: fused psum step per global
-        batch (the reference's per-iteration averaging ≡ gradient all-reduce)."""
+        batch (the reference's per-iteration averaging ≡ gradient all-reduce).
+
+        Batch semantics match the reference's round-robin dispatch
+        (``ParallelWrapper.java:497-516``): each device consumes ONE iterator
+        batch per parallel iteration, so ``workers_`` iterator batches are
+        merged into the global batch of a step. A tail group smaller than
+        ``workers_`` is still trained (sharded across all devices) so no data
+        is dropped."""
         net = self.net
         step = self._ensure_sync_step()
         self._device_put_model()
-        for ds in it:
-            f, l = self._global_batch([ds])
+        pending = []
+        it = iter(it)
+        exhausted = False
+        while not exhausted:
+            try:
+                pending.append(next(it))
+            except StopIteration:
+                exhausted = True
+            if not pending or (len(pending) < self.workers_ and not exhausted):
+                continue
+            total = sum(b.num_examples() for b in pending)
+            if total % self.workers_:
+                # tail (or odd-sized) group not shardable: train it on the
+                # net's own replicated step instead of dropping or crashing
+                group, pending = pending, []
+                if len(group) == 1:
+                    merged = group[0]
+                elif self._is_graph:
+                    merged = MultiDataSet.merge([self.net._as_multi(b)
+                                                 for b in group])
+                else:
+                    merged = DataSet.merge(group)
+                log.info("Batch group of %d examples not divisible by %d "
+                         "devices; training it unsharded", total,
+                         self.workers_)
+                net._fit_batch(merged)
+                self.iteration_count += 1
+                self.last_score = float(net.score_)
+                continue
+            f, l, fm, lm = self._global_batch(pending)
+            pending = []
             itc = jnp.asarray(net.iteration_count, jnp.int32)
             key = jax.device_put(net._next_rng(), replicated(self.mesh))
             net.params, net.states, net.updater_state, loss = step(
                 net.params, net.states, net.updater_state, itc, key, f, l,
-                None, None)
+                fm, lm)
             self.last_score = float(loss)
             net.score_ = loss
             net.iteration_count += 1
@@ -241,13 +286,14 @@ class ParallelWrapper:
             pending.append(ds)
             if len(pending) < self.averaging_frequency:
                 continue
-            fs, ls = self._stacked_batches(pending)
+            fs, ls, fms, lms = self._stacked_batches(pending)
             pending = []
             itc = jnp.asarray(net.iteration_count, jnp.int32)
             key = jax.device_put(net._next_rng(), replicated(self.mesh))
             t0 = time.perf_counter()
             net.params, net.states, net.updater_state, loss = step(
-                net.params, net.states, net.updater_state, itc, key, fs, ls)
+                net.params, net.states, net.updater_state, itc, key, fs, ls,
+                fms, lms)
             jax.block_until_ready(net.params)
             self.averaging_ms = (time.perf_counter() - t0) * 1e3
             net.iteration_count += self.averaging_frequency
@@ -263,26 +309,91 @@ class ParallelWrapper:
 
     # ---------------------------------------------------------------- helpers
     def _global_batch(self, batches):
+        """Merge iterator batches into one sharded global batch.
+
+        Source dtypes are preserved (integer embedding indices, f64 nets);
+        the layers' own ``cast_in`` decides the compute dtype. For a
+        ComputationGraph the step takes tuples of input/label streams."""
+        if self._is_graph:
+            mds_list = [self.net._as_multi(b) for b in batches]
+            mds = mds_list[0] if len(mds_list) == 1 else MultiDataSet.merge(mds_list)
+            b = mds.num_examples()
+            if b % self.workers_:
+                raise ValueError(
+                    f"Global batch {b} not divisible by {self.workers_} devices")
+            f = tuple(shard_batch(jnp.asarray(x), self.mesh)
+                      for x in mds.features)
+            l = tuple(shard_batch(jnp.asarray(x), self.mesh)
+                      for x in mds.labels)
+            fm = (None if mds.features_masks is None else tuple(
+                None if m is None else shard_batch(jnp.asarray(m), self.mesh)
+                for m in mds.features_masks))
+            lm = (None if mds.labels_masks is None else tuple(
+                None if m is None else shard_batch(jnp.asarray(m), self.mesh)
+                for m in mds.labels_masks))
+            return f, l, fm, lm
         ds = batches[0] if len(batches) == 1 else DataSet.merge(batches)
-        f = np.asarray(ds.features, np.float32)
-        l = np.asarray(ds.labels, np.float32)
+        f = np.asarray(ds.features)
+        l = np.asarray(ds.labels)
         b = f.shape[0]
         if b % self.workers_:
             raise ValueError(
                 f"Global batch {b} not divisible by {self.workers_} devices")
+        fm = (None if ds.features_mask is None
+              else shard_batch(jnp.asarray(ds.features_mask), self.mesh))
+        lm = (None if ds.labels_mask is None
+              else shard_batch(jnp.asarray(ds.labels_mask), self.mesh))
         return (shard_batch(jnp.asarray(f), self.mesh),
-                shard_batch(jnp.asarray(l), self.mesh))
+                shard_batch(jnp.asarray(l), self.mesh), fm, lm)
 
     def _stacked_batches(self, batches):
-        """[N, global_b, ...] with the global batch dim sharded."""
-        fs = np.stack([np.asarray(b.features, np.float32) for b in batches])
-        ls = np.stack([np.asarray(b.labels, np.float32) for b in batches])
-        if fs.shape[1] % self.workers_:
-            raise ValueError(f"Global batch {fs.shape[1]} not divisible by "
+        """[N, global_b, ...] with the global batch dim sharded. Masks ride
+        along (all-ones filled when presence is mixed across micro-batches)."""
+        def stack_masks(masks, data):
+            if all(m is None for m in masks):
+                return None
+            ndim = next(m.ndim for m in masks if m is not None)
+            return np.stack([m if m is not None
+                             else np.ones(np.asarray(d).shape[:ndim],
+                                          np.float32)
+                             for m, d in zip(masks, data)])
+
+        if self._is_graph:
+            mds_list = [self.net._as_multi(b) for b in batches]
+            n_in = len(mds_list[0].features)
+            n_out = len(mds_list[0].labels)
+            fs = tuple(np.stack([np.asarray(m.features[i]) for m in mds_list])
+                       for i in range(n_in))
+            ls = tuple(np.stack([np.asarray(m.labels[i]) for m in mds_list])
+                       for i in range(n_out))
+            fms = tuple(stack_masks(
+                [None if m.features_masks is None else m.features_masks[i]
+                 for m in mds_list],
+                [m.features[i] for m in mds_list]) for i in range(n_in))
+            lms = tuple(stack_masks(
+                [None if m.labels_masks is None else m.labels_masks[i]
+                 for m in mds_list],
+                [m.labels[i] for m in mds_list]) for i in range(n_out))
+            if all(m is None for m in fms):
+                fms = None
+            if all(m is None for m in lms):
+                lms = None
+            gb = fs[0].shape[1]
+        else:
+            fs = np.stack([np.asarray(b.features) for b in batches])
+            ls = np.stack([np.asarray(b.labels) for b in batches])
+            fms = stack_masks([b.features_mask for b in batches],
+                              [b.features for b in batches])
+            lms = stack_masks([b.labels_mask for b in batches],
+                              [b.labels for b in batches])
+            gb = fs.shape[1]
+        if gb % self.workers_:
+            raise ValueError(f"Global batch {gb} not divisible by "
                              f"{self.workers_} devices")
-        spec = P(None, DATA_AXIS)
-        sh = NamedSharding(self.mesh, spec)
-        return jax.device_put(jnp.asarray(fs), sh), jax.device_put(jnp.asarray(ls), sh)
+        sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        put = lambda t: (None if t is None else jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sh), t))
+        return put(fs), put(ls), put(fms), put(lms)
 
     def shutdown(self):
         pass  # no worker threads to stop — SPMD has no zoo of replicas
