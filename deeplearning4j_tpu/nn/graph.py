@@ -187,12 +187,14 @@ class ComputationGraph:
         return total + reg, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _raw_step(self, with_rnn_state=False):
+    def _raw_update_core(self):
+        """Shared step core (see MultiLayerNetwork._raw_update_core): returns
+        ``(updates, new_states, new_upd, loss, rnn_out)`` without applying."""
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
 
-        def step(params, states, upd_state, iteration, rng, inputs, labels,
+        def core(params, states, upd_state, iteration, rng, inputs, labels,
                  input_masks, label_masks, rnn_state_in=None):
             inputs = self._adapt_inputs(inputs)
 
@@ -206,6 +208,18 @@ class ComputationGraph:
                 grads = _tm(lambda g: -g, grads)
             grads = normalize_gradients(grads, gn_mode, gn_thresh)
             updates, new_upd = self.updater.apply(upd_state, grads, iteration)
+            return updates, new_states, new_upd, loss, rnn_out
+
+        return core
+
+    def _raw_step(self, with_rnn_state=False):
+        core = self._raw_update_core()
+
+        def step(params, states, upd_state, iteration, rng, inputs, labels,
+                 input_masks, label_masks, rnn_state_in=None):
+            updates, new_states, new_upd, loss, rnn_out = core(
+                params, states, upd_state, iteration, rng, inputs, labels,
+                input_masks, label_masks, rnn_state_in)
             new_params = _tm(lambda p, u: p - u.astype(p.dtype), params, updates)
             new_params = self._apply_constraints(new_params)
             if with_rnn_state:
@@ -213,6 +227,20 @@ class ComputationGraph:
                            if rnn_out else rnn_out)
                 return new_params, new_states, new_upd, loss, rnn_out
             return new_params, new_states, new_upd, loss
+
+        return step
+
+    def _raw_update_step(self):
+        """Updater-transformed update without application — SHARED_GRADIENTS
+        wire seam (see MultiLayerNetwork._raw_update_step)."""
+        core = self._raw_update_core()
+
+        def step(params, states, upd_state, iteration, rng, inputs, labels,
+                 input_masks, label_masks):
+            updates, new_states, new_upd, loss, _ = core(
+                params, states, upd_state, iteration, rng, inputs, labels,
+                input_masks, label_masks)
+            return updates, new_states, new_upd, loss
 
         return step
 

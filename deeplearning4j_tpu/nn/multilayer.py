@@ -176,16 +176,17 @@ class MultiLayerNetwork:
         return loss + reg, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _raw_step(self, with_rnn_state):
-        """The pure (unjitted) train-step function. ``_build_step`` jits it for
-        single-device training; ``deeplearning4j_tpu.parallel`` re-jits it with
-        explicit ``NamedSharding``s over a device mesh (SPMD data parallelism —
-        the reference's ParallelWrapper role, SURVEY.md §2.4/§7 Phase 3)."""
+    def _raw_update_core(self):
+        """Shared step core: loss → AD grads → gradient normalization →
+        updater transform. Returns ``(updates, new_states, new_upd, loss,
+        rnn_out)`` WITHOUT applying the update, so both ``_raw_step`` (apply
+        in-graph) and ``_raw_update_step`` (ship the update through the
+        SHARED_GRADIENTS codec) stay in lock-step by construction."""
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
 
-        def step(params, states, upd_state, iteration, rng, f, l, fm, lm,
+        def core(params, states, upd_state, iteration, rng, f, l, fm, lm,
                  rnn_state_in=None):
             f = self._adapt_input(f)
 
@@ -199,13 +200,44 @@ class MultiLayerNetwork:
                 grads = _tm(lambda g: -g, grads)
             grads = normalize_gradients(grads, gn_mode, gn_thresh)
             updates, new_upd = self.updater.apply(upd_state, grads, iteration)
-            new_params = jax.tree_util.tree_map(lambda p, u: p - u.astype(p.dtype),
-                                                params, updates)
+            return updates, new_states, new_upd, loss, rnn_out
+
+        return core
+
+    def _raw_step(self, with_rnn_state):
+        """The pure (unjitted) train-step function. ``_build_step`` jits it for
+        single-device training; ``deeplearning4j_tpu.parallel`` re-jits it with
+        explicit ``NamedSharding``s over a device mesh (SPMD data parallelism —
+        the reference's ParallelWrapper role, SURVEY.md §2.4/§7 Phase 3)."""
+        core = self._raw_update_core()
+
+        def step(params, states, upd_state, iteration, rng, f, l, fm, lm,
+                 rnn_state_in=None):
+            updates, new_states, new_upd, loss, rnn_out = core(
+                params, states, upd_state, iteration, rng, f, l, fm, lm,
+                rnn_state_in)
+            new_params = _tm(lambda p, u: p - u.astype(p.dtype), params,
+                             updates)
             new_params = self._apply_constraints(new_params)
             if with_rnn_state:
                 rnn_out = _tm(jax.lax.stop_gradient, rnn_out) if rnn_out else rnn_out
                 return new_params, new_states, new_upd, loss, rnn_out
             return new_params, new_states, new_upd, loss
+
+        return step
+
+    def _raw_update_step(self):
+        """Updater-transformed update without application — the
+        SHARED_GRADIENTS wire seam: the reference encodes post-updater updates
+        for peer broadcast (``SymmetricTrainer`` via
+        ``EncodingHandler.java:136``), so the codec must see the update, not
+        the raw gradient."""
+        core = self._raw_update_core()
+
+        def step(params, states, upd_state, iteration, rng, f, l, fm, lm):
+            updates, new_states, new_upd, loss, _ = core(
+                params, states, upd_state, iteration, rng, f, l, fm, lm)
+            return updates, new_states, new_upd, loss
 
         return step
 

@@ -10,7 +10,8 @@ from .wrapper import ParallelWrapper, TrainingMode
 from .inference import ParallelInference, InferenceMode
 from .accumulation import (GradientsAccumulator, EncodedGradientsAccumulator,
                            EncodingHandler, threshold_encode, threshold_decode)
-from .distributed import (TrainingMaster, ParameterAveragingTrainingMaster,
+from .distributed import (ProcessLocalIterator, is_chief,
+                          TrainingMaster, ParameterAveragingTrainingMaster,
                           SharedTrainingMaster, DistributedMultiLayerNetwork,
                           DistributedComputationGraph, SparkDl4jMultiLayer,
                           SparkComputationGraph, initialize_distributed)
@@ -26,6 +27,7 @@ __all__ = [
     "TrainingMaster", "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
     "DistributedMultiLayerNetwork", "DistributedComputationGraph",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",
+    "ProcessLocalIterator", "is_chief",
     "ring_attention", "ulysses_attention", "full_attention",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
 ]

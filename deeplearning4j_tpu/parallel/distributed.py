@@ -43,13 +43,60 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            process_id: Optional[int] = None):
     """Form the multi-host cluster (replaces the reference's
     ``VoidParameterServer.init`` Aeron mesh handshake,
-    ``SharedTrainingMaster.java:469``). No-op when single-process."""
+    ``SharedTrainingMaster.java:469``). No-op when single-process.
+
+    On the CPU backend (tests / virtual clusters) cross-process collectives
+    need the gloo transport — configured automatically when available."""
     if coordinator_address is None:
         return False
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # TPU backends use ICI/DCN natively
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
     return True
+
+
+def is_chief() -> bool:
+    """True on the coordinator process (host 0) — checkpointing, listener
+    output and UI posting are gated on this so N hosts don't write N copies
+    (the reference's Spark driver/executor role split)."""
+    return jax.process_index() == 0
+
+
+class ProcessLocalIterator:
+    """Round-robins a shared data stream across processes: process ``p`` of
+    ``P`` keeps batches ``p, p+P, p+2P, ...`` — the multi-controller
+    equivalent of the reference's per-executor RDD partition feeding
+    (``VirtualDataSetIterator``; fixes the naive every-host-feeds-everything
+    double-feed). The stream is truncated to a multiple of ``P`` batches so
+    every process sees the same number of steps (collective schedules must
+    match)."""
+
+    def __init__(self, iterator, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.it = iterator
+        self.p = jax.process_index() if process_index is None else process_index
+        self.P = jax.process_count() if process_count is None else process_count
+
+    def __iter__(self):
+        # rolling window of P batches — never materializes the stream (the
+        # final partial window is dropped so all processes see equal counts)
+        chunk = []
+        for b in self.it:
+            chunk.append(b)
+            if len(chunk) == self.P:
+                yield chunk[self.p]
+                chunk = []
+
+    def reset(self):
+        if hasattr(self.it, "reset"):
+            self.it.reset()
+
+    def async_supported(self):
+        return False
 
 
 class TrainingMaster:
@@ -166,13 +213,30 @@ class DistributedMultiLayerNetwork:
     """User-facing facade (reference ``SparkDl4jMultiLayer``:
     ``fit(JavaRDD<DataSet>)`` :214 → ``trainingMaster.executeTraining``)."""
 
-    def __init__(self, net, training_master: TrainingMaster):
+    def __init__(self, net, training_master: TrainingMaster,
+                 checkpoint_path: Optional[str] = None):
         self.net = net
         self.training_master = training_master
+        self.checkpoint_path = checkpoint_path
 
     def fit(self, iterator, epochs: int = 1):
-        for _ in range(epochs):
-            self.training_master.execute_training(self.net, iterator)
+        multi = jax.process_count() > 1
+        if multi:
+            # each process consumes only its round-robin share of the stream;
+            # the wrapper assembles the global batch from the process locals
+            iterator = ProcessLocalIterator(iterator)
+            if not is_chief():
+                # host-0 gating: listeners fire once per cluster, not per host
+                saved_listeners, self.net.listeners = self.net.listeners, []
+        try:
+            for _ in range(epochs):
+                self.training_master.execute_training(self.net, iterator)
+        finally:
+            if multi and not is_chief():
+                self.net.listeners = saved_listeners
+        if self.checkpoint_path and is_chief():
+            from ..utils.model_serializer import ModelSerializer
+            ModelSerializer.write_model(self.net, self.checkpoint_path)
         return self.net
 
     def evaluate(self, iterator):

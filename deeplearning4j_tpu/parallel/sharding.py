@@ -55,8 +55,27 @@ def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
 
 
 def shard_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
-    """Device_put a host batch with its leading dim split across ``axis``."""
+    """Place a host batch with its leading dim split across ``axis``.
+
+    Single-process: a plain sharded device_put. Multi-process (after
+    ``jax.distributed.initialize``): ``x`` is this process's LOCAL portion of
+    the global batch — the global array is assembled from every process's
+    local data without any host ever holding the full batch (the reference's
+    per-executor ``VirtualDataSetIterator`` partition feeding, done the JAX
+    multi-controller way)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            batch_sharded(mesh, axis), np.asarray(x))
     return jax.device_put(x, batch_sharded(mesh, axis))
+
+
+def put_replicated(x, mesh: Mesh):
+    """Replicate a host value over the (possibly multi-process) mesh. Every
+    process must hold the same value (same-seed init guarantees this)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(replicated(mesh),
+                                                      np.asarray(x))
+    return jax.device_put(x, replicated(mesh))
 
 
 def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):

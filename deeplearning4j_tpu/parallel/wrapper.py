@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
-                       shard_batch, data_parallel_step, pvary)
+                       shard_batch, put_replicated, data_parallel_step, pvary)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
@@ -121,6 +121,16 @@ class ParallelWrapper:
         self.mesh = mesh if mesh is not None else make_mesh(devices,
                                                             axes=(DATA_AXIS,))
         self.workers_ = int(np.prod(self.mesh.devices.shape))
+        # multi-process (multi-host) awareness: each process feeds only its
+        # addressable devices' share of the global batch
+        self.process_count = jax.process_count()
+        if self.process_count > 1:
+            pidx = jax.process_index()
+            self.local_workers_ = sum(1 for d in self.mesh.devices.flat
+                                      if d.process_index == pidx)
+        else:
+            self.local_workers_ = self.workers_
+        self._mp_batch_size = None  # enforced-uniform size (multi-process)
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.training_mode = training_mode
@@ -204,7 +214,9 @@ class ParallelWrapper:
             it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
         net = self.net
         for _ in range(epochs):
-            if self.averaging_frequency == 1:
+            if self.training_mode == TrainingMode.SHARED_GRADIENTS:
+                self._fit_shared(it)
+            elif self.averaging_frequency == 1:
                 self._fit_sync(it)
             else:
                 self._fit_local_sgd(it)
@@ -212,11 +224,11 @@ class ParallelWrapper:
         return self
 
     def _device_put_model(self):
-        repl = replicated(self.mesh)
         net = self.net
-        net.params = jax.device_put(net.params, repl)
-        net.states = jax.device_put(net.states, repl)
-        net.updater_state = jax.device_put(net.updater_state, repl)
+        put = lambda t: _tm(lambda x: put_replicated(x, self.mesh), t)
+        net.params = put(net.params)
+        net.states = put(net.states)
+        net.updater_state = put(net.updater_state)
 
     def _fit_sync(self, it):
         """AVERAGING freq=1 / SHARED_GRADIENTS: fused psum step per global
@@ -231,6 +243,34 @@ class ParallelWrapper:
         net = self.net
         step = self._ensure_sync_step()
         self._device_put_model()
+        for group in self._batch_groups(it):
+            if group is None:
+                continue  # tail handled unsharded by _batch_groups
+            f, l, fm, lm = self._global_batch(group)
+            itc = jnp.asarray(net.iteration_count, jnp.int32)
+            key = put_replicated(net._next_rng(), self.mesh)
+            net.params, net.states, net.updater_state, loss = step(
+                net.params, net.states, net.updater_state, itc, key, f, l,
+                fm, lm)
+            self.last_score = float(loss)
+            net.score_ = loss
+            net.iteration_count += 1
+            self.iteration_count += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration_count - 1, float(loss))
+
+    def _batch_groups(self, it):
+        """Yield groups of iterator batches (reference round-robin dispatch):
+        one batch per LOCAL device per parallel iteration — under multi-process
+        each process feeds only its addressable share of the global batch.
+
+        Single-process, a group whose example total is not divisible by the
+        device count is trained unsharded right here (net's own replicated
+        step) and yielded as None so no data is dropped or crashed on.
+        Multi-process, an unsharded step would desync the collective schedule
+        across processes, so the odd tail is dropped with a warning instead."""
+        net = self.net
+        group_size = self.local_workers_
         pending = []
         it = iter(it)
         exhausted = False
@@ -239,17 +279,33 @@ class ParallelWrapper:
                 pending.append(next(it))
             except StopIteration:
                 exhausted = True
-            if not pending or (len(pending) < self.workers_ and not exhausted):
+            if not pending or (len(pending) < group_size and not exhausted):
                 continue
-            total = sum(b.num_examples() for b in pending)
-            if total % self.workers_:
-                # tail (or odd-sized) group not shardable: train it on the
-                # net's own replicated step instead of dropping or crashing
-                group, pending = pending, []
+            group, pending = pending, []
+            total = sum(b.num_examples() for b in group)
+            if self.process_count > 1:
+                # the divisibility decision must be identical on every process
+                # or collective schedules desync (hang); uniform batch sizes
+                # guarantee that, so enforce them loudly instead
+                sizes = {b.num_examples() for b in group}
+                if self._mp_batch_size is None:
+                    self._mp_batch_size = next(iter(sizes))
+                sizes.add(self._mp_batch_size)
+                if len(sizes) != 1:
+                    raise ValueError(
+                        f"multi-process training requires uniform iterator "
+                        f"batch sizes; saw {sorted(sizes)}")
+            if total % group_size:
+                if self.process_count > 1:
+                    log.warning("Dropping %d-example tail group (not divisible "
+                                "by %d local devices; unsharded fallback would "
+                                "desync processes)", total, group_size)
+                    yield None
+                    continue
                 if len(group) == 1:
                     merged = group[0]
                 elif self._is_graph:
-                    merged = MultiDataSet.merge([self.net._as_multi(b)
+                    merged = MultiDataSet.merge([net._as_multi(b)
                                                  for b in group])
                 else:
                     merged = DataSet.merge(group)
@@ -259,14 +315,61 @@ class ParallelWrapper:
                 net._fit_batch(merged)
                 self.iteration_count += 1
                 self.last_score = float(net.score_)
+                yield None
                 continue
-            f, l, fm, lm = self._global_batch(pending)
-            pending = []
+            yield group
+
+    def _ensure_shared_steps(self):
+        """Two jitted halves around the host codec seam: compute the
+        updater-transformed update (gradient psum on ICI), then apply a
+        decoded update. The host hop between them is the DCN boundary the
+        encoding exists for."""
+        if getattr(self, "_shared_steps", None) is not None:
+            return self._shared_steps
+        net = self.net
+        repl = replicated(self.mesh)
+        data = batch_sharded(self.mesh)
+        update_step = jax.jit(
+            net._raw_update_step(),
+            in_shardings=(repl, repl, repl, repl, repl, data, data, data, data),
+            out_shardings=(repl, repl, repl, repl),
+            donate_argnums=(2,))
+
+        def apply_fn(params, update):
+            new = _tm(lambda p, u: p - u.astype(p.dtype), params, update)
+            return net._apply_constraints(new)
+
+        apply_step = jax.jit(apply_fn, out_shardings=repl, donate_argnums=(0,))
+        self._shared_steps = (update_step, apply_step)
+        return self._shared_steps
+
+    def _fit_shared(self, it):
+        """SHARED_GRADIENTS (reference ``SymmetricTrainer`` +
+        ``EncodedGradientsAccumulator.java:257``): every round the all-reduced
+        update is threshold-encoded — sub-threshold mass stays in the host
+        residual, the quantized decode is what peers (other slices over DCN)
+        would receive — and ALL replicas apply the decoded update, keeping
+        them bit-identical while the wire carries ``encoded_bytes()`` instead
+        of dense tensors. Trajectories genuinely differ from AVERAGING."""
+        net = self.net
+        if self.accumulator is None:
+            self.accumulator = EncodedGradientsAccumulator()
+        update_step, apply_step = self._ensure_shared_steps()
+        self._device_put_model()
+        for group in self._batch_groups(it):
+            if group is None:
+                continue
+            f, l, fm, lm = self._global_batch(group)
             itc = jnp.asarray(net.iteration_count, jnp.int32)
-            key = jax.device_put(net._next_rng(), replicated(self.mesh))
-            net.params, net.states, net.updater_state, loss = step(
+            key = put_replicated(net._next_rng(), self.mesh)
+            update, net.states, net.updater_state, loss = update_step(
                 net.params, net.states, net.updater_state, itc, key, f, l,
                 fm, lm)
+            # host hop: encode (residual kept) → decoded quantized update
+            decoded = self.accumulator.store_update(
+                _tm(np.asarray, update))
+            decoded = _tm(jnp.asarray, decoded)
+            net.params = apply_step(net.params, decoded)
             self.last_score = float(loss)
             net.score_ = loss
             net.iteration_count += 1
@@ -289,7 +392,7 @@ class ParallelWrapper:
             fs, ls, fms, lms = self._stacked_batches(pending)
             pending = []
             itc = jnp.asarray(net.iteration_count, jnp.int32)
-            key = jax.device_put(net._next_rng(), replicated(self.mesh))
+            key = put_replicated(net._next_rng(), self.mesh)
             t0 = time.perf_counter()
             net.params, net.states, net.updater_state, loss = step(
                 net.params, net.states, net.updater_state, itc, key, fs, ls,
@@ -318,9 +421,10 @@ class ParallelWrapper:
             mds_list = [self.net._as_multi(b) for b in batches]
             mds = mds_list[0] if len(mds_list) == 1 else MultiDataSet.merge(mds_list)
             b = mds.num_examples()
-            if b % self.workers_:
+            if b % self.local_workers_:
                 raise ValueError(
-                    f"Global batch {b} not divisible by {self.workers_} devices")
+                    f"Local batch {b} not divisible by "
+                    f"{self.local_workers_} local devices")
             f = tuple(shard_batch(jnp.asarray(x), self.mesh)
                       for x in mds.features)
             l = tuple(shard_batch(jnp.asarray(x), self.mesh)
@@ -336,9 +440,10 @@ class ParallelWrapper:
         f = np.asarray(ds.features)
         l = np.asarray(ds.labels)
         b = f.shape[0]
-        if b % self.workers_:
+        if b % self.local_workers_:
             raise ValueError(
-                f"Global batch {b} not divisible by {self.workers_} devices")
+                f"Local batch {b} not divisible by "
+                f"{self.local_workers_} local devices")
         fm = (None if ds.features_mask is None
               else shard_batch(jnp.asarray(ds.features_mask), self.mesh))
         lm = (None if ds.labels_mask is None
@@ -387,12 +492,17 @@ class ParallelWrapper:
             lms = stack_masks([b.labels_mask for b in batches],
                               [b.labels for b in batches])
             gb = fs.shape[1]
-        if gb % self.workers_:
-            raise ValueError(f"Global batch {gb} not divisible by "
-                             f"{self.workers_} devices")
+        if gb % self.local_workers_:
+            raise ValueError(f"Local batch {gb} not divisible by "
+                             f"{self.local_workers_} local devices")
         sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        if self.process_count > 1:
+            put_leaf = lambda a: jax.make_array_from_process_local_data(
+                sh, np.asarray(a))
+        else:
+            put_leaf = lambda a: jax.device_put(jnp.asarray(a), sh)
         put = lambda t: (None if t is None else jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(a), sh), t))
+            put_leaf, t))
         return put(fs), put(ls), put(fms), put(lms)
 
     def shutdown(self):

@@ -1,0 +1,66 @@
+"""Worker for the 2-process distributed training test (the reference tests
+distributed paths in-process via Spark local[N] — ``BaseSparkTest.java:89``;
+JAX's multi-controller model needs real processes, so the test spawns two of
+these and asserts both converge to identical parameters).
+
+Usage: python multiproc_worker.py <process_id> <num_processes> <port> <outdir>
+"""
+import sys
+import os
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), sys.argv[4])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from deeplearning4j_tpu.parallel import (initialize_distributed,
+                                         ParameterAveragingTrainingMaster,
+                                         DistributedMultiLayerNetwork,
+                                         is_chief)
+
+initialize_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                       process_id=pid)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 2 * nproc
+
+import numpy as np
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+conf = (NeuralNetConfiguration.builder().seed(5)
+        .updater(Sgd(learning_rate=5e-2)).activation("tanh")
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=16))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+# every process constructs the SAME full stream; ProcessLocalIterator inside
+# DistributedMultiLayerNetwork round-robins it so each host feeds only its share
+rng = np.random.default_rng(0)
+batches = []
+for i in range(8):
+    f = rng.normal(size=(8, 6)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    batches.append(DataSet(f, l))
+
+net.set_listeners(ScoreIterationListener(1))
+master = (ParameterAveragingTrainingMaster.Builder(8)
+          .averaging_frequency(1).build())
+dist = DistributedMultiLayerNetwork(net, master)
+s0 = net.score(DataSet.merge(batches))
+dist.fit(ListDataSetIterator(batches), epochs=4)
+s1 = net.score(DataSet.merge(batches))
+
+np.save(os.path.join(outdir, f"params_{pid}.npy"), net.params_flat())
+with open(os.path.join(outdir, f"result_{pid}.txt"), "w") as fh:
+    fh.write(f"{s0} {s1} {net.iteration_count} {int(is_chief())}\n")
+print(f"proc {pid}: score {s0:.4f} -> {s1:.4f}, chief={is_chief()}")

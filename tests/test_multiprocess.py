@@ -1,0 +1,55 @@
+"""Real multi-process distributed training (VERDICT item 4): two local
+processes form a JAX cluster over a virtual CPU mesh, train the same model
+with process-local data feeding, and must end with byte-identical parameters.
+
+Reference counterpart: the Spark layer's executor training
+(``SharedTrainingWrapper.java:160-244``, ``BaseSparkTest.java:89`` local[N]
+pattern); here the cluster is the JAX multi-controller runtime and the
+all-reduce rides the (virtual) mesh's collectives.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training_identical_params(tmp_path):
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "resources", "multiproc_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker sets its own config
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+    p0 = np.load(tmp_path / "params_0.npy")
+    p1 = np.load(tmp_path / "params_1.npy")
+    np.testing.assert_array_equal(p0, p1)  # replicas bit-identical
+
+    r0 = (tmp_path / "result_0.txt").read_text().split()
+    r1 = (tmp_path / "result_1.txt").read_text().split()
+    s0, s1 = float(r0[0]), float(r0[1])
+    assert s1 < s0, "distributed training must converge"
+    assert r0[0] == r1[0] and r0[1] == r1[1]  # same scores on both hosts
+    assert r0[3] == "1" and r1[3] == "0"  # exactly one chief
+    # 8 batches / (2 procs × 2 local devices) = 2 steps/epoch × 4 epochs
+    assert int(r0[2]) == 8

@@ -79,6 +79,45 @@ def test_parallel_wrapper_shared_gradients_mode():
           .training_mode(TrainingMode.SHARED_GRADIENTS).build())
     pw.fit(ListDataSetIterator([_data(64)]), epochs=2)
     assert np.isfinite(pw.last_score)
+    # a default accumulator was engaged and actually carried the updates
+    assert pw.accumulator is not None and pw.accumulator.encoded_bytes() > 0
+
+
+def test_shared_gradients_differs_from_averaging_and_converges():
+    """VERDICT item 3 'done' criteria: SHARED_GRADIENTS must produce a
+    *different* (quantized, residual-corrected) trajectory from AVERAGING,
+    still converge, and carry fewer bytes than dense over the wire seam
+    (reference EncodedGradientsAccumulator.java:257 semantics)."""
+    batches = [_data(64, seed=i) for i in range(4)]
+
+    avg = _net(seed=11, lr=5e-2)
+    (ParallelWrapper.Builder(avg).workers(8)
+     .training_mode(TrainingMode.AVERAGING).build()
+     .fit(ListDataSetIterator(batches), epochs=3))
+
+    sg = _net(seed=11, lr=5e-2)
+    acc = EncodedGradientsAccumulator(initial_threshold=1e-3)
+    pw = (ParallelWrapper.Builder(sg).workers(8)
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .gradients_accumulator(acc).build())
+    s0 = sg.score(batches[0])
+    pw.fit(ListDataSetIterator(batches), epochs=3)
+    s1 = sg.score(batches[0])
+    assert s1 < s0, "SHARED_GRADIENTS must converge"
+
+    # quantization makes the trajectory differ from exact averaging
+    max_diff = max(float(np.max(np.abs(np.asarray(avg.params[k][p])
+                                       - np.asarray(sg.params[k][p]))))
+                   for k in avg.params for p in avg.params[k])
+    assert max_diff > 0.0
+
+    # the wire seam carries the encoding, not dense tensors
+    dense_bytes = sum(np.asarray(v).nbytes
+                      for v in jax.tree_util.tree_leaves(sg.params))
+    assert 0 < acc.encoded_bytes() < dense_bytes
+
+    # residual correction: sub-threshold mass is retained, not lost
+    assert any(float(np.abs(r).sum()) > 0 for r in acc._residual.values())
 
 
 def test_parallel_wrapper_odd_batch_trains_unsharded():
