@@ -26,6 +26,12 @@ from .serde import register, to_json, from_json
 from .inputs import InputType, InputTypeConvolutional, InputTypeConvolutionalFlat
 from .layers import Layer, BaseLayer, FeedForwardLayer
 from .preprocessors import InputPreProcessor
+from .reconstruction import (ReconstructionDistribution,
+                             GaussianReconstructionDistribution,
+                             BernoulliReconstructionDistribution,
+                             ExponentialReconstructionDistribution,
+                             CompositeReconstructionDistribution,
+                             LossFunctionWrapper)
 from ..updaters import (IUpdater, Sgd, Adam, AdaMax, Nadam, Nesterovs, RmsProp,
                         AdaGrad, AdaDelta, NoOp, AMSGrad, FixedSchedule,
                         ExponentialSchedule, InverseSchedule, PolySchedule,

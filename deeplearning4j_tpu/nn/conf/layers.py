@@ -601,7 +601,9 @@ class VariationalAutoencoder(FeedForwardLayer):
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
     pzx_activation: str = "identity"
-    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    # a ReconstructionDistribution object (conf.reconstruction) or a legacy
+    # string name: gaussian (learned variance) | bernoulli | exponential
+    reconstruction_distribution: Any = "gaussian"
     num_samples: int = 1
 
     def is_pretrain_layer(self):

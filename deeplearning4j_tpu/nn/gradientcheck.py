@@ -55,6 +55,59 @@ def _loss_at(net, params, ds):
     return loss
 
 
+def check_function_gradients(loss_fn, params, epsilon: float = 1e-6,
+                             max_rel_error: float = 1e-3,
+                             min_abs_error: float = 1e-8,
+                             max_per_param: Optional[int] = None,
+                             seed: int = 12345,
+                             expect_zero: Optional[set] = None) -> bool:
+    """Central-difference check of an arbitrary scalar ``loss_fn(params)``
+    against its AD gradient — used for pretrain losses (VAE/AutoEncoder,
+    reference ``VaeGradientCheckTests``) and any custom objective.
+
+    ``expect_zero``: leaf-path substrings whose analytic gradient must be
+    exactly zero (frozen layers) — those leaves skip the numeric comparison
+    and instead assert the zero."""
+    loss_fn = jax.jit(loss_fn)
+    analytic = jax.grad(loss_fn)(params)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    analytic_map = {_key_str(kp): np.asarray(v) for kp, v in
+                    jax.tree_util.tree_flatten_with_path(analytic)[0]}
+    rng = np.random.default_rng(seed)
+    failed = 0
+    for keypath, leaf in leaves:
+        name = _key_str(keypath)
+        grad = analytic_map[name]
+        if expect_zero and any(z in name for z in expect_zero):
+            if float(np.abs(grad).max(initial=0.0)) != 0.0:
+                log.warning("Expected zero gradient for %s, got max %g", name,
+                            np.abs(grad).max())
+                failed += 1
+            continue
+        base = np.asarray(leaf, dtype=np.float64)
+        flat_idx = np.arange(base.size)
+        if max_per_param is not None and base.size > max_per_param:
+            flat_idx = rng.choice(base.size, size=max_per_param, replace=False)
+        for i in flat_idx:
+            plus = base.copy().ravel()
+            plus[i] += epsilon
+            minus = base.copy().ravel()
+            minus[i] -= epsilon
+            p_plus = _with_leaf(params, keypath, plus.reshape(base.shape))
+            p_minus = _with_leaf(params, keypath, minus.reshape(base.shape))
+            num = (float(loss_fn(p_plus)) - float(loss_fn(p_minus))) / (2 * epsilon)
+            ana = float(grad.ravel()[i])
+            denom = max(abs(num), abs(ana))
+            rel = 0.0 if denom == 0 else abs(num - ana) / denom
+            if not (rel <= max_rel_error or (abs(num) < min_abs_error
+                                             and abs(ana) < min_abs_error)):
+                log.warning("Gradient check FAILED %s[%d]: numeric=%.8e "
+                            "analytic=%.8e relError=%.4e", name, i, num, ana,
+                            rel)
+                failed += 1
+    return failed == 0
+
+
 class GradientCheckUtil:
     @staticmethod
     def check_gradients(net, ds, epsilon: float = 1e-6,
@@ -63,7 +116,8 @@ class GradientCheckUtil:
                         print_results: bool = False,
                         exit_on_first_error: bool = False,
                         max_per_param: Optional[int] = None,
-                        seed: int = 12345) -> bool:
+                        seed: int = 12345,
+                        exclude: Optional[set] = None) -> bool:
         """Return True when every checked element's analytic gradient matches the
         central difference within ``max_rel_error`` (elements where both are
         below ``min_abs_error`` pass unconditionally, reference semantics).
@@ -90,6 +144,8 @@ class GradientCheckUtil:
         max_err_seen = 0.0
         for keypath, leaf in leaves:
             name = _key_str(keypath)
+            if exclude and any(x in name for x in exclude):
+                continue  # e.g. frozen layers (AD-zero but numerically active)
             base = np.asarray(leaf, dtype=np.float64)
             grad = analytic_leaves[name]
             flat_idx = np.arange(base.size)

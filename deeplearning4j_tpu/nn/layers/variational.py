@@ -1,10 +1,18 @@
 """Variational autoencoder implementation.
 
-TPU-native equivalent of reference ``nn/layers/variational/VariationalAutoencoder.java``
-(1163 LoC): MLP encoder → diagonal-Gaussian q(z|x) → MLP decoder → reconstruction
-distribution. Supervised forward emits the mean of q(z|x) (reference behavior when
-used mid-network); ``pretrain_loss`` is the negative ELBO with the reparameterization
-trick, ``num_samples`` MC samples drawn inside the jitted step.
+TPU-native equivalent of reference
+``nn/layers/variational/VariationalAutoencoder.java`` (1163 LoC): MLP encoder
+→ diagonal-Gaussian q(z|x) → MLP decoder → pluggable reconstruction
+distribution p(x|z) (``nn/conf/layers/variational/`` — Gaussian with learned
+variance, Bernoulli, Exponential, Composite, LossFunctionWrapper; see
+``..conf.reconstruction``). Supervised forward emits the mean of q(z|x)
+(reference behavior when used mid-network); ``pretrain_loss`` is the negative
+ELBO with the reparameterization trick, ``num_samples`` MC samples drawn
+inside the jitted step. ``reconstruction_log_probability`` is the
+importance-sampled estimate the reference exposes for anomaly scoring
+(``reconstructionLogProbability``); ``reconstruction_error`` covers the
+``hasLossFunction`` distributions the same way the reference splits the two
+APIs.
 """
 from __future__ import annotations
 
@@ -14,10 +22,15 @@ import jax.numpy as jnp
 from .base import LayerImpl, implements
 from .feedforward import _dot
 from ..activations import get_activation
+from ..conf.reconstruction import resolve_distribution
 
 
 @implements("VariationalAutoencoder")
 class VAEImpl(LayerImpl):
+    @property
+    def recon_dist(self):
+        return resolve_distribution(self.conf.reconstruction_distribution)
+
     def _sizes(self):
         c = self.conf
         enc = [c.n_in] + list(c.encoder_layer_sizes)
@@ -45,9 +58,11 @@ class VAEImpl(LayerImpl):
                                             dec[i], dec[i + 1])
             params[f"db{i}"] = self._init_b((dec[i + 1],))
             ki += 1
-        # p(x|z) head: gaussian → mean (+ fixed unit variance), bernoulli → logits
-        params["xW"] = self._init_w(keys[ki], (dec[-1], c.n_in), dec[-1], c.n_in)
-        params["xb"] = self._init_b((c.n_in,))
+        # p(x|z) head: width = distribution param size ("pXZ" params; e.g.
+        # Gaussian emits [mean, log var] = 2*nIn)
+        px = self.recon_dist.param_size(c.n_in)
+        params["xW"] = self._init_w(keys[ki], (dec[-1], px), dec[-1], px)
+        params["xb"] = self._init_b((px,))
         return params, {}
 
     def encode(self, params, x):
@@ -62,6 +77,7 @@ class VAEImpl(LayerImpl):
         return pzx_act(mean), log_var
 
     def decode(self, params, z):
+        """z → pre-activation distribution params of p(x|z)."""
         _, dec = self._sizes()
         h = z
         for i in range(len(dec) - 1):
@@ -74,42 +90,81 @@ class VAEImpl(LayerImpl):
         mean, _ = self.encode(params, x)
         return mean.astype(self.dtype), state
 
+    def has_loss_function(self):
+        """Reference ``hasLossFunction()`` — true for LossFunctionWrapper."""
+        return self.recon_dist.has_loss_function
+
+    hasLossFunction = has_loss_function
+
     def pretrain_loss(self, params, x, rng):
+        """Negative ELBO (reference ``computeGradientAndScore`` pretrain
+        path): KL(q(z|x) || N(0,I)) + E_q[−log p(x|z)], reparameterized."""
         c = self.conf
+        dist = self.recon_dist
         mean, log_var = self.encode(params, x)
-        kl = -0.5 * jnp.sum(1 + log_var - mean * mean - jnp.exp(log_var), axis=-1)
+        kl = -0.5 * jnp.sum(1 + log_var - mean * mean - jnp.exp(log_var),
+                            axis=-1)
         total_recon = 0.0
         keys = jax.random.split(rng, c.num_samples)
         for k in keys:
             eps = jax.random.normal(k, mean.shape, mean.dtype)
             z = mean + jnp.exp(0.5 * log_var) * eps
-            xhat = self.decode(params, z)
-            if c.reconstruction_distribution == "bernoulli":
-                recon = jnp.sum(
-                    jnp.maximum(xhat, 0) - xhat * x + jnp.log1p(jnp.exp(-jnp.abs(xhat))),
-                    axis=-1)
-            else:  # gaussian, unit variance
-                recon = 0.5 * jnp.sum((xhat - x) ** 2, axis=-1)
-            total_recon = total_recon + recon
+            total_recon = total_recon + dist.neg_log_prob(
+                x, self.decode(params, z))
         recon = total_recon / c.num_samples
         return jnp.mean(recon + kl)
 
-    def reconstruction_probability(self, params, x, rng, num_samples=None):
-        """Reference ``VariationalAutoencoder.reconstructionProbability`` —
-        importance-sampled estimate of log p(x)."""
+    # ------------------------------------------------- reference API surface
+    def reconstruction_log_probability(self, params, x, rng, num_samples=None):
+        """Importance-sampled estimate of log p(x) per example (reference
+        ``reconstructionLogProbability``): log p(x) ≈ logsumexp_k[log p(x|z_k)
+        + log p(z_k) − log q(z_k|x)] − log K, z_k ~ q(z|x). The reference's
+        anomaly-scoring entry point."""
+        if self.recon_dist.has_loss_function:
+            raise ValueError(
+                "reconstruction_log_probability is undefined for "
+                "LossFunctionWrapper distributions — use reconstruction_error "
+                "(reference throws the same way)")
         n = num_samples or self.conf.num_samples
+        dist = self.recon_dist
         mean, log_var = self.encode(params, x)
         keys = jax.random.split(rng, n)
-        logps = []
+        logws = []
         for k in keys:
             eps = jax.random.normal(k, mean.shape, mean.dtype)
             z = mean + jnp.exp(0.5 * log_var) * eps
-            xhat = self.decode(params, z)
-            if self.conf.reconstruction_distribution == "bernoulli":
-                logp = -jnp.sum(
-                    jnp.maximum(xhat, 0) - xhat * x + jnp.log1p(jnp.exp(-jnp.abs(xhat))),
-                    axis=-1)
-            else:
-                logp = -0.5 * jnp.sum((xhat - x) ** 2 + jnp.log(2 * jnp.pi), axis=-1)
-            logps.append(logp)
-        return jax.scipy.special.logsumexp(jnp.stack(logps), axis=0) - jnp.log(float(n))
+            log_p_xz = -dist.neg_log_prob(x, self.decode(params, z))
+            log_prior = -0.5 * jnp.sum(z * z + jnp.log(2 * jnp.pi), axis=-1)
+            log_q = -0.5 * jnp.sum(eps * eps + jnp.log(2 * jnp.pi) + log_var,
+                                   axis=-1)
+            logws.append(log_p_xz + log_prior - log_q)
+        return (jax.scipy.special.logsumexp(jnp.stack(logws), axis=0)
+                - jnp.log(float(n)))
+
+    def reconstruction_probability(self, params, x, rng, num_samples=None):
+        """exp of :meth:`reconstruction_log_probability` (reference
+        ``reconstructionProbability``)."""
+        return jnp.exp(self.reconstruction_log_probability(params, x, rng,
+                                                           num_samples))
+
+    def reconstruction_error(self, params, x):
+        """Per-example deterministic reconstruction error (reference
+        ``reconstructionError`` — only for ``hasLossFunction`` distributions)."""
+        if not self.recon_dist.has_loss_function:
+            raise ValueError(
+                "reconstruction_error requires a LossFunctionWrapper "
+                "distribution — use reconstruction_log_probability")
+        mean, _ = self.encode(params, x)
+        return self.recon_dist.neg_log_prob(x, self.decode(params, mean))
+
+    def generate_at_mean_given_z(self, params, z):
+        """Reference ``generateAtMeanGivenZ``."""
+        return self.recon_dist.mean(self.decode(params, z))
+
+    generateAtMeanGivenZ = generate_at_mean_given_z
+
+    def generate_random_given_z(self, params, z, rng):
+        """Reference ``generateRandomGivenZ``."""
+        return self.recon_dist.sample(rng, self.decode(params, z))
+
+    generateRandomGivenZ = generate_random_given_z

@@ -1,0 +1,419 @@
+"""Extended gradient-check breadth (VERDICT item 5) — mirrors the reference's
+13-suite coverage in ``deeplearning4j-core/src/test/.../gradientcheck/``:
+``LossFunctionGradientCheck``, ``VaeGradientCheckTests``,
+``YoloGradientCheckTests``, ``GradientCheckTestsComputationGraph`` (merge /
+elementwise / skip), masking variants, ``NoBiasGradientCheckTests``, frozen
+layers, embedding, global pooling, bidirectional/Graves recurrent familes.
+
+All checks run in f64 on the CPU backend (the reference's double-precision
+rule, ``GradientCheckUtil.java:122``).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                InputType, Sgd, DataSet)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn,
+    Bidirectional, RnnOutputLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+    GlobalPoolingLayer, PoolingType, Yolo2OutputLayer, FrozenLayer, LossLayer,
+    CenterLossOutputLayer, AutoEncoder, VariationalAutoencoder, ActivationLayer)
+from deeplearning4j_tpu.nn.conf import (GaussianReconstructionDistribution,
+                                        BernoulliReconstructionDistribution,
+                                        CompositeReconstructionDistribution)
+from deeplearning4j_tpu.nn.gradientcheck import (GradientCheckUtil,
+                                                 check_function_gradients,
+                                                 double_precision)
+from deeplearning4j_tpu.nn.losses import LossFunction
+
+
+def _f64_builder():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345).updater(Sgd(learning_rate=1.0))
+            .dtype("float64").compute_dtype("float64"))
+
+
+def _onehot(rng, n, c):
+    return np.eye(c)[rng.integers(0, c, n)].astype(np.float64)
+
+
+def _check(net, ds, **kw):
+    kw.setdefault("max_per_param", 12)
+    kw.setdefault("print_results", True)
+    assert GradientCheckUtil.check_gradients(net, ds, **kw)
+
+
+# ------------------------------------------------- every loss function
+# (activation, label factory) per loss — mirrors LossFunctionGradientCheck's
+# valid-domain pairing table
+def _labels_real(rng, n, c):
+    return rng.normal(size=(n, c))
+
+
+def _labels_pos(rng, n, c):
+    return np.abs(rng.normal(size=(n, c))) + 0.5
+
+
+def _labels_binary(rng, n, c):
+    return (rng.random((n, c)) > 0.5).astype(np.float64)
+
+
+def _labels_dist(rng, n, c):
+    p = rng.random((n, c)) + 0.05
+    return p / p.sum(axis=1, keepdims=True)
+
+
+def _labels_pm1(rng, n, c):
+    return np.sign(rng.normal(size=(n, c))) + (rng.normal(size=(n, c)) == 0)
+
+
+_LOSS_CASES = [
+    ("mse", "identity", _labels_real),
+    ("mse", "tanh", _labels_real),
+    ("l2", "identity", _labels_real),
+    ("l1", "identity", _labels_real),
+    ("mean_absolute_error", "identity", _labels_real),
+    ("mean_absolute_percentage_error", "identity", _labels_pos),
+    ("mean_squared_logarithmic_error", "softplus", _labels_pos),
+    ("mcxent", "softmax", lambda rng, n, c: _onehot(rng, n, c)),
+    ("negativeloglikelihood", "softmax", lambda rng, n, c: _onehot(rng, n, c)),
+    ("xent", "sigmoid", _labels_binary),
+    ("reconstruction_crossentropy", "sigmoid",
+     lambda rng, n, c: rng.random((n, c)) * 0.9 + 0.05),
+    ("kl_divergence", "softmax", _labels_dist),
+    ("poisson", "softplus", lambda rng, n, c:
+     rng.integers(0, 5, (n, c)).astype(np.float64)),
+    ("cosine_proximity", "identity", _labels_real),
+    ("squared_hinge", "identity", _labels_pm1),
+]
+
+
+@pytest.mark.parametrize("loss,act,labels", _LOSS_CASES,
+                         ids=[f"{l}-{a}" for l, a, _ in _LOSS_CASES])
+def test_loss_function_gradients(loss, act, labels):
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=5))
+                .layer(OutputLayer(n_in=5, n_out=3, activation=act, loss=loss))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(7)
+        ds = DataSet(rng.normal(size=(5, 4)), labels(rng, 5, 3))
+        _check(net, ds)
+
+
+# MAE/L1/hinge are piecewise-linear (kinks make central differences unreliable
+# exactly at them); the cases above use seeds that avoid the kinks, matching
+# the reference's tolerance-tuned LossFunctionGradientCheck.
+
+
+# ------------------------------------------------- no-bias nets
+def test_no_bias_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=5, has_bias=False))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                                   loss="mcxent", has_bias=False))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert not any("b" == k for lp in net.params.values() for k in lp)
+        rng = np.random.default_rng(8)
+        _check(net, DataSet(rng.normal(size=(6, 4)), _onehot(rng, 6, 3)))
+
+
+# ------------------------------------------------- embedding (int inputs)
+def test_embedding_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(EmbeddingLayer(n_in=9, n_out=5))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(9)
+        f = rng.integers(0, 9, size=(6, 1)).astype(np.float64)
+        _check(net, DataSet(f, _onehot(rng, 6, 3)))
+
+
+# ------------------------------------------------- recurrent family + masking
+@pytest.mark.parametrize("layer", [
+    GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+    GravesBidirectionalLSTM(n_in=3, n_out=4, activation="tanh"),
+    SimpleRnn(n_in=3, n_out=4, activation="tanh"),
+    Bidirectional(inner=LSTM(n_in=3, n_out=4, activation="tanh")),
+], ids=["graves", "graves-bidi", "simple", "bidi-wrapper"])
+def test_recurrent_family_gradients(layer):
+    with double_precision():
+        # GravesBidirectionalLSTM sums directions (stays n_out);
+        # Bidirectional(concat) doubles it
+        n_out_rnn = 8 if isinstance(layer, Bidirectional) else 4
+        conf = (_f64_builder()
+                .list()
+                .layer(layer)
+                .layer(RnnOutputLayer(n_in=n_out_rnn, n_out=2,
+                                      activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(10)
+        T = 4
+        f = rng.normal(size=(3, T, 3))
+        l = np.stack([_onehot(rng, T, 2) for _ in range(3)])
+        _check(net, ds=DataSet(f, l), max_per_param=8)
+
+
+def test_rnn_masking_gradients():
+    """Per-example sequence masks flow through the loss (reference
+    GradientCheckTests masking variants)."""
+    with double_precision():
+        conf = (_f64_builder()
+                .list()
+                .layer(LSTM(n_in=3, n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(11)
+        T = 5
+        f = rng.normal(size=(4, T, 3))
+        l = np.stack([_onehot(rng, T, 2) for _ in range(4)])
+        lengths = np.array([5, 3, 4, 2])
+        mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float64)
+        ds = DataSet(f, l, features_mask=mask, labels_mask=mask)
+        _check(net, ds, max_per_param=8)
+
+
+def test_global_pooling_rnn_masked_gradients():
+    with double_precision():
+        conf = (_f64_builder()
+                .list()
+                .layer(LSTM(n_in=3, n_out=4, activation="tanh"))
+                .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(12)
+        T = 4
+        f = rng.normal(size=(3, T, 3))
+        l = _onehot(rng, 3, 2)
+        mask = (np.arange(T)[None, :] < np.array([4, 2, 3])[:, None]).astype(
+            np.float64)
+        _check(net, DataSet(f, l, features_mask=mask), max_per_param=8)
+
+
+# ------------------------------------------------- frozen layers
+def test_frozen_layer_gradients():
+    """Frozen params: AD gradient exactly zero; the rest still checks out
+    (reference FrozenLayer + gradient check pattern)."""
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(FrozenLayer(inner=DenseLayer(n_in=4, n_out=5)))
+                .layer(DenseLayer(n_in=5, n_out=5))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(13)
+        ds = DataSet(rng.normal(size=(6, 4)), _onehot(rng, 6, 3))
+        grads, _ = net.compute_gradient_and_score(ds)
+        for k, v in grads["0"].items():
+            assert float(jnp.abs(v).max()) == 0.0, f"frozen 0/{k} has gradient"
+        _check(net, ds, exclude={"0/"})
+
+
+# ------------------------------------------------- output layer variants
+def test_loss_layer_and_activation_layer_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=3))
+                .layer(ActivationLayer(activation="softmax"))
+                .layer(LossLayer(loss="mcxent", activation="identity"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(14)
+        _check(net, DataSet(rng.normal(size=(6, 4)), _onehot(rng, 6, 3)))
+
+
+def test_center_loss_output_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=5))
+                .layer(CenterLossOutputLayer(n_in=5, n_out=3,
+                                             activation="softmax",
+                                             loss="mcxent", lambda_=0.1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(15)
+        ds = DataSet(rng.normal(size=(6, 4)), _onehot(rng, 6, 3))
+        # centers are state (EMA-updated outside AD), not checked params
+        _check(net, ds)
+
+
+# ------------------------------------------------- pretrain losses (VAE, AE)
+@pytest.mark.parametrize("dist", [
+    GaussianReconstructionDistribution(),
+    BernoulliReconstructionDistribution(),
+    (CompositeReconstructionDistribution.builder()
+     .add_distribution(3, GaussianReconstructionDistribution())
+     .add_distribution(3, BernoulliReconstructionDistribution()).build()),
+], ids=["gaussian", "bernoulli", "composite"])
+def test_vae_pretrain_gradients(dist):
+    """Reference VaeGradientCheckTests (pretrain path)."""
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(VariationalAutoencoder(
+                    n_in=6, n_out=3, encoder_layer_sizes=(7,),
+                    decoder_layer_sizes=(7,),
+                    reconstruction_distribution=dist, num_samples=1))
+                .layer(OutputLayer(n_in=3, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(16)
+        x = rng.normal(size=(5, 6))
+        if isinstance(dist, BernoulliReconstructionDistribution):
+            x = (x > 0).astype(np.float64)
+        impl = net.impls[0]
+        key = jax.random.PRNGKey(0)
+        assert check_function_gradients(
+            lambda p: impl.pretrain_loss(p, jnp.asarray(x), key),
+            net.params["0"], max_per_param=10)
+
+
+def test_vae_supervised_gradients():
+    """Reference VaeGradientCheckTests (supervised/backprop path — VAE used
+    mid-network emits mean of q(z|x))."""
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(VariationalAutoencoder(
+                    n_in=6, n_out=3, encoder_layer_sizes=(7,),
+                    decoder_layer_sizes=(7,)))
+                .layer(OutputLayer(n_in=3, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(17)
+        ds = DataSet(rng.normal(size=(6, 6)), _onehot(rng, 6, 2))
+        # decoder params don't participate in the supervised path
+        _check(net, ds, exclude={"0/d", "0/x"})
+
+
+def test_autoencoder_pretrain_gradients():
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(AutoEncoder(n_in=5, n_out=3, corruption_level=0.0))
+                .layer(OutputLayer(n_in=3, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(18)
+        x = rng.normal(size=(5, 5))
+        impl = net.impls[0]
+        key = jax.random.PRNGKey(0)
+        assert check_function_gradients(
+            lambda p: impl.pretrain_loss(p, jnp.asarray(x), key),
+            net.params["0"], max_per_param=10)
+
+
+# ------------------------------------------------- YOLO2
+def test_yolo2_gradients():
+    """Reference YoloGradientCheckTests."""
+    with double_precision():
+        gh = gw = 3
+        C = 2
+        B = 2
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(ConvolutionLayer(n_out=B * 5 + C, kernel_size=(1, 1),
+                                        stride=(1, 1)))
+                .layer(Yolo2OutputLayer(boxes=[[1.0, 1.0], [2.0, 2.0]]))
+                .set_input_type(InputType.convolutional(gh, gw, 4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(19)
+        f = rng.normal(size=(2, 4, gh, gw))
+        # labels [b, 4+C, gh, gw]: one object per image
+        labels = np.zeros((2, 4 + C, gh, gw))
+        for b in range(2):
+            i, j = rng.integers(0, gh), rng.integers(0, gw)
+            labels[b, :4, i, j] = [j + 0.2, i + 0.2, j + 0.8, i + 0.8]
+            labels[b, 4 + rng.integers(0, C), i, j] = 1.0
+        _check(net, DataSet(f, labels), max_per_param=10,
+               max_rel_error=5e-3)
+
+
+# ------------------------------------------------- ComputationGraph topologies
+def _cg(conf_builder):
+    return ComputationGraph(conf_builder.build()).init()
+
+
+def test_cg_merge_vertex_gradients():
+    """Reference GradientCheckTestsComputationGraph merge topology."""
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_in=4, n_out=3), "in")
+                .add_layer("b", DenseLayer(n_in=4, n_out=3), "in")
+                .add_layer("out", OutputLayer(n_in=6, n_out=2,
+                                              activation="softmax",
+                                              loss="mcxent"), "a", "b")
+                .set_outputs("out"))
+        net = _cg(conf)
+        rng = np.random.default_rng(20)
+        ds = DataSet(rng.normal(size=(5, 4)), _onehot(rng, 5, 2))
+        _check(net, ds)
+
+
+def test_cg_elementwise_and_skip_gradients():
+    """Elementwise-add vertex + skip connection (residual pattern)."""
+    with double_precision():
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+        conf = (_f64_builder().activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=4), "in")
+                .add_vertex("add", ElementWiseVertex("add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                              activation="softmax",
+                                              loss="mcxent"), "add")
+                .set_outputs("out"))
+        net = _cg(conf)
+        rng = np.random.default_rng(21)
+        ds = DataSet(rng.normal(size=(5, 4)), _onehot(rng, 5, 2))
+        _check(net, ds)
+
+
+def test_cg_multi_output_gradients():
+    """Two output layers training jointly (multi-task)."""
+    with double_precision():
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        conf = (_f64_builder().activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_in=4, n_out=6), "in")
+                .add_layer("out1", OutputLayer(n_in=6, n_out=2,
+                                               activation="softmax",
+                                               loss="mcxent"), "trunk")
+                .add_layer("out2", OutputLayer(n_in=6, n_out=3,
+                                               activation="identity",
+                                               loss="mse"), "trunk")
+                .set_outputs("out1", "out2"))
+        net = _cg(conf)
+        rng = np.random.default_rng(22)
+        mds = MultiDataSet([rng.normal(size=(5, 4))],
+                           [_onehot(rng, 5, 2), rng.normal(size=(5, 3))])
+        _check(net, mds)
