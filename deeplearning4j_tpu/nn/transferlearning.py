@@ -206,12 +206,282 @@ class TransferLearning:
             new_net.updater_state = new_net.updater.init_state(new_net.params)
             return new_net
 
-    GraphBuilder = None  # ComputationGraph transfer: see graph_transfer below
+    class GraphBuilder:
+        """ComputationGraph transfer surgery (reference
+        ``TransferLearning.GraphBuilder`` in ``TransferLearning.java``):
+        freeze a feature-extractor subgraph, replace layer widths, remove and
+        append vertices — carrying over retained parameters."""
+
+        def __init__(self, net):
+            self._net = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen_at: List[str] = []
+            self._n_out_replace: Dict[str, tuple] = {}
+            self._removed: List[str] = []
+            self._added: List[tuple] = []  # (name, layer_or_vertex, inputs)
+            self._outputs: Optional[List[str]] = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, *vertex_names):
+            """Freeze the named vertices AND everything feeding them
+            (reference semantics: the frozen boundary is inclusive)."""
+            self._frozen_at = list(vertex_names)
+            return self
+
+        setFeatureExtractor = set_feature_extractor
+
+        def n_out_replace(self, layer_name: str, n_out: int,
+                          weight_init: Optional[str] = None):
+            self._n_out_replace[layer_name] = (int(n_out), weight_init)
+            return self
+
+        nOutReplace = n_out_replace
+
+        def remove_vertex_and_connections(self, name: str):
+            self._removed.append(name)
+            return self
+
+        removeVertexAndConnections = remove_vertex_and_connections
+
+        def add_layer(self, name: str, layer: Layer, *inputs):
+            self._added.append((name, layer, list(inputs)))
+            return self
+
+        addLayer = add_layer
+
+        def add_vertex(self, name: str, vertex, *inputs):
+            self._added.append((name, vertex, list(inputs)))
+            return self
+
+        addVertex = add_vertex
+
+        def set_outputs(self, *names):
+            self._outputs = list(names)
+            return self
+
+        setOutputs = set_outputs
+
+        def build(self):
+            from .graph import ComputationGraph
+            from .conf.graph import (ComputationGraphConfiguration,
+                                     MergeVertex)
+
+            old_conf = self._net.conf
+            gc = old_conf.global_conf
+            if self._fine_tune is not None:
+                gc = self._fine_tune.apply_to(gc)
+
+            vertices = {k: copy.deepcopy(v)
+                        for k, v in old_conf.vertices.items()}
+            vertex_inputs = {k: list(v)
+                             for k, v in old_conf.vertex_inputs.items()}
+            outputs = list(self._outputs if self._outputs is not None
+                           else old_conf.network_outputs)
+
+            for name in self._removed:
+                vertices.pop(name, None)
+                vertex_inputs.pop(name, None)
+                if name in outputs:
+                    outputs.remove(name)
+
+            reinit = set()
+            for name, layer, inputs in self._added:
+                ins = list(inputs)
+                if len(ins) > 1 and isinstance(layer, Layer):
+                    merge = f"{name}-merge"
+                    vertices[merge] = MergeVertex()
+                    vertex_inputs[merge] = ins
+                    ins = [merge]
+                vertices[name] = copy.deepcopy(layer)
+                vertex_inputs[name] = ins
+                reinit.add(name)
+
+            consumers: Dict[str, List[str]] = {}
+            for v, ins in vertex_inputs.items():
+                for i in ins:
+                    consumers.setdefault(i, []).append(v)
+
+            for name, (n_out, w_init) in self._n_out_replace.items():
+                lc = vertices.get(name)
+                inner = getattr(lc, "inner", None) or lc
+                if not isinstance(inner, FeedForwardLayer):
+                    raise ValueError(f"nOutReplace on '{name}' "
+                                     f"({type(inner).__name__}): not a "
+                                     f"FeedForwardLayer")
+                inner.n_out = n_out
+                if w_init is not None:
+                    inner.weight_init = w_init
+                reinit.add(name)
+                # cascade: direct consumers (and through merge vertices) get
+                # their nIn re-derived by infer_shapes
+                stack = list(consumers.get(name, []))
+                while stack:
+                    c = stack.pop()
+                    cv = vertices.get(c)
+                    ci = getattr(cv, "inner", None) or cv
+                    if isinstance(ci, FeedForwardLayer):
+                        ci.n_in = None  # re-filled by infer_shapes
+                        reinit.add(c)
+                    elif not isinstance(cv, Layer):
+                        stack.extend(consumers.get(c, []))  # e.g. MergeVertex
+
+            # freeze the named boundary + its ancestor closure
+            if self._frozen_at:
+                frozen = set()
+                stack = list(self._frozen_at)
+                while stack:
+                    n = stack.pop()
+                    if n in frozen or n not in vertices:
+                        continue
+                    frozen.add(n)
+                    stack.extend(i for i in vertex_inputs.get(n, [])
+                                 if i in vertices)
+                for n in frozen:
+                    if isinstance(vertices[n], Layer) and not isinstance(
+                            vertices[n], FrozenLayer):
+                        vertices[n] = FrozenLayer(inner=vertices[n])
+
+            new_conf = ComputationGraphConfiguration(
+                global_conf=gc,
+                network_inputs=list(old_conf.network_inputs),
+                network_outputs=outputs,
+                vertices=vertices,
+                vertex_inputs=vertex_inputs,
+                input_preprocessors={
+                    k: v for k, v in old_conf.input_preprocessors.items()
+                    if k in vertices},
+                input_types=old_conf.input_types,
+                backprop_type=old_conf.backprop_type,
+                tbptt_fwd_length=old_conf.tbptt_fwd_length,
+                tbptt_back_length=old_conf.tbptt_back_length)
+            new_conf.infer_shapes()
+
+            new_net = ComputationGraph(new_conf).init()
+            for name in vertices:
+                if name in old_conf.vertices and name not in reinit:
+                    if self._net.params.get(name):
+                        new_net.params[name] = _tm(lambda x: x,
+                                                   self._net.params[name])
+                    if self._net.states.get(name):
+                        new_net.states[name] = _tm(lambda x: x,
+                                                   self._net.states[name])
+            new_net.updater_state = new_net.updater.init_state(new_net.params)
+            return new_net
+
+
+class GraphTransferLearningHelper:
+    """ComputationGraph variant of the featurization helper (reference
+    ``TransferLearningHelper(ComputationGraph, String... frozenOutputAt)``):
+    the frozen subgraph is everything feeding the named boundary vertices;
+    ``featurize`` runs it once, and the unfrozen tail trains as its own graph
+    whose network inputs are the boundary activations."""
+
+    def __init__(self, net, *frozen_output_at: str):
+        from .graph import ComputationGraph
+        from .conf.graph import ComputationGraphConfiguration
+        if not frozen_output_at:
+            raise ValueError("Name at least one frozen boundary vertex")
+        self.orig = net
+        conf = net.conf
+        frozen = set()
+        stack = list(frozen_output_at)
+        while stack:
+            n = stack.pop()
+            if n in frozen or n not in conf.vertices:
+                continue
+            frozen.add(n)
+            stack.extend(i for i in conf.vertex_inputs.get(n, [])
+                         if i in conf.vertices)
+        self.frozen = frozen
+        for out in conf.network_outputs:
+            if out in frozen:
+                raise ValueError(f"Output '{out}' is inside the frozen "
+                                 f"subgraph")
+
+        tail_vertices = {n: copy.deepcopy(v)
+                         for n, v in conf.vertices.items() if n not in frozen}
+        tail_inputs: List[str] = []
+        tail_vertex_inputs: Dict[str, List[str]] = {}
+        for n in tail_vertices:
+            ins = []
+            for i in conf.vertex_inputs[n]:
+                if i in frozen or i in conf.network_inputs:
+                    if i not in tail_inputs:
+                        tail_inputs.append(i)
+                ins.append(i)
+            tail_vertex_inputs[n] = ins
+        self.boundary = tail_inputs  # featurize() emits these, in order
+
+        tail_conf = ComputationGraphConfiguration(
+            global_conf=conf.global_conf,
+            network_inputs=tail_inputs,
+            network_outputs=list(conf.network_outputs),
+            vertices=tail_vertices,
+            vertex_inputs=tail_vertex_inputs,
+            input_preprocessors={k: v
+                                 for k, v in conf.input_preprocessors.items()
+                                 if k in tail_vertices},
+            input_types=None,
+            backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length)
+        self.tail = ComputationGraph(tail_conf).init()
+        for n in tail_vertices:
+            if net.params.get(n):
+                self.tail.params[n] = _tm(lambda x: x, net.params[n])
+            if net.states.get(n):
+                self.tail.states[n] = _tm(lambda x: x, net.states[n])
+        self.tail.updater_state = self.tail.updater.init_state(self.tail.params)
+
+    def featurize(self, ds):
+        """Run the frozen subgraph once; returns a MultiDataSet whose features
+        are the boundary activations in tail-input order."""
+        import numpy as np
+        from ..datasets.dataset import MultiDataSet
+        mds = self.orig._as_multi(ds)
+        acts = self.orig.feed_forward(*mds.features, train=False)
+        feats = []
+        for name in self.boundary:
+            if name in self.orig.conf.network_inputs:
+                idx = self.orig.conf.network_inputs.index(name)
+                feats.append(np.asarray(mds.features[idx]))
+            else:
+                feats.append(np.asarray(acts[name]))
+        return MultiDataSet(feats, list(mds.labels),
+                            mds.features_masks, mds.labels_masks)
+
+    def fit_featurized(self, mds):
+        self.tail.fit(mds)
+        return self
+
+    fitFeaturized = fit_featurized
+
+    def output_from_featurized(self, *features):
+        return self.tail.output(*features)
+
+    outputFromFeaturized = output_from_featurized
+
+    def unfrozen_graph(self):
+        return self.tail
+
+    unfrozenGraph = unfrozen_graph
 
 
 class TransferLearningHelper:
     """Featurize once through the frozen block, then train only the unfrozen
-    tail (reference ``TransferLearningHelper.java``)."""
+    tail (reference ``TransferLearningHelper.java``). For a
+    ComputationGraph, pass boundary vertex names — dispatches to
+    :class:`GraphTransferLearningHelper`."""
+
+    def __new__(cls, net, frozen_till, *more):
+        if not isinstance(net, MultiLayerNetwork):
+            return GraphTransferLearningHelper(net, frozen_till, *more)
+        return super().__new__(cls)
 
     def __init__(self, net: MultiLayerNetwork, frozen_till: int):
         self.orig = net

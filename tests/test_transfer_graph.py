@@ -1,0 +1,138 @@
+"""ComputationGraph transfer learning (VERDICT item 8; reference
+``TransferLearning.GraphBuilder`` + ``TransferLearningHelper.java``):
+freeze subgraph, replace outputs, featurize — done-criterion test fine-tunes
+zoo ResNet50's head."""
+import numpy as np
+import pytest
+import jax
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DataSet,
+                                ListDataSetIterator, Sgd, Adam)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer, FrozenLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.transferlearning import (TransferLearning,
+                                                    TransferLearningHelper,
+                                                    GraphTransferLearningHelper,
+                                                    FineTuneConfiguration)
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.models.zoo import ResNet50
+
+
+def _small_cg(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=1e-2)).activation("tanh")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_in=6, n_out=8), "in")
+            .add_layer("d1", DenseLayer(n_in=8, n_out=8), "d0")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss="mcxent"), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _ds(n=16, n_in=6, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet(rng.normal(size=(n, n_in)).astype(np.float32),
+                   np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)])
+
+
+def test_graph_builder_freeze_and_replace():
+    net = _small_cg()
+    orig_d0 = np.asarray(net.params["d0"]["W"]).copy()
+    orig_d1 = np.asarray(net.params["d1"]["W"]).copy()
+
+    new = (TransferLearning.GraphBuilder(net)
+           .fine_tune_configuration(
+               FineTuneConfiguration.builder().updater(Sgd(learning_rate=5e-2))
+               .build())
+           .set_feature_extractor("d0")
+           .n_out_replace("out", 4)
+           .build())
+    assert isinstance(new.conf.vertices["d0"], FrozenLayer)
+    assert not isinstance(new.conf.vertices["d1"], FrozenLayer)
+    # d0/d1 params carried over; out re-initialized at new width
+    np.testing.assert_array_equal(np.asarray(new.params["d0"]["W"]), orig_d0)
+    np.testing.assert_array_equal(np.asarray(new.params["d1"]["W"]), orig_d1)
+    assert new.params["out"]["W"].shape == (8, 4)
+
+    ds = _ds(n_out=4)
+    new.fit(ds)
+    # frozen layer unchanged by training; downstream layers moved
+    np.testing.assert_array_equal(np.asarray(new.params["d0"]["W"]), orig_d0)
+    assert np.abs(np.asarray(new.params["d1"]["W"]) - orig_d1).max() > 0
+
+
+def test_graph_builder_remove_and_add_vertex():
+    net = _small_cg()
+    new = (TransferLearning.GraphBuilder(net)
+           .remove_vertex_and_connections("out")
+           .add_layer("head", DenseLayer(n_in=8, n_out=5,
+                                         activation="relu"), "d1")
+           .add_layer("out2", OutputLayer(n_in=5, n_out=2,
+                                          activation="softmax",
+                                          loss="mcxent"), "head")
+           .set_outputs("out2")
+           .build())
+    assert "out" not in new.conf.vertices
+    ds = _ds(n_out=2)
+    s0 = new.score(ds)
+    new.fit(ListDataSetIterator([ds]), epochs=10)
+    assert new.score(ds) < s0
+
+
+def test_graph_nout_replace_cascades_nin():
+    net = _small_cg()
+    new = (TransferLearning.GraphBuilder(net)
+           .n_out_replace("d0", 12)
+           .build())
+    assert new.params["d0"]["W"].shape == (6, 12)
+    assert new.params["d1"]["W"].shape == (12, 8)  # nIn re-derived
+    new.fit(_ds())  # trains fine at the new widths
+
+
+def test_graph_transfer_helper_featurize():
+    net = _small_cg()
+    helper = TransferLearningHelper(net, "d0")
+    assert isinstance(helper, GraphTransferLearningHelper)
+    ds = _ds(8)
+    mds = helper.featurize(ds)
+    assert isinstance(mds, MultiDataSet)
+    assert mds.features[0].shape == (8, 8)  # d0 activations
+    # featurized output == full-graph output for the unfrozen tail
+    full = np.asarray(net.output(ds.features))
+    tail = np.asarray(helper.output_from_featurized(mds.features[0]))
+    np.testing.assert_allclose(tail, full, rtol=1e-5, atol=1e-6)
+    helper.fit_featurized(mds)  # trains without touching the frozen block
+
+
+def test_finetune_zoo_resnet50_head():
+    """VERDICT done-criterion: fine-tune zoo ResNet50's head (new class
+    count), body frozen, params carried over."""
+    net = ResNet50(num_classes=4, input_shape=(3, 32, 32)).init()
+    stem_w = np.asarray(net.params["stem-conv"]["W"]).copy()
+
+    new = (TransferLearning.GraphBuilder(net)
+           .fine_tune_configuration(
+               FineTuneConfiguration.builder().updater(Adam(learning_rate=1e-3))
+               .build())
+           .set_feature_extractor("gap")
+           .n_out_replace("output", 10)
+           .build())
+    assert new.params["output"]["W"].shape[-1] == 10
+    assert isinstance(new.conf.vertices["stem-conv"], FrozenLayer)
+    np.testing.assert_array_equal(np.asarray(new.params["stem-conv"]["W"]),
+                                  stem_w)
+
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 3, 32, 32)).astype(np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)])
+    head_before = np.asarray(new.params["output"]["W"]).copy()
+    new.fit(ds)
+    assert np.isfinite(float(new.score_))
+    # body frozen, head moved
+    np.testing.assert_array_equal(np.asarray(new.params["stem-conv"]["W"]),
+                                  stem_w)
+    assert np.abs(np.asarray(new.params["output"]["W"]) - head_before).max() > 0
