@@ -27,7 +27,9 @@ def main():
     from deeplearning4j_tpu.models import ResNet50
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    batch = 64
+    # batch 256: v5e is HBM-bandwidth-bound on ResNet50; smaller batches
+    # under-amortize fixed per-step work (PERF.md has the batch sweep)
+    batch = 256
     warmup, iters = 3, 10
 
     model = ResNet50(num_classes=1000)

@@ -109,10 +109,22 @@ class GlobalConfig:
     gradient_normalization: str = GradientNormalization.None_
     gradient_normalization_threshold: float = 1.0
     mini_batch: bool = True
-    # TPU-native dtype policy: params kept in `dtype`, matmul/conv compute in
-    # `compute_dtype` (bfloat16 targets the MXU; see /opt/skills guide).
+    # TPU-native dtype policy: params kept in `dtype` (f32 master copies),
+    # matmul/conv compute and inter-layer activations in `compute_dtype`
+    # (bfloat16 targets the MXU; reductions/statistics accumulate in f32).
     dtype: str = "float32"
     compute_dtype: str = "float32"
+    # Rematerialization policy for the jitted train step: "on" applies
+    # jax.checkpoint with a named-saveable policy (store conv/gemm/pool and
+    # junction-vertex outputs + BN statistics, recompute elementwise layers
+    # in the backward pass) — the TPU equivalent of the reference's
+    # workspace/CacheMode memory management. "auto" enables it only for
+    # convolutional non-recurrent nets. Default "off": measured on
+    # ResNet50/v5e, XLA's own fusion already avoids materializing elementwise
+    # chains, and forced remat *adds* HBM traffic (see PERF.md); turn it on
+    # when activation memory, not bandwidth, is the binding constraint
+    # (very large batch/images).
+    remat: str = "off"
     # parity-only knobs
     training_workspace_mode: str = WorkspaceMode.ENABLED
     inference_workspace_mode: str = WorkspaceMode.ENABLED
@@ -352,6 +364,11 @@ class Builder:
 
     def compute_dtype(self, d):
         self._conf.compute_dtype = str(d)
+        return self
+
+    def remat(self, mode):
+        """Activation rematerialization policy: "auto" | "on" | "off"."""
+        self._conf.remat = str(mode)
         return self
 
     def training_workspace_mode(self, m):

@@ -28,7 +28,10 @@ from .conf import BackpropType, GradientNormalization
 from .conf.graph import ComputationGraphConfiguration
 from .conf.layers import Layer
 from .conf.inputs import InputTypeConvolutional
+from jax.ad_checkpoint import checkpoint_name
+
 from .layers import impl_for
+from .layers.base import remat_enabled, remat_policy
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
@@ -141,11 +144,18 @@ class ComputationGraph:
                 p_n = impl.noised_params(params[name], train, keys.get(name))
                 y, ns = impl.forward(p_n, states[name], x, train=train,
                                      rng=keys.get(name), mask=m, ctx=ctx)
+                if impl.save_output:
+                    # tag for the remat policy (identity outside jax.checkpoint)
+                    y = checkpoint_name(y, "dl4j_act")
                 new_states[name] = ns
                 acts[name] = y
                 masks[name] = m
             else:
-                acts[name] = v.forward(xs, ctx)
+                # vertex outputs are saved under the remat policy: junction
+                # vertices (ElementWise/Merge) carry the residual spine, and
+                # an unsaved spine would recompute-chain through every
+                # upstream block during the backward pass
+                acts[name] = checkpoint_name(v.forward(xs, ctx), "dl4j_act")
                 masks[name] = v.propagate_mask([masks.get(i) for i in in_names])
         return acts, new_states, masks, ctx
 
@@ -194,6 +204,8 @@ class ComputationGraph:
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
 
+        use_remat = remat_enabled(self.gc, self.impls.values())
+
         def core(params, states, upd_state, iteration, rng, inputs, labels,
                  input_masks, label_masks, rnn_state_in=None):
             inputs = self._adapt_inputs(inputs)
@@ -202,6 +214,8 @@ class ComputationGraph:
                 return self._loss_fn(p, states, inputs, labels, input_masks,
                                      label_masks, True, rng, rnn_state_in)
 
+            if use_remat:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy())
             (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             if not minimize:

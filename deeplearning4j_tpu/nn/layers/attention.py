@@ -72,4 +72,4 @@ class SelfAttentionImpl(LayerImpl):
                 key_mask=mask)
         o = o.reshape(b, T, h * d)
         y = o @ params["Wo"].astype(o.dtype) + params["b"].astype(o.dtype)
-        return self.activation(y).astype(self.dtype), state
+        return self.activation(y).astype(self.out_dtype), state

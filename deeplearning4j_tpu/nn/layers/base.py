@@ -59,12 +59,31 @@ def _resolved(conf, gc, field, default=None):
 class LayerImpl:
     """Base implementation; resolves per-layer vs global config fields."""
 
+    #: Whether this layer's output is worth storing for the backward pass.
+    #: Under the train step's remat policy (``GlobalConfig.remat``), outputs of
+    #: layers with ``save_output=True`` (convs, gemms, pooling — expensive to
+    #: recompute) are checkpointed; cheap elementwise layers (BN normalize,
+    #: activations, dropout, padding) are recomputed during the backward pass
+    #: instead of being written to and re-read from HBM. This is the TPU
+    #: answer to the reference's workspace memory management
+    #: (``WorkspaceMode``, ``nn/conf/WorkspaceMode.java``): activation
+    #: residency is a compiler-visible policy, not a buffer pool.
+    save_output = True
+
     def __init__(self, conf, gc, input_type=None):
         self.conf = conf
         self.gc = gc
         self.input_type = input_type
         self.dtype = jnp.dtype(gc.dtype)
         self.compute_dtype = jnp.dtype(gc.compute_dtype)
+        # Mixed-precision activation policy: params live in `dtype` (f32
+        # master copies), activations flow between layers in the compute
+        # dtype when it is sub-32-bit (bfloat16). Casting every layer output
+        # back to f32 — the naive reading of the reference's single global
+        # dtype — doubles HBM traffic on conv nets, and HBM bandwidth is the
+        # TPU bottleneck (see PERF.md).
+        self.out_dtype = (self.compute_dtype
+                          if self.compute_dtype.itemsize < 4 else self.dtype)
         if isinstance(conf, BaseLayer):
             self.activation_name = _resolved(conf, gc, "activation", "identity")
             self.activation = get_activation(self.activation_name)
@@ -145,6 +164,43 @@ class LayerImpl:
 
     def num_params(self, params):
         return sum(int(v.size) for v in jax.tree_util.tree_leaves(params))
+
+
+def remat_enabled(gc, impls) -> bool:
+    """Whether the jitted train step should run under the named-saveable
+    remat policy (``GlobalConfig.remat``). "auto" enables it for
+    convolutional feed-forward nets — where activation HBM round-trips
+    dominate the step — and leaves recurrent nets alone (scan residuals
+    interact badly with whole-step remat)."""
+    mode = getattr(gc, "remat", "off")
+    if mode == "on":
+        return True
+    if mode != "auto":
+        return False
+
+    def unwrap(i):
+        # wrapper impls (Frozen, Bidirectional, LastTimeStep) hide the inner
+        # layer behind .inner — recurse so a wrapped LSTM still counts as
+        # recurrent
+        seen = []
+        while i is not None:
+            seen.append(i)
+            i = getattr(i, "inner", None)
+        return seen
+
+    flat = [j for i in impls for j in unwrap(i)]
+    has_conv = any(getattr(j.conf, "kernel_size", None) is not None
+                   for j in flat)
+    has_rnn = any(hasattr(j, "init_stream_state") for j in flat)
+    return has_conv and not has_rnn
+
+
+#: jax.checkpoint policy saving exactly the tensors the layer protocol tags:
+#: layer outputs flagged ``save_output`` ("dl4j_act") and BN statistics
+#: ("dl4j_stat"). Everything else is recomputed during the backward pass.
+def remat_policy():
+    return jax.checkpoint_policies.save_only_these_names("dl4j_act",
+                                                         "dl4j_stat")
 
 
 def acc_dtype(compute_dtype):

@@ -59,7 +59,7 @@ class Conv2DImpl(LayerImpl):
         z = self._conv(x, params["W"])
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("Convolution1DLayer")
@@ -92,7 +92,7 @@ class Conv1DImpl(LayerImpl):
             preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("Deconvolution2D")
@@ -131,7 +131,7 @@ class Deconv2DImpl(Conv2DImpl):
             preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("DepthwiseConvolution2D")
@@ -159,7 +159,7 @@ class DepthwiseConv2DImpl(LayerImpl):
             preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("SeparableConvolution2D")
@@ -196,11 +196,13 @@ class SeparableConv2DImpl(LayerImpl):
             preferred_element_type=pet_dtype(self.compute_dtype))
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("ZeroPaddingLayer")
 class ZeroPaddingImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         t, b, l, r = self.conf._pads()
         return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
@@ -208,6 +210,8 @@ class ZeroPaddingImpl(NoParamLayerImpl):
 
 @implements("ZeroPadding1DLayer")
 class ZeroPadding1DImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         l, r = _pair(self.conf.padding)
         return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
@@ -215,6 +219,8 @@ class ZeroPadding1DImpl(NoParamLayerImpl):
 
 @implements("Cropping2D")
 class Cropping2DImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         t, b, l, r = self.conf._crops()
         h, w = x.shape[1], x.shape[2]
@@ -223,6 +229,8 @@ class Cropping2DImpl(NoParamLayerImpl):
 
 @implements("SpaceToDepthLayer")
 class SpaceToDepthImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         bsz = int(self.conf.block_size)
         b, h, w, c = x.shape
@@ -235,6 +243,8 @@ class SpaceToDepthImpl(NoParamLayerImpl):
 class Upsampling2DImpl(NoParamLayerImpl):
     """Nearest-neighbor upsampling (reference ``Upsampling2D.java``)."""
 
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         sh, sw = _pair(self.conf.size)
         return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2), state
@@ -242,5 +252,7 @@ class Upsampling2DImpl(NoParamLayerImpl):
 
 @implements("Upsampling1D")
 class Upsampling1DImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         return jnp.repeat(x, int(self.conf.size), axis=1), state

@@ -39,11 +39,13 @@ class DenseImpl(LayerImpl):
         z = _dot(x, params["W"], self.compute_dtype)
         if "b" in params:
             z = z + params["b"].astype(z.dtype)
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("ActivationLayer")
 class ActivationImpl(NoParamLayerImpl):
+    save_output = False
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         return self.activation(x), state
 
@@ -51,6 +53,8 @@ class ActivationImpl(NoParamLayerImpl):
 @implements("DropoutLayer")
 class DropoutImpl(NoParamLayerImpl):
     """Reference ``nn/layers/DropoutLayer.java``; dropout = retain probability."""
+
+    save_output = False
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         return self.maybe_dropout(x, train, rng), state
@@ -79,7 +83,7 @@ class EmbeddingImpl(LayerImpl):
         z = jnp.take(params["W"], idx, axis=0)
         if "b" in params:
             z = z + params["b"]
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("EmbeddingSequenceLayer")
@@ -100,7 +104,7 @@ class EmbeddingSequenceImpl(LayerImpl):
         z = jnp.take(params["W"], idx, axis=0)
         if "b" in params:
             z = z + params["b"]
-        return self.activation(z).astype(self.dtype), state
+        return self.activation(z).astype(self.out_dtype), state
 
 
 @implements("AutoEncoder")
@@ -129,7 +133,7 @@ class AutoEncoderImpl(LayerImpl):
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
-        return self.encode(params, x).astype(self.dtype), state
+        return self.encode(params, x).astype(self.out_dtype), state
 
     def pretrain_loss(self, params, x, rng):
         from ..losses import get_loss

@@ -1,14 +1,30 @@
 """Normalization implementations: BatchNormalization, LocalResponseNormalization.
 
 TPU-native equivalents of reference ``nn/layers/normalization/{BatchNormalization,
-LocalResponseNormalization}.java`` (cuDNN helper hooks in the reference; here XLA
-fuses the normalization arithmetic into neighbors). Running mean/var live in the
-layer *state* pytree — the functional replacement for the reference's mutable
-mean/var params — and are updated only when ``train=True``.
+LocalResponseNormalization}.java`` (cuDNN helper hooks at
+``CudnnBatchNormalizationHelper``; here the XLA schedule plays that role).
+Running mean/var live in the layer *state* pytree — the functional replacement
+for the reference's mutable mean/var params — and are updated only when
+``train=True``.
+
+BN is pure HBM traffic, so the training path is written for the memory system
+(see PERF.md):
+
+ - batch statistics are a *single* fused pass over ``x``: two reductions
+   (sum, sum-of-squares) with f32 accumulators via the reduce's ``dtype=`` —
+   ``jnp.var``'s mean-then-deviations formulation costs an extra full
+   traversal of every conv output.
+ - the per-channel statistics are tagged ``checkpoint_name`` so the train
+   step's remat policy (``GlobalConfig.remat``) stores them — tiny [C]
+   vectors — while the normalized output itself is recomputed in the
+   backward pass instead of being round-tripped through HBM
+   (``save_output = False``).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from .base import LayerImpl, implements, acc_dtype
 
@@ -19,6 +35,8 @@ class BatchNormImpl(LayerImpl):
     Params gamma/beta (reference keys), state mean/var with ``decay`` EMA
     (reference ``BatchNormalization.java`` decay semantics:
     running = decay * running + (1-decay) * batch)."""
+
+    save_output = False  # normalize is elementwise given stats: recompute
 
     def init(self, rng):
         c = self.conf
@@ -37,8 +55,25 @@ class BatchNormImpl(LayerImpl):
         sd = acc_dtype(self.compute_dtype)
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
         if train:
-            mean = jnp.mean(x.astype(sd), axis=axes)
-            var = jnp.var(x.astype(sd), axis=axes)
+            if jnp.dtype(x.dtype).itemsize < 4:
+                # one fused traversal of x: f32-accumulated sum and
+                # sum-of-squares. E[x^2]-E[x]^2 cancels catastrophically when
+                # |mean| >> std, but sub-32-bit x cannot *represent* such
+                # data (bf16's 8-bit mantissa bounds mean/std ≈ 256, keeping
+                # the f32 error below the input quantization) — so the fused
+                # form is safe exactly where it is fast. Guard is on x's own
+                # dtype: full-precision inputs take the exact path below even
+                # under a bf16 compute policy.
+                mean = jnp.mean(x, axis=axes, dtype=sd)
+                meansq = jnp.mean(jnp.square(x.astype(sd)), axis=axes)
+                var = jnp.maximum(meansq - mean * mean, 0.0)
+            else:
+                # full-precision compute: shifted two-pass (jnp.var) — exact
+                # for large-mean data; f32/f64 runs are correctness-first
+                mean = jnp.mean(x, axis=axes, dtype=sd)
+                var = jnp.var(x.astype(sd), axis=axes)
+            mean = checkpoint_name(mean, "dl4j_stat")
+            var = checkpoint_name(var, "dl4j_stat")
             new_state = {
                 "mean": c.decay * state["mean"] + (1 - c.decay) * mean,
                 "var": c.decay * state["var"] + (1 - c.decay) * var,
@@ -46,7 +81,7 @@ class BatchNormImpl(LayerImpl):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = 1.0 / jnp.sqrt(var + c.eps)
+        inv = jax.lax.rsqrt((var + c.eps).astype(sd))
         y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
         if "gamma" in params:
             y = y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
@@ -62,6 +97,8 @@ class BatchNormImpl(LayerImpl):
 class LRNImpl(LayerImpl):
     """Across-channel LRN on NHWC (reference ``LocalResponseNormalization.java``):
     y = x / (k + alpha * sum_{j in window} x_j^2)^beta."""
+
+    save_output = False
 
     def init(self, rng):
         return {}, {}

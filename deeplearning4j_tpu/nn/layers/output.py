@@ -33,6 +33,9 @@ class _OutputBase(LayerImpl):
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
+        # terminal layer: user-facing predictions stay full precision — the
+        # bf16 inter-layer policy (out_dtype) is an HBM-bandwidth measure and
+        # the one output cast costs nothing
         return self.activation(self.preout(params, x)).astype(self.dtype), state
 
     def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):

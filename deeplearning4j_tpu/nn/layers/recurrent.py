@@ -128,7 +128,7 @@ class _BaseLSTMImpl(LayerImpl):
         y = jnp.swapaxes(ys, 0, 1)
         if reverse:
             y = jnp.flip(y, axis=1)
-        return y.astype(self.dtype), (hT, cT)
+        return y.astype(self.out_dtype), (hT, cT)
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
@@ -227,7 +227,7 @@ class SimpleRnnImpl(LayerImpl):
             hT, ys = lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
         if ctx is not None and idx is not None:
             ctx.setdefault("rnn_state_out", {})[idx] = hT
-        return jnp.swapaxes(ys, 0, 1).astype(self.dtype), state
+        return jnp.swapaxes(ys, 0, 1).astype(self.out_dtype), state
 
 
 class _WrapperImpl(LayerImpl):

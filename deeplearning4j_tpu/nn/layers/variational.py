@@ -88,7 +88,7 @@ class VAEImpl(LayerImpl):
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         x = self.maybe_dropout(x, train, rng)
         mean, _ = self.encode(params, x)
-        return mean.astype(self.dtype), state
+        return mean.astype(self.out_dtype), state
 
     def has_loss_function(self):
         """Reference ``hasLossFunction()`` — true for LossFunctionWrapper."""

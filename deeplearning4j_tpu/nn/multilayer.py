@@ -30,7 +30,10 @@ import jax.numpy as jnp
 from .conf import (MultiLayerConfiguration, BackpropType, GradientNormalization)
 from .conf.inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
                           InputTypeRecurrent)
+from jax.ad_checkpoint import checkpoint_name
+
 from .layers import impl_for
+from .layers.base import remat_enabled, remat_policy
 from .layers.recurrent import _BaseLSTMImpl
 from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 from ..datasets.iterators import AsyncDataSetIterator
@@ -137,6 +140,9 @@ class MultiLayerNetwork:
             p_i = impl.noised_params(params[str(i)], train, keys[i])
             x, ns = impl.forward(p_i, states[str(i)], x, train=train,
                                  rng=keys[i], mask=fmask, ctx=ctx)
+            if impl.save_output:
+                # tag for the remat policy (identity outside jax.checkpoint)
+                x = checkpoint_name(x, "dl4j_act")
             new_states[str(i)] = ns
         return x, new_states, ctx
 
@@ -186,6 +192,8 @@ class MultiLayerNetwork:
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
 
+        use_remat = remat_enabled(self.gc, self.impls)
+
         def core(params, states, upd_state, iteration, rng, f, l, fm, lm,
                  rnn_state_in=None):
             f = self._adapt_input(f)
@@ -194,6 +202,8 @@ class MultiLayerNetwork:
                 return self._loss_fn(p, states, f, l, fm, lm, True, rng,
                                      rnn_state_in)
 
+            if use_remat:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy())
             (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             if not minimize:

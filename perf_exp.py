@@ -1,0 +1,48 @@
+"""Perf experiment harness (not part of the framework; PERF.md records results).
+
+Batch-size sweep over the ResNet50 train step — the measurement loop behind
+the PERF.md table. `python perf_exp.py 64 128 256`.
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bench_resnet(batch=256, iters=10, warmup=3, compute_dtype="bfloat16"):
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    model = ResNet50(num_classes=1000)
+    conf = model.conf()
+    conf.global_conf.compute_dtype = compute_dtype
+    net = ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)), jnp.float32)
+    l = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+
+    step = net._ensure_step()
+    params, states, upd = net.params, net.states, net.updater_state
+    key = jax.random.PRNGKey(0)
+    for i in range(warmup):
+        it = jnp.asarray(i, jnp.int32)
+        params, states, upd, loss = step(params, states, upd, it, key, (f,), (l,), None, None)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + iters):
+        it = jnp.asarray(i, jnp.int32)
+        params, states, upd, loss = step(params, states, upd, it, key, (f,), (l,), None, None)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+    print(f"batch={batch} dtype={compute_dtype}: {ips:.1f} img/s "
+          f"({dt / iters * 1e3:.1f} ms/step)")
+    return ips
+
+
+if __name__ == "__main__":
+    for b in (int(x) for x in sys.argv[1:] or ["256"]):
+        bench_resnet(batch=b)
