@@ -1,74 +1,243 @@
-"""Benchmark harness: prints ONE JSON line
-``{"metric", "value", "unit", "vs_baseline"}``.
+"""Benchmark harness.
 
-Measured config — the BASELINE.json north star: ResNet50 (deeplearning4j-zoo
-ComputationGraph architecture) training on synthetic ImageNet-shaped input
-(the reference's ``BenchmarkDataSetIterator`` pattern), images/sec on one
-chip. The whole train step (forward, AD backward, updater, param update) is a
-single jitted XLA computation; params in f32, matmul/conv compute in bfloat16
-on the MXU with f32 accumulation.
+Default run prints ONE JSON line — the BASELINE.json north-star metric
+(ResNet50 ComputationGraph training, images/sec on one chip). ``--all`` also
+benchmarks every config BASELINE.md commits to (LeNet MNIST, VGG16, GravesLSTM
+char-RNN with TBPTT, Word2Vec skip-gram, Keras-imported inception-style model
+under ParallelWrapper), writes the results into ``BASELINE.json.published``,
+and still prints the single ResNet50 JSON line last.
 
 Throughput accounting matches the reference's ``PerformanceListener``
-(samples/sec). The reference publishes no numbers (BASELINE.md), so
-``vs_baseline`` is the ratio against ``published`` in BASELINE.json when
-present, else 1.0.
+(samples/sec; ``optimize/listeners/PerformanceListener.java:22-23``). Synthetic
+inputs follow the reference's ``BenchmarkDataSetIterator`` pattern. The whole
+train step (forward, AD backward, updater, param update) is a single jitted
+XLA computation; params in f32, matmul/conv compute in bfloat16 on the MXU
+(see PERF.md for the measurement史 and the roofline analysis).
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _time_steps(step_fn, n_warmup=3, n_timed=10):
+    """Run ``step_fn(i)`` (must return a device value to block on) and return
+    the timed-phase duration in seconds."""
+    out = None
+    for i in range(n_warmup):
+        out = step_fn(i)
+    import jax
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(n_warmup, n_warmup + n_timed):
+        out = step_fn(i)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def _cnn_throughput(model_cls, batch, img, classes=1000, iters=10,
+                    compute_dtype="bfloat16", **model_kw):
+    """images/sec for a zoo ComputationGraph model on synthetic data."""
     import jax
     import jax.numpy as jnp
-    from deeplearning4j_tpu.models import ResNet50
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
+    model = model_cls(num_classes=classes, **model_kw)
+    conf = model.conf()
+    conf.global_conf.compute_dtype = compute_dtype
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    c, h, w = img
+    f = jnp.asarray(rng.normal(size=(batch, c, h, w)), jnp.float32)
+    l = jnp.asarray(np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, batch)])
+    step = net._ensure_step()
+    state = {"p": net.params, "s": net.states, "u": net.updater_state}
+    key = jax.random.PRNGKey(0)
+
+    def one(i):
+        it = jnp.asarray(i, jnp.int32)
+        state["p"], state["s"], state["u"], loss = step(
+            state["p"], state["s"], state["u"], it, key, (f,), (l,),
+            None, None)
+        return loss
+
+    dt = _time_steps(one, n_timed=iters)
+    return batch * iters / dt
+
+
+def bench_resnet50(batch=256):
     # batch 256: v5e is HBM-bandwidth-bound on ResNet50; smaller batches
     # under-amortize fixed per-step work (PERF.md has the batch sweep)
-    batch = 256
-    warmup, iters = 3, 10
+    from deeplearning4j_tpu.models import ResNet50
+    return _cnn_throughput(ResNet50, batch, (3, 224, 224))
 
-    model = ResNet50(num_classes=1000)
-    conf = model.conf()
-    conf.global_conf.compute_dtype = "bfloat16"  # MXU path, f32 accumulation
-    net = ComputationGraph(conf).init()
+
+def bench_vgg16(batch=128):
+    from deeplearning4j_tpu.models import VGG16
+    return _cnn_throughput(VGG16, batch, (3, 224, 224))
+
+
+def bench_lenet(batch=1024):
+    """LeNet MNIST (MultiLayerNetwork) images/sec."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = LeNet(num_classes=10).conf()
+    conf.global_conf.compute_dtype = "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(rng.normal(size=(batch, 1, 28, 28)), jnp.float32)
+    l = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    step = net._ensure_step()
+    state = {"p": net.params, "s": net.states, "u": net.updater_state}
+    key = jax.random.PRNGKey(0)
+
+    def one(i):
+        it = jnp.asarray(i, jnp.int32)
+        state["p"], state["s"], state["u"], loss = step(
+            state["p"], state["s"], state["u"], it, key, f, l, None, None)
+        return loss
+
+    dt = _time_steps(one, n_timed=20)
+    return batch * 20 / dt
+
+
+def bench_graves_lstm(batch=64, seq_len=200, tbptt=50, vocab=80, width=512):
+    """GravesLSTM char-RNN with TBPTT (the reference CudnnLSTMHelper's
+    showcase config): characters/sec processed."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, BackpropType
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Adam(learning_rate=1e-3)).activation("tanh")
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(GravesLSTM(n_in=vocab, n_out=width))
+            .layer(GravesLSTM(n_in=width, n_out=width))
+            .layer(RnnOutputLayer(n_in=width, n_out=vocab,
+                                  activation="softmax", loss="mcxent"))
+            .build())
+    conf.backprop_type = BackpropType.TruncatedBPTT
+    conf.tbptt_fwd_length = tbptt
+    conf.tbptt_back_length = tbptt
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=(batch, seq_len))
+    f = np.eye(vocab, dtype=np.float32)[ids]          # [b, T, vocab]
+    l = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    ds = DataSet(f, l)
+    net.fit(ds)  # warmup/compile all TBPTT segment shapes
+    n = 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        net.fit(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return batch * seq_len * n / dt
+
+
+def bench_word2vec(n_sentences=2000, sent_len=40, vocab_target=5000):
+    """Word2Vec skip-gram (HS) words/sec through the jitted kernels."""
+    from deeplearning4j_tpu.nlp import Word2Vec
 
     rng = np.random.default_rng(0)
-    f = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)), jnp.float32)
-    l = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000,
-                                                                batch)])
-
-    step = net._ensure_step()
-    params, states, upd = net.params, net.states, net.updater_state
-    key = jax.random.PRNGKey(0)
-    for i in range(warmup):
-        it = jnp.asarray(i, jnp.int32)
-        params, states, upd, loss = step(params, states, upd, it, key, (f,),
-                                         (l,), None, None)
-    loss.block_until_ready()
-
+    zipf = rng.zipf(1.3, size=n_sentences * sent_len) % vocab_target
+    words = zipf.reshape(n_sentences, sent_len)
+    sentences = [" ".join(f"w{t}" for t in row) for row in words]
+    w2v = Word2Vec(vector_length=128, window=5, epochs=1, batch_size=8192,
+                   min_word_frequency=1)
     t0 = time.perf_counter()
-    for i in range(warmup, warmup + iters):
-        it = jnp.asarray(i, jnp.int32)
-        params, states, upd, loss = step(params, states, upd, it, key, (f,),
-                                         (l,), None, None)
-    loss.block_until_ready()
+    w2v.fit(sentences)
     dt = time.perf_counter() - t0
+    return n_sentences * sent_len / dt
 
-    images_per_sec = batch * iters / dt
+
+def bench_keras_import_parallel(batch_per_step=256, iters=10):
+    """Keras-imported inception-style ComputationGraph trained under
+    ParallelWrapper (BASELINE.md config 6; single chip → one worker, the
+    multi-chip path is exercised by the virtual-mesh dryrun)."""
+    import os
+    import jax
+    from deeplearning4j_tpu.keras.model_import import KerasModelImport
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests",
+                        "resources", "keras", "functional_inception.h5")
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    net.gc.compute_dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    n_dev = len(jax.devices())
+    dsets = [DataSet(rng.normal(size=(batch_per_step // n_dev, 3, 16, 16)
+                                ).astype(np.float32),
+                     np.eye(6, dtype=np.float32)[
+                         rng.integers(0, 6, batch_per_step // n_dev)])
+             for _ in range(n_dev)]
+    pw = (ParallelWrapper.Builder(net).training_mode(TrainingMode.AVERAGING)
+          .averaging_frequency(1).build())
+    pw.fit(ListDataSetIterator(dsets))  # compile + one pass
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pw.fit(ListDataSetIterator(dsets))
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return batch_per_step * iters / dt
+
+
+ALL_BENCHES = [
+    ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
+    ("resnet50_imagenet_images_per_sec", "images/sec", bench_resnet50),
+    ("vgg16_imagenet_images_per_sec", "images/sec", bench_vgg16),
+    ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
+    ("word2vec_skipgram_words_per_sec", "words/sec", bench_word2vec),
+    ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
+     bench_keras_import_parallel),
+]
+
+
+def main():
+    run_all = "--all" in sys.argv
+    # prior published baseline read BEFORE any update — vs_baseline compares
+    # against the previous round's number, not the one this run writes
     try:
         with open("BASELINE.json") as fh:
-            published = json.load(fh).get("published", {})
-        base = published.get("resnet50_imagenet_images_per_sec")
+            base_doc = json.load(fh)
+        base_val = base_doc.get("published", {}).get(
+            "resnet50_imagenet_images_per_sec")
     except Exception:
-        base = None
-    vs = images_per_sec / base if base else 1.0
+        base_doc, base_val = None, None
+
+    results = {}
+    if run_all:
+        for name, unit, fn in ALL_BENCHES:
+            try:
+                results[name] = round(fn(), 1)
+                print(f"# {name}: {results[name]} {unit}", file=sys.stderr)
+            except Exception as e:  # keep the headline metric alive
+                print(f"# {name} FAILED: {e}", file=sys.stderr)
+        if base_doc is not None:
+            base_doc.setdefault("published", {}).update(results)
+            with open("BASELINE.json", "w") as fh:
+                json.dump(base_doc, fh, indent=2)
+        value = results.get("resnet50_imagenet_images_per_sec")
+    else:
+        value = round(bench_resnet50(), 1)
+
+    vs = (value / base_val) if (base_val and value) else 1.0
     print(json.dumps({"metric": "resnet50_imagenet_images_per_sec",
-                      "value": round(images_per_sec, 1),
+                      "value": value,
                       "unit": "images/sec",
                       "vs_baseline": round(vs, 3)}))
 

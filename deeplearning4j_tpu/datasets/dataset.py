@@ -21,6 +21,10 @@ class DataSet:
         self.labels = None if labels is None else np.asarray(labels)
         self.features_mask = None if features_mask is None else np.asarray(features_mask)
         self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        # True when served by a fetcher's synthetic fallback (zero-egress
+        # stand-in data) — accuracy measured on it is meaningless and callers
+        # can assert on the flag (fetchers also log a warning)
+        self.synthetic = False
 
     def num_examples(self) -> int:
         return int(self.features.shape[0])

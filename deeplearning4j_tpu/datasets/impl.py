@@ -13,27 +13,30 @@ import numpy as np
 
 from .dataset import DataSet, DataSetIterator
 from .fetchers import (MnistDataFetcher, EmnistDataFetcher, IrisDataFetcher,
-                       CifarDataFetcher)
+                       CifarDataFetcher, LFWDataFetcher, TinyImageNetFetcher)
 
 
 class _ArrayIterator(DataSetIterator):
     """Minibatch iterator over in-memory feature/label arrays."""
 
     def __init__(self, features, labels, batch_size: int,
-                 num_examples: Optional[int] = None):
+                 num_examples: Optional[int] = None, synthetic: bool = False):
         n = len(features) if num_examples is None else min(num_examples,
                                                            len(features))
         self._features = features[:n]
         self._labels = labels[:n]
         self._batch = int(batch_size)
         self._pos = 0
+        self._synthetic = bool(synthetic)
 
     def __next__(self) -> DataSet:
         if self._pos >= len(self._features):
             raise StopIteration
         sl = slice(self._pos, self._pos + self._batch)
         self._pos += self._batch
-        return DataSet(self._features[sl], self._labels[sl])
+        ds = DataSet(self._features[sl], self._labels[sl])
+        ds.synthetic = self._synthetic  # loud stand-in-data marker
+        return ds
 
     def reset(self):
         self._pos = 0
@@ -60,7 +63,8 @@ class MnistDataSetIterator(_ArrayIterator):
         f = MnistDataFetcher(train=train, binarize=binarize, shuffle=shuffle,
                              seed=seed, **fetcher_kw)
         self.fetcher = f
-        super().__init__(f.features, f.labels, batch, num_examples)
+        super().__init__(f.features, f.labels, batch, num_examples,
+                         synthetic=f.is_synthetic)
 
 
 class EmnistDataSetIterator(_ArrayIterator):
@@ -70,7 +74,8 @@ class EmnistDataSetIterator(_ArrayIterator):
         f = EmnistDataFetcher(split=split, train=train, shuffle=shuffle,
                               seed=seed, **fetcher_kw)
         self.fetcher = f
-        super().__init__(f.features, f.labels, batch, num_examples)
+        super().__init__(f.features, f.labels, batch, num_examples,
+                         synthetic=f.is_synthetic)
 
 
 class IrisDataSetIterator(_ArrayIterator):
@@ -88,4 +93,28 @@ class CifarDataSetIterator(_ArrayIterator):
                  train: bool = True, seed: int = 123, **fetcher_kw):
         f = CifarDataFetcher(train=train, seed=seed, **fetcher_kw)
         self.fetcher = f
-        super().__init__(f.features, f.labels, batch, num_examples)
+        super().__init__(f.features, f.labels, batch, num_examples,
+                         synthetic=f.is_synthetic)
+
+
+class LFWDataSetIterator(_ArrayIterator):
+    """Reference ``LFWDataSetIterator`` (``LFWDataFetcher.java:1``); features
+    NCHW [b, 3, H, W]."""
+
+    def __init__(self, batch: int, num_examples: Optional[int] = None,
+                 seed: int = 123, **fetcher_kw):
+        f = LFWDataFetcher(seed=seed, **fetcher_kw)
+        self.fetcher = f
+        super().__init__(f.features, f.labels, batch, num_examples,
+                         synthetic=f.is_synthetic)
+
+
+class TinyImageNetDataSetIterator(_ArrayIterator):
+    """Reference ``TinyImageNetDataSetIterator``; 200-class 64×64 RGB."""
+
+    def __init__(self, batch: int, num_examples: Optional[int] = None,
+                 seed: int = 123, **fetcher_kw):
+        f = TinyImageNetFetcher(seed=seed, **fetcher_kw)
+        self.fetcher = f
+        super().__init__(f.features, f.labels, batch, num_examples,
+                         synthetic=f.is_synthetic)

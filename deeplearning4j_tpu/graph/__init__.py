@@ -2,8 +2,11 @@
 graph API, loaders, random-walk iterators, DeepWalk, GraphVectors."""
 from .api import Graph, Vertex, Edge
 from .loaders import GraphLoader
-from .walks import RandomWalkIterator, WeightedRandomWalkIterator
+from .walks import (RandomWalkIterator, WeightedRandomWalkIterator,
+                    Node2VecWalkIterator)
 from .deepwalk import DeepWalk, GraphVectors
+from .node2vec import Node2Vec
 
 __all__ = ["Graph", "Vertex", "Edge", "GraphLoader", "RandomWalkIterator",
-           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors"]
+           "WeightedRandomWalkIterator", "Node2VecWalkIterator", "DeepWalk",
+           "GraphVectors", "Node2Vec"]

@@ -48,3 +48,54 @@ class WeightedRandomWalkIterator(RandomWalkIterator):
         weights = np.asarray([w for _, w in nbrs], np.float64)
         p = weights / weights.sum()
         return int(nbrs[rng.choice(len(nbrs), p=p)][0])
+
+
+class Node2VecWalkIterator(RandomWalkIterator):
+    """Second-order biased walks (node2vec, Grover & Leskovec 2016; reference
+    ``models/node2vec/Node2Vec.java:34`` drives them through a GraphWalker).
+
+    Transition weight from the previous vertex ``t`` through current ``v`` to
+    neighbor ``x``: ``1/p`` to return (x == t), ``1`` when x is also a
+    neighbor of t (BFS-ish), ``1/q`` otherwise (DFS-ish). ``p`` high + ``q``
+    low → outward exploration; ``p`` low → local backtracking walks.
+    """
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 123, walks_per_vertex: int = 1):
+        super().__init__(graph, walk_length, seed, walks_per_vertex)
+        self.p = float(p)
+        self.q = float(q)
+        # neighbor sets for the dist(t, x) == 1 test
+        self._nbr_sets = [set(graph.get_connected_vertices(i))
+                          for i in range(graph.num_vertices())]
+
+    def _biased_next(self, rng, prev: Optional[int], current: int) -> int:
+        nbrs = self.graph.get_connected_vertices(current)
+        if not nbrs:
+            return current  # SELF_LOOP_ON_DISCONNECTED
+        if prev is None:
+            return int(nbrs[rng.integers(0, len(nbrs))])
+        w = np.empty(len(nbrs), np.float64)
+        prev_nbrs = self._nbr_sets[prev]
+        for i, x in enumerate(nbrs):
+            if x == prev:
+                w[i] = 1.0 / self.p
+            elif x in prev_nbrs:
+                w[i] = 1.0
+            else:
+                w[i] = 1.0 / self.q
+        w /= w.sum()
+        return int(nbrs[rng.choice(len(nbrs), p=w)])
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.walks_per_vertex):
+            for start in range(self.graph.num_vertices()):
+                walk = [start]
+                prev: Optional[int] = None
+                cur = start
+                for _ in range(self.walk_length - 1):
+                    nxt = self._biased_next(rng, prev, cur)
+                    prev, cur = cur, nxt
+                    walk.append(cur)
+                yield walk

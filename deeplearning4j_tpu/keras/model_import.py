@@ -83,17 +83,45 @@ def _maybe_last_step(layer, cfg):
     return LastTimeStep(inner=layer)
 
 
+#: custom-layer SPI (reference ``KerasLayer.registerCustomLayer`` +
+#: ``keras/layers/custom/``): Keras class name → mapper(cfg) returning a
+#: layer config; optional weight_setter(params_dict, state_dict, weights)
+#: overrides the built-in weight copy for that layer.
+_CUSTOM_LAYERS: Dict[str, Tuple[Any, Optional[Any]]] = {}
+
+
+def register_custom_layer(class_name: str, mapper, weight_setter=None):
+    """Register an importer for a custom Keras layer type. ``mapper(cfg)``
+    receives the Keras config dict and returns a layer config;
+    ``weight_setter(params, state, weights)`` (optional) receives the layer's
+    param/state dicts and the {short name: array} weight map."""
+    _CUSTOM_LAYERS[str(class_name)] = (mapper, weight_setter)
+
+
+registerCustomLayer = register_custom_layer
+
+
 class KerasLayerMapper:
     """Config-dict → layer-config translation (reference ``KerasLayer``
-    subclasses). Keras 1 and 2 key spellings both accepted."""
+    subclasses). Keras 1 and 2 key spellings both accepted (the reference
+    carries both in ``config/KerasLayerConfiguration.java:43-71``)."""
 
     SKIPPED = {"InputLayer", "Flatten", "Reshape"}  # handled structurally
 
     @staticmethod
     def map(class_name: str, cfg: Dict) -> Optional[Any]:
+        if class_name in _CUSTOM_LAYERS:
+            mapper, setter = _CUSTOM_LAYERS[class_name]
+            layer = mapper(cfg)
+            if setter is not None:
+                # carried to _set_layer_weights (custom copy semantics)
+                layer._keras_weight_setter = setter
+            return layer
         m = getattr(KerasLayerMapper, f"_map_{class_name.lower()}", None)
         if m is None:
-            raise ValueError(f"Unsupported Keras layer type '{class_name}'")
+            raise ValueError(
+                f"Unsupported Keras layer type '{class_name}' — register an "
+                f"importer with register_custom_layer('{class_name}', ...)")
         return m(cfg)
 
     # ------------------------------------------------------------- dense etc.
@@ -202,12 +230,64 @@ class KerasLayerMapper:
                           activation=_act(cfg.get("activation", "tanh")))
         return _maybe_last_step(layer, cfg)
 
+    @staticmethod
+    def _map_timedistributed(cfg):
+        """TimeDistributed wrapper (reference ``KerasTimeDistributed``,
+        dual-name row in ``KerasLayerConfiguration.java``): per-timestep
+        application of the wrapped layer. Dense & co. already apply
+        per-timestep on [b, T, f] activations, so the mapping is the inner
+        layer itself."""
+        inner = cfg.get("layer", {})
+        return KerasLayerMapper.map(inner.get("class_name"),
+                                    inner.get("config", {}))
+
 
 # --------------------------------------------------------------------- parse
 def _decode(v):
     if isinstance(v, bytes):
         return v.decode("utf-8")
     return v
+
+
+def _tensor_source(entry):
+    """Source layer name from one inbound tensor reference: Keras 3
+    ``__keras_tensor__`` dicts carry it in ``keras_history``; Keras 1/2 use
+    ``[name, node_idx, tensor_idx, ...]`` lists or bare names."""
+    if isinstance(entry, dict):
+        hist = entry.get("config", {}).get("keras_history", [None])
+        return hist[0]
+    if isinstance(entry, (list, tuple)):
+        return entry[0]
+    return entry
+
+
+def _inbound_names(inbound) -> List[str]:
+    """Input layer names from a layer's ``inbound_nodes`` across Keras
+    dialects (1/2: nested lists; 3: {"args": [...]} call records)."""
+    if not inbound:
+        return []
+    node = inbound[0]
+    if isinstance(node, dict):  # Keras 3
+        args = node.get("args", [])
+        if not args:
+            return []
+        first = args[0]
+        entries = first if isinstance(first, list) else [first]
+        return [_tensor_source(e) for e in entries]
+    return [_tensor_source(e) for e in node]
+
+
+def _io_names(spec) -> List[str]:
+    """Model input/output layer names: Keras 2 nests ``[[name, 0, 0], ...]``;
+    Keras 3 flattens a single entry to ``[name, 0, 0]``."""
+    if not spec:
+        return []
+    if isinstance(spec[0], (list, tuple)):
+        return [s[0] for s in spec]
+    if (len(spec) == 3 and isinstance(spec[0], str)
+            and isinstance(spec[1], int)):
+        return [spec[0]]
+    return [s if isinstance(s, str) else s[0] for s in spec]
 
 
 def _read_model_config(f) -> Dict:
@@ -227,8 +307,26 @@ def _layer_list(model_cfg: Dict) -> List[Dict]:
     return cfg["layers"]
 
 
+#: Keras-1 weight-name suffixes → Keras-2 canonical names (the reference's
+#: dual-dialect table, ``KerasLayerConfiguration.java:43-71``). Longest
+#: suffixes first so ``_running_mean`` wins over ``_b``-style matches.
+_K1_WEIGHT_SUFFIXES = (("running_mean", "moving_mean"),
+                       ("running_std", "moving_variance"),
+                       ("gamma", "gamma"), ("beta", "beta"),
+                       ("U", "recurrent_kernel"),
+                       ("W", "kernel"), ("b", "bias"))
+
+
+def _canonical_weight_name(short: str) -> str:
+    for suf, canon in _K1_WEIGHT_SUFFIXES:
+        if short == suf or short.endswith("_" + suf):
+            return canon
+    return short
+
+
 def _layer_weights(f, name: str) -> Dict[str, np.ndarray]:
-    """{short weight name: array} for a layer from model_weights."""
+    """{short weight name: array} for a layer from model_weights; Keras-1
+    ``<layer>_W``-style names normalized to the Keras-2 spellings."""
     mw = f["model_weights"] if "model_weights" in f else f
     if name not in mw:
         return {}
@@ -237,7 +335,7 @@ def _layer_weights(f, name: str) -> Dict[str, np.ndarray]:
     out = {}
     for wn in weight_names:
         short = wn.split("/")[-1].split(":")[0]
-        out[short] = np.asarray(grp[wn])
+        out[_canonical_weight_name(short)] = np.asarray(grp[wn])
     return out
 
 
@@ -251,6 +349,14 @@ def _lstm_reorder(arr: np.ndarray, H: int) -> np.ndarray:
 def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
     """Copy Keras weights into the param/state pytrees for layer ``key``."""
     import jax.numpy as jnp
+    setter = getattr(layer_conf, "_keras_weight_setter", None)
+    if setter is not None:  # custom-layer SPI override
+        p = dict(net_params.get(key, {}))
+        s = dict(net_states.get(key, {}))
+        setter(p, s, weights)
+        net_params[key] = {k: jnp.asarray(v) for k, v in p.items()}
+        net_states[key] = s
+        return
     if type(layer_conf).__name__ == "LastTimeStep":
         layer_conf = layer_conf.inner  # params live on the wrapped layer
     t = type(layer_conf).__name__
@@ -301,6 +407,27 @@ def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
     else:
         raise ValueError(f"Weight copy not implemented for layer type {t}")
     net_params[key] = p
+
+
+def _maybe_permute_dense_kernel(weights: Dict[str, np.ndarray],
+                                pre) -> Dict[str, np.ndarray]:
+    """Keras flattens conv activations in (h, w, c) order; our
+    CnnToFeedForward preprocessor flattens channel-major (c, h, w) —
+    reference parity, ``CnnToFeedForwardPreProcessor.java``. A Dense kernel
+    following a Flatten must have its input rows permuted accordingly
+    (reference ``KerasDense`` dim-ordering handling)."""
+    if pre is None or type(pre).__name__ != "CnnToFeedForwardPreProcessor":
+        return weights
+    k = weights.get("kernel")
+    if k is None or k.ndim != 2:
+        return weights
+    h, w, c = int(pre.height), int(pre.width), int(pre.channels)
+    if h * w * c != k.shape[0]:
+        return weights
+    k2 = k.reshape(h, w, c, -1).transpose(2, 0, 1, 3).reshape(k.shape[0], -1)
+    out = dict(weights)
+    out["kernel"] = k2
+    return out
 
 
 def _input_type_from_shape(shape) -> Optional[Any]:
@@ -380,6 +507,7 @@ class KerasModelImport:
                     continue
                 w = _layer_weights(f, cfg.get("name", cls.lower()))
                 if w:
+                    w = _maybe_permute_dense_kernel(w, conf.preprocessor(li))
                     _set_layer_weights(net.params, net.states, str(li),
                                        conf.layers[li], w)
                 li += 1
@@ -401,8 +529,8 @@ class KerasModelImport:
                 raise ValueError(f"Unsupported Keras model class '{cls_name}'")
             cfg = model_cfg["config"]
             layer_cfgs = cfg["layers"]
-            input_layers = [n[0] for n in cfg["input_layers"]]
-            output_layers = [n[0] for n in cfg["output_layers"]]
+            input_layers = _io_names(cfg["input_layers"])
+            output_layers = _io_names(cfg["output_layers"])
             training_cfg = f.attrs.get("training_config")
             loss = None
             if training_cfg is not None:
@@ -417,15 +545,8 @@ class KerasModelImport:
                 cls = lc["class_name"]
                 kcfg = lc.get("config", {})
                 name = lc.get("name", kcfg.get("name"))
-                inbound = lc.get("inbound_nodes", [])
-                ins = []
-                if inbound:
-                    node = inbound[0]
-                    if isinstance(node, dict):  # Keras 3 style
-                        node = node.get("args", [[]])[0]
-                    for entry in node:
-                        src = entry[0] if isinstance(entry, (list, tuple)) else entry
-                        ins.append(skipped_alias.get(src, src))
+                ins = [skipped_alias.get(src, src)
+                       for src in _inbound_names(lc.get("inbound_nodes", []))]
                 if cls == "InputLayer":
                     shape = kcfg.get("batch_input_shape", kcfg.get("batch_shape"))
                     it = _input_type_from_shape(shape[1:] if shape else None)
@@ -453,6 +574,8 @@ class KerasModelImport:
             for name, lconf in name_to_conf.items():
                 w = _layer_weights(f, name)
                 if w:
+                    w = _maybe_permute_dense_kernel(
+                        w, conf.input_preprocessors.get(name))
                     _set_layer_weights(net.params, net.states, name, lconf, w)
         return net
 

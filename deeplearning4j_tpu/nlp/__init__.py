@@ -10,6 +10,9 @@ from .vocab import VocabCache, VocabWord, SequenceElement, Huffman, build_vocab
 from .sequencevectors import SequenceVectors, InMemoryLookupTable
 from .word2vec import Word2Vec, CBOW, ParagraphVectors
 from .glove import Glove
+from .distributed import (DistributedWord2Vec, DistributedGlove,
+                          SparkWord2Vec, SparkGlove, partition_sentences)
+from .bagofwords import InvertedIndex, BagOfWordsVectorizer, TfidfVectorizer
 from .serializer import WordVectorSerializer, StaticWordVectors
 
 __all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
@@ -18,4 +21,7 @@ __all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator"
            "LowCasePreProcessor", "StopWords", "VocabCache", "VocabWord",
            "SequenceElement", "Huffman", "build_vocab", "SequenceVectors",
            "InMemoryLookupTable", "Word2Vec", "CBOW", "ParagraphVectors",
-           "Glove", "WordVectorSerializer", "StaticWordVectors"]
+           "Glove", "DistributedWord2Vec", "DistributedGlove",
+           "SparkWord2Vec", "SparkGlove", "partition_sentences",
+           "InvertedIndex", "BagOfWordsVectorizer", "TfidfVectorizer",
+           "WordVectorSerializer", "StaticWordVectors"]

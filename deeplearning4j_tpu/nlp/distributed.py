@@ -1,0 +1,177 @@
+"""Distributed NLP embeddings: multi-process Word2Vec and GloVe.
+
+TPU-native equivalent of the reference's ``dl4j-spark-nlp`` module (5,255 LoC;
+SURVEY.md §2.4 "Spark NLP"):
+
+ - ``spark/text/functions/TextPipeline.java:1`` — cluster-wide tokenize +
+   word-frequency count producing one vocab for all workers. Here every
+   process tokenizes the full (shared) corpus deterministically, which yields
+   the identical vocab the reference gets by building on the driver and
+   broadcasting.
+ - ``spark/models/embeddings/word2vec/FirstIterationFunction.java`` /
+   ``SecondIterationFunction.java`` — map-partition training: each executor
+   trains skip-gram on its own partition of sentences.
+ - ``spark/models/embeddings/word2vec/Word2Vec.java:237`` ("Updating syn0
+   second pass: average obtained vectors") — partition results are merged by
+   *averaging* the trained vectors.
+
+Architecture shift: Spark's driver/executor RDD machinery collapses into the
+JAX multi-controller model (same SPMD program on every host,
+``jax.distributed.initialize`` forms the cluster — see
+``parallel/distributed.py``). The partition feed is the round-robin
+``ProcessLocalIterator`` pattern; the driver-side aggregation is a
+cross-process mean of the embedding tables over the global device mesh
+(ICI/DCN collectives instead of Spark shuffle).
+
+GloVe distributes the *co-occurrence counting* (the reference's
+``glove/count/`` machinery runs it as Spark jobs): each process counts its
+sentence share, the sparse COO counts are all-gathered and merged, and the
+factorization then runs identically on every process from the identical
+merged counts — bit-identical vectors everywhere without further
+communication.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+
+from .word2vec import Word2Vec
+from .glove import Glove
+
+__all__ = ["DistributedWord2Vec", "DistributedGlove", "SparkWord2Vec",
+           "SparkGlove", "partition_sentences"]
+
+
+def partition_sentences(sentences, process_index: Optional[int] = None,
+                        process_count: Optional[int] = None):
+    """Round-robin sentence partitioning: process ``p`` of ``P`` keeps
+    sentences ``p, p+P, ...`` — the map-partition feed of
+    ``FirstIterationFunction`` without materializing remote shards."""
+    p = jax.process_index() if process_index is None else process_index
+    P = jax.process_count() if process_count is None else process_count
+    return [s for i, s in enumerate(sentences) if i % P == p]
+
+
+def _mean_across_processes(arr: np.ndarray) -> np.ndarray:
+    """Cross-process mean of a replicated host array (the reference's
+    driver-side vector averaging, ``Word2Vec.java:237``), over the global
+    mesh's collectives. Identity when single-process."""
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)  # [P, ...]
+    return np.asarray(gathered).mean(axis=0)
+
+
+def _allgather_varlen(rows: np.ndarray) -> np.ndarray:
+    """All-gather variable-length per-process row blocks: pad to the global
+    max, gather, strip padding. Used to merge sparse COO co-occurrence
+    blocks whose lengths differ per partition."""
+    from jax.experimental import multihost_utils
+    n = np.asarray([rows.shape[0]], np.int64)
+    counts = np.asarray(multihost_utils.process_allgather(n)).reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m,) + rows.shape[1:], rows.dtype)
+    padded[:rows.shape[0]] = rows
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate([gathered[p, :int(c)]
+                           for p, c in enumerate(counts)], axis=0)
+
+
+class DistributedWord2Vec:
+    """Multi-process Word2Vec (reference Spark ``Word2Vec.java:61``).
+
+    Usage matches the single-host Builder; ``fit`` partitions sentences
+    across processes, trains each partition locally with the existing jitted
+    skip-gram engine (``nlp/sequencevectors.py``), and averages the embedding
+    tables across processes after every epoch. All processes finish with
+    bit-identical tables.
+    """
+
+    def __init__(self, word2vec: Optional[Word2Vec] = None, **kw):
+        self.w2v = word2vec if word2vec is not None else Word2Vec(**kw)
+
+    def fit(self, sentences):
+        """``sentences``: the full corpus (every process passes the same
+        list — the reference ships the RDD; we ship the stream and partition
+        by index)."""
+        w = self.w2v
+        tokenized = [w._tokenizer.create(s).get_tokens() for s in sentences]
+        # TextPipeline: one vocab for the whole cluster, built identically
+        # on every process (driver-build + broadcast equivalent)
+        w.build_vocab(tokenized)
+        local = partition_sentences(tokenized)
+        # epochs are driven here so tables average once per epoch (the
+        # reference's per-iteration aggregation cadence); the local engine
+        # runs single epochs over the partition
+        epochs, w.epochs = w.epochs, 1
+        try:
+            for _ in range(epochs):
+                if local:
+                    w.fit_tokenized(local)
+                lt = w.lookup_table
+                lt.syn0 = _mean_across_processes(np.asarray(lt.syn0))
+                if lt.syn1 is not None:
+                    lt.syn1 = _mean_across_processes(np.asarray(lt.syn1))
+                if lt.syn1neg is not None:
+                    lt.syn1neg = _mean_across_processes(np.asarray(lt.syn1neg))
+        finally:
+            w.epochs = epochs
+        return self
+
+    # delegate the query surface
+    def __getattr__(self, name):
+        return getattr(self.w2v, name)
+
+
+class DistributedGlove:
+    """Multi-process GloVe (reference ``glove/count/`` Spark co-occurrence
+    jobs feeding ``Glove.java``): counting is partitioned, counts are merged
+    cluster-wide, training runs identically everywhere — the distributed
+    model equals the single-process model on the same corpus exactly."""
+
+    def __init__(self, glove: Optional[Glove] = None, **kw):
+        self.glove = glove if glove is not None else Glove(**kw)
+
+    def fit(self, sentences):
+        g = self.glove
+        tokenized = [g._tokenizer.create(s).get_tokens() for s in sentences]
+        from .vocab import build_vocab
+        # cluster-wide vocab, built identically everywhere (TextPipeline)
+        g.vocab = build_vocab(tokenized, g.min_word_frequency,
+                              build_huffman=False)
+        local = partition_sentences(tokenized)
+        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        for seq in local:
+            idxs = [g.vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, i in enumerate(idxs):
+                for off in range(1, g.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    cooc[(i, idxs[j])] += 1.0 / off
+                    cooc[(idxs[j], i)] += 1.0 / off
+        if cooc:
+            block = np.asarray([(i, j, v) for (i, j), v in cooc.items()],
+                               np.float64)
+        else:
+            block = np.zeros((0, 3), np.float64)
+        if jax.process_count() > 1:
+            block = _allgather_varlen(block)
+        merged: Dict[Tuple[int, int], float] = defaultdict(float)
+        for i, j, v in block:
+            merged[(int(i), int(j))] += float(v)
+        g.fit_cooccurrences(merged)
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.glove, name)
+
+
+# reference-name aliases (Spark facade naming)
+SparkWord2Vec = DistributedWord2Vec
+SparkGlove = DistributedGlove

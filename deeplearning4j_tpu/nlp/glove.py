@@ -151,6 +151,15 @@ class Glove:
                     # distance-weighted count, symmetric (GloVe convention)
                     cooc[(i, idxs[j])] += 1.0 / off
                     cooc[(idxs[j], i)] += 1.0 / off
+        return self.fit_cooccurrences(cooc)
+
+    def fit_cooccurrences(self, cooc: Dict[Tuple[int, int], float]):
+        """Train the factorization from a co-occurrence map. Split out so
+        distributed counting (``nlp/distributed.py``, reference
+        ``glove/count/`` Spark jobs) can merge partition counts and feed the
+        identical map on every process. Pairs are sorted canonically so the
+        same counts always produce bit-identical vectors regardless of map
+        insertion order."""
         n = self.vocab.num_words()
         d = self.vector_length
         rng = np.random.default_rng(self.seed)
@@ -163,8 +172,9 @@ class Glove:
         hb = jnp.ones((n,), jnp.float32)
         hbc = jnp.ones((n,), jnp.float32)
 
-        pairs = np.asarray(list(cooc.keys()), np.int32)
-        counts = np.asarray(list(cooc.values()), np.float32)
+        items = sorted(cooc.items())
+        pairs = np.asarray([ij for ij, _ in items], np.int32).reshape(-1, 2)
+        counts = np.asarray([v for _, v in items], np.float32)
         logx = np.log(counts)
         fx = np.minimum((counts / self.x_max) ** self.alpha, 1.0).astype(np.float32)
         B = self.batch_size
@@ -180,6 +190,8 @@ class Glove:
         # final vectors: w + wc (GloVe paper recommendation)
         self.syn0 = np.asarray(w) + np.asarray(wc)
         return self
+
+    fitCooccurrences = fit_cooccurrences
 
     # ----------------------------------------------------------------- query
     def word_vector(self, word: str) -> Optional[np.ndarray]:

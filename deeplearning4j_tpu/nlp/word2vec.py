@@ -137,6 +137,12 @@ class Word2Vec(SequenceVectors):
                              "builder or pass sentences to fit()")
         return super().fit(lambda: self._sentences())
 
+    def fit_tokenized(self, token_sequences):
+        """Train on pre-tokenized sequences against the existing vocab —
+        the per-partition step of distributed training (reference
+        ``FirstIterationFunction``; see ``nlp/distributed.py``)."""
+        return SequenceVectors.fit(self, token_sequences)
+
 
 class CBOW(Word2Vec):
     """Continuous bag-of-words: the averaged context predicts the center

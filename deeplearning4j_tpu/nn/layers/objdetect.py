@@ -1,12 +1,27 @@
 """YOLOv2 output layer implementation.
 
 TPU-native equivalent of reference ``nn/layers/objdetect/Yolo2OutputLayer.java``
-(714 LoC). Input activations: [b, gh, gw, B*(5+C)] NHWC (reference: [b, B*(5+C),
-gh, gw]); labels: [b, 4+C, gh, gw] as in the reference (class map + bbox corner
-coords in grid units). Loss = lambda_coord * position/size SSE (sqrt w/h) +
-object/no-object confidence SSE (vs IOU) + per-cell classification SSE, the
-reference's YOLOv2 formulation. All box math is vectorized over the grid — no
-per-cell host loops.
+(714 LoC). Exact layout/semantic parity with the reference:
+
+ - input activations [b, gh, gw, 5B + C] NHWC (reference [mb, 5B+C, H, W],
+   ``Yolo2OutputLayer.java:130-137``): B anchor blocks of (x, y, w, h, conf)
+   followed by C per-CELL class logits (classes are shared across anchors).
+ - labels [b, 4+C, gh, gw]: corner coords (x1, y1, x2, y2) in grid units +
+   one-hot class map; object-presence mask inferred from the class one-hots
+   (``:108-109``).
+ - responsibility mask 1_ij^obj = IsMax over B of IOU(pred, label) × object
+   present (``:155-157``); noobj mask is its complement (``:158``).
+ - losses (all LossL2 sums, defaults ``conf/layers/objdetect/
+   Yolo2OutputLayer.java:134-137``): position = (σ(xy) − frac(center))²,
+   size = (√(prior·e^wh) − √(labelWH))², both responsibility-masked and
+   λ_coord-scaled; confidence label is the IOU itself (gradients flow through
+   it, ``:284-300``) with λ_noObj on the non-responsible term; class loss =
+   per-cell softmax vs one-hot L2, object-masked (``:208-217``).
+ - score divided by minibatch only (``:226``).
+
+The reference hand-writes ~400 lines of backward (``:230-330``); here the
+backward is AD of this loss — including the confidence-through-IOU terms the
+reference derives manually.
 """
 from __future__ import annotations
 
@@ -19,28 +34,35 @@ from .base import NoParamLayerImpl, implements
 @implements("Yolo2OutputLayer")
 class Yolo2OutputImpl(NoParamLayerImpl):
     def _boxes(self):
-        return jnp.asarray(self.conf.boxes, jnp.float32)  # [B, 2] (h, w)
+        return jnp.asarray(self.conf.boxes, jnp.float32)  # [B, 2] (w, h)
 
-    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
-        """Inference activations (reference ``activate``): sigmoid on xy/conf,
-        exp-scaled wh, softmax on classes."""
+    def _split(self, x):
+        """[b, gh, gw, 5B+C] → box block [b, gh, gw, B, 5] + class logits
+        [b, gh, gw, C]."""
         B = self._boxes().shape[0]
         b, gh, gw, ch = x.shape
-        C = ch // B - 5
-        x = x.reshape(b, gh, gw, B, 5 + C)
-        xy = jax.nn.sigmoid(x[..., 0:2])
-        wh = jnp.exp(x[..., 2:4]) * self._boxes()[None, None, None, :, :]
-        conf = jax.nn.sigmoid(x[..., 4:5])
-        cls = jax.nn.softmax(x[..., 5:], axis=-1)
-        return jnp.concatenate([xy, wh, conf, cls], axis=-1).reshape(b, gh, gw, ch), state
+        boxes = x[..., :5 * B].reshape(b, gh, gw, B, 5)
+        cls_logits = x[..., 5 * B:]
+        return boxes, cls_logits
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        """Inference activations (reference ``activate`` :336-345): sigmoid on
+        xy/conf, prior-scaled exp on wh, per-cell softmax on classes."""
+        boxes, cls_logits = self._split(x)
+        b, gh, gw, B, _ = boxes.shape
+        xy = jax.nn.sigmoid(boxes[..., 0:2])
+        wh = jnp.exp(boxes[..., 2:4]) * self._boxes()[None, None, None, :, :]
+        conf = jax.nn.sigmoid(boxes[..., 4:5])
+        out_boxes = jnp.concatenate([xy, wh, conf], axis=-1).reshape(
+            b, gh, gw, 5 * B)
+        out_cls = jax.nn.softmax(cls_logits, axis=-1)
+        return jnp.concatenate([out_boxes, out_cls], axis=-1), state
 
     def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):
         c = self.conf
-        anchors = self._boxes()                          # [B, 2]
-        B = anchors.shape[0]
-        b, gh, gw, ch = x.shape
-        C = ch // B - 5
-        x = x.reshape(b, gh, gw, B, 5 + C)
+        anchors = self._boxes()                           # [B, 2]
+        boxes, cls_logits = self._split(x)
+        b, gh, gw, B, _ = boxes.shape
 
         # labels [b, 4+C, gh, gw] → bbox [b, gh, gw, 4], classmap [b, gh, gw, C]
         labels = jnp.transpose(labels, (0, 2, 3, 1))
@@ -49,19 +71,21 @@ class Yolo2OutputImpl(NoParamLayerImpl):
         obj_mask = (jnp.sum(cls_label, axis=-1, keepdims=True) > 0)  # [b,gh,gw,1]
 
         # ground-truth center/size per cell
-        gt_wh = jnp.stack([bbox[..., 2] - bbox[..., 0], bbox[..., 3] - bbox[..., 1]], -1)
+        gt_wh = jnp.stack([bbox[..., 2] - bbox[..., 0],
+                           bbox[..., 3] - bbox[..., 1]], -1)
         gt_cxy = jnp.stack([0.5 * (bbox[..., 0] + bbox[..., 2]),
                             0.5 * (bbox[..., 1] + bbox[..., 3])], -1)
         # predicted box params
         cell_x = jnp.arange(gw, dtype=jnp.float32)[None, None, :, None]
         cell_y = jnp.arange(gh, dtype=jnp.float32)[None, :, None, None]
-        p_xy_rel = jax.nn.sigmoid(x[..., 0:2])            # within-cell offset
+        p_xy_rel = jax.nn.sigmoid(boxes[..., 0:2])        # within-cell offset
         p_cx = p_xy_rel[..., 0] + cell_x
         p_cy = p_xy_rel[..., 1] + cell_y
-        p_wh = jnp.exp(jnp.clip(x[..., 2:4], -10, 6)) * anchors[None, None, None]
-        p_conf = jax.nn.sigmoid(x[..., 4])
+        # wide clip for numerical safety only; reference exp is unclipped
+        p_wh = jnp.exp(jnp.clip(boxes[..., 2:4], -20, 20)) * anchors[None, None, None]
+        p_conf = jax.nn.sigmoid(boxes[..., 4])
 
-        # IOU of each predicted box vs GT box of its cell
+        # IOU of each predicted box vs the GT box of its cell (:148)
         p_x1 = p_cx - 0.5 * p_wh[..., 0]
         p_x2 = p_cx + 0.5 * p_wh[..., 0]
         p_y1 = p_cy - 0.5 * p_wh[..., 1]
@@ -73,30 +97,32 @@ class Yolo2OutputImpl(NoParamLayerImpl):
         iw = jnp.maximum(ix2 - ix1, 0.0)
         ih = jnp.maximum(iy2 - iy1, 0.0)
         inter = iw * ih
-        area_p = jnp.maximum(p_wh[..., 0] * p_wh[..., 1], 1e-9)
-        area_g = jnp.maximum(gt_wh[..., 0] * gt_wh[..., 1], 1e-9)[..., None]
-        iou = inter / (area_p + area_g - inter + 1e-9)    # [b, gh, gw, B]
+        area_p = p_wh[..., 0] * p_wh[..., 1]
+        area_g = (gt_wh[..., 0] * gt_wh[..., 1])[..., None]
+        iou = inter / (area_p + area_g - inter + 1e-12)   # [b, gh, gw, B]
 
-        # responsible predictor = argmax IOU per cell (reference behavior)
-        resp = jax.nn.one_hot(jnp.argmax(iou, axis=-1), B, dtype=jnp.float32)
-        resp = resp * obj_mask.astype(jnp.float32)        # [b, gh, gw, B]
+        # responsible predictor: IsMax over B × object present (:155-157)
+        resp = jax.nn.one_hot(jnp.argmax(iou, axis=-1), B, dtype=x.dtype)
+        resp = resp * obj_mask.astype(x.dtype)            # [b, gh, gw, B]
 
-        # coordinate loss (sqrt on w/h as in YOLOv2)
+        # position + size losses, λ_coord-scaled (:213-215, :220)
         gt_xy_rel = gt_cxy - jnp.floor(gt_cxy)
         d_xy = jnp.sum((p_xy_rel - gt_xy_rel[..., None, :]) ** 2, axis=-1)
-        d_wh = jnp.sum((jnp.sqrt(p_wh + 1e-9)
-                        - jnp.sqrt(gt_wh[..., None, :] + 1e-9)) ** 2, axis=-1)
+        d_wh = jnp.sum((jnp.sqrt(p_wh + 1e-12)
+                        - jnp.sqrt(jnp.maximum(gt_wh, 0.0)[..., None, :] + 1e-12)) ** 2,
+                       axis=-1)
         coord_loss = jnp.sum(resp * (d_xy + d_wh))
 
-        # confidence loss: responsible → target IOU; others → 0
+        # confidence: label = IOU·1_ij^obj, L2 on responsible + λ_noObj on the
+        # complement (:165, :216-217); gradients flow through IOU as in the
+        # reference's hand-derived dLc/dIOU (:284-300)
         conf_loss_obj = jnp.sum(resp * (p_conf - iou) ** 2)
         conf_loss_noobj = jnp.sum((1.0 - resp) * p_conf ** 2)
 
-        # classification loss per object cell (softmax SSE, reference default)
-        p_cls = jax.nn.softmax(x[..., 5:], axis=-1)
-        cell_cls = jnp.sum(resp[..., None] * p_cls, axis=3)
-        cls_loss = jnp.sum(obj_mask[..., 0, None].astype(jnp.float32)
-                           * (cell_cls - cls_label) ** 2)
+        # per-CELL class loss: softmax over C logits vs one-hot, object-masked
+        # (:208-211, :218)
+        p_cls = jax.nn.softmax(cls_logits, axis=-1)
+        cls_loss = jnp.sum(obj_mask.astype(x.dtype) * (p_cls - cls_label) ** 2)
 
         total = (c.lambda_coord * coord_loss + conf_loss_obj
                  + c.lambda_no_obj * conf_loss_noobj + cls_loss)

@@ -9,10 +9,13 @@ from .sharding import (DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS, make_mesh,
 from .wrapper import ParallelWrapper, TrainingMode
 from .inference import ParallelInference, InferenceMode
 from .accumulation import (GradientsAccumulator, EncodedGradientsAccumulator,
-                           EncodingHandler, threshold_encode, threshold_decode)
+                           EncodingHandler, threshold_encode, threshold_decode,
+                           serialize_encoded, deserialize_encoded)
+from .transport import UpdateChannel
 from .distributed import (ProcessLocalIterator, is_chief,
                           TrainingMaster, ParameterAveragingTrainingMaster,
-                          SharedTrainingMaster, DistributedMultiLayerNetwork,
+                          SharedTrainingMaster, SharedGradientsClusterTrainer,
+                          DistributedMultiLayerNetwork,
                           DistributedComputationGraph, SparkDl4jMultiLayer,
                           SparkComputationGraph, initialize_distributed)
 from .sequence import ring_attention, ulysses_attention, full_attention
@@ -23,7 +26,8 @@ __all__ = [
     "batch_sharded", "shard_batch", "data_parallel_step",
     "ParallelWrapper", "TrainingMode", "ParallelInference", "InferenceMode",
     "GradientsAccumulator", "EncodedGradientsAccumulator", "EncodingHandler",
-    "threshold_encode", "threshold_decode",
+    "threshold_encode", "threshold_decode", "serialize_encoded",
+    "deserialize_encoded", "UpdateChannel", "SharedGradientsClusterTrainer",
     "TrainingMaster", "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
     "DistributedMultiLayerNetwork", "DistributedComputationGraph",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",

@@ -100,39 +100,48 @@ class GradientsAccumulator:
 class EncodedGradientsAccumulator(GradientsAccumulator):
     """Host-side residual accumulator for updates that must cross DCN
     (reference ``EncodedGradientsAccumulator``): each ``store_update`` call
-    threshold-encodes the gradient pytree per-leaf, keeps the residual, and
-    returns the decoded (quantized) update — what a peer slice would apply.
+    threshold-encodes the *flattened* update vector — the reference encodes
+    the flat param-view buffer, not per-layer tensors — keeps the residual,
+    and returns the decoded (quantized) update pytree: what a peer slice
+    would apply after receiving the wire bytes. One native codec call per
+    round (``threshold_encode_f32`` over the whole vector) instead of a
+    Python loop over leaves.
     """
 
     def __init__(self, initial_threshold: float = 1e-3, **handler_kw):
-        self._handlers: Dict[str, EncodingHandler] = {}
-        self._residual: Dict[str, np.ndarray] = {}
-        self._kw = dict(initial_threshold=initial_threshold, **handler_kw)
-        self.last_encoded = None  # {path: (idx, signs, threshold)} — wire form
+        self._handler_kw = dict(initial_threshold=initial_threshold,
+                                **handler_kw)
+        self._handler = EncodingHandler(**self._handler_kw)
+        self._residual: Optional[np.ndarray] = None
+        self._treedef = None
+        self._shapes = None
+        self.last_encoded = None  # (idx, signs, threshold, n) — wire form
 
-    def _handler(self, path) -> EncodingHandler:
-        if path not in self._handlers:
-            self._handlers[path] = EncodingHandler(**self._kw)
-        return self._handlers[path]
+    def _flatten(self, grads) -> np.ndarray:
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        self._treedef = treedef
+        self._shapes = [np.shape(l) for l in leaves]
+        return np.concatenate([np.asarray(l, np.float32).ravel()
+                               for l in leaves]) if leaves else np.zeros(0,
+                                                                         np.float32)
+
+    def _unflatten(self, flat: np.ndarray):
+        out = []
+        off = 0
+        for shp in self._shapes:
+            n = int(np.prod(shp)) if shp else 1
+            out.append(flat[off:off + n].reshape(shp))
+            off += n
+        return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def store_update(self, grads):
-        leaves = jax.tree_util.tree_flatten_with_path(grads)[0]
-        encoded = {}
-        decoded = {}
-        for keypath, leaf in leaves:
-            path = jax.tree_util.keystr(keypath)
-            g = np.asarray(leaf, np.float32)
-            if path in self._residual:
-                g = g + self._residual[path]
-            (idx, signs, thr), residual = self._handler(path).encode(g)
-            self._residual[path] = residual
-            encoded[path] = (idx, signs, thr)
-            decoded[path] = threshold_decode(idx, signs, thr, g.shape)
-        self.last_encoded = encoded
-        # rebuild pytree with decoded leaves
-        flat_vals = [decoded[jax.tree_util.keystr(kp)] for kp, _ in leaves]
-        treedef = jax.tree_util.tree_structure(grads)
-        return jax.tree_util.tree_unflatten(treedef, flat_vals)
+        g = self._flatten(grads)
+        if self._residual is not None:
+            g = g + self._residual
+        (idx, signs, thr), residual = self._handler.encode(g)
+        self._residual = residual
+        self.last_encoded = (idx, signs, thr, g.size)
+        return self._unflatten(threshold_decode(idx, signs, thr, (g.size,)))
 
     storeUpdate = store_update
 
@@ -140,10 +149,65 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
         """Wire size of the last encoding (index + sign bytes)."""
         if not self.last_encoded:
             return 0
-        return sum(idx.nbytes + signs.nbytes
-                   for idx, signs, _ in self.last_encoded.values())
+        idx, signs, _, _ = self.last_encoded
+        return idx.nbytes + signs.nbytes
+
+    # ------------------------------------------------------------- wire form
+    def serialize_last(self) -> bytes:
+        """Wire bytes of the last encoding (the reference's
+        ``SilentUpdatesMessage`` payload)."""
+        if self.last_encoded is None:
+            raise ValueError("no update stored yet")
+        return serialize_encoded(self.last_encoded)
+
+    serializeLast = serialize_last
+
+    def decode_payload(self, data: bytes):
+        """Decode a peer's wire bytes into an update pytree shaped like the
+        last stored update (reference ``SilentTrainingDriver`` applying a
+        received ``SilentUpdatesMessage``)."""
+        idx, signs, thr, n = deserialize_encoded(data)
+        return self._unflatten(threshold_decode(idx, signs, thr, (n,)))
+
+    decodePayload = decode_payload
 
     def reset(self):
-        self._residual.clear()
-        self._handlers.clear()
+        self._residual = None
+        # fresh handler: the adaptive threshold returns to initial_threshold,
+        # matching a newly constructed accumulator
+        self._handler = EncodingHandler(**self._handler_kw)
         self.last_encoded = None
+
+
+# ------------------------------------------------------------------ wire I/O
+_WIRE_MAGIC = 0x444C3454  # "DL4T"
+
+
+def serialize_encoded(encoded) -> bytes:
+    """Pack (idx, signs, threshold, n) into the wire frame: little-endian
+    header [magic u32, n u64, k u64, threshold f32] + idx i32[k] + signs
+    i8[k] — the Aeron-free counterpart of the reference's
+    ``SilentUpdatesMessage`` (``networking/messages/SilentUpdatesMessage.java``)."""
+    idx, signs, thr, n = encoded
+    idx = np.ascontiguousarray(idx, np.int32)
+    signs = np.ascontiguousarray(signs, np.int8)
+    header = np.zeros(6, np.uint32)
+    header[0] = _WIRE_MAGIC
+    header[1] = n & 0xFFFFFFFF
+    header[2] = n >> 32
+    header[3] = idx.size & 0xFFFFFFFF
+    header[4] = idx.size >> 32
+    header[5] = np.float32(thr).view(np.uint32)
+    return header.tobytes() + idx.tobytes() + signs.tobytes()
+
+
+def deserialize_encoded(data: bytes):
+    header = np.frombuffer(data[:24], np.uint32)
+    if int(header[0]) != _WIRE_MAGIC:
+        raise ValueError("bad wire frame")
+    n = int(header[1]) | (int(header[2]) << 32)
+    k = int(header[3]) | (int(header[4]) << 32)
+    thr = float(header[5:6].view(np.float32)[0])
+    idx = np.frombuffer(data[24:24 + 4 * k], np.int32)
+    signs = np.frombuffer(data[24 + 4 * k:24 + 5 * k], np.int8)
+    return idx, signs, thr, n

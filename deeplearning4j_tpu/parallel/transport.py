@@ -1,0 +1,137 @@
+"""Peer-to-peer update transport for SHARED_GRADIENTS across hosts.
+
+TPU-native replacement for the reference's Aeron UDP data plane
+(``nd4j-aeron`` dependency driven from ``SharedTrainingWrapper.java:206-244``;
+update frames are ``networking/messages/SilentUpdatesMessage.java`` relayed by
+``networking/SilentTrainingDriver.java``). On TPU pods the *gradient*
+all-reduce rides ICI inside the jitted step; this channel carries the
+threshold-encoded update frames (``parallel/accumulation.py`` wire form) when
+updates must cross DCN between independently-jitted slices — the situation the
+reference's Ethernet-era compression was built for.
+
+Topology: full mesh of TCP streams between N processes (N is small — one per
+slice/host). Frames are length-prefixed. ``broadcast`` sends the local frame
+to every peer; ``gather`` collects one frame from each peer, so a round trip
+is: encode → broadcast → gather → decode+apply all — exactly the reference's
+"each worker applies everyone's quantized update" semantics.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Dict, List, Sequence
+
+__all__ = ["UpdateChannel"]
+
+
+class UpdateChannel:
+    """Full-mesh, length-prefixed frame exchange between training processes.
+
+    ``process_id``/``addresses``: this process's rank and the listen address
+    of every process (index-aligned). Lower ranks accept connections from
+    higher ranks; higher ranks dial lower ranks — a deterministic handshake
+    with no coordinator (the reference needed a shard/client role split,
+    ``VoidConfiguration`` — multi-controller symmetry removes it).
+    """
+
+    def __init__(self, process_id: int, addresses: Sequence[str],
+                 timeout: float = 60.0):
+        self.p = int(process_id)
+        self.addrs = [(h, int(pt)) for h, pt in
+                      (a.rsplit(":", 1) for a in addresses)]
+        self.P = len(self.addrs)
+        self._peers: Dict[int, socket.socket] = {}
+        self._listener = None
+        if self.P > 1:
+            self._connect(timeout)
+
+    # ------------------------------------------------------------- handshake
+    def _connect(self, timeout: float):
+        host, port = self.addrs[self.p]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(self.P)
+        self._listener = srv
+        expected_in = [q for q in range(self.P) if q > self.p]
+        expected_out = [q for q in range(self.P) if q < self.p]
+        deadline = time.monotonic() + timeout
+        for q in expected_out:
+            while True:
+                try:
+                    s = socket.create_connection(self.addrs[q], timeout=2.0)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"peer {q} unreachable")
+                    time.sleep(0.05)
+            # the 2s timeout is for the dial only — steps can legitimately
+            # take longer (compile skew, data stalls), so frames block forever
+            s.settimeout(None)
+            s.sendall(struct.pack("<i", self.p))
+            self._peers[q] = s
+        for _ in expected_in:
+            srv.settimeout(max(deadline - time.monotonic(), 0.1))
+            s, _ = srv.accept()
+            s.settimeout(None)
+            q = struct.unpack("<i", self._recv_exact(s, 4))[0]
+            self._peers[q] = s
+
+    @staticmethod
+    def _recv_exact(s: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # ----------------------------------------------------------------- frames
+    def broadcast(self, frame: bytes):
+        """Send one frame to every peer (``SilentUpdatesMessage`` fan-out)."""
+        header = struct.pack("<q", len(frame))
+        for s in self._peers.values():
+            s.sendall(header)
+            s.sendall(frame)
+
+    def gather(self) -> List[bytes]:
+        """Receive exactly one frame from every peer, rank order."""
+        out = []
+        for q in sorted(self._peers):
+            s = self._peers[q]
+            (n,) = struct.unpack("<q", self._recv_exact(s, 8))
+            out.append(self._recv_exact(s, n))
+        return out
+
+    def exchange(self, frame: bytes) -> List[bytes]:
+        """broadcast + gather — one SHARED_GRADIENTS wire round. The send
+        runs on a helper thread while this thread receives: with every rank
+        sending before reading, frames larger than the kernel socket buffers
+        would otherwise deadlock the full mesh pairwise."""
+        import threading
+        exc: List[BaseException] = []
+
+        def send():
+            try:
+                self.broadcast(frame)
+            except BaseException as e:  # surfaced after the join
+                exc.append(e)
+
+        t = threading.Thread(target=send, daemon=True)
+        t.start()
+        out = self.gather()
+        t.join()
+        if exc:
+            raise exc[0]
+        return out
+
+    def close(self):
+        for s in self._peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            self._listener.close()

@@ -32,7 +32,23 @@ th{background:#eee}</style></head>
 <div id="meta"></div>
 <svg id="score" class="chart" width="800" height="240"></svg>
 <table id="params"></table>
+<h1>System</h1>
+<div id="sysmeta"></div>
+<svg id="system" class="chart" width="800" height="160"></svg>
+<h1>t-SNE</h1>
+<svg id="tsne" class="chart" width="400" height="400"></svg>
+<h1>Convolutional activations</h1>
+<div id="actmeta"></div>
+<div id="acts"></div>
 <script>
+function polyline(svg, xs, ys, w, h, color){
+  if(ys.length<2){return;}
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const pts=xs.map((x,i)=>((x-xmin)/(xmax-xmin||1)*(w-20)+10)+','+
+    (h-10-(ys[i]-ymin)/(ymax-ymin||1)*(h-20))).join(' ');
+  svg.innerHTML+='<polyline fill="none" stroke="'+color+'" points="'+pts+'"/>';
+}
 async function refresh(){
   const sessions = await (await fetch('/train/sessions')).json();
   if(!sessions.length){setTimeout(refresh,2000);return;}
@@ -43,14 +59,7 @@ async function refresh(){
     (ov.scores.length?ov.scores[ov.scores.length-1].toFixed(5):'n/a');
   const svg = document.getElementById('score');
   svg.innerHTML='';
-  if(ov.scores.length>1){
-    const xs=ov.iterations, ys=ov.scores;
-    const xmin=Math.min(...xs), xmax=Math.max(...xs);
-    const ymin=Math.min(...ys), ymax=Math.max(...ys);
-    const pts=xs.map((x,i)=>((x-xmin)/(xmax-xmin||1)*780+10)+','+
-      (230-(ys[i]-ymin)/(ymax-ymin||1)*220)).join(' ');
-    svg.innerHTML='<polyline fill="none" stroke="#07c" points="'+pts+'"/>';
-  }
+  polyline(svg, ov.iterations, ov.scores, 800, 240, '#07c');
   const model = await (await fetch('/train/model?sid='+sid)).json();
   let html='<tr><th>param</th><th>norm2</th><th>mean</th><th>stdev</th></tr>';
   for(const [name,st] of Object.entries(model.params||{})){
@@ -59,6 +68,43 @@ async function refresh(){
       '</td><td>'+(st.stdev!==undefined?st.stdev.toFixed(5):'')+'</td></tr>';
   }
   document.getElementById('params').innerHTML=html;
+  const sys = await (await fetch('/train/system?sid='+sid)).json();
+  const ssvg = document.getElementById('system');
+  ssvg.innerHTML='';
+  const rss = (sys.host_rss_bytes||[]).filter(v=>v!=null);
+  if(rss.length){
+    document.getElementById('sysmeta').textContent =
+      'host RSS '+(rss[rss.length-1]/1048576).toFixed(0)+' MB';
+    polyline(ssvg, sys.iterations, rss, 800, 160, '#c70');
+  }
+  const dev=(sys.device_bytes_in_use||[]).filter(v=>v!=null);
+  if(dev.length){polyline(ssvg, sys.iterations.slice(-dev.length), dev, 800, 160, '#0a5');}
+  const ts = await (await fetch('/tsne/coords')).json();
+  const tsvg = document.getElementById('tsne');
+  tsvg.innerHTML='';
+  if(ts.coords && ts.coords.length){
+    const xs=ts.coords.map(c=>c[0]), ys=ts.coords.map(c=>c[1]);
+    const xmin=Math.min(...xs),xmax=Math.max(...xs);
+    const ymin=Math.min(...ys),ymax=Math.max(...ys);
+    tsvg.innerHTML=ts.coords.map((c,i)=>'<circle r="2" fill="#07c" cx="'+
+      ((c[0]-xmin)/(xmax-xmin||1)*380+10)+'" cy="'+
+      ((c[1]-ymin)/(ymax-ymin||1)*380+10)+'"/>').join('');
+  }
+  const act = await (await fetch('/train/activations?sid='+sid)).json();
+  if(act.grids && act.grids.length){
+    document.getElementById('actmeta').textContent =
+      'layer '+act.layer+' @ iteration '+act.iteration;
+    document.getElementById('acts').innerHTML = act.grids.map(g=>{
+      const h=g.length,w=g[0].length;
+      let lo=Infinity,hi=-Infinity;
+      g.forEach(r=>r.forEach(v=>{lo=Math.min(lo,v);hi=Math.max(hi,v);}));
+      const cells=g.map((row,y)=>row.map((v,x)=>{
+        const s=Math.round((v-lo)/(hi-lo||1)*255);
+        return '<rect x="'+x*4+'" y="'+y*4+'" width="4" height="4" fill="rgb('+
+          s+','+s+','+s+')"/>';}).join('')).join('');
+      return '<svg class="chart" width="'+w*4+'" height="'+h*4+'">'+cells+'</svg>';
+    }).join(' ');
+  }
   setTimeout(refresh,2000);
 }
 refresh();
@@ -67,6 +113,7 @@ refresh();
 
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # set by server factory
+    tsne_data = None              # latest uploaded t-SNE coords/labels
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -106,19 +153,64 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"params": latest.param_stats if latest else {},
                         "updates": latest.update_stats if latest else {}})
             return
+        if url.path == "/train/system":
+            # per-iteration memory/GC series (reference train-UI system tab,
+            # data from BaseStatsListener.java:286-307)
+            sid = q.get("sid", [None])[0] or self._latest_session()
+            ups = self.storage.get_all_updates(sid) if sid else []
+            sys_ups = [u for u in ups if getattr(u, "system", None)]
+            self._json({
+                "iterations": [u.iteration for u in sys_ups],
+                "host_rss_bytes": [u.system.get("host_rss_bytes")
+                                   for u in sys_ups],
+                "host_peak_rss_bytes": [u.system.get("host_peak_rss_bytes")
+                                        for u in sys_ups],
+                "device_bytes_in_use": [u.system.get("device_bytes_in_use")
+                                        for u in sys_ups],
+                "gc_collections": [u.system.get("gc_collections")
+                                   for u in sys_ups],
+            })
+            return
+        if url.path == "/train/activations":
+            # latest conv-activation grid (reference TrainModule's
+            # convolutional activations view)
+            sid = q.get("sid", [None])[0] or self._latest_session()
+            ups = self.storage.get_all_updates(sid) if sid else []
+            for u in reversed(ups):
+                if getattr(u, "activations", None):
+                    self._json({"iteration": u.iteration,
+                                **u.activations})
+                    return
+            self._json({"iteration": None, "layer": None, "grids": []})
+            return
+        if url.path == "/tsne/coords":
+            self._json(type(self).tsne_data or {"coords": [], "labels": []})
+            return
         self._json({"error": "not found"}, 404)
 
     def do_POST(self):
-        if urlparse(self.path).path != "/remote":
-            self._json({"error": "not found"}, 404)
-            return
+        path = urlparse(self.path).path
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length).decode("utf-8")
-        try:
-            self.storage.put_update(StatsReport.from_json(body))
-            self._json({"status": "ok"})
-        except Exception as e:  # malformed report
-            self._json({"error": str(e)}, 400)
+        if path == "/remote":
+            try:
+                self.storage.put_update(StatsReport.from_json(body))
+                self._json({"status": "ok"})
+            except Exception as e:  # malformed report
+                self._json({"error": str(e)}, 400)
+            return
+        if path == "/tsne/upload":
+            # t-SNE tab data (reference tsne UI module): {"coords": [[x,y]..],
+            # "labels": [...]} — typically produced by clustering.tsne
+            try:
+                data = json.loads(body)
+                type(self).tsne_data = {"coords": data.get("coords", []),
+                                        "labels": data.get("labels", [])}
+                self._json({"status": "ok"})
+            except Exception as e:
+                self._json({"error": str(e)}, 400)
+            return
+        self._json({"error": "not found"}, 404)
 
     def _latest_session(self):
         ids = self.storage.list_session_ids()
@@ -163,6 +255,20 @@ class UIServer:
                                         daemon=True)
         self._thread.start()
         return self.port
+
+    def upload_tsne(self, coords, labels=None):
+        """Publish t-SNE coordinates to the UI's t-SNE tab (reference tsne
+        UI module; typically fed from ``clustering.tsne.BarnesHutTsne``)."""
+        import numpy as np
+        data = {"coords": np.asarray(coords).tolist(),
+                "labels": list(labels) if labels is not None else []}
+        if self._httpd is not None:
+            self._httpd.RequestHandlerClass.tsne_data = data
+        else:
+            _Handler.tsne_data = data
+        return self
+
+    uploadTsne = upload_tsne
 
     def stop(self):
         if self._httpd is not None:

@@ -37,13 +37,49 @@ def _array_stats(arr: np.ndarray, bins: int = 20) -> Dict[str, Any]:
             "histogram_edges": [float(edges[0]), float(edges[-1])]}
 
 
+def _system_stats() -> Dict[str, Any]:
+    """Per-iteration system/memory stats (reference
+    ``BaseStatsListener.java:286-307``: JVM current/max memory, off-heap, GC
+    count+time per collector). Here: host RSS + peak, device HBM in-use/limit
+    (when the backend reports ``memory_stats``), and Python GC collection
+    counts standing in for the JVM GC counters."""
+    import gc
+    import resource
+
+    out: Dict[str, Any] = {}
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    # ru_maxrss is KiB on Linux
+    out["host_peak_rss_bytes"] = int(ru.ru_maxrss) * 1024
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        out["host_rss_bytes"] = pages * 4096
+    except OSError:
+        out["host_rss_bytes"] = out["host_peak_rss_bytes"]
+    try:
+        import jax
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            out["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+            out["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
+            out["device_peak_bytes_in_use"] = int(
+                ms.get("peak_bytes_in_use", 0))
+    except Exception:
+        pass  # CPU backends may not report memory stats
+    out["gc_collections"] = [s.get("collections", 0) for s in gc.get_stats()]
+    out["gc_collected"] = [s.get("collected", 0) for s in gc.get_stats()]
+    return out
+
+
 class StatsReport:
     """One iteration's stats (reference ``StatsReport``/SBE payload)."""
 
     def __init__(self, session_id: str, worker_id: str, iteration: int,
                  timestamp: float, score: float,
                  param_stats: Dict[str, Dict], update_stats: Dict[str, Dict],
-                 duration_ms: float, memory_bytes: Optional[int] = None):
+                 duration_ms: float, memory_bytes: Optional[int] = None,
+                 system: Optional[Dict[str, Any]] = None,
+                 activations: Optional[Dict[str, Any]] = None):
         self.session_id = session_id
         self.worker_id = worker_id
         self.iteration = iteration
@@ -53,6 +89,8 @@ class StatsReport:
         self.update_stats = update_stats
         self.duration_ms = duration_ms
         self.memory_bytes = memory_bytes
+        self.system = system
+        self.activations = activations
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -203,14 +241,51 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  session_id: Optional[str] = None, worker_id: str = "worker0",
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True,
+                 collect_system: bool = True,
+                 activation_probe=None, activation_frequency: int = 10,
+                 activation_max_channels: int = 16):
+        """``collect_system``: per-iteration memory/GC stats (reference
+        ``BaseStatsListener.java:286-307`` system tab data).
+        ``activation_probe``: optional features batch; every
+        ``activation_frequency`` reports, the model runs it forward and the
+        first convolutional activation map of example 0 is stored
+        (downsampled to ``activation_max_channels`` channels) — the
+        reference train-UI's convolutional-activations view
+        (``module/train/TrainModule.java``)."""
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or f"session_{int(time.time() * 1e3)}"
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
+        self.collect_system = collect_system
+        self.activation_probe = activation_probe
+        self.activation_frequency = max(1, activation_frequency)
+        self.activation_max_channels = activation_max_channels
         self._last_time = None
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._reports = 0
+
+    def _conv_activations(self, model):
+        """First rank-4 (conv) activation of example 0 on the probe batch →
+        {"layer", "grids": [[rows]...]} per channel."""
+        probe = np.asarray(self.activation_probe)
+        acts = model.feed_forward(probe)
+        if isinstance(acts, dict):
+            # CG: skip the graph inputs, keep layer/vertex activations
+            inputs = set(getattr(model.conf, "network_inputs", ()))
+            items = ((k, v) for k, v in acts.items() if k not in inputs)
+        else:
+            # MLN list starts with the input itself — skip it
+            items = ((str(i), a) for i, a in enumerate(acts[1:]))
+        for name, a in items:
+            a = np.asarray(a)
+            if a.ndim == 4:  # NHWC
+                grids = [a[0, :, :, c].tolist()
+                         for c in range(min(a.shape[-1],
+                                            self.activation_max_channels))]
+                return {"layer": name, "grids": grids}
+        return None
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.frequency != 0:
@@ -230,7 +305,13 @@ class StatsListener(TrainingListener):
                 updates[name] = (_array_stats(delta) if self.collect_histograms
                                  else {"norm2": float(np.linalg.norm(delta))})
         self._prev_params = {k: np.asarray(v).copy() for k, v in table.items()}
+        system = _system_stats() if self.collect_system else None
+        activations = None
+        if (self.activation_probe is not None
+                and self._reports % self.activation_frequency == 0):
+            activations = self._conv_activations(model)
+        self._reports += 1
         report = StatsReport(self.session_id, self.worker_id, int(iteration),
                              time.time(), float(score), params, updates,
-                             duration)
+                             duration, system=system, activations=activations)
         self.storage.put_update(report)

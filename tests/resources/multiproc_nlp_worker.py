@@ -1,0 +1,57 @@
+"""Worker for the 2-process distributed NLP test (VERDICT r2 item 3).
+
+Each process gets the same corpus, trains DistributedWord2Vec (map-partition
+skip-gram + cross-process vector averaging — reference
+``FirstIterationFunction.java`` / ``Word2Vec.java:237``) and DistributedGlove
+(partitioned co-occurrence counting merged cluster-wide — reference
+``glove/count/``), then dumps the resulting tables for the parent test to
+compare across processes and against the single-process model.
+
+Usage: python multiproc_nlp_worker.py <process_id> <num_processes> <port> <outdir>
+"""
+import sys
+import os
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), sys.argv[4])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from deeplearning4j_tpu.parallel import initialize_distributed
+
+initialize_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                       process_id=pid)
+assert jax.process_count() == nproc
+
+import numpy as np
+from deeplearning4j_tpu.nlp import DistributedWord2Vec, DistributedGlove
+
+# two topical clusters; related words co-occur, unrelated never do
+corpus = []
+for i in range(30):
+    corpus.append(f"cat dog pet animal fur cat dog tail {i % 3}")
+    corpus.append(f"stock market trade price index stock market fund {i % 3}")
+
+dw = DistributedWord2Vec(vector_length=24, window=3, epochs=3, seed=7,
+                         min_word_frequency=1, learning_rate=0.05,
+                         batch_size=256)
+dw.fit(corpus)
+np.save(os.path.join(outdir, f"w2v_syn0_{pid}.npy"),
+        np.asarray(dw.lookup_table.syn0))
+sim_related = dw.similarity("cat", "dog")
+sim_unrelated = dw.similarity("cat", "market")
+
+dg = DistributedGlove(vector_length=16, window=3, epochs=20, seed=7,
+                      min_word_frequency=1)
+dg.fit(corpus)
+np.save(os.path.join(outdir, f"glove_syn0_{pid}.npy"), dg.syn0)
+
+with open(os.path.join(outdir, f"nlp_result_{pid}.txt"), "w") as fh:
+    fh.write(f"{sim_related} {sim_unrelated}\n")
+print(f"proc {pid}: sim(cat,dog)={sim_related:.3f} "
+      f"sim(cat,market)={sim_unrelated:.3f}")

@@ -136,3 +136,57 @@ def test_sequence_record_reader_padding(tmp_path):
     assert ds.labels.shape == (2, 3, 2)
     np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 0, 0]])
     np.testing.assert_allclose(ds.features[1, 0], [7.0, 8.0])
+
+
+def test_lfw_tinyimagenet_fetchers_and_synthetic_flag(tmp_path, caplog):
+    """LFW/TinyImageNet fetchers (VERDICT r2 item 10): local-or-synthetic
+    pattern, NCHW shapes, and the loud synthetic marker on DataSets."""
+    import logging
+    from deeplearning4j_tpu.datasets.impl import (LFWDataSetIterator,
+                                                  TinyImageNetDataSetIterator)
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.datasets.fetchers"):
+        it = LFWDataSetIterator(batch=8, num_examples=16, image_size=32,
+                                num_synthetic=16)
+    assert any("SYNTHETIC" in r.message for r in caplog.records)
+    ds = next(it)
+    assert ds.synthetic is True
+    assert ds.features.shape == (8, 3, 32, 32)
+    assert ds.labels.shape[1] == it.fetcher.num_classes
+
+    tin = TinyImageNetDataSetIterator(batch=4, num_examples=8, num_synthetic=8)
+    ds2 = next(tin)
+    assert ds2.synthetic is True
+    assert ds2.features.shape == (4, 3, 64, 64)
+    assert ds2.labels.shape == (4, 200)
+
+
+def test_image_folder_fetcher_reads_local_files(tmp_path, monkeypatch):
+    """With real class folders on disk, the fetchers read images (not
+    synthetic) and the DataSet flag stays False."""
+    from PIL import Image
+    import numpy as np
+    base = tmp_path / "lfw"
+    for person in ("alice", "bob"):
+        d = base / person
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = (np.random.default_rng(i).random((40, 40, 3)) * 255
+                   ).astype("uint8")
+            Image.fromarray(arr).save(d / f"img_{i}.jpg")
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    from deeplearning4j_tpu.datasets.impl import LFWDataSetIterator
+    it = LFWDataSetIterator(batch=6, image_size=24)
+    ds = next(it)
+    assert ds.synthetic is False
+    assert ds.features.shape == (6, 3, 24, 24)
+    assert ds.labels.shape == (6, 2)
+    assert it.fetcher.class_names == ["alice", "bob"]
+
+
+def test_mnist_synthetic_flag_propagates():
+    from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+    it = MnistDataSetIterator(batch=32, num_examples=64)
+    ds = next(it)
+    # zero-egress environment: no local MNIST → synthetic and flagged
+    assert ds.synthetic == it.fetcher.is_synthetic

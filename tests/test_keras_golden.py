@@ -1,0 +1,183 @@
+"""Golden-file Keras import tests (VERDICT r2 item 5).
+
+Unlike ``test_keras_import.py``'s synthetic h5 files, the fixtures under
+``tests/resources/keras/`` are COMMITTED binaries written by real Keras
+(tf.keras 3.x legacy-HDF5 save) together with Keras's own ``predict`` outputs
+— the reference's golden-file pattern (23 suites under
+``deeplearning4j-modelimport/src/test``). The import must reproduce Keras's
+outputs on the stored probe inputs.
+
+Covers: Sequential CNN (Conv/BN/MaxPool/Flatten/Dense), functional
+inception-style branches (Concatenate + GlobalAveragePooling — feeds the
+BASELINE.md Keras-import benchmark config), LSTM + TimeDistributed(Dense),
+the Keras-1 config dialect, and the custom-layer SPI
+(reference ``KerasLayerConfiguration.java:43-71`` dual naming,
+``keras/layers/custom/``).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.model_import import (KerasModelImport,
+                                                   register_custom_layer)
+
+RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "resources",
+                   "keras")
+
+
+def _nchw(x):
+    return np.transpose(x, (0, 3, 1, 2))
+
+
+def test_golden_sequential_cnn_matches_keras_predict():
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        os.path.join(RES, "seq_cnn.h5"))
+    x = np.load(os.path.join(RES, "seq_cnn_in.npy"))
+    want = np.load(os.path.join(RES, "seq_cnn_out.npy"))
+    got = np.asarray(net.output(_nchw(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_golden_functional_inception_matches_keras_predict():
+    net = KerasModelImport.import_keras_model_and_weights(
+        os.path.join(RES, "functional_inception.h5"))
+    x = np.load(os.path.join(RES, "functional_inception_in.npy"))
+    want = np.load(os.path.join(RES, "functional_inception_out.npy"))
+    got = np.asarray(net.output(_nchw(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_golden_lstm_timedistributed_matches_keras_predict():
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        os.path.join(RES, "lstm_td.h5"))
+    x = np.load(os.path.join(RES, "lstm_td_in.npy"))
+    want = np.load(os.path.join(RES, "lstm_td_out.npy"))
+    got = np.asarray(net.output(x))  # [b, T, f] both sides
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ Keras 1 dialect
+def _write_keras1_h5(path):
+    """A Keras-1-dialect model file: old class names (Convolution2D), old
+    keys (nb_filter/nb_row/nb_col/subsample/border_mode/output_dim/p), config
+    as a bare list (pre-'layers' nesting). Weights use the classic
+    <name>/<name>_W:0 naming."""
+    import h5py
+    rng = np.random.default_rng(7)
+    cW = rng.normal(scale=0.2, size=(3, 3, 1, 2)).astype(np.float32)
+    cb = np.zeros(2, np.float32)
+    dW = rng.normal(scale=0.2, size=(2 * 4 * 4, 3)).astype(np.float32)
+    db = np.zeros(3, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Convolution2D",
+             "config": {"name": "conv", "nb_filter": 2, "nb_row": 3,
+                        "nb_col": 3, "subsample": [1, 1],
+                        "border_mode": "same", "activation": "relu",
+                        "batch_input_shape": [None, 4, 4, 1]}},
+            {"class_name": "Dropout", "config": {"name": "drop", "p": 0.5}},
+            {"class_name": "Flatten", "config": {"name": "flat"}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "output_dim": 3,
+                        "activation": "softmax"}},
+        ],
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("conv")
+        g.attrs["weight_names"] = np.asarray(["conv/conv_W:0", "conv/conv_b:0"],
+                                             dtype=object)
+        g["conv/conv_W:0"] = cW
+        g["conv/conv_b:0"] = cb
+        d = mw.create_group("fc")
+        d.attrs["weight_names"] = np.asarray(["fc/fc_W:0", "fc/fc_b:0"],
+                                             dtype=object)
+        d["fc/fc_W:0"] = dW
+        d["fc/fc_b:0"] = db
+    return cW, cb, dW, db
+
+
+def test_keras1_dialect_sequential(tmp_path):
+    path = str(tmp_path / "keras1.h5")
+    cW, cb, dW, db = _write_keras1_h5(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    # conv weights landed (HWIO straight copy)
+    np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), cW)
+    x = np.random.default_rng(1).normal(size=(2, 1, 4, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+    # independent forward check: conv(relu) → flatten → dense softmax
+    from scipy.signal import correlate  # noqa: F401  (not used; manual conv)
+    # manual conv 'same' on 4x4x1 with HWIO kernel
+    xp = np.pad(x[:, 0], ((0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((2, 4, 4, 2), np.float32)
+    for i in range(4):
+        for j in range(4):
+            patch = xp[:, i:i + 3, j:j + 3]
+            conv[:, i, j, :] = np.tensordot(patch, cW[:, :, 0, :], ([1, 2], [0, 1]))
+    conv = np.maximum(conv + cb, 0)
+    logits = conv.reshape(2, -1) @ dW + db
+    want = np.exp(logits - logits.max(1, keepdims=True))
+    want /= want.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ custom layer SPI
+def test_custom_layer_registration(tmp_path):
+    """register_custom_layer maps an unknown Keras class and installs its
+    weights (reference custom-layer seam, ``keras/layers/custom/``)."""
+    import h5py
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+
+    calls = {}
+
+    def map_mylayer(cfg):
+        calls["cfg"] = cfg
+        return DenseLayer(n_out=int(cfg["units"]), activation="tanh")
+
+    def set_mylayer_weights(params, state, weights):
+        calls["weights"] = sorted(weights)
+        params["W"] = weights["alpha"]
+        params["b"] = weights["beta"]
+
+    register_custom_layer("MyProjection", map_mylayer, set_mylayer_weights)
+
+    W = np.random.default_rng(3).normal(size=(6, 4)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "MyProjection",
+             "config": {"name": "proj", "units": 4,
+                        "batch_input_shape": [None, 6]}},
+            {"class_name": "Dense",
+             "config": {"name": "out", "units": 2, "activation": "softmax"}},
+        ],
+    }
+    path = str(tmp_path / "custom.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("proj")
+        g.attrs["weight_names"] = np.asarray(["proj/alpha", "proj/beta"],
+                                             dtype=object)
+        g["proj/alpha"] = W
+        g["proj/beta"] = b
+        d = mw.create_group("out")
+        d.attrs["weight_names"] = np.asarray(["out/kernel", "out/bias"],
+                                             dtype=object)
+        d["out/kernel"] = np.random.default_rng(4).normal(
+            size=(4, 2)).astype(np.float32)
+        d["out/bias"] = np.zeros(2, np.float32)
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    assert calls["cfg"]["units"] == 4
+    assert calls["weights"] == ["alpha", "beta"]
+    np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), W)
+    out = np.asarray(net.output(np.zeros((1, 6), np.float32)))
+    assert out.shape == (1, 2)

@@ -53,3 +53,86 @@ def test_two_process_training_identical_params(tmp_path):
     assert r0[3] == "1" and r1[3] == "0"  # exactly one chief
     # 8 batches / (2 procs × 2 local devices) = 2 steps/epoch × 4 epochs
     assert int(r0[2]) == 8
+
+
+def test_two_process_distributed_nlp(tmp_path):
+    """Distributed Word2Vec/GloVe (VERDICT r2 item 3): 2 processes partition
+    the corpus, train, and must produce identical vectors on both hosts; the
+    GloVe result must equal the single-process model exactly (merged
+    partition counts == full-corpus counts)."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "resources", "multiproc_nlp_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"nlp worker failed:\n{out[-4000:]}"
+
+    w0 = np.load(tmp_path / "w2v_syn0_0.npy")
+    w1 = np.load(tmp_path / "w2v_syn0_1.npy")
+    np.testing.assert_array_equal(w0, w1)  # averaged tables bit-identical
+
+    g0 = np.load(tmp_path / "glove_syn0_0.npy")
+    g1 = np.load(tmp_path / "glove_syn0_1.npy")
+    np.testing.assert_array_equal(g0, g1)
+
+    # similarity sanity (the checks test_nlp.py applies to single-host w2v)
+    r0 = (tmp_path / "nlp_result_0.txt").read_text().split()
+    r1 = (tmp_path / "nlp_result_1.txt").read_text().split()
+    assert r0 == r1
+    sim_related, sim_unrelated = float(r0[0]), float(r0[1])
+    assert sim_related > sim_unrelated, \
+        "co-occurring words must embed closer than unrelated ones"
+
+    # distributed GloVe == single-process GloVe on the same corpus
+    from deeplearning4j_tpu.nlp import Glove
+    corpus = []
+    for i in range(30):
+        corpus.append(f"cat dog pet animal fur cat dog tail {i % 3}")
+        corpus.append(f"stock market trade price index stock market fund {i % 3}")
+    ref = Glove(vector_length=16, window=3, epochs=20, seed=7,
+                min_word_frequency=1)
+    ref.fit(corpus)
+    np.testing.assert_allclose(g0, ref.syn0, rtol=0, atol=0)
+
+
+def test_shared_gradients_real_wire(tmp_path):
+    """SHARED_GRADIENTS across a real process boundary (VERDICT r2 item 4):
+    the threshold-ENCODED update is what crosses the TCP wire; replicas stay
+    bit-identical after decode+apply and the wire carries fewer bytes than
+    the dense update."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "resources", "multiproc_wire_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"wire worker failed:\n{out[-4000:]}"
+
+    p0 = np.load(tmp_path / "wire_params_0.npy")
+    p1 = np.load(tmp_path / "wire_params_1.npy")
+    np.testing.assert_array_equal(p0, p1)  # bit-identical replicas
+
+    r0 = (tmp_path / "wire_result_0.txt").read_text().split()
+    r1 = (tmp_path / "wire_result_1.txt").read_text().split()
+    s0, s1 = float(r0[0]), float(r0[1])
+    assert s1 < s0, "wire-coupled training must converge"
+    assert r0[0] == r1[0] and r0[1] == r1[1]
+    wire, dense = int(r0[2]), int(r0[3])
+    assert 0 < wire < dense  # compression is real on the wire

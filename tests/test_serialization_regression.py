@@ -1,0 +1,36 @@
+"""On-disk model-format regression test (VERDICT r2 item 9b).
+
+The reference commits models serialized by old releases and asserts current
+code still loads them (``deeplearning4j-core/src/test/java/org/deeplearning4j/
+regressiontest/RegressionTest080.java:1``). Same pattern here: the zip under
+``tests/resources/`` was written by the round-3 build (config JSON +
+coefficients + updater state — ``util/ModelSerializer.java:39-41`` layout) and
+is COMMITTED, never regenerated. If this test fails after a serde change, the
+change broke backward compatibility with saved models — add a migration, do
+not regenerate the fixture.
+"""
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+
+RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "resources")
+
+
+def test_frozen_model_zip_restores_bit_exact():
+    net = ModelSerializer.restore_multi_layer_network(
+        os.path.join(RES, "regression_model_r3.zip"))
+    want_params = np.load(os.path.join(RES, "regression_model_r3_params.npy"))
+    np.testing.assert_array_equal(net.params_flat(), want_params)
+
+    # restored network reproduces the recorded inference outputs exactly
+    probe = np.load(os.path.join(RES, "regression_model_r3_probe.npy"))
+    want_out = np.load(os.path.join(RES, "regression_model_r3_output.npy"))
+    got = np.asarray(net.output(probe))
+    np.testing.assert_allclose(got, want_out, rtol=1e-5, atol=1e-6)
+
+    # structural expectations pinned against the frozen config JSON
+    assert len(net.conf.layers) == 5
+    assert net.conf.global_conf.seed == 424242
+    assert net.updater_state is not None  # updater state round-tripped

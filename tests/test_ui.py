@@ -94,3 +94,105 @@ def test_ui_server_endpoints_and_remote_post():
         assert "remote_session" in storage.list_session_ids()
     finally:
         server.stop()
+
+
+def test_system_stats_collected_and_served():
+    """System tab data (VERDICT r2 item 7; reference
+    BaseStatsListener.java:286-307): per-iteration host/device memory + GC
+    counters, served by /train/system."""
+    storage = InMemoryStatsStorage()
+    _train_with(storage, iters=3)
+    ups = storage.get_all_updates("s1")
+    assert all(u.system is not None for u in ups)
+    s = ups[-1].system
+    assert s["host_rss_bytes"] > 0
+    assert s["host_peak_rss_bytes"] >= s["host_rss_bytes"] // 2
+    assert isinstance(s["gc_collections"], list) and s["gc_collections"]
+
+    srv = UIServer(port=0)
+    srv.attach(storage)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/system?sid=s1") as r:
+            data = json.loads(r.read())
+        assert data["iterations"] == [u.iteration for u in ups]
+        assert all(v > 0 for v in data["host_rss_bytes"])
+    finally:
+        srv.stop()
+
+
+def test_tsne_tab_upload_and_fetch():
+    """t-SNE tab (reference tsne UI module): coordinates from
+    clustering.tsne published to the server and fetched back."""
+    from deeplearning4j_tpu.clustering.tsne import Tsne
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(0, 0.2, size=(10, 6)),
+                        rng.normal(4, 0.2, size=(10, 6))]).astype(np.float32)
+    coords = Tsne(n_components=2, n_iter=30, perplexity=5.0, seed=3).fit_transform(x)
+    assert coords.shape == (20, 2)
+    srv = UIServer(port=0)
+    srv.attach(InMemoryStatsStorage())
+    port = srv.start()
+    try:
+        srv.upload_tsne(coords, labels=[0] * 10 + [1] * 10)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tsne/coords") as r:
+            data = json.loads(r.read())
+        assert len(data["coords"]) == 20 and len(data["labels"]) == 20
+        # remote POST path too
+        body = json.dumps({"coords": [[0, 0], [1, 1]], "labels": ["a", "b"]})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/tsne/upload", data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tsne/coords") as r:
+            data = json.loads(r.read())
+        assert data["labels"] == ["a", "b"]
+    finally:
+        srv.stop()
+
+
+def test_conv_activations_endpoint():
+    """Convolutional-activations view (reference TrainModule): the listener
+    probes the first conv layer's activation maps; /train/activations serves
+    the grid."""
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   SubsamplingLayer)
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Sgd(learning_rate=0.05)).activation("relu")
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3)))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    probe = rng.normal(size=(2, 1, 8, 8)).astype(np.float32)
+    storage = InMemoryStatsStorage()
+    lst = StatsListener(storage, session_id="sconv", activation_probe=probe,
+                        activation_frequency=1)
+    net.set_listeners(lst)
+    ds = DataSet(rng.normal(size=(8, 1, 8, 8)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+    net.fit(ds)
+    ups = storage.get_all_updates("sconv")
+    assert ups[-1].activations is not None
+    assert len(ups[-1].activations["grids"]) == 3  # one per channel
+    assert len(ups[-1].activations["grids"][0]) == 6  # 8-3+1 valid conv
+
+    srv = UIServer(port=0)
+    srv.attach(storage)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/activations?sid=sconv") as r:
+            data = json.loads(r.read())
+        assert data["grids"] and data["layer"] is not None
+    finally:
+        srv.stop()
