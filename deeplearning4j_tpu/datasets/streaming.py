@@ -1,0 +1,314 @@
+"""Streaming ingestion/serving: NDArray pub/sub + streaming iterators.
+
+TPU-native equivalent of reference ``dl4j-streaming`` (SURVEY.md §2.4
+"Streaming", 1537 LoC): ``NDArrayKafkaClient``/``NDArrayPublisher``/
+``NDArrayConsumer`` (``streaming/kafka/``), the record→NDArray/DataSet
+conversion functions (``streaming/conversion/``) and the Camel serving route
+(``routes/DL4jServeRouteBuilder.java`` — consume features, run the model,
+publish predictions).
+
+Kafka/Camel are JVM-era infrastructure; the seam that matters is *arrays in
+flight feeding training/serving*. Here:
+
+ - :class:`NDArrayMessage` — little-endian wire codec for numpy arrays
+   (dtype tag + rank + dims + raw bytes), the counterpart of the reference's
+   base64 NDArray payloads (``conversion/NDArrayType``).
+ - :class:`StreamingBroker` — in-process topic broker over TCP with the
+   length-prefixed framing of ``parallel/transport.py``; plays the embedded
+   Kafka role of the reference's tests. A real deployment would point the
+   publisher/consumer at any broker speaking the same framing.
+ - :class:`NDArrayPublisher` / :class:`NDArrayConsumer` — publish/subscribe
+   numpy arrays (tuples of arrays = one message with multiple parts,
+   matching ``publish(INDArray[])``).
+ - :class:`StreamingDataSetIterator` — adapts a consumer of
+   (features, labels) messages into the ``DataSetIterator`` seam, so
+   ``net.fit`` trains straight off the stream with the existing async
+   prefetch machinery.
+ - :class:`ServingRoute` — the DL4jServeRouteBuilder equivalent: consume
+   feature arrays from one topic, run ``net.output``, publish predictions to
+   another.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import DataSet, DataSetIterator
+
+__all__ = ["NDArrayMessage", "StreamingBroker", "NDArrayPublisher",
+           "NDArrayConsumer", "StreamingDataSetIterator", "ServingRoute"]
+
+
+# ------------------------------------------------------------------ wire codec
+class NDArrayMessage:
+    """Multi-part numpy array wire codec. Frame = u32 part count, then per
+    part: u8 dtype tag, u8 rank, u64 dims[rank], raw bytes."""
+
+    _DTYPES = [np.dtype(np.float32), np.dtype(np.float64),
+               np.dtype(np.int32), np.dtype(np.int64),
+               np.dtype(np.uint8), np.dtype(np.bool_), np.dtype(np.float16),
+               np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.uint16),
+               np.dtype(np.uint32), np.dtype(np.uint64)]
+    _TAG = {d: i for i, d in enumerate(_DTYPES)}
+
+    @classmethod
+    def encode(cls, arrays: Sequence[np.ndarray]) -> bytes:
+        if isinstance(arrays, np.ndarray):
+            arrays = [arrays]
+        out = [struct.pack("<I", len(arrays))]
+        for a in arrays:
+            a = np.asarray(a)
+            if a.ndim and not a.flags["C_CONTIGUOUS"]:
+                # ascontiguousarray only when needed: it promotes 0-d arrays
+                # to 1-d, breaking scalar round-trips
+                a = np.ascontiguousarray(a)
+            if a.dtype not in cls._TAG:
+                # a wire codec must not silently change dtype
+                raise ValueError(f"NDArrayMessage: unsupported dtype "
+                                 f"{a.dtype}; supported: "
+                                 f"{[str(d) for d in cls._DTYPES]}")
+            out.append(struct.pack("<BB", cls._TAG[a.dtype], a.ndim))
+            out.append(struct.pack(f"<{max(a.ndim, 1)}q",
+                                   *(a.shape or (a.size,))))
+            out.append(a.tobytes())
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> List[np.ndarray]:
+        (n,) = struct.unpack_from("<I", data, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            tag, rank = struct.unpack_from("<BB", data, off)
+            off += 2
+            dims = struct.unpack_from(f"<{max(rank, 1)}q", data, off)
+            off += 8 * max(rank, 1)
+            dt = cls._DTYPES[tag]
+            count = int(np.prod(dims[:rank])) if rank else int(dims[0])
+            nbytes = count * dt.itemsize
+            arr = np.frombuffer(data[off:off + nbytes], dt)
+            off += nbytes
+            # rank 0 round-trips to a scalar shape (), not (1,)
+            out.append(arr.reshape(dims[:rank] if rank else ()))
+        return out
+
+
+def _send_frame(sock: socket.socket, payload: bytes):
+    sock.sendall(struct.pack("<q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<q", header)
+    return _recv_exact(sock, n)
+
+
+# ---------------------------------------------------------------------- broker
+class StreamingBroker:
+    """Topic broker: clients send ``SUB <topic>`` or ``PUB <topic>`` control
+    frames, then publishers stream message frames which the broker fans out
+    to every subscriber of that topic (at-most-once, the reference test
+    cluster's semantics)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.address = f"{host}:{self._srv.getsockname()[1]}"
+        self._subs: Dict[str, List[socket.socket]] = {}
+        # per-subscriber send locks: two publishers on one topic fan out from
+        # different threads, and interleaved sendall() on the same socket
+        # would corrupt the subscriber's frame stream
+        self._send_locks: Dict[socket.socket, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                s, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client_loop, args=(s,),
+                             daemon=True).start()
+
+    def _client_loop(self, s: socket.socket):
+        hello = _recv_frame(s)
+        if hello is None:
+            s.close()
+            return
+        mode, _, topic = hello.decode("utf-8").partition(" ")
+        if mode == "SUB":
+            with self._lock:
+                self._subs.setdefault(topic, []).append(s)
+                self._send_locks[s] = threading.Lock()
+            return  # frames are pushed by publishers; socket stays open
+        while True:  # PUB
+            frame = _recv_frame(s)
+            if frame is None:
+                s.close()
+                return
+            with self._lock:
+                targets = [(t, self._send_locks[t])
+                           for t in self._subs.get(topic, ())]
+            for t, lock in targets:
+                try:
+                    with lock:
+                        _send_frame(t, frame)
+                except OSError:
+                    with self._lock:
+                        if t in self._subs.get(topic, ()):
+                            self._subs[topic].remove(t)
+                        self._send_locks.pop(t, None)
+
+    def close(self):
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for socks in self._subs.values():
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+
+def _connect(address: str) -> socket.socket:
+    host, _, port = address.rpartition(":")
+    return socket.create_connection((host, int(port)), timeout=30.0)
+
+
+class NDArrayPublisher:
+    """Reference ``NDArrayPublisher`` (``streaming/kafka/NDArrayPublisher.java:23``,
+    ``publish(INDArray)``/``publish(INDArray[])``)."""
+
+    def __init__(self, address: str, topic: str):
+        self._sock = _connect(address)
+        _send_frame(self._sock, f"PUB {topic}".encode("utf-8"))
+
+    def publish(self, arrays):
+        _send_frame(self._sock, NDArrayMessage.encode(arrays))
+
+    def close(self):
+        self._sock.close()
+
+
+class NDArrayConsumer:
+    """Reference ``NDArrayConsumer``: blocking array receive from a topic."""
+
+    def __init__(self, address: str, topic: str, timeout: float = 30.0):
+        self._sock = _connect(address)
+        self._sock.settimeout(timeout)
+        _send_frame(self._sock, f"SUB {topic}".encode("utf-8"))
+
+    def receive(self) -> Optional[List[np.ndarray]]:
+        """Next message's arrays; None only on CLEAN stream close. A stalled
+        producer raises TimeoutError and a dropped connection raises
+        ConnectionError — silently treating either as end-of-stream would let
+        training finish "successfully" on a truncated stream."""
+        try:
+            frame = _recv_frame(self._sock)
+        except socket.timeout:
+            raise TimeoutError(
+                f"no message within {self._sock.gettimeout()}s — producer "
+                f"stalled? (pass a larger timeout for slow producers)")
+        except OSError as e:
+            raise ConnectionError(f"stream connection lost: {e}") from e
+        return None if frame is None else NDArrayMessage.decode(frame)
+
+    getINDArray = receive
+
+    def close(self):
+        self._sock.close()
+
+
+# ------------------------------------------------------------------- iterators
+class StreamingDataSetIterator(DataSetIterator):
+    """DataSetIterator over an array stream: each message is (features,
+    labels[, features_mask, labels_mask]). ``num_batches`` bounds the stream
+    (None → iterate until the producer closes). The conversion-function role
+    of the reference's ``streaming/conversion`` is the optional ``convert``
+    hook mapping raw message parts to a DataSet."""
+
+    def __init__(self, consumer: NDArrayConsumer,
+                 num_batches: Optional[int] = None,
+                 convert: Optional[Callable[[List[np.ndarray]], DataSet]] = None):
+        self.consumer = consumer
+        self.num_batches = num_batches
+        self.convert = convert
+        self._seen = 0
+
+    def __next__(self) -> DataSet:
+        if self.num_batches is not None and self._seen >= self.num_batches:
+            raise StopIteration
+        parts = self.consumer.receive()
+        if parts is None:
+            raise StopIteration
+        self._seen += 1
+        if self.convert is not None:
+            return self.convert(parts)
+        return DataSet(*parts[:4])
+
+    def reset(self):
+        self._seen = 0  # a stream cannot rewind; counting restarts
+
+    def async_supported(self):
+        return True  # prefetch thread overlaps H2D with the network
+
+
+class ServingRoute:
+    """Reference ``routes/DL4jServeRouteBuilder.java``: consume feature
+    arrays, run the model, publish predictions. Runs on a daemon thread;
+    ``serve_forever=False`` processes ``max_messages`` then returns."""
+
+    def __init__(self, net, consumer: NDArrayConsumer,
+                 publisher: NDArrayPublisher):
+        self.net = net
+        self.consumer = consumer
+        self.publisher = publisher
+        self.served = 0
+
+    def run(self, max_messages: Optional[int] = None):
+        is_graph = hasattr(self.net, "_as_multi")  # ComputationGraph
+        while max_messages is None or self.served < max_messages:
+            parts = self.consumer.receive()
+            if parts is None:
+                return
+            if is_graph:
+                out = self.net.output(*parts)   # multi-input graphs
+            elif len(parts) > 1:
+                # MLN: (features, mask) message shape
+                out = self.net.output(parts[0], mask=parts[1])
+            else:
+                out = self.net.output(parts[0])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self.publisher.publish([np.asarray(o) for o in outs])
+            self.served += 1
+
+    def start(self, max_messages: Optional[int] = None) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(max_messages,),
+                             daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,61 @@
+"""TPU pod provisioning tests (reference ``deeplearning4j-aws`` module:
+Ec2BoxCreator / HostProvisioner / ClusterSetup / S3 staging — command
+construction tested without cloud access, as the reference does)."""
+from deeplearning4j_tpu.provision import (TpuPodConfig, TpuPodProvisioner,
+                                          HostProvisioner, GcsStager,
+                                          ClusterSetup)
+
+
+def _cfg(**kw):
+    return TpuPodConfig(name="bench-pod", zone="us-east5-b", **kw)
+
+
+def test_create_delete_commands():
+    p = TpuPodProvisioner(_cfg(project="proj-1", preemptible=True,
+                               tags={"team": "ml"}))
+    cmd = p.create_command()
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "bench-pod" in cmd and "--accelerator-type" in cmd
+    assert cmd[cmd.index("--accelerator-type") + 1] == "v5litepod-16"
+    assert "--preemptible" in cmd
+    assert cmd[cmd.index("--labels") + 1] == "team=ml"
+    d = p.delete_command()
+    assert "delete" in d and "--quiet" in d
+
+
+def test_host_provisioner_fans_out_to_all_workers():
+    hosts = HostProvisioner(TpuPodProvisioner(_cfg()))
+    cmd = hosts.run_command("pip install -e .")
+    assert "--worker" in cmd and cmd[cmd.index("--worker") + 1] == "all"
+    assert cmd[cmd.index("--command") + 1] == "pip install -e ."
+    up = hosts.upload_command("train.py", "/tmp/train.py")
+    assert "scp" in up and "bench-pod:/tmp/train.py" in up
+
+
+def test_gcs_stager_commands():
+    s = GcsStager("gs://my-bucket/data")
+    up = s.upload_command("/local/imagenet", "imagenet")
+    assert up[-1] == "gs://my-bucket/data/imagenet"
+    down = s.download_command("imagenet", "/local/imagenet")
+    assert down[-2] == "gs://my-bucket/data/imagenet"
+
+
+def test_cluster_setup_plan_is_symmetric():
+    """No parameter-server role: one identical launch command on all workers
+    (multi-controller SPMD replaces the reference's ClusterSetup role split)."""
+    plan = ClusterSetup(TpuPodProvisioner(_cfg()),
+                        train_script="train.py",
+                        env={"JAX_PLATFORMS": "tpu"}).plan()
+    assert len(plan) == 3
+    assert "create" in plan[0]
+    assert any("train.py" in part for part in plan[1])
+    launch = plan[2][plan[2].index("--command") + 1]
+    assert launch == "JAX_PLATFORMS=tpu python3 train.py"
+
+
+def test_runner_injection_executes_commands():
+    calls = []
+    p = TpuPodProvisioner(_cfg(), runner=lambda cmd: calls.append(cmd) or "ok")
+    assert p.create(run=True) == "ok"
+    assert p.delete(run=True) == "ok"
+    assert calls[0][4] == "create" and calls[1][4] == "delete"

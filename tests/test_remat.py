@@ -1,0 +1,64 @@
+"""Remat policy pin (GlobalConfig.remat): forced rematerialization must be a
+schedule change only — losses bit-identical to the default path — and the
+named-saveable tags must be inert when remat is off."""
+import numpy as np
+import jax.numpy as jnp
+import jax
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                InputType, DataSet, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                               BatchNormalization,
+                                               SubsamplingLayer, DenseLayer,
+                                               OutputLayer)
+
+
+def _net(remat):
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater(Sgd(learning_rate=0.05)).activation("relu")
+            .remat(remat)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_remat_on_equals_off_bitwise():
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(8, 1, 10, 10)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    losses = {}
+    params = {}
+    for mode in ("off", "on"):
+        net = _net(mode)
+        for _ in range(3):
+            net.fit(DataSet(f, l))
+        losses[mode] = float(net.score_)
+        params[mode] = np.asarray(net.params_flat())
+    assert losses["off"] == losses["on"]
+    np.testing.assert_array_equal(params["off"], params["on"])
+
+
+def test_remat_auto_excludes_recurrent():
+    from deeplearning4j_tpu.nn.layers.base import remat_enabled
+    net_conv = _net("auto")
+    assert remat_enabled(net_conv.gc, net_conv.impls)
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer, Bidirectional
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd())
+            .remat("auto").list()
+            .layer(ConvolutionLayer(n_in=4, n_out=4, kernel_size=(1, 1)))
+            .layer(Bidirectional(inner=LSTM(n_in=4, n_out=4)))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    # conv present but a WRAPPED recurrent layer must disable auto remat
+    # (the unwrap path looks through Bidirectional's .inner)
+    from deeplearning4j_tpu.nn.layers import impl_for
+    assert not remat_enabled(conf.global_conf,
+                             [impl_for(conf.layers[0], conf.global_conf),
+                              impl_for(conf.layers[1], conf.global_conf)])
