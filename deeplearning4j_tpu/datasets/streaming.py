@@ -97,26 +97,15 @@ class NDArrayMessage:
         return out
 
 
-def _send_frame(sock: socket.socket, payload: bytes):
-    sock.sendall(struct.pack("<q", len(payload)) + payload)
+# framing shared with the SHARED_GRADIENTS update wire — one format, one
+# implementation (parallel/transport.py)
+from ..parallel.transport import send_frame as _send_frame  # noqa: E402
+from ..parallel.transport import recv_frame as _recv_frame  # noqa: E402
 
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[bytes]:
-    header = _recv_exact(sock, 8)
-    if header is None:
-        return None
-    (n,) = struct.unpack("<q", header)
-    return _recv_exact(sock, n)
+#: zero-length payload = end-of-stream control frame: a closing publisher
+#: sends it and the broker fans it out, so subscribers see a clean end
+#: instead of blocking until their socket times out
+_EOS = b""
 
 
 # ---------------------------------------------------------------------- broker
@@ -163,7 +152,10 @@ class StreamingBroker:
                 self._send_locks[s] = threading.Lock()
             return  # frames are pushed by publishers; socket stays open
         while True:  # PUB
-            frame = _recv_frame(s)
+            try:
+                frame = _recv_frame(s)
+            except (ConnectionError, OSError):
+                frame = None  # abrupt publisher disconnect
             if frame is None:
                 s.close()
                 return
@@ -211,7 +203,15 @@ class NDArrayPublisher:
     def publish(self, arrays):
         _send_frame(self._sock, NDArrayMessage.encode(arrays))
 
-    def close(self):
+    def close(self, end_stream: bool = True):
+        """``end_stream`` sends the EOS control frame first, giving
+        subscribers a clean end-of-stream (None from ``receive``) instead of
+        an eventual timeout."""
+        if end_stream:
+            try:
+                _send_frame(self._sock, _EOS)
+            except OSError:
+                pass
         self._sock.close()
 
 
@@ -224,10 +224,11 @@ class NDArrayConsumer:
         _send_frame(self._sock, f"SUB {topic}".encode("utf-8"))
 
     def receive(self) -> Optional[List[np.ndarray]]:
-        """Next message's arrays; None only on CLEAN stream close. A stalled
-        producer raises TimeoutError and a dropped connection raises
-        ConnectionError — silently treating either as end-of-stream would let
-        training finish "successfully" on a truncated stream."""
+        """Next message's arrays; None only on CLEAN stream end (a
+        publisher's EOS frame or an orderly socket close). A stalled producer
+        raises TimeoutError and a dropped connection raises ConnectionError —
+        silently treating either as end-of-stream would let training finish
+        "successfully" on a truncated stream."""
         try:
             frame = _recv_frame(self._sock)
         except socket.timeout:
@@ -236,7 +237,9 @@ class NDArrayConsumer:
                 f"stalled? (pass a larger timeout for slow producers)")
         except OSError as e:
             raise ConnectionError(f"stream connection lost: {e}") from e
-        return None if frame is None else NDArrayMessage.decode(frame)
+        if frame is None or frame == _EOS:
+            return None
+        return NDArrayMessage.decode(frame)
 
     getINDArray = receive
 
@@ -280,8 +283,12 @@ class StreamingDataSetIterator(DataSetIterator):
 
 class ServingRoute:
     """Reference ``routes/DL4jServeRouteBuilder.java``: consume feature
-    arrays, run the model, publish predictions. Runs on a daemon thread;
-    ``serve_forever=False`` processes ``max_messages`` then returns."""
+    arrays, run the model, publish predictions. ``run(max_messages=N)``
+    processes N messages then returns; ``max_messages=None`` serves until
+    the stream ends. ``start`` runs the same loop on a daemon thread; a
+    fatal error is stored on ``self.error`` (and re-raised by ``check``)
+    rather than dying silently inside the thread. Idle timeouts are NOT
+    fatal — gaps between requests are normal for a serving endpoint."""
 
     def __init__(self, net, consumer: NDArrayConsumer,
                  publisher: NDArrayPublisher):
@@ -289,11 +296,18 @@ class ServingRoute:
         self.consumer = consumer
         self.publisher = publisher
         self.served = 0
+        self.error: Optional[BaseException] = None
 
     def run(self, max_messages: Optional[int] = None):
         is_graph = hasattr(self.net, "_as_multi")  # ComputationGraph
         while max_messages is None or self.served < max_messages:
-            parts = self.consumer.receive()
+            try:
+                parts = self.consumer.receive()
+            except TimeoutError:
+                continue  # idle between requests — keep serving
+            except ConnectionError as e:
+                self.error = e
+                return
             if parts is None:
                 return
             if is_graph:
@@ -306,6 +320,11 @@ class ServingRoute:
             outs = out if isinstance(out, (list, tuple)) else [out]
             self.publisher.publish([np.asarray(o) for o in outs])
             self.served += 1
+
+    def check(self):
+        """Re-raise a fatal serving error captured on the daemon thread."""
+        if self.error is not None:
+            raise self.error
 
     def start(self, max_messages: Optional[int] = None) -> threading.Thread:
         t = threading.Thread(target=self.run, args=(max_messages,),

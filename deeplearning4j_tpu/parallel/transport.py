@@ -22,7 +22,34 @@ import struct
 import time
 from typing import Dict, List, Sequence
 
-__all__ = ["UpdateChannel"]
+__all__ = ["UpdateChannel", "send_frame", "recv_exact", "recv_frame"]
+
+
+# Shared length-prefixed framing (little-endian i64 length + payload). Also
+# used by the streaming pub/sub layer (datasets/streaming.py) so the two wire
+# formats cannot diverge.
+def send_frame(sock: "socket.socket", payload: bytes):
+    sock.sendall(struct.pack("<q", len(payload)) + payload)
+
+
+def recv_exact(sock: "socket.socket", n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: "socket.socket"):
+    """One frame, or None when the peer closed cleanly before a header."""
+    try:
+        header = recv_exact(sock, 8)
+    except ConnectionError:
+        return None
+    (n,) = struct.unpack("<q", header)
+    return recv_exact(sock, n)
 
 
 class UpdateChannel:
@@ -75,18 +102,8 @@ class UpdateChannel:
             srv.settimeout(max(deadline - time.monotonic(), 0.1))
             s, _ = srv.accept()
             s.settimeout(None)
-            q = struct.unpack("<i", self._recv_exact(s, 4))[0]
+            q = struct.unpack("<i", recv_exact(s, 4))[0]
             self._peers[q] = s
-
-    @staticmethod
-    def _recv_exact(s: socket.socket, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = s.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("peer closed")
-            buf.extend(chunk)
-        return bytes(buf)
 
     # ----------------------------------------------------------------- frames
     def broadcast(self, frame: bytes):
@@ -101,8 +118,8 @@ class UpdateChannel:
         out = []
         for q in sorted(self._peers):
             s = self._peers[q]
-            (n,) = struct.unpack("<q", self._recv_exact(s, 8))
-            out.append(self._recv_exact(s, n))
+            (n,) = struct.unpack("<q", recv_exact(s, 8))
+            out.append(recv_exact(s, n))
         return out
 
     def exchange(self, frame: bytes) -> List[bytes]:

@@ -61,11 +61,21 @@ async function refresh(){
   svg.innerHTML='';
   polyline(svg, ov.iterations, ov.scores, 800, 240, '#07c');
   const model = await (await fetch('/train/model?sid='+sid)).json();
-  let html='<tr><th>param</th><th>norm2</th><th>mean</th><th>stdev</th></tr>';
+  function hist(st){
+    if(!st.histogram||!st.histogram.length){return '';}
+    const h=st.histogram, hmax=Math.max(...h)||1;
+    return '<svg width="'+(h.length*4)+'" height="24">'+h.map((v,i)=>
+      '<rect x="'+i*4+'" y="'+(24-22*v/hmax)+'" width="3" height="'+(22*v/hmax)+
+      '" fill="#07c"/>').join('')+'</svg>';
+  }
+  let html='<tr><th>param</th><th>norm2</th><th>mean</th><th>stdev</th>'+
+    '<th>histogram</th><th>update hist</th></tr>';
   for(const [name,st] of Object.entries(model.params||{})){
+    const up=(model.updates||{})[name]||{};
     html+='<tr><td style="text-align:left">'+name+'</td><td>'+
       (st.norm2||0).toFixed(4)+'</td><td>'+(st.mean!==undefined?st.mean.toFixed(5):'')+
-      '</td><td>'+(st.stdev!==undefined?st.stdev.toFixed(5):'')+'</td></tr>';
+      '</td><td>'+(st.stdev!==undefined?st.stdev.toFixed(5):'')+'</td><td>'+
+      hist(st)+'</td><td>'+hist(up)+'</td></tr>';
   }
   document.getElementById('params').innerHTML=html;
   const sys = await (await fetch('/train/system?sid='+sid)).json();

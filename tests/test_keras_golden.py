@@ -181,3 +181,23 @@ def test_custom_layer_registration(tmp_path):
     np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), W)
     out = np.asarray(net.output(np.zeros((1, 6), np.float32)))
     assert out.shape == (1, 2)
+
+
+def test_real_inceptionv3_import_end_to_end(tmp_path):
+    """The BASELINE.md import config, for real: build tf.keras applications
+    InceptionV3 (313 layers, 21.8M params, weights=None), save legacy h5,
+    import as a ComputationGraph, and reproduce Keras's predict outputs
+    (reference `trainedmodels`/InceptionV3 import scenario)."""
+    tf = pytest.importorskip("tensorflow")
+    import os
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    tf.keras.utils.set_random_seed(5)
+    m = tf.keras.applications.InceptionV3(weights=None,
+                                          input_shape=(75, 75, 3), classes=10)
+    x = np.random.default_rng(0).normal(size=(2, 75, 75, 3)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    path = str(tmp_path / "iv3.h5")
+    m.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(net.output(_nchw(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

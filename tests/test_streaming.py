@@ -128,3 +128,35 @@ def test_serving_route_publishes_predictions():
         np.testing.assert_allclose(got1[0].sum(axis=1), 1.0, rtol=1e-5)
     finally:
         broker.close()
+
+
+def test_unbounded_stream_ends_on_publisher_close():
+    """num_batches=None: the stream ends CLEANLY when the publisher closes
+    (EOS control frame) — no timeout, no exception."""
+    broker = StreamingBroker()
+    try:
+        consumer = NDArrayConsumer(broker.address, "u", timeout=10.0)
+        time.sleep(0.05)
+        pub = NDArrayPublisher(broker.address, "u")
+        for i in range(3):
+            pub.publish([np.full((4, 2), i, np.float32),
+                         np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]])
+        pub.close()  # sends EOS
+        it = StreamingDataSetIterator(consumer)  # unbounded
+        seen = [ds for ds in it]
+        assert len(seen) == 3
+        consumer.close()
+    finally:
+        broker.close()
+
+
+def test_consumer_timeout_raises_not_silent():
+    import pytest
+    broker = StreamingBroker()
+    try:
+        consumer = NDArrayConsumer(broker.address, "quiet", timeout=0.3)
+        time.sleep(0.05)
+        with pytest.raises(TimeoutError, match="stalled"):
+            consumer.receive()
+    finally:
+        broker.close()
