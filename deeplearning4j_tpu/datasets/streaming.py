@@ -40,7 +40,14 @@ import numpy as np
 from .dataset import DataSet, DataSetIterator
 
 __all__ = ["NDArrayMessage", "StreamingBroker", "NDArrayPublisher",
-           "NDArrayConsumer", "StreamingDataSetIterator", "ServingRoute"]
+           "NDArrayConsumer", "StreamingDataSetIterator", "ServingRoute",
+           "StreamIdleTimeout"]
+
+
+class StreamIdleTimeout(TimeoutError):
+    """Timeout that fired BETWEEN frames (no bytes consumed) — safe to retry.
+    A plain TimeoutError from ``receive`` means bytes of a frame were already
+    consumed; retrying would desync the framed stream."""
 
 
 # ------------------------------------------------------------------ wire codec
@@ -126,6 +133,10 @@ class StreamingBroker:
         # different threads, and interleaved sendall() on the same socket
         # would corrupt the subscriber's frame stream
         self._send_locks: Dict[socket.socket, threading.Lock] = {}
+        # active publishers per topic: EOS reaches subscribers only when the
+        # LAST publisher of a topic closes — one departing publisher must not
+        # end the stream for a topic others are still feeding
+        self._pubs: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -151,26 +162,39 @@ class StreamingBroker:
                 self._subs.setdefault(topic, []).append(s)
                 self._send_locks[s] = threading.Lock()
             return  # frames are pushed by publishers; socket stays open
+        with self._lock:
+            self._pubs[topic] = self._pubs.get(topic, 0) + 1
         while True:  # PUB
             try:
                 frame = _recv_frame(s)
-            except (ConnectionError, OSError):
-                frame = None  # abrupt publisher disconnect
-            if frame is None:
+            except OSError:  # abrupt publisher disconnect (incl. resets)
+                frame = None
+            if frame == _EOS or frame is None:
+                with self._lock:
+                    self._pubs[topic] = self._pubs.get(topic, 1) - 1
+                    last = self._pubs[topic] <= 0
+                # forward EOS only on an EXPLICIT close of the last
+                # publisher; an abrupt disconnect stays loud (subscribers
+                # time out instead of "finishing" a truncated stream)
+                if frame == _EOS and last:
+                    self._fanout(topic, _EOS)
                 s.close()
                 return
-            with self._lock:
-                targets = [(t, self._send_locks[t])
-                           for t in self._subs.get(topic, ())]
-            for t, lock in targets:
-                try:
-                    with lock:
-                        _send_frame(t, frame)
-                except OSError:
-                    with self._lock:
-                        if t in self._subs.get(topic, ()):
-                            self._subs[topic].remove(t)
-                        self._send_locks.pop(t, None)
+            self._fanout(topic, frame)
+
+    def _fanout(self, topic: str, frame: bytes):
+        with self._lock:
+            targets = [(t, self._send_locks[t])
+                       for t in self._subs.get(topic, ())]
+        for t, lock in targets:
+            try:
+                with lock:
+                    _send_frame(t, frame)
+            except OSError:
+                with self._lock:
+                    if t in self._subs.get(topic, ()):
+                        self._subs[topic].remove(t)
+                    self._send_locks.pop(t, None)
 
     def close(self):
         self._running = False
@@ -223,18 +247,46 @@ class NDArrayConsumer:
         self._sock.settimeout(timeout)
         _send_frame(self._sock, f"SUB {topic}".encode("utf-8"))
 
-    def receive(self) -> Optional[List[np.ndarray]]:
-        """Next message's arrays; None only on CLEAN stream end (a
-        publisher's EOS frame or an orderly socket close). A stalled producer
-        raises TimeoutError and a dropped connection raises ConnectionError —
-        silently treating either as end-of-stream would let training finish
-        "successfully" on a truncated stream."""
+    def _recv_idle_aware(self) -> Optional[bytes]:
+        """One frame; distinguishes idle (no bytes consumed → safe to retry)
+        from a mid-frame stall (stream desynced → fatal)."""
         try:
-            frame = _recv_frame(self._sock)
+            first = self._sock.recv(8)
         except socket.timeout:
-            raise TimeoutError(
+            raise StreamIdleTimeout(
                 f"no message within {self._sock.gettimeout()}s — producer "
-                f"stalled? (pass a larger timeout for slow producers)")
+                f"idle or stalled (safe to retry)")
+        if not first:
+            return None  # orderly close
+        buf = bytearray(first)
+        while len(buf) < 8:
+            chunk = self._sock.recv(8 - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed mid-header")
+            buf.extend(chunk)
+        (n,) = struct.unpack("<q", bytes(buf))
+        payload = bytearray()
+        while len(payload) < n:
+            chunk = self._sock.recv(n - len(payload))
+            if not chunk:
+                raise ConnectionError("peer closed mid-frame")
+            payload.extend(chunk)
+        return bytes(payload)
+
+    def receive(self) -> Optional[List[np.ndarray]]:
+        """Next message's arrays; None only on CLEAN stream end (the last
+        publisher's EOS frame or an orderly socket close). An idle/stalled
+        producer raises StreamIdleTimeout (retryable — no bytes consumed); a
+        timeout or close mid-frame raises TimeoutError/ConnectionError
+        (fatal: the framed stream is desynced). Silently treating failures
+        as end-of-stream would let training finish "successfully" on a
+        truncated stream."""
+        try:
+            frame = self._recv_idle_aware()
+        except StreamIdleTimeout:
+            raise
+        except socket.timeout:
+            raise TimeoutError("timeout mid-frame — framed stream desynced")
         except OSError as e:
             raise ConnectionError(f"stream connection lost: {e}") from e
         if frame is None or frame == _EOS:
@@ -303,23 +355,25 @@ class ServingRoute:
         while max_messages is None or self.served < max_messages:
             try:
                 parts = self.consumer.receive()
-            except TimeoutError:
+                if parts is None:
+                    return  # clean end of the request stream
+                if is_graph:
+                    out = self.net.output(*parts)   # multi-input graphs
+                elif len(parts) > 1:
+                    # MLN: (features, mask) message shape
+                    out = self.net.output(parts[0], mask=parts[1])
+                else:
+                    out = self.net.output(parts[0])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                self.publisher.publish([np.asarray(o) for o in outs])
+                self.served += 1
+            except StreamIdleTimeout:
                 continue  # idle between requests — keep serving
-            except ConnectionError as e:
+            except Exception as e:  # noqa: BLE001 — surfaced via check()
+                # ANY fatal error (desync, decode, inference shape mismatch)
+                # is stored, not swallowed by the daemon thread
                 self.error = e
                 return
-            if parts is None:
-                return
-            if is_graph:
-                out = self.net.output(*parts)   # multi-input graphs
-            elif len(parts) > 1:
-                # MLN: (features, mask) message shape
-                out = self.net.output(parts[0], mask=parts[1])
-            else:
-                out = self.net.output(parts[0])
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            self.publisher.publish([np.asarray(o) for o in outs])
-            self.served += 1
 
     def check(self):
         """Re-raise a fatal serving error captured on the daemon thread."""

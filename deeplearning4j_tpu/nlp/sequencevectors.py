@@ -140,6 +140,18 @@ class SequenceVectors:
             use_hs=self.use_hs, use_neg=self.negative > 0)
         self._code_len = max((len(w.codes)
                               for w in self.vocab.vocab_words()), default=1)
+        if self.use_hs:
+            # vocab-wide Huffman tables: batch HS encoding becomes three
+            # array gathers instead of a Python loop over targets
+            V, L = self.vocab.num_words(), self._code_len
+            self._hs_points = np.zeros((V, L), np.int32)
+            self._hs_codes = np.zeros((V, L), np.float32)
+            self._hs_mask = np.zeros((V, L), np.float32)
+            for i, w in enumerate(self.vocab.vocab_words()):
+                k = len(w.codes)
+                self._hs_points[i, :k] = w.points
+                self._hs_codes[i, :k] = w.codes
+                self._hs_mask[i, :k] = 1.0
         if self.negative > 0:
             self._neg_table = self._build_unigram_table()
         return self
@@ -167,25 +179,44 @@ class SequenceVectors:
         words_seen = 0
         est_total = total_words * self.epochs
         for epoch in range(self.epochs):
-            batch_centers: List[int] = []
-            batch_contexts: List[int] = []
+            pend_c: List[np.ndarray] = []
+            pend_t: List[np.ndarray] = []
+            pending = 0
             for seq in provider():
                 idxs = self._subsampled_indices(seq, rng)
                 words_seen += len(idxs)
-                for center, context in self._sequence_pairs(idxs, rng):
-                    self._emit(batch_centers, batch_contexts, center, context)
-                    if len(batch_centers) >= self.batch_size:
+                c, t = self._sequence_pairs_arrays(idxs, rng)
+                if c.size:
+                    pend_c.append(c)
+                    pend_t.append(t)
+                    pending += c.size
+                if pending >= self.batch_size:
+                    # concatenate ONCE, then walk batch-size slices — the
+                    # remainder is a view, so the copy cost stays linear in
+                    # the number of pairs
+                    cat_c = np.concatenate(pend_c)
+                    cat_t = np.concatenate(pend_t)
+                    off = 0
+                    while pending - off >= self.batch_size:
                         lr = self._lr(words_seen, est_total)
-                        self._flush(batch_centers, batch_contexts, lr, rng)
-            if batch_centers:
+                        self._apply_pairs(cat_c[off:off + self.batch_size],
+                                          cat_t[off:off + self.batch_size],
+                                          lr, rng)
+                        off += self.batch_size
+                    pend_c = [cat_c[off:]]
+                    pend_t = [cat_t[off:]]
+                    pending -= off
+            if pending:
                 lr = self._lr(words_seen, est_total)
-                self._flush(batch_centers, batch_contexts, lr, rng)
+                self._apply_pairs(np.concatenate(pend_c),
+                                  np.concatenate(pend_t), lr, rng)
         return self
 
     def _sequence_pairs(self, idxs, rng):
         """Yield (center, context) training pairs for one sequence: dynamic
         windows, skip-gram convention. Overridden by doc2vec to add
-        document-level pairs."""
+        document-level pairs; the vectorized array path below is used when
+        this method is NOT overridden."""
         for pos, center in enumerate(idxs):
             b = rng.integers(1, self.window + 1)  # dynamic window
             lo = max(0, pos - b)
@@ -193,6 +224,47 @@ class SequenceVectors:
             for j in range(lo, hi):
                 if j != pos:
                     yield center, idxs[j]
+
+    def _sequence_pairs_arrays(self, idxs, rng):
+        """(centers, contexts) int32 arrays for one sequence. Vectorized —
+        the per-pair Python loop was the host-side bottleneck of training
+        (the reference hits the same issue and batches into native
+        ``AggregateSkipGram`` calls, ``SkipGram.java:176-283``). Subclasses
+        that override ``_sequence_pairs`` (doc2vec) automatically fall back
+        to the generator; ``_orient_pairs`` gives CBOW its row/target swap."""
+        n = len(idxs)
+        if n < 2:
+            empty = np.empty(0, np.int32)
+            return empty, empty
+        if type(self)._sequence_pairs is not SequenceVectors._sequence_pairs:
+            pairs = list(self._sequence_pairs(idxs, rng))
+            if not pairs:
+                empty = np.empty(0, np.int32)
+                return empty, empty
+            arr = np.asarray(pairs, np.int32)
+            return self._orient_pairs(arr[:, 0], arr[:, 1])
+        arr = np.asarray(idxs, np.int32)
+        pos = np.arange(n)
+        b = rng.integers(1, self.window + 1, size=n)
+        lo = np.maximum(0, pos - b)
+        hi = np.minimum(n, pos + b + 1)
+        counts = hi - lo - 1                      # window size minus center
+        total = int(counts.sum())
+        if total == 0:
+            empty = np.empty(0, np.int32)
+            return empty, empty
+        centers_pos = np.repeat(pos, counts)
+        # within-window offsets 0..count-1 per center
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        offs = np.arange(total) - np.repeat(starts, counts)
+        ctx_pos = np.repeat(lo, counts) + offs
+        ctx_pos += (ctx_pos >= centers_pos)       # skip the center slot
+        return self._orient_pairs(arr[centers_pos], arr[ctx_pos])
+
+    def _orient_pairs(self, centers, contexts):
+        """Skip-gram orientation: the CENTER row is updated against the
+        context's objective. CBOW overrides to swap."""
+        return centers, contexts
 
     def _lr(self, words_seen, est_total):
         frac = min(words_seen / est_total, 1.0)
@@ -212,34 +284,17 @@ class SequenceVectors:
             out.append(i)
         return out
 
-    # hooks overridden by CBOW/ParagraphVectors variants -------------------
-    def _emit(self, centers, contexts, center_idx, context_idx):
-        """Skip-gram: predict context from center → the *center* row is
-        updated against the context word's HS path / NS targets."""
-        centers.append(center_idx)
-        contexts.append(context_idx)
-
-    def _flush(self, centers, contexts, lr, rng):
-        c = np.asarray(centers, np.int32)
-        t = np.asarray(contexts, np.int32)
-        centers.clear()
-        contexts.clear()
-        self._apply_pairs(c, t, lr, rng)
-
     def _apply_pairs(self, rows, targets, lr, rng):
         """Update syn0[rows] against targets' objective."""
         lt = self.lookup_table
+        rows = np.ascontiguousarray(rows, np.int32)
+        targets = np.ascontiguousarray(targets, np.int32)
         if self.use_hs:
-            L = self._code_len
-            points = np.zeros((len(targets), L), np.int32)
-            codes = np.zeros((len(targets), L), np.float32)
-            mask = np.zeros((len(targets), L), np.float32)
-            for i, tgt in enumerate(targets):
-                w = self.vocab.word_at(int(tgt))
-                k = len(w.codes)
-                points[i, :k] = w.points
-                codes[i, :k] = w.codes
-                mask[i, :k] = 1.0
+            # batched Huffman lookup: three gathers from the vocab-wide
+            # tables (see build_vocab) — no per-target Python loop
+            points = self._hs_points[targets]
+            codes = self._hs_codes[targets]
+            mask = self._hs_mask[targets]
             lt.syn0, lt.syn1 = _hs_step(
                 jnp.asarray(lt.syn0), jnp.asarray(lt.syn1),
                 jnp.asarray(rows), jnp.asarray(points), jnp.asarray(codes),

@@ -147,11 +147,11 @@ class Word2Vec(SequenceVectors):
 class CBOW(Word2Vec):
     """Continuous bag-of-words: the averaged context predicts the center
     (reference ``CBOW.java``). Implemented by flipping the (row, target) pair
-    emission: context rows are updated against the center word's objective."""
+    orientation: context rows are updated against the center word's
+    objective."""
 
-    def _emit(self, centers, contexts, center_idx, context_idx):
-        centers.append(context_idx)   # row updated: the context word
-        contexts.append(center_idx)   # objective: the center word
+    def _orient_pairs(self, centers, contexts):
+        return contexts, centers  # row updated: context; objective: center
 
 
 class ParagraphVectors(Word2Vec):

@@ -160,3 +160,60 @@ def test_consumer_timeout_raises_not_silent():
             consumer.receive()
     finally:
         broker.close()
+
+
+def test_eos_waits_for_last_publisher():
+    """EOS must not end the topic while another publisher still feeds it."""
+    broker = StreamingBroker()
+    try:
+        consumer = NDArrayConsumer(broker.address, "multi", timeout=10.0)
+        time.sleep(0.05)
+        p1 = NDArrayPublisher(broker.address, "multi")
+        p2 = NDArrayPublisher(broker.address, "multi")
+        time.sleep(0.05)
+        p1.publish(np.ones((1,), np.float32))
+        p1.close()                      # EOS from p1 — p2 still open
+        p2.publish(np.full((1,), 2, np.float32))
+        p2.close()                      # LAST publisher → EOS forwarded
+        got = []
+        while True:
+            parts = consumer.receive()
+            if parts is None:
+                break
+            got.append(float(parts[0][0]))
+        assert got == [1.0, 2.0]
+        consumer.close()
+    finally:
+        broker.close()
+
+
+def test_serving_route_survives_idle_and_captures_errors():
+    """Idle timeouts keep the route alive; a malformed request is captured on
+    route.error instead of dying silently."""
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd())
+            .list()
+            .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                               loss="mcxent")).build())
+    net = MultiLayerNetwork(conf).init()
+    broker = StreamingBroker()
+    try:
+        fc = NDArrayConsumer(broker.address, "rin", timeout=0.2)  # short idle
+        pc = NDArrayConsumer(broker.address, "rout", timeout=10.0)
+        time.sleep(0.05)
+        from deeplearning4j_tpu.datasets.streaming import ServingRoute
+        route = ServingRoute(net, fc, NDArrayPublisher(broker.address, "rout"))
+        t = route.start()
+        time.sleep(0.5)                 # several idle timeouts elapse
+        assert t.is_alive()             # still serving
+        pub = NDArrayPublisher(broker.address, "rin")
+        x = np.zeros((2, 4), np.float32)
+        pub.publish(x)
+        assert pc.receive()[0].shape == (2, 2)
+        pub.publish(np.zeros((2, 9), np.float32))  # wrong feature width
+        t.join(timeout=10)
+        assert route.error is not None
+        import pytest
+        with pytest.raises(Exception):
+            route.check()
+    finally:
+        broker.close()
