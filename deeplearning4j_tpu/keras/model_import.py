@@ -33,7 +33,10 @@ from ..nn.conf.layers import (DenseLayer, ConvolutionLayer, SubsamplingLayer,
                               EmbeddingSequenceLayer, LSTM, SimpleRnn,
                               LastTimeStep, OutputLayer, RnnOutputLayer,
                               LossLayer, GlobalPoolingLayer, ZeroPaddingLayer,
-                              Upsampling2D, PoolingType, ConvolutionMode)
+                              Upsampling2D, Upsampling1D, PoolingType,
+                              ConvolutionMode, SeparableConvolution2D,
+                              DepthwiseConvolution2D, Convolution1DLayer,
+                              Subsampling1DLayer, Cropping2D, Bidirectional)
 from ..nn.conf.graph import MergeVertex, ElementWiseVertex
 from ..nn.multilayer import MultiLayerNetwork
 from ..nn.graph import ComputationGraph
@@ -231,6 +234,124 @@ class KerasLayerMapper:
         return _maybe_last_step(layer, cfg)
 
     @staticmethod
+    def _map_separableconv2d(cfg):
+        return SeparableConvolution2D(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter"))),
+            kernel_size=_pair(cfg.get("kernel_size",
+                                      (cfg.get("nb_row", 3),
+                                       cfg.get("nb_col", 3)))),
+            stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
+            dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode=_padding_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", cfg.get("bias", True))))
+
+    @staticmethod
+    def _map_depthwiseconv2d(cfg):
+        return DepthwiseConvolution2D(
+            kernel_size=_pair(cfg.get("kernel_size", (3, 3))),
+            stride=_pair(cfg.get("strides", (1, 1))),
+            dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode=_padding_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)))
+
+    @staticmethod
+    def _map_conv1d(cfg):
+        k = cfg.get("kernel_size", cfg.get("filter_length", 3))
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = cfg.get("strides", cfg.get("subsample_length", 1))
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        d = cfg.get("dilation_rate", 1)
+        d = int(d[0] if isinstance(d, (list, tuple)) else d)
+        return Convolution1DLayer(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter"))),
+            kernel_size=k, stride=s, dilation=d,
+            convolution_mode=_padding_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", cfg.get("bias", True))))
+
+    _map_convolution1d = _map_conv1d  # Keras 1 name
+
+    @staticmethod
+    def _map_maxpooling1d(cfg):
+        p = cfg.get("pool_size", cfg.get("pool_length", 2))
+        p = int(p[0] if isinstance(p, (list, tuple)) else p)
+        s = cfg.get("strides", cfg.get("stride")) or p
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        return Subsampling1DLayer(pooling_type=PoolingType.MAX,
+                                  kernel_size=p, stride=s,
+                                  convolution_mode=_padding_mode(cfg))
+
+    @staticmethod
+    def _map_averagepooling1d(cfg):
+        p = cfg.get("pool_size", cfg.get("pool_length", 2))
+        p = int(p[0] if isinstance(p, (list, tuple)) else p)
+        s = cfg.get("strides", cfg.get("stride")) or p
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        return Subsampling1DLayer(pooling_type=PoolingType.AVG,
+                                  kernel_size=p, stride=s,
+                                  convolution_mode=_padding_mode(cfg))
+
+    @staticmethod
+    def _map_leakyrelu(cfg):
+        # Keras 3 spells it negative_slope; Keras 1/2 alpha. Default 0.3
+        # (Keras) ≠ 0.01 (our bare "leakyrelu") — carry it explicitly
+        alpha = float(cfg.get("negative_slope", cfg.get("alpha", 0.3)))
+        return ActivationLayer(activation=f"leakyrelu:{alpha}")
+
+    @staticmethod
+    def _map_elu(cfg):
+        return ActivationLayer(
+            activation=f"elu:{float(cfg.get('alpha', 1.0))}")
+
+    @staticmethod
+    def _map_cropping2d(cfg):
+        c = cfg.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(c, (list, tuple)) and c and isinstance(c[0], (list, tuple)):
+            crops = (int(c[0][0]), int(c[0][1]), int(c[1][0]), int(c[1][1]))
+        else:
+            ch, cw = _pair(c)
+            crops = (ch, ch, cw, cw)
+        return Cropping2D(cropping=crops)
+
+    @staticmethod
+    def _map_upsampling1d(cfg):
+        sz = cfg.get("size", cfg.get("length", 2))
+        return Upsampling1D(size=int(sz[0] if isinstance(sz, (list, tuple))
+                                     else sz))
+
+    @staticmethod
+    def _map_spatialdropout2d(cfg):
+        # per-feature-map dropout approximated by elementwise dropout (the
+        # reference maps SpatialDropout to plain DropoutLayer too)
+        return DropoutLayer(dropout=1.0 - float(cfg.get("rate",
+                                                        cfg.get("p", 0.5))))
+
+    _map_spatialdropout1d = _map_spatialdropout2d
+
+    @staticmethod
+    def _map_bidirectional(cfg):
+        inner_cfg = cfg.get("layer", {})
+        inner = KerasLayerMapper.map(inner_cfg.get("class_name"),
+                                     inner_cfg.get("config", {}))
+        merge = cfg.get("merge_mode", "concat")
+        modes = {"concat": "concat", "sum": "add", "ave": "ave", "mul": "mul"}
+        if merge not in modes:
+            # merge_mode=None means TWO output tensors — structurally
+            # unrepresentable as one wrapped layer; fail loudly
+            raise ValueError(f"Unsupported Bidirectional merge_mode "
+                             f"{merge!r} (supported: {sorted(modes)})")
+        mode = modes[merge]
+        if type(inner).__name__ == "LastTimeStep":
+            # wrap order: Bidirectional over the RNN, LastTimeStep outside
+            return LastTimeStep(inner=Bidirectional(inner=inner.inner,
+                                                    mode=mode))
+        return Bidirectional(inner=inner, mode=mode)
+
+    @staticmethod
     def _map_timedistributed(cfg):
         """TimeDistributed wrapper (reference ``KerasTimeDistributed``,
         dual-name row in ``KerasLayerConfiguration.java``): per-timestep
@@ -335,7 +456,16 @@ def _layer_weights(f, name: str) -> Dict[str, np.ndarray]:
     out = {}
     for wn in weight_names:
         short = wn.split("/")[-1].split(":")[0]
-        out[_canonical_weight_name(short)] = np.asarray(grp[wn])
+        canon = _canonical_weight_name(short)
+        # Bidirectional wrappers carry direction in a PATH SEGMENT
+        # ('forward_lstm/...'); anchor the match there so a layer merely
+        # NAMED 'feedforward' is not misclassified
+        segs = wn.split("/")[:-1]
+        if any(g == "forward" or g.startswith("forward_") for g in segs):
+            canon = "forward_" + canon
+        elif any(g == "backward" or g.startswith("backward_") for g in segs):
+            canon = "backward_" + canon
+        out[canon] = np.asarray(grp[wn])
     return out
 
 
@@ -373,10 +503,39 @@ def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
         put("W", weights["kernel"] if "kernel" in weights else weights["W"])
         if "b" in p:
             put("b", weights.get("bias", weights.get("b")))
-    elif t == "ConvolutionLayer":
+    elif t in ("ConvolutionLayer", "Convolution1DLayer"):
         put("W", weights["kernel"])  # HWIO == HWIO, straight copy
         if "b" in p:
             put("b", weights["bias"])
+    elif t == "DepthwiseConvolution2D":
+        # Keras 3 names the depthwise kernel plain "kernel"
+        dk = weights.get("depthwise_kernel", weights.get("kernel"))  # [kh,kw,C,m]
+        kh, kw, cin, m = dk.shape
+        put("W", dk.reshape(kh, kw, 1, cin * m))  # grouped-conv layout
+        if "b" in p:
+            put("b", weights["bias"])
+    elif t == "SeparableConvolution2D":
+        dk = weights["depthwise_kernel"]
+        kh, kw, cin, m = dk.shape
+        put("dW", dk.reshape(kh, kw, 1, cin * m))
+        put("pW", weights["pointwise_kernel"])
+        if "b" in p:
+            put("b", weights["bias"])
+    elif t == "Bidirectional":
+        H = layer_conf.inner.n_out
+        for side, pre in (("fwd", "forward_"), ("bwd", "backward_")):
+            sub = p[side]
+            for ours, theirs in (("W", "kernel"), ("RW", "recurrent_kernel"),
+                                 ("b", "bias")):
+                if theirs == "bias" and (ours not in sub
+                                         or pre + theirs not in weights):
+                    continue  # use_bias=False inner RNN
+                arr = _lstm_reorder(weights[pre + theirs], H)
+                tgt = sub[ours]
+                if tuple(arr.shape) != tuple(tgt.shape):
+                    raise ValueError(f"Layer {key} Bidirectional {side}.{ours}"
+                                     f": {arr.shape} != {tuple(tgt.shape)}")
+                sub[ours] = jnp.asarray(arr, tgt.dtype)
     elif t == "BatchNormalization":
         # scale=False / center=False models ship only one of gamma/beta —
         # copy each independently
@@ -397,11 +556,13 @@ def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
         H = layer_conf.n_out
         put("W", _lstm_reorder(weights["kernel"], H))
         put("RW", _lstm_reorder(weights["recurrent_kernel"], H))
-        put("b", _lstm_reorder(weights["bias"], H))
+        if "b" in p and "bias" in weights:
+            put("b", _lstm_reorder(weights["bias"], H))
     elif t == "SimpleRnn":
         put("W", weights["kernel"])
         put("RW", weights["recurrent_kernel"])
-        put("b", weights["bias"])
+        if "b" in p and "bias" in weights:
+            put("b", weights["bias"])
     elif not weights:
         pass
     else:

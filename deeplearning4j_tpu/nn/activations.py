@@ -174,10 +174,24 @@ class Activation:
 
 
 def get_activation(name):
-    """Resolve an activation by name (case-insensitive) or pass callables through."""
+    """Resolve an activation by name (case-insensitive) or pass callables
+    through. Parametric spellings stay JSON-serializable strings:
+    ``"leakyrelu:0.3"``, ``"elu:0.7"``, ``"thresholdedrelu:1.5"`` bind the
+    parameter (the reference's IActivation fields, e.g.
+    ``ActivationLReLU(alpha)``)."""
     if callable(name):
         return name
     key = str(name).lower()
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        val = float(arg)
+        if base == "leakyrelu":
+            return lambda x: _leakyrelu(x, val)
+        if base == "elu":
+            return lambda x: _elu(x, val)
+        if base == "thresholdedrelu":
+            return lambda x: _thresholdedrelu(x, val)
+        raise ValueError(f"Unknown parametric activation '{name}'")
     if key not in _ACTIVATIONS:
         raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_ACTIVATIONS)}")
     return _ACTIVATIONS[key]

@@ -207,9 +207,11 @@ class Convolution1DLayer(ConvolutionLayer):
 class DepthwiseConvolution2D(ConvolutionLayer):
     depth_multiplier: int = 1
 
-    def get_output_type(self, index, input_type):
-        out = super().get_output_type(index, input_type)
-        return out
+    def set_n_in(self, input_type, override=False):
+        super().set_n_in(input_type, override)
+        # depthwise output channels are determined: n_in × depth_multiplier
+        if self.n_out is None and self.n_in is not None:
+            self.n_out = self.n_in * int(self.depth_multiplier)
 
 
 @register

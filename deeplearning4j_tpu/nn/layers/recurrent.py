@@ -249,7 +249,19 @@ class BidirectionalImpl(_WrapperImpl):
         pb, sb = self.inner.init(kb)
         return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
 
-    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+    def _merge(self, a, b):
+        mode = self.conf.mode
+        if mode == "concat":
+            return jnp.concatenate([a, b], axis=-1)
+        if mode == "add":
+            return a + b
+        if mode == "mul":
+            return a * b
+        if mode == "ave":
+            return 0.5 * (a + b)
+        raise ValueError(f"Unknown Bidirectional mode {mode}")
+
+    def _run_directions(self, params, state, x, train, rng, mask):
         kf = kb = None
         if rng is not None:
             kf, kb = jax.random.split(rng)
@@ -259,23 +271,31 @@ class BidirectionalImpl(_WrapperImpl):
         mr = None if mask is None else jnp.flip(mask, axis=1)
         yb, sb = self.inner.forward(params["bwd"], state["bwd"], xr, train=train,
                                     rng=kb, mask=mr, ctx=None)
-        yb = jnp.flip(yb, axis=1)
-        mode = self.conf.mode
-        if mode == "concat":
-            y = jnp.concatenate([yf, yb], axis=-1)
-        elif mode == "add":
-            y = yf + yb
-        elif mode == "mul":
-            y = yf * yb
-        elif mode == "ave":
-            y = 0.5 * (yf + yb)
-        else:
-            raise ValueError(f"Unknown Bidirectional mode {mode}")
-        return y, {"fwd": sf, "bwd": sb}
+        return yf, yb, {"fwd": sf, "bwd": sb}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        yf, yb, new_state = self._run_directions(params, state, x, train, rng,
+                                                 mask)
+        return self._merge(yf, jnp.flip(yb, axis=1)), new_state
 
     def regularization(self, params):
         return (self.inner.regularization(params["fwd"])
                 + self.inner.regularization(params["bwd"]))
+
+    def forward_last(self, params, state, x, train=False, rng=None, mask=None,
+                     ctx=None):
+        """Per-direction final outputs, merged (reference/Keras
+        ``Bidirectional(..., return_sequences=False)`` semantics): the
+        BACKWARD direction's last step is its state after consuming the whole
+        reversed sequence — full left context — not the t=T-1 slot of the
+        flipped output sequence. Mask-correct for right-padded sequences:
+        the recurrent impls freeze state on masked steps, so each direction's
+        final output IS its last valid state (forward: padding freezes after
+        the data; backward: the flipped mask holds state zero through the
+        leading padding)."""
+        yf, yb, new_state = self._run_directions(params, state, x, train, rng,
+                                                 mask)
+        return self._merge(yf[:, -1, :], yb[:, -1, :]), new_state
 
 
 @implements("LastTimeStep")
@@ -287,6 +307,11 @@ class LastTimeStepImpl(_WrapperImpl):
         return self.inner.init(rng)
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        if hasattr(self.inner, "forward_last"):
+            # bidirectional inner: each direction contributes ITS OWN final
+            # step (full context both ways), not the t=T-1 concat slot
+            return self.inner.forward_last(params, state, x, train=train,
+                                           rng=rng, mask=mask, ctx=ctx)
         y, new_state = self.inner.forward(params, state, x, train=train, rng=rng,
                                           mask=mask, ctx=ctx)
         if mask is None:

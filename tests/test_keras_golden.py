@@ -201,3 +201,57 @@ def test_real_inceptionv3_import_end_to_end(tmp_path):
     net = KerasModelImport.import_keras_model_and_weights(path)
     got = np.asarray(net.output(_nchw(x)))
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_extended_layer_mappers_cnn(tmp_path):
+    """Separable/Depthwise conv, Cropping2D, LeakyReLU, AveragePooling — one
+    real-Keras model, predict outputs reproduced."""
+    tf = pytest.importorskip("tensorflow")
+    import os as _os
+    _os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    tf.keras.utils.set_random_seed(11)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((16, 16, 3)),
+        tf.keras.layers.SeparableConv2D(8, 3, padding="same", name="sep"),
+        tf.keras.layers.LeakyReLU(name="lr"),
+        tf.keras.layers.DepthwiseConv2D(3, padding="same",
+                                        depth_multiplier=2, name="dw"),
+        tf.keras.layers.Cropping2D(((1, 1), (2, 2)), name="crop"),
+        tf.keras.layers.AveragePooling2D(2, name="ap"),
+        tf.keras.layers.Flatten(name="fl"),
+        tf.keras.layers.Dense(4, activation="softmax", name="d"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    x = np.random.default_rng(2).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    path = str(tmp_path / "ext_cnn.h5")
+    m.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    got = np.asarray(net.output(_nchw(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_extended_layer_mappers_rnn(tmp_path):
+    """Conv1D + MaxPooling1D + Bidirectional(LSTM) — real-Keras model with
+    direction-split weight copy, predict outputs reproduced."""
+    tf = pytest.importorskip("tensorflow")
+    import os as _os
+    _os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    tf.keras.utils.set_random_seed(12)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 6)),
+        tf.keras.layers.Conv1D(8, 3, padding="same", activation="relu",
+                               name="c1"),
+        tf.keras.layers.MaxPooling1D(2, name="mp"),
+        tf.keras.layers.Bidirectional(
+            tf.keras.layers.LSTM(5, return_sequences=False), name="bd"),
+        tf.keras.layers.Dense(3, activation="softmax", name="out"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    x = np.random.default_rng(3).normal(size=(2, 12, 6)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    path = str(tmp_path / "ext_rnn.h5")
+    m.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
