@@ -529,7 +529,12 @@ def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
                                  ("b", "bias")):
                 if theirs == "bias" and (ours not in sub
                                          or pre + theirs not in weights):
-                    continue  # use_bias=False inner RNN
+                    if ours in sub:
+                        # use_bias=False inner RNN: zero our initialized
+                        # bias (forget gate starts at 1.0) instead of
+                        # silently keeping it
+                        sub[ours] = jnp.zeros_like(sub[ours])
+                    continue
                 arr = _lstm_reorder(weights[pre + theirs], H)
                 tgt = sub[ours]
                 if tuple(arr.shape) != tuple(tgt.shape):
@@ -556,13 +561,21 @@ def _set_layer_weights(net_params, net_states, key, layer_conf, weights):
         H = layer_conf.n_out
         put("W", _lstm_reorder(weights["kernel"], H))
         put("RW", _lstm_reorder(weights["recurrent_kernel"], H))
-        if "b" in p and "bias" in weights:
-            put("b", _lstm_reorder(weights["bias"], H))
+        if "b" in p:
+            if "bias" in weights:
+                put("b", _lstm_reorder(weights["bias"], H))
+            else:
+                # use_bias=False: our init sets forget-gate bias to 1.0 —
+                # zero it so the imported model computes what Keras did
+                p["b"] = jnp.zeros_like(p["b"])
     elif t == "SimpleRnn":
         put("W", weights["kernel"])
         put("RW", weights["recurrent_kernel"])
-        if "b" in p and "bias" in weights:
-            put("b", weights["bias"])
+        if "b" in p:
+            if "bias" in weights:
+                put("b", weights["bias"])
+            else:
+                p["b"] = jnp.zeros_like(p["b"])
     elif not weights:
         pass
     else:

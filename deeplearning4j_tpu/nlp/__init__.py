@@ -14,6 +14,9 @@ from .distributed import (DistributedWord2Vec, DistributedGlove,
                           SparkWord2Vec, SparkGlove, partition_sentences)
 from .bagofwords import InvertedIndex, BagOfWordsVectorizer, TfidfVectorizer
 from .serializer import WordVectorSerializer, StaticWordVectors
+from .lang import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
+                   KoreanTokenizerFactory, UimaTokenizerFactory,
+                   AnnotationPipeline)
 
 __all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
            "Tokenizer", "TokenizerFactory", "DefaultTokenizerFactory",
@@ -24,4 +27,7 @@ __all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator"
            "Glove", "DistributedWord2Vec", "DistributedGlove",
            "SparkWord2Vec", "SparkGlove", "partition_sentences",
            "InvertedIndex", "BagOfWordsVectorizer", "TfidfVectorizer",
-           "WordVectorSerializer", "StaticWordVectors"]
+           "WordVectorSerializer", "StaticWordVectors",
+           "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+           "KoreanTokenizerFactory", "UimaTokenizerFactory",
+           "AnnotationPipeline"]

@@ -1,0 +1,377 @@
+"""CJK + UIMA-style language modules for the text pipeline.
+
+TPU-native equivalent of the reference's language modules (SURVEY.md §2.5
+"Language modules"): ``deeplearning4j-nlp-chinese`` (bundled ansj segmenter),
+``deeplearning4j-nlp-japanese`` (bundled Kuromoji), ``deeplearning4j-nlp-korean``
+(arirang wrapper) and ``deeplearning4j-nlp-uima`` (ClearTK annotation
+pipeline). The reference bundles ~24k LoC of third-party morphological
+analyzers; re-bundling them is neither possible (zero egress) nor useful.
+What the framework actually *needs* from those modules is the contract each
+gives the NLP stack: a ``TokenizerFactory`` that turns CJK text (which has no
+spaces) into word tokens, and a UIMA-like annotation pipeline (sentence
+segmentation → tokenization → POS). This module implements those contracts
+natively:
+
+- ``ChineseTokenizerFactory`` — forward-maximum-matching segmentation over a
+  user-extendable lexicon with single-character fallback (the core dictionary
+  strategy of ansj's DAT segmenter, reference
+  ``deeplearning4j-nlp-chinese/.../ChineseTokenizerFactory``), Latin/digit
+  runs kept whole.
+- ``JapaneseTokenizerFactory`` — script-class segmentation (kanji / hiragana /
+  katakana / Latin runs) with lexicon longest-match and trailing-particle
+  splitting (the observable behavior of the Kuromoji wrapper in
+  ``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory``).
+- ``KoreanTokenizerFactory`` — whitespace eojeol split + josa/particle
+  suffix stripping (arirang's stemming contract, reference
+  ``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory``).
+- ``UimaTokenizerFactory`` / ``AnnotationPipeline`` — sentence segmenter +
+  tokenizer + rule-based POS tagger behind one pipeline object (reference
+  ``deeplearning4j-nlp-uima/.../UimaTokenizerFactory``,
+  ``annotator/SentenceAnnotator``, ``annotator/PoStagger``).
+
+All factories honor ``set_token_pre_processor`` like every other
+``TokenizerFactory`` so they drop into Word2Vec/ParagraphVectors/TF-IDF
+unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .text import Tokenizer, TokenizerFactory, TokenPreProcess
+
+
+# --------------------------------------------------------------- script tests
+def _is_cjk(ch: str) -> bool:
+    o = ord(ch)
+    return (0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
+            or 0xF900 <= o <= 0xFAFF or 0x20000 <= o <= 0x2FA1F)
+
+
+def _is_hiragana(ch: str) -> bool:
+    return 0x3040 <= ord(ch) <= 0x309F
+
+
+def _is_katakana(ch: str) -> bool:
+    return 0x30A0 <= ord(ch) <= 0x30FF
+
+
+def _is_hangul(ch: str) -> bool:
+    o = ord(ch)
+    return 0xAC00 <= o <= 0xD7A3 or 0x1100 <= o <= 0x11FF
+
+
+def _script_class(ch: str) -> str:
+    if _is_hiragana(ch):
+        return "hira"
+    if _is_katakana(ch):
+        return "kata"
+    if _is_cjk(ch):
+        return "han"
+    if _is_hangul(ch):
+        return "hangul"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+def _script_runs(text: str) -> List[Tuple[str, str]]:
+    """Split ``text`` into maximal same-script runs → [(run, class)]."""
+    return [("".join(grp), cls)
+            for cls, grp in itertools.groupby(text, key=_script_class)]
+
+
+# ------------------------------------------------------------------- Chinese
+#: Seed lexicon: common multi-character words so segmentation is useful out of
+#: the box; extend per-corpus via ``ChineseTokenizerFactory(lexicon=...)``.
+CHINESE_LEXICON = {
+    "中国", "我们", "你们", "他们", "今天", "明天", "昨天", "时间", "工作",
+    "学习", "深度", "深度学习", "机器", "机器学习", "神经", "网络",
+    "神经网络", "数据", "模型", "训练", "语言", "自然", "自然语言",
+    "处理", "计算", "计算机", "人工", "智能", "人工智能", "北京", "上海",
+    "大学", "老师", "学生", "朋友", "喜欢", "可以", "没有", "什么",
+    "知道", "现在", "因为", "所以", "如果", "但是", "已经", "开始",
+}
+
+
+class _MaxMatchSegmenter:
+    """Forward maximum matching over a lexicon; unmatched CJK chars emitted
+    singly (ansj's dictionary-first strategy without the 3rd-party DAT)."""
+
+    def __init__(self, lexicon: Iterable[str]):
+        self._lex = set(lexicon)
+        self._max_len = max((len(w) for w in self._lex), default=1)
+
+    def add(self, *words: str):
+        for w in words:
+            self._lex.add(w)
+            self._max_len = max(self._max_len, len(w))
+
+    def segment(self, run: str) -> List[str]:
+        out: List[str] = []
+        i, n = 0, len(run)
+        while i < n:
+            for L in range(min(self._max_len, n - i), 1, -1):
+                if run[i:i + L] in self._lex:
+                    out.append(run[i:i + L])
+                    i += L
+                    break
+            else:
+                out.append(run[i])
+                i += 1
+        return out
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """Dictionary forward-maximum-matching Chinese tokenizer (reference
+    ``deeplearning4j-nlp-chinese/.../tokenization/tokenizerFactory/
+    ChineseTokenizerFactory.java`` over the bundled ansj segmenter)."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        self._pre: Optional[TokenPreProcess] = None
+        self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
+                                       else CHINESE_LEXICON)
+
+    def add_words(self, *words: str):
+        """Extend the lexicon (ansj's user-dictionary seam)."""
+        self._seg.add(*words)
+        return self
+
+    addWords = add_words
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for run, cls in _script_runs(text):
+            if cls == "han":
+                tokens.extend(self._seg.segment(run))
+            elif cls in ("latin", "kata", "hira", "hangul"):
+                tokens.append(run)
+            # space/punct dropped
+        return self._finish(tokens)
+
+
+# ------------------------------------------------------------------ Japanese
+#: Common trailing hiragana particles/copulas split off kanji+hiragana runs
+#: (Kuromoji segments these as separate morphemes).
+JAPANESE_PARTICLES = (
+    "でした", "ました", "です", "ます", "から", "まで", "には", "とは",
+    "は", "が", "を", "に", "へ", "と", "で", "も", "の", "や", "ね", "よ",
+    "か", "な",
+)
+
+#: Seed lexicon for common multi-kanji words.
+JAPANESE_LEXICON = {
+    "日本", "東京", "大学", "学生", "先生", "機械", "学習", "機械学習",
+    "言語", "自然", "自然言語", "処理", "深層", "深層学習", "好き",
+}
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Script-run + particle-split Japanese tokenizer (contract of reference
+    ``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory.java`` over
+    bundled Kuromoji). Kanji runs are lexicon max-matched; hiragana runs are
+    greedily split into known particles (longest first) where possible."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        self._pre: Optional[TokenPreProcess] = None
+        self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
+                                       else JAPANESE_LEXICON)
+        self._particles = sorted(JAPANESE_PARTICLES, key=len, reverse=True)
+
+    def _split_hiragana(self, run: str) -> List[str]:
+        """Peel ONE longest known particle off the END of the run (a hiragana
+        run after a kanji run is typically okurigana/content + a trailing
+        particle; compound tails like でした are single lexicon entries).
+        Splitting mid-word, or peeling repeatedly, would shred content words
+        like ありがとう / もも whose characters double as particles."""
+        for p in self._particles:
+            if run.endswith(p) and run != p:
+                return [run[:-len(p)], p]
+        return [run]
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for run, cls in _script_runs(text):
+            if cls == "han":
+                tokens.extend(self._seg.segment(run))
+            elif cls == "hira":
+                tokens.extend(self._split_hiragana(run))
+            elif cls in ("kata", "latin", "hangul"):
+                tokens.append(run)
+        return self._finish(tokens)
+
+
+# -------------------------------------------------------------------- Korean
+#: Common josa (case particles) stripped from eojeol tails — arirang's
+#: observable stemming behavior for embedding pipelines.
+KOREAN_JOSA = (
+    "에서는", "에서", "에게", "으로", "로", "은", "는", "이", "가", "을",
+    "를", "에", "와", "과", "도", "만", "의",
+)
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Whitespace eojeol split + josa suffix strip (contract of reference
+    ``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory.java`` over the
+    arirang analyzer)."""
+
+    def __init__(self, strip_josa: bool = True):
+        self._pre: Optional[TokenPreProcess] = None
+        self._strip = strip_josa
+        self._josa = sorted(KOREAN_JOSA, key=len, reverse=True)
+
+    def _stem(self, word: str) -> str:
+        if not self._strip or not all(_is_hangul(c) for c in word):
+            return word
+        for j in self._josa:
+            if len(word) > len(j) and word.endswith(j):
+                return word[:-len(j)]
+        return word
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for raw in text.split():
+            # punctuation splits the eojeol (안녕,세상 → 안녕 / 세상)
+            for word, cls in _script_runs(raw):
+                if cls != "punct":
+                    tokens.append(self._stem(word))
+        return self._finish(tokens)
+
+
+# ------------------------------------------------------- UIMA-style pipeline
+_ABBREV = {"mr", "mrs", "ms", "dr", "prof", "st", "vs", "etc", "e.g", "i.e",
+           "fig", "jr", "sr"}
+
+
+class SentenceAnnotator:
+    """Rule-based sentence segmentation (reference
+    ``deeplearning4j-nlp-uima/.../annotator/SentenceAnnotator.java``):
+    split on ``.!?`` with abbreviation and decimal guards."""
+
+    def annotate(self, text: str) -> List[str]:
+        sentences: List[str] = []
+        buf: List[str] = []
+        i, n = 0, len(text)
+        while i < n:
+            ch = text[i]
+            buf.append(ch)
+            if ch in ".!?":
+                prev = "".join(buf).rstrip(".!?").split()
+                last = prev[-1].lower().rstrip(".") if prev else ""
+                nxt = text[i + 1] if i + 1 < n else " "
+                if ch == "." and (last in _ABBREV or nxt.isdigit()):
+                    i += 1
+                    continue
+                if nxt.isspace() or i + 1 == n:
+                    s = "".join(buf).strip()
+                    if s:
+                        sentences.append(s)
+                    buf = []
+            i += 1
+        tail = "".join(buf).strip()
+        if tail:
+            sentences.append(tail)
+        return sentences
+
+
+class TokenizerAnnotator:
+    """Penn-treebank-ish tokenization: words, numbers, punctuation tokens
+    (reference ``annotator/TokenizerAnnotator.java``)."""
+
+    _PAT = re.compile(
+        r"[^\W\d_]+(?:'[^\W\d_]+)?|\d+(?:\.\d+)?|[^\w\s]", re.UNICODE)
+
+    def annotate(self, sentence: str) -> List[str]:
+        return self._PAT.findall(sentence)
+
+
+class PoStagger:
+    """Suffix-rule POS tagger over Penn tags (reference
+    ``annotator/PoStagger.java`` via ClearTK; rule-based stand-in with the
+    same annotation contract: token → tag)."""
+
+    _DET = {"the", "a", "an", "this", "that", "these", "those"}
+    _PRON = {"i", "you", "he", "she", "it", "we", "they", "me", "him", "her",
+             "us", "them"}
+    _PREP = {"in", "on", "at", "of", "to", "by", "for", "with", "from",
+             "over", "under", "into"}
+    _CONJ = {"and", "or", "but", "nor", "so", "yet"}
+    _MODAL = {"can", "could", "will", "would", "shall", "should", "may",
+              "might", "must"}
+    _BE = {"is", "are", "was", "were", "be", "been", "am", "being"}
+
+    def tag(self, token: str) -> str:
+        t = token.lower()
+        if re.fullmatch(r"\d+(\.\d+)?", t):
+            return "CD"
+        if not any(c.isalnum() for c in t):
+            return "."
+        if t in self._DET:
+            return "DT"
+        if t in self._PRON:
+            return "PRP"
+        if t in self._PREP:
+            return "IN"
+        if t in self._CONJ:
+            return "CC"
+        if t in self._MODAL:
+            return "MD"
+        if t in self._BE:
+            return "VB"
+        if t.endswith("ing"):
+            return "VBG"
+        if t.endswith("ed"):
+            return "VBD"
+        if t.endswith("ly"):
+            return "RB"
+        if t.endswith(("ous", "ful", "ive", "able", "ible", "al", "ic")):
+            return "JJ"
+        if t.endswith("s") and len(t) > 3 and not t.endswith("ss"):
+            return "NNS"
+        if token[:1].isupper():
+            return "NNP"
+        return "NN"
+
+    def annotate(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
+        return [(tok, self.tag(tok)) for tok in tokens]
+
+
+class AnnotationPipeline:
+    """Sentence → token → POS pipeline (the UIMA AnalysisEngine aggregate the
+    reference builds in ``UimaResource``/``UimaTokenizerFactory``)."""
+
+    def __init__(self):
+        self.sentences = SentenceAnnotator()
+        self.tokenizer = TokenizerAnnotator()
+        self.pos = PoStagger()
+
+    def process(self, text: str) -> List[Dict[str, object]]:
+        out: List[Dict[str, object]] = []
+        for sent in self.sentences.annotate(text):
+            toks = self.tokenizer.annotate(sent)
+            out.append({"sentence": sent, "tokens": toks,
+                        "pos": self.pos.annotate(toks)})
+        return out
+
+
+class UimaTokenizerFactory(TokenizerFactory):
+    """TokenizerFactory over the annotation pipeline (reference
+    ``deeplearning4j-nlp-uima/.../UimaTokenizerFactory.java``)."""
+
+    def __init__(self, pipeline: Optional[AnnotationPipeline] = None,
+                 drop_punct: bool = True):
+        self._pre: Optional[TokenPreProcess] = None
+        self._pipeline = pipeline or AnnotationPipeline()
+        self._drop_punct = drop_punct
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for ann in self._pipeline.process(text):
+            for tok, tag in ann["pos"]:
+                if self._drop_punct and tag == ".":
+                    continue
+                tokens.append(tok)
+        return self._finish(tokens)

@@ -79,6 +79,14 @@ class TokenizerFactory:
 
     setTokenPreProcessor = set_token_pre_processor
 
+    def _finish(self, tokens: List[str]) -> Tokenizer:
+        """Apply the configured preprocessor and drop emptied tokens — the
+        shared tail of every factory's ``create``."""
+        pre = getattr(self, "_pre", None)
+        if pre is not None:
+            tokens = [pre(t) for t in tokens]
+        return Tokenizer([t for t in tokens if t])
+
 
 class DefaultTokenizerFactory(TokenizerFactory):
     """Whitespace tokenization + optional preprocessor (reference
@@ -88,10 +96,7 @@ class DefaultTokenizerFactory(TokenizerFactory):
         self._pre: Optional[TokenPreProcess] = None
 
     def create(self, text: str) -> Tokenizer:
-        tokens = text.split()
-        if self._pre is not None:
-            tokens = [self._pre(t) for t in tokens]
-        return Tokenizer([t for t in tokens if t])
+        return self._finish(text.split())
 
 
 class NGramTokenizerFactory(TokenizerFactory):
@@ -109,7 +114,7 @@ class NGramTokenizerFactory(TokenizerFactory):
         for n in range(self._min, self._max + 1):
             for i in range(len(tokens) - n + 1):
                 out.append(" ".join(tokens[i:i + n]))
-        return Tokenizer(out)
+        return self._finish(out)
 
 
 # ---------------------------------------------------------- sentence sources

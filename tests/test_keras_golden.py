@@ -255,3 +255,29 @@ def test_extended_layer_mappers_rnn(tmp_path):
     net = KerasModelImport.import_keras_sequential_model_and_weights(path)
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_use_bias_false_zeroes_framework_bias(tmp_path):
+    """use_bias=False RNNs: Keras ships no bias dataset; the import must zero
+    the framework's initialized bias (LSTM forget gate starts at 1.0), not
+    silently keep it."""
+    tf = pytest.importorskip("tensorflow")
+    import os as _os
+    _os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    tf.keras.utils.set_random_seed(13)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 4)),
+        tf.keras.layers.LSTM(5, use_bias=False, return_sequences=True,
+                             name="l"),
+        tf.keras.layers.Bidirectional(
+            tf.keras.layers.LSTM(3, use_bias=False), name="bi"),
+        tf.keras.layers.Dense(2, activation="softmax", name="d"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    x = np.random.default_rng(3).normal(size=(2, 6, 4)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    path = str(tmp_path / "nobias.h5")
+    m.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,116 @@
+"""CJK + UIMA language modules (reference deeplearning4j-nlp-{chinese,
+japanese,korean,uima}; SURVEY.md §2.5 "Language modules")."""
+from deeplearning4j_tpu.nlp.lang import (AnnotationPipeline,
+                                         ChineseTokenizerFactory,
+                                         JapaneseTokenizerFactory,
+                                         KoreanTokenizerFactory, PoStagger,
+                                         SentenceAnnotator,
+                                         UimaTokenizerFactory)
+from deeplearning4j_tpu.nlp.text import LowCasePreProcessor
+
+
+def test_chinese_max_match_segmentation():
+    f = ChineseTokenizerFactory()
+    toks = f.create("我们喜欢深度学习和神经网络").get_tokens()
+    # lexicon words win over per-char fallback; FMM takes the longest match
+    assert "深度学习" in toks
+    assert "神经网络" in toks
+    assert "我们" in toks and "喜欢" in toks
+    assert "和" in toks  # non-lexicon char falls back to single character
+
+
+def test_chinese_mixed_latin_and_user_dict():
+    f = ChineseTokenizerFactory()
+    toks = f.create("我用JAX训练模型").get_tokens()
+    assert "JAX" in toks and "训练" in toks and "模型" in toks
+    f.add_words("用户词")
+    assert "用户词" in f.create("这是用户词测试").get_tokens()
+
+
+def test_japanese_particle_and_kanji_split():
+    f = JapaneseTokenizerFactory()
+    toks = f.create("私は機械学習が好きです").get_tokens()
+    assert "機械学習" in toks          # lexicon max-match on the kanji run
+    assert "は" in toks and "が" in toks  # particles split out
+    assert "です" in toks
+
+
+def test_japanese_katakana_run_kept_whole():
+    f = JapaneseTokenizerFactory()
+    toks = f.create("テンソルの計算").get_tokens()
+    assert "テンソル" in toks and "の" in toks
+
+
+def test_japanese_no_midword_particle_shredding():
+    f = JapaneseTokenizerFactory()
+    # particles peel off the END of a hiragana run only; content words with
+    # particle characters inside survive whole
+    assert "ありがとう" in f.create("ありがとう").get_tokens()
+    assert f.create("ももが").get_tokens() == ["もも", "が"]
+
+
+def test_chinese_supplementary_plane_cjk():
+    f = ChineseTokenizerFactory()
+    toks = f.create("𠮷野家で123").get_tokens()  # 𠮷 = U+20BD7 (ext B)
+    assert "𠮷" in toks and "123" in toks
+    assert all("𠮷" not in t or t == "𠮷" for t in toks)
+
+
+def test_korean_punct_splits_eojeol():
+    toks = KoreanTokenizerFactory().create("안녕,세상").get_tokens()
+    assert toks == ["안녕", "세상"]
+
+
+def test_korean_josa_stripping():
+    f = KoreanTokenizerFactory()
+    toks = f.create("학교에서 친구를 만났다").get_tokens()
+    assert "학교" in toks      # 에서 stripped
+    assert "친구" in toks      # 를 stripped
+    assert "만났다" in toks
+    raw = KoreanTokenizerFactory(strip_josa=False).create(
+        "학교에서 친구를").get_tokens()
+    assert raw == ["학교에서", "친구를"]
+
+
+def test_sentence_annotator_guards():
+    s = SentenceAnnotator()
+    out = s.annotate("Dr. Smith trains models. Accuracy hit 99.5 today! Done?")
+    assert out == ["Dr. Smith trains models.", "Accuracy hit 99.5 today!",
+                   "Done?"]
+
+
+def test_pos_tagger_rules():
+    p = PoStagger()
+    assert p.tag("the") == "DT"
+    assert p.tag("running") == "VBG"
+    assert p.tag("trained") == "VBD"
+    assert p.tag("quickly") == "RB"
+    assert p.tag("42") == "CD"
+    assert p.tag("models") == "NNS"
+
+
+def test_uima_pipeline_and_factory():
+    pipe = AnnotationPipeline()
+    anns = pipe.process("The model trains fast. It converged!")
+    assert len(anns) == 2
+    assert ("The", "DT") in anns[0]["pos"]
+    f = UimaTokenizerFactory()
+    f.set_token_pre_processor(LowCasePreProcessor())
+    toks = f.create("The model trains fast. It converged!").get_tokens()
+    assert "the" in toks and "converged" in toks
+    assert "." not in toks and "!" not in toks
+
+
+def test_cjk_factories_feed_word2vec():
+    # the factories drop into the embedding stack unchanged (the reference's
+    # whole point for these modules)
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    base = ["我们喜欢深度学习", "我们学习神经网络", "模型训练数据"]
+    sentences = [base[i % 3] for i in range(60)]
+    w2v = (Word2Vec.builder().layer_size(16).window_size(2).epochs(2)
+           .min_word_frequency(1).seed(1)
+           .tokenizer_factory(ChineseTokenizerFactory()).build())
+    w2v.fit(sentences)
+    assert w2v.word_vector("深度学习") is not None
+    assert w2v.word_vector("我们") is not None
