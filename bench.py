@@ -23,32 +23,48 @@ import time
 import numpy as np
 
 
+def _sync(x):
+    """Reliable completion barrier: materialize the VALUE of (a leaf of) ``x``
+    on the host. Under the axon TPU tunnel ``jax.block_until_ready`` can
+    return before the device program finishes (measured: a VGG16 train step
+    "completing" in 0.4 ms), so timing must gate on an actual device→host
+    value transfer — the loss scalar, whose value transitively requires every
+    queued step's compute."""
+    import jax
+    leaf = jax.tree_util.tree_leaves(x)[-1]
+    return np.asarray(leaf)
+
+
 def _time_steps(step_fn, n_warmup=3, n_timed=10):
-    """Run ``step_fn(i)`` (must return a device value to block on) and return
-    the timed-phase duration in seconds."""
+    """Run ``step_fn(i)`` (must return a device value whose VALUE depends on
+    the step's compute — the loss) and return the timed-phase duration."""
     out = None
     for i in range(n_warmup):
         out = step_fn(i)
-    import jax
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     for i in range(n_warmup, n_warmup + n_timed):
         out = step_fn(i)
-    jax.block_until_ready(out)
+    _sync(out)
     return time.perf_counter() - t0
 
 
 def _cnn_throughput(model_cls, batch, img, classes=1000, iters=10,
                     compute_dtype="bfloat16", **model_kw):
-    """images/sec for a zoo ComputationGraph model on synthetic data."""
+    """images/sec for a zoo CNN (ComputationGraph or MultiLayerNetwork) on
+    synthetic data."""
     import jax
     import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
     from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     model = model_cls(num_classes=classes, **model_kw)
     conf = model.conf()
     conf.global_conf.compute_dtype = compute_dtype
-    net = ComputationGraph(conf).init()
+    is_graph = isinstance(conf, ComputationGraphConfiguration)
+    net = (ComputationGraph(conf) if is_graph
+           else MultiLayerNetwork(conf)).init()
     rng = np.random.default_rng(0)
     c, h, w = img
     f = jnp.asarray(rng.normal(size=(batch, c, h, w)), jnp.float32)
@@ -58,10 +74,13 @@ def _cnn_throughput(model_cls, batch, img, classes=1000, iters=10,
     state = {"p": net.params, "s": net.states, "u": net.updater_state}
     key = jax.random.PRNGKey(0)
 
+    feats = (f,) if is_graph else f
+    labels = (l,) if is_graph else l
+
     def one(i):
         it = jnp.asarray(i, jnp.int32)
         state["p"], state["s"], state["u"], loss = step(
-            state["p"], state["s"], state["u"], it, key, (f,), (l,),
+            state["p"], state["s"], state["u"], it, key, feats, labels,
             None, None)
         return loss
 
@@ -139,11 +158,12 @@ def bench_graves_lstm(batch=64, seq_len=200, tbptt=50, vocab=80, width=512):
 
     ds = DataSet(f, l)
     net.fit(ds)  # warmup/compile all TBPTT segment shapes
+    _sync(net.score_)
     n = 3
     t0 = time.perf_counter()
     for _ in range(n):
         net.fit(ds)
-    jax.block_until_ready(net.params)
+    _sync(net.score_)  # value fetch: transitively waits on every segment step
     dt = time.perf_counter() - t0
     return batch * seq_len * n / dt
 
@@ -188,10 +208,13 @@ def bench_keras_import_parallel(batch_per_step=256, iters=10):
     pw = (ParallelWrapper.Builder(net).training_mode(TrainingMode.AVERAGING)
           .averaging_frequency(1).build())
     pw.fit(ListDataSetIterator(dsets))  # compile + one pass
+    _sync(net.params)
     t0 = time.perf_counter()
     for _ in range(iters):
         pw.fit(ListDataSetIterator(dsets))
-    jax.block_until_ready(net.params)
+    # value-fetch a param leaf (pw.last_score is already a host float);
+    # axon block_until_ready is unreliable — see _sync
+    _sync(net.params)
     dt = time.perf_counter() - t0
     return batch_per_step * iters / dt
 

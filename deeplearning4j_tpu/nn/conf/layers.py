@@ -145,6 +145,26 @@ class DenseLayer(FeedForwardLayer):
 
 @register
 @dataclasses.dataclass
+class MoEDenseLayer(FeedForwardLayer):
+    """Mixture-of-experts dense layer — net-new vs the 0.9.x reference
+    (like :class:`SelfAttentionLayer`), included because expert parallelism
+    is a first-class mesh axis in the TPU build: the expert dim of the
+    parameters shards over the ``expert`` mesh axis
+    (``parallel/expert.py``), XLA partitioning the per-expert einsums.
+
+    Dense (Shazeer-style) top-k routing: every token's input reaches each
+    local expert shard, gate weights zero the non-selected experts, and the
+    expert-dim reduction becomes a psum over the axis. ``aux_loss_weight``
+    scales the Switch-Transformer load-balancing loss, accumulated through
+    the forward ``ctx`` into the training objective."""
+    num_experts: int = 4
+    top_k: int = 2
+    aux_loss_weight: float = 1e-2
+    has_bias: bool = True
+
+
+@register
+@dataclasses.dataclass
 class ConvolutionLayer(FeedForwardLayer):
     """2-D convolution (reference ``nn/conf/layers/ConvolutionLayer.java``).
 

@@ -194,7 +194,8 @@ class ComputationGraph:
         reg = 0.0
         for name, impl in self.impls.items():
             reg = reg + impl.regularization(params[name])
-        return total + reg, (new_states, ctx.get("rnn_state_out"))
+        aux = ctx.get("aux_loss", 0.0)  # e.g. MoE load balancing
+        return total + reg + aux, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
     def _raw_update_core(self):

@@ -14,4 +14,5 @@ from . import output  # noqa: F401
 from . import variational  # noqa: F401
 from . import objdetect  # noqa: F401
 from . import attention  # noqa: F401
+from . import moe  # noqa: F401
 from . import wrapper  # noqa: F401

@@ -1,0 +1,94 @@
+"""Mixture-of-experts dense layer (expert parallelism).
+
+Net-new vs the 0.9.x reference (SURVEY.md §2.4: data parallelism only), the
+``expert`` counterpart to the net-new tensor/sequence/pipeline axes. Dense
+top-k dispatch in einsum form so the expert dimension is a *shardable array
+axis*: with ``W: [E, n_in, n_out]`` sharded over the mesh ``expert`` axis
+(``parallel/expert.py``), XLA partitions the per-expert einsum so each device
+computes only its expert shard and the final expert-dim reduction lowers to a
+psum over ICI — expert parallelism without a hand-written all-to-all.
+
+The Switch-Transformer load-balancing auxiliary loss (num_experts × Σ_e
+fraction_of_tokens_routed_to_e × mean_gate_prob_e) accumulates through the
+forward ``ctx`` into the training objective (``nn/multilayer.py`` /
+``nn/graph.py`` add ``ctx['aux_loss']`` to loss+reg).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, implements, pet_dtype
+
+
+@implements("MoEDenseLayer")
+class MoEDenseImpl(LayerImpl):
+    def init(self, rng):
+        c = self.conf
+        E = c.num_experts
+        kg, kw = jax.random.split(rng)
+        params = {
+            # router: small, always f32-precision-critical
+            "Wg": self._init_w(kg, (c.n_in, E), c.n_in, E),
+            # per-expert dense weights, expert dim leading (shardable)
+            "W": self._init_w(kw, (E, c.n_in, c.n_out), c.n_in, c.n_out),
+        }
+        if c.has_bias:
+            params["b"] = self._init_b((E, c.n_out))
+        return params, {}
+
+    def _router_dtype(self):
+        """Router math runs at least f32 (precision-critical softmax), and
+        full f64 under the gradient-check dtype policy."""
+        return jnp.promote_types(jnp.float32, self.dtype)
+
+    def _route(self, xr, Wg):
+        """Top-k gates: softmax over experts, keep the k largest, renormalize.
+        Returns gates [b, E] (zero outside the top-k) and the full probs."""
+        c = self.conf
+        logits = xr @ Wg.astype(xr.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if c.top_k >= c.num_experts:
+            return probs, probs
+        # index-based mask: exactly top_k experts even on tied probs (a
+        # threshold mask would gate ALL experts for an all-uniform row)
+        _, idxs = jax.lax.top_k(probs, c.top_k)
+        mask = jnp.sum(jax.nn.one_hot(idxs, c.num_experts, dtype=probs.dtype),
+                       axis=-2)
+        gates = probs * mask
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+        return gates, probs
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None,
+                ctx=None):
+        c = self.conf
+        x = self.maybe_dropout(x, train, rng)
+        flat = x.reshape(-1, x.shape[-1])                # [n, F] (rnn-safe)
+        rdt = self._router_dtype()
+        gates, probs = self._route(flat.astype(rdt), params["Wg"])
+
+        cd = self.compute_dtype
+        # per-expert dense: [n, F] × [E, F, O] → [n, E, O]; expert dim E is
+        # a plain array axis, shardable over the mesh 'expert' axis
+        h = jnp.einsum("nf,efo->neo", flat.astype(cd),
+                       params["W"].astype(cd),
+                       preferred_element_type=pet_dtype(cd))
+        if "b" in params:
+            h = h + params["b"].astype(h.dtype)
+        # gate-weighted combine; reduction over E → psum when E is sharded
+        y = jnp.einsum("ne,neo->no", gates.astype(h.dtype), h,
+                       preferred_element_type=pet_dtype(cd))
+        y = y.reshape(x.shape[:-1] + (c.n_out,))
+
+        if ctx is not None and c.aux_loss_weight > 0.0:
+            # Switch load-balancing loss: E * Σ_e f_e · P_e, where f_e is the
+            # fraction of tokens whose TOP-1 expert is e and P_e the mean
+            # router probability for e; minimized (=1) at uniform routing
+            top1 = jnp.argmax(probs, axis=-1)
+            f = jnp.mean(jax.nn.one_hot(top1, c.num_experts, dtype=rdt),
+                         axis=0)
+            P = jnp.mean(probs, axis=0)
+            aux = c.aux_loss_weight * c.num_experts * jnp.sum(f * P)
+            ctx["aux_loss"] = ctx.get("aux_loss", 0.0) + aux
+
+        return self.activation(y).astype(self.out_dtype), state

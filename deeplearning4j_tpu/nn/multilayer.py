@@ -179,7 +179,10 @@ class MultiLayerNetwork:
         reg = 0.0
         for i, impl in enumerate(self.impls):
             reg = reg + impl.regularization(params[str(i)])
-        return loss + reg, (new_states, ctx.get("rnn_state_out"))
+        # activation-dependent auxiliary losses (e.g. MoE load balancing)
+        # accumulate in ctx during the forward pass
+        aux = ctx.get("aux_loss", 0.0)
+        return loss + reg + aux, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
     def _raw_update_core(self):

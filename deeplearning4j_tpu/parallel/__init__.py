@@ -1,8 +1,10 @@
 """Parallelism & distribution (reference ``deeplearning4j-scaleout/``,
 SURVEY.md §2.4): mesh/sharding substrate, ParallelWrapper (sync + local-SGD
 data parallelism), ParallelInference, gradient accumulation/encoding,
-TrainingMaster SPI with the collective masters, plus TPU-first extensions —
-tensor parallelism and ring/Ulysses sequence parallelism."""
+TrainingMaster SPI with the collective masters, plus TPU-first extensions
+completing the mesh-axis family: tensor (``model``), sequence
+(ring/Ulysses), pipeline (GPipe over ``pipe``) and expert (MoE over
+``expert``) parallelism."""
 from .sharding import (DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS, make_mesh,
                        replicated, batch_sharded, shard_batch,
                        data_parallel_step)
@@ -20,6 +22,9 @@ from .distributed import (ProcessLocalIterator, is_chief,
                           SparkComputationGraph, initialize_distributed)
 from .sequence import ring_attention, ulysses_attention, full_attention
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
+from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
+                       stack_stage_params)
+from .expert import EXPERT_AXIS, expert_rules, expert_parallel_step
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQUENCE_AXIS", "make_mesh", "replicated",
@@ -34,4 +39,6 @@ __all__ = [
     "ProcessLocalIterator", "is_chief",
     "ring_attention", "ulysses_attention", "full_attention",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
+    "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
+    "EXPERT_AXIS", "expert_rules", "expert_parallel_step",
 ]

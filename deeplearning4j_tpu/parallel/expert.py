@@ -1,0 +1,54 @@
+"""Expert parallelism: shard MoE expert parameters over an ``expert`` axis.
+
+Net-new vs the 0.9.x reference, completing the dp/tp/pp/sp/ep mesh-axis
+family. An :class:`~deeplearning4j_tpu.nn.conf.layers.MoEDenseLayer` keeps
+its experts on a leading array axis (``W: [E, n_in, n_out]`` —
+``nn/layers/moe.py``); expert parallelism is therefore *just a sharding
+rule*: annotate that axis over the mesh ``expert`` dim and jit the SAME
+train step — XLA partitions the per-expert einsums so each device holds and
+computes only its expert shard, and the gate-weighted combine's expert-dim
+reduction lowers to a psum over ICI. Composes with data parallelism by
+adding a ``data`` mesh axis (batch sharded, experts replicated across it).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .tensor import tensor_parallel_step
+
+EXPERT_AXIS = "expert"
+
+
+def expert_rules(net, axis: str = EXPERT_AXIS) -> Dict[str, P]:
+    """{param-path regex: PartitionSpec} sharding every MoE layer's expert
+    dim; the router (``Wg``) stays replicated (it is tiny and every token
+    needs it)."""
+    rules: Dict[str, P] = {}
+    layers = getattr(net.conf, "layers", None)
+    if layers is not None:  # MultiLayerNetwork
+        it = [(str(i), l) for i, l in enumerate(layers)]
+    else:  # ComputationGraph
+        it = [(name, v.layer) for name, v in net.conf.vertices.items()
+              if getattr(v, "layer", None) is not None]
+    for key, layer in it:
+        if type(layer).__name__ == "MoEDenseLayer":
+            k = re.escape(key)  # CG vertex names may hold regex metachars
+            rules[rf"^{k}/W$"] = P(axis, None, None)
+            rules[rf"^{k}/b$"] = P(axis, None)
+    return rules
+
+
+def expert_parallel_step(net, mesh: Mesh,
+                         extra_rules: Optional[Dict[str, P]] = None):
+    """Jit the network's train step with expert shardings (+DP over ``data``
+    when that axis is present). Returns ``(step, place)`` like
+    :func:`~deeplearning4j_tpu.parallel.tensor.tensor_parallel_step`, whose
+    machinery (updater-state mirroring, placement) is reused — EP is a rules
+    preset, not a different engine."""
+    rules = expert_rules(net)
+    if extra_rules:
+        rules.update(extra_rules)
+    return tensor_parallel_step(net, mesh, rules=rules)

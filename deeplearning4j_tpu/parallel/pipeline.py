@@ -1,0 +1,182 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pipe`` mesh axis.
+
+Net-new capability vs the 0.9.x reference (SURVEY.md §2.4: only data
+parallelism exists there), completing the mesh-axis family alongside tensor
+(``parallel/tensor.py``) and sequence (``parallel/sequence.py``) parallelism.
+
+TPU-first design (the standard XLA pipelining pattern, not a thread-per-stage
+port): the S pipeline stages must be structurally identical blocks — their
+parameters are STACKED on a leading stage axis and sharded across the ``pipe``
+mesh axis, so each device holds 1/S of the body parameters. The whole GPipe
+schedule — M microbatches flowing through S stages in M+S-1 ticks, activations
+hopping stage→stage over ICI via ``ppermute`` — is ONE jitted ``lax.scan``
+inside ``shard_map``. Because ``scan``/``ppermute``/``where`` are all
+differentiable, reverse-mode AD of the scheduled forward IS the reverse
+pipeline schedule (backward bubbles included) — no hand-written backward pass,
+the exact analogue of how the containers get backprop from AD.
+
+The homogeneous-stage constraint is the same one production TPU pipelining
+makes (stacked transformer blocks); heterogeneous nets pipeline their
+homogeneous middle and keep entry/head replicated, which is what
+:class:`GPipe` does with its ``head_fn``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .sharding import pvary
+
+PIPELINE_AXIS = "pipe"
+
+_tm = jax.tree_util.tree_map
+
+
+def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                  mesh: Mesh, axis: str = PIPELINE_AXIS,
+                  data_axis: Optional[str] = None):
+    """Build ``pipelined(stacked_params, xs) -> ys``.
+
+    ``stacked_params``: pytree whose leaves carry a leading stage dim of
+    extent S = mesh.shape[axis] (sharded over ``axis``). ``xs``: microbatches
+    ``[M, mb, ...]``. ``stage_fn(params_slice, x) -> y`` must map ``[mb, F] →
+    [mb, F]`` (same shape family every stage — the SPMD homogeneity rule).
+    Returns ``ys`` ``[M, mb, ...]``, the last stage's outputs, replicated
+    across ``axis``. When ``data_axis`` is given the microbatch dim stays
+    sharded over it (combined DP×PP).
+    """
+    S = mesh.shape[axis]
+
+    def per_device(params, xs):
+        params = _tm(lambda p: p[0], params)      # [1, ...] local slice → stage
+        idx = lax.axis_index(axis)
+        M = xs.shape[0]
+        xs = pvary(xs, (axis,))
+        perm = [(j, (j + 1) % S) for j in range(S)]
+        buf0 = jnp.zeros_like(xs[0])
+
+        def tick(buf, t):
+            # stage 0 ingests microbatch t (zeros once the feed is drained);
+            # everyone else consumes the activation received last tick
+            x_t = jnp.where(t < M, xs[jnp.minimum(t, M - 1)],
+                            jnp.zeros_like(xs[0]))
+            inp = jnp.where(idx == 0, x_t, buf)
+            out = stage_fn(params, inp)
+            nxt = lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        _, outs = lax.scan(tick, buf0, jnp.arange(M + S - 1))
+        # tick t on the last stage finishes microbatch t-(S-1): ticks
+        # S-1 .. M+S-2 are exactly microbatches 0..M-1
+        ys = outs[S - 1:]
+        ys = lax.psum(jnp.where(idx == S - 1, ys, jnp.zeros_like(ys)), axis)
+        return ys
+
+    pspec = _leading_axis_spec(axis)
+    xspec = P(None, data_axis) if data_axis else P()
+    return shard_map(per_device, mesh=mesh,
+                     in_specs=(pspec, xspec), out_specs=xspec,
+                     check_vma=False)
+
+
+def _leading_axis_spec(axis: str):
+    """PartitionSpec pytree-prefix: shard every leaf's leading dim."""
+    return P(axis)
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """Stack a list of S identical pytrees along a new leading stage axis."""
+    return _tm(lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+class GPipe:
+    """GPipe trainer: pipelined homogeneous body + replicated head.
+
+    ``block_fn(block_params, x) -> x`` is one stage; ``head_fn(head_params,
+    y_feats, labels) -> scalar mean loss`` closes the step. ``params`` is
+    ``{"blocks": stacked-pytree [S, ...], "head": pytree}``. The jitted
+    ``train_step`` does fwd + AD bwd (reverse pipeline schedule) + updater +
+    apply in one XLA computation, with body params/updater-state sharded over
+    ``pipe`` and the head replicated — the same whole-step-compile shape as
+    the containers' ``_ensure_step``.
+    """
+
+    def __init__(self, block_fn, head_fn, mesh: Mesh, n_microbatches: int,
+                 updater, axis: str = PIPELINE_AXIS,
+                 data_axis: Optional[str] = None):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+        if data_axis is not None and data_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{data_axis}' axis: "
+                             f"{mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.data_axis = data_axis
+        self.n_microbatches = int(n_microbatches)
+        self.updater = updater
+        self._pipeline = spmd_pipeline(block_fn, mesh, axis, self.data_axis)
+        self._head_fn = head_fn
+        self._step = None
+
+    # -- placement --------------------------------------------------------
+    def block_sharding(self):
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def place(self, params, upd_state=None):
+        """device_put params (+ mirrored updater state) onto the mesh:
+        blocks stage-sharded, head replicated."""
+        repl = NamedSharding(self.mesh, P())
+        blk = self.block_sharding()
+
+        def put(tree):
+            return {"blocks": _tm(lambda p: jax.device_put(p, blk),
+                                  tree["blocks"]),
+                    "head": _tm(lambda p: jax.device_put(p, repl),
+                                tree["head"])}
+        return put(params) if upd_state is None else (put(params),
+                                                      put(upd_state))
+
+    # -- the step ----------------------------------------------------------
+    def _loss(self, params, x_mb, y_mb):
+        feats = self._pipeline(params["blocks"], x_mb)
+        # head applied per-microbatch; mean of means == global mean when
+        # microbatches are equal-sized
+        losses = jax.vmap(lambda f, y: self._head_fn(params["head"], f, y)
+                          )(feats, y_mb)
+        return jnp.mean(losses)
+
+    def _build_step(self):
+        upd = self.updater
+
+        def step(params, upd_state, it, x, y):
+            M = self.n_microbatches
+            x_mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            y_mb = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+            loss, grads = jax.value_and_grad(self._loss)(params, x_mb, y_mb)
+            updates, new_state = upd.apply(upd_state, grads, it)
+            new_params = _tm(lambda p, u: p - u, params, updates)
+            return new_params, new_state, loss
+
+        repl = NamedSharding(self.mesh, P())
+        blk = self.block_sharding()
+        tree_sh = {"blocks": blk, "head": repl}
+        dsh = (NamedSharding(self.mesh, P(self.data_axis))
+               if self.data_axis else repl)
+        return jax.jit(step,
+                       in_shardings=(tree_sh, tree_sh, repl, dsh, dsh),
+                       out_shardings=(tree_sh, tree_sh, repl),
+                       donate_argnums=(0, 1))
+
+    def train_step(self, params, upd_state, iteration, x, y):
+        """One pipelined training step. Returns (params, upd_state, loss)."""
+        if self._step is None:
+            self._step = self._build_step()
+        it = jnp.asarray(iteration, jnp.int32)
+        return self._step(params, upd_state, it, x, y)

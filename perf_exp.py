@@ -30,12 +30,12 @@ def bench_resnet(batch=256, iters=10, warmup=3, compute_dtype="bfloat16"):
     for i in range(warmup):
         it = jnp.asarray(i, jnp.int32)
         params, states, upd, loss = step(params, states, upd, it, key, (f,), (l,), None, None)
-    loss.block_until_ready()
+    float(loss)  # value fetch: axon block_until_ready can return early
     t0 = time.perf_counter()
     for i in range(warmup, warmup + iters):
         it = jnp.asarray(i, jnp.int32)
         params, states, upd, loss = step(params, states, upd, it, key, (f,), (l,), None, None)
-    loss.block_until_ready()
+    float(loss)  # value fetch: axon block_until_ready can return early
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
     print(f"batch={batch} dtype={compute_dtype}: {ips:.1f} img/s "

@@ -1,0 +1,179 @@
+"""Mixture-of-experts layer + expert parallelism (net-new vs the reference,
+the ``ep`` member of the dp/tp/pp/sp/ep mesh-axis family). Correctness bars:
+top-k routing semantics, aux-loss accumulation into the training objective,
+gradient check of the full layer, and expert-sharded == replicated training."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, Adam, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, MoEDenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.losses import LossFunction
+from deeplearning4j_tpu.parallel import (EXPERT_AXIS, expert_rules,
+                                         expert_parallel_step, make_mesh,
+                                         replicated)
+
+
+def _moe_net(n_in=6, n_out=4, experts=4, top_k=2, aux=0.0, seed=5,
+             updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Sgd(learning_rate=0.1))
+            .activation("identity")
+            .list()
+            .layer(MoEDenseLayer(n_in=n_in, n_out=8, num_experts=experts,
+                                 top_k=top_k, aux_loss_weight=aux,
+                                 activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=n_out, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_moe_forward_topk_routing_semantics():
+    net = _moe_net()
+    impl = net.impls[0]
+    p = net.params["0"]
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(7, 6)), jnp.float32)
+    gates, probs = impl._route(x.astype(jnp.float32), p["Wg"])
+    g = np.asarray(gates)
+    # exactly top_k nonzero gates per token, summing to 1
+    assert (np.count_nonzero(g, axis=1) == 2).all()
+    np.testing.assert_allclose(g.sum(axis=1), 1.0, rtol=1e-5)
+    # the nonzero entries are the 2 largest router probs
+    pr = np.asarray(probs)
+    for i in range(g.shape[0]):
+        top2 = set(np.argsort(pr[i])[-2:])
+        assert set(np.nonzero(g[i])[0]) == top2
+
+
+def test_moe_topk_exact_on_tied_probs():
+    """An all-zero row gives a uniform router softmax; the index-based mask
+    must still gate exactly top_k experts (a threshold mask would gate all)."""
+    net = _moe_net()
+    impl = net.impls[0]
+    p = net.params["0"]
+    x = jnp.zeros((3, 6), jnp.float32)
+    gates, _ = impl._route(x, p["Wg"])
+    g = np.asarray(gates)
+    assert (np.count_nonzero(g, axis=1) == 2).all()
+    np.testing.assert_allclose(g.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_moe_output_matches_manual_dense_dispatch():
+    net = _moe_net(top_k=4)  # top_k == E: gates are the full softmax
+    impl = net.impls[0]
+    p = net.params["0"]
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(5, 6)), jnp.float32)
+    y, _ = impl.forward(p, {}, x)
+    probs = np.asarray(jax.nn.softmax(np.asarray(x) @ np.asarray(p["Wg"]),
+                                      axis=-1))
+    W, b = np.asarray(p["W"]), np.asarray(p["b"])
+    want = np.zeros((5, 8), np.float32)
+    for e in range(4):
+        want += probs[:, e:e + 1] * (np.asarray(x) @ W[e] + b[e])
+    np.testing.assert_allclose(np.asarray(y), np.maximum(want, 0.0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_aux_loss_enters_objective():
+    rng = np.random.default_rng(2)
+    f = rng.normal(size=(16, 6)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    net0 = _moe_net(aux=0.0)
+    net1 = _moe_net(aux=10.0)  # big weight → visibly different score
+    s0 = float(net0.score(DataSet(f, l)))
+    s1 = float(net1.score(DataSet(f, l)))
+    assert s1 > s0 + 0.1, (s0, s1)  # aux = w * E * Σ f·P ≥ w * 1
+
+
+def _f64_moe_net(top_k, aux, seed=9):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=1.0))
+            .dtype("float64").compute_dtype("float64")
+            .activation("identity")
+            .list()
+            .layer(MoEDenseLayer(n_in=6, n_out=8, num_experts=4, top_k=top_k,
+                                 aux_loss_weight=aux, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_moe_gradient_check_dense_routing():
+    """top_k == E: routing is smooth softmax everywhere, so EVERY param —
+    router included — must pass the central-difference check."""
+    from deeplearning4j_tpu.nn.gradientcheck import (GradientCheckUtil,
+                                                     double_precision)
+    with double_precision():
+        net = _f64_moe_net(top_k=4, aux=0.0)
+        rng = np.random.default_rng(3)
+        ds = DataSet(rng.normal(size=(8, 6)),
+                     np.eye(4)[rng.integers(0, 4, 8)].astype(np.float64))
+        assert GradientCheckUtil.check_gradients(net, ds, print_results=True)
+
+
+def test_moe_gradient_check_topk_experts():
+    """top_k < E: the loss is piecewise-smooth in the ROUTER (gate support
+    changes discontinuously at top-k boundaries, and the aux loss's argmax
+    fraction is piecewise constant), so the router is excluded — the expert
+    weights/biases flow smoothly through the fixed gates and must pass."""
+    from deeplearning4j_tpu.nn.gradientcheck import (GradientCheckUtil,
+                                                     double_precision)
+    with double_precision():
+        net = _f64_moe_net(top_k=2, aux=1e-2)
+        rng = np.random.default_rng(3)
+        ds = DataSet(rng.normal(size=(8, 6)),
+                     np.eye(4)[rng.integers(0, 4, 8)].astype(np.float64))
+        assert GradientCheckUtil.check_gradients(net, ds, print_results=True,
+                                                 exclude={"Wg"})
+
+
+def test_moe_trains_and_improves():
+    rng = np.random.default_rng(4)
+    f = rng.normal(size=(64, 6)).astype(np.float32)
+    labels = (f[:, 0] + f[:, 1] > 0).astype(int)
+    l = np.eye(4, dtype=np.float32)[labels]
+    net = _moe_net(aux=1e-2, updater=Adam(learning_rate=5e-3))
+    ds = DataSet(f, l)
+    s0 = float(net.score(ds))
+    for _ in range(60):
+        net.fit(ds)
+    assert float(net.score(ds)) < s0 * 0.6
+
+
+def test_expert_parallel_matches_replicated_training():
+    """The EP-sharded jitted step must produce the same params as the
+    unsharded step (the TPU analogue of the reference's cuDNN-vs-builtin
+    cross-checks)."""
+    mesh = make_mesh(jax.devices()[:4], axes=(EXPERT_AXIS,))
+    net_a = _moe_net(seed=21)
+    net_b = _moe_net(seed=21)
+    rules = expert_rules(net_a)
+    assert any("/W$" in k for k in rules), rules
+
+    step, place = expert_parallel_step(net_a, mesh)
+    place(net_a)
+    rng = np.random.default_rng(5)
+    f = jnp.asarray(rng.normal(size=(8, 6)), jnp.float32)
+    l = jnp.asarray(np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)])
+    it = jax.device_put(jnp.asarray(0, jnp.int32), replicated(mesh))
+    key = jax.device_put(jax.random.PRNGKey(0), replicated(mesh))
+    pa, sa, ua, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                              it, key, f, l, None, None)
+
+    raw = jax.jit(net_b._raw_step(False))
+    pb, sb, ub, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                             f, l, None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

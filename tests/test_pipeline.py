@@ -1,0 +1,181 @@
+"""Pipeline parallelism (GPipe over the ``pipe`` mesh axis) — net-new vs the
+reference (SURVEY.md §2.4: data parallelism only). Correctness bar: the
+pipelined schedule must be numerically identical to running the same stacked
+blocks sequentially on one device (the analogue of the reference's
+cuDNN-vs-builtin cross-validation, SURVEY.md §4.4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.parallel.pipeline import (GPipe, PIPELINE_AXIS,
+                                                  spmd_pipeline,
+                                                  stack_stage_params)
+from deeplearning4j_tpu.parallel.sharding import DATA_AXIS, make_mesh
+from deeplearning4j_tpu.nn.updaters import Sgd, Adam
+
+
+def _block_fn(params, x):
+    return jnp.tanh(x @ params["W"] + params["b"])
+
+
+def _make_blocks(S, F, seed=0):
+    rng = np.random.default_rng(seed)
+    per_stage = [{"W": jnp.asarray(rng.normal(size=(F, F)) / np.sqrt(F),
+                                   jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=(F,)) * 0.1, jnp.float32)}
+                 for _ in range(S)]
+    return stack_stage_params(per_stage), per_stage
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = _block_fn(p, x)
+    return x
+
+
+def test_spmd_pipeline_matches_sequential_forward():
+    S, M, mb, F = 4, 6, 2, 8
+    mesh = make_mesh(jax.devices()[:S], axes=(PIPELINE_AXIS,))
+    stacked, per_stage = _make_blocks(S, F)
+    rng = np.random.default_rng(1)
+    xs = jnp.asarray(rng.normal(size=(M, mb, F)), jnp.float32)
+
+    ys = spmd_pipeline(_block_fn, mesh)(stacked, xs)
+    want = np.stack([_sequential(per_stage, xs[m]) for m in range(M)])
+    np.testing.assert_allclose(np.asarray(ys), want, rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_pipeline_grad_matches_sequential():
+    """AD through the scheduled forward == AD of the sequential net: the
+    reverse pipeline schedule is exactly the chain rule."""
+    S, M, mb, F = 4, 5, 2, 6
+    mesh = make_mesh(jax.devices()[:S], axes=(PIPELINE_AXIS,))
+    stacked, per_stage = _make_blocks(S, F, seed=3)
+    rng = np.random.default_rng(4)
+    xs = jnp.asarray(rng.normal(size=(M, mb, F)), jnp.float32)
+    pipe = spmd_pipeline(_block_fn, mesh)
+
+    def loss_pipe(stacked):
+        return jnp.sum(pipe(stacked, xs) ** 2)
+
+    def loss_seq(stacked):
+        per = [jax.tree_util.tree_map(lambda p: p[s], stacked)
+               for s in range(S)]
+        ys = jnp.stack([_sequential(per, xs[m]) for m in range(M)])
+        return jnp.sum(ys ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def _head_fn(head, feats, labels):
+    logits = feats @ head["W"] + head["b"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def _gpipe_setup(S, F, C, seed=0):
+    stacked, per_stage = _make_blocks(S, F, seed=seed)
+    rng = np.random.default_rng(seed + 10)
+    head = {"W": jnp.asarray(rng.normal(size=(F, C)) / np.sqrt(F),
+                             jnp.float32),
+            "b": jnp.zeros((C,), jnp.float32)}
+    return {"blocks": stacked, "head": head}, per_stage
+
+
+def _seq_train_step(params, upd_state, updater, x, y, M):
+    """Single-device oracle: same microbatch loss averaging, same updater."""
+    S = params["blocks"]["W"].shape[0]
+
+    def loss_fn(params):
+        x_mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        y_mb = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+        per = [jax.tree_util.tree_map(lambda p: p[s], params["blocks"])
+               for s in range(S)]
+        losses = [
+            _head_fn(params["head"], _sequential(per, x_mb[m]), y_mb[m])
+            for m in range(M)]
+        return jnp.mean(jnp.stack(losses))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, new_state = updater.apply(upd_state, grads, 0)
+    new_params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
+    return new_params, new_state, loss
+
+
+@pytest.mark.parametrize("updater_cls", [Sgd, Adam])
+def test_gpipe_train_step_matches_single_device(updater_cls):
+    S, M, F, C = 4, 4, 8, 5
+    B = M * 2
+    mesh = make_mesh(jax.devices()[:S], axes=(PIPELINE_AXIS,))
+    updater = updater_cls(learning_rate=0.05)
+    params, _ = _gpipe_setup(S, F, C)
+    upd_state = updater.init_state(params)
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(B, F)), jnp.float32)
+    y = jnp.asarray(np.eye(C, dtype=np.float32)[rng.integers(0, C, B)])
+
+    # oracle FIRST: train_step donates its (possibly aliased) inputs
+    want_p, want_s, want_loss = _seq_train_step(params, upd_state, updater,
+                                                x, y, M)
+    gp = GPipe(_block_fn, _head_fn, mesh, n_microbatches=M, updater=updater)
+    p_dev, s_dev = gp.place(params, upd_state)
+    new_p, new_s, loss = gp.train_step(p_dev, s_dev, 0, x, y)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(new_p),
+                    jax.tree_util.tree_leaves(want_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_combined_dp_pp():
+    """DP×PP mesh: batch sharded over ``data`` while stages shard over
+    ``pipe`` — one jitted step, XLA inserts the cross-data grad psum."""
+    S, M, F, C = 4, 4, 8, 3
+    B = M * 4
+    mesh = make_mesh(jax.devices()[:8], axes=(DATA_AXIS, PIPELINE_AXIS),
+                     shape=(2, 4))
+    updater = Sgd(learning_rate=0.1)
+    params, _ = _gpipe_setup(S, F, C, seed=2)
+    upd_state = updater.init_state(params)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(B, F)), jnp.float32)
+    y = jnp.asarray(np.eye(C, dtype=np.float32)[rng.integers(0, C, B)])
+    want_p, _, want_loss = _seq_train_step(params, upd_state, updater, x, y, M)
+    gp = GPipe(_block_fn, _head_fn, mesh, n_microbatches=M, updater=updater,
+               data_axis=DATA_AXIS)
+    p_dev, s_dev = gp.place(params, upd_state)
+    new_p, new_s, loss = gp.train_step(p_dev, s_dev, 0, x, y)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(new_p),
+                    jax.tree_util.tree_leaves(want_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_loss_decreases_over_steps():
+    S, M, F, C = 2, 4, 8, 4
+    B = M * 4
+    mesh = make_mesh(jax.devices()[:S], axes=(PIPELINE_AXIS,))
+    updater = Adam(learning_rate=1e-2)
+    params, _ = _gpipe_setup(S, F, C, seed=5)
+    upd_state = updater.init_state(params)
+    gp = GPipe(_block_fn, _head_fn, mesh, n_microbatches=M, updater=updater)
+    p, s = gp.place(params, upd_state)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(B, F)), jnp.float32)
+    y = jnp.asarray(np.eye(C, dtype=np.float32)[rng.integers(0, C, B)])
+    losses = []
+    for it in range(12):
+        p, s, loss = gp.train_step(p, s, it, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
