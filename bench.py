@@ -90,12 +90,15 @@ def _cnn_throughput(model_cls, batch, img, classes=1000, iters=10,
 
 def bench_resnet50(batch=256):
     # batch 256: v5e is HBM-bandwidth-bound on ResNet50; smaller batches
-    # under-amortize fixed per-step work (PERF.md has the batch sweep)
+    # under-amortize fixed per-step work (PERF.md has the batch sweep).
+    # 25 timed iters: single runs of 10 showed a ~5% run-to-run band
     from deeplearning4j_tpu.models import ResNet50
-    return _cnn_throughput(ResNet50, batch, (3, 224, 224))
+    return _cnn_throughput(ResNet50, batch, (3, 224, 224), iters=25)
 
 
-def bench_vgg16(batch=128):
+def bench_vgg16(batch=256):
+    # batch 256: 1403 img/s = 126 TFLOPS = 64% MFU by XLA's flop count
+    # (22.98 TF / 69.9 GB per step) — compute-bound; 128 gives 1311
     from deeplearning4j_tpu.models import VGG16
     return _cnn_throughput(VGG16, batch, (3, 224, 224))
 
@@ -140,6 +143,7 @@ def bench_graves_lstm(batch=64, seq_len=200, tbptt=50, vocab=80, width=512):
     conf = (NeuralNetConfiguration.builder().seed(1)
             .updater(Adam(learning_rate=1e-3)).activation("tanh")
             .compute_dtype("bfloat16")
+            .cache_mode("device")  # epoch reuse: one H2D, HBM-resident after
             .list()
             .layer(GravesLSTM(n_in=vocab, n_out=width))
             .layer(GravesLSTM(n_in=width, n_out=width))
@@ -168,8 +172,10 @@ def bench_graves_lstm(batch=64, seq_len=200, tbptt=50, vocab=80, width=512):
     return batch * seq_len * n / dt
 
 
-def bench_word2vec(n_sentences=2000, sent_len=40, vocab_target=5000):
-    """Word2Vec skip-gram (HS) words/sec through the jitted kernels."""
+def bench_word2vec(n_sentences=20000, sent_len=40, vocab_target=5000):
+    """Word2Vec skip-gram (HS) words/sec through the jitted kernels.
+    800k-word corpus so steady-state batch throughput dominates the one-time
+    vocab build + kernel compile (PerformanceListener-style accounting)."""
     from deeplearning4j_tpu.nlp import Word2Vec
 
     rng = np.random.default_rng(0)

@@ -12,6 +12,29 @@ import numpy as np
 from typing import List, Optional, Sequence
 
 
+def _array_key(a):
+    """Identity key for the device-residency cache: id + data pointer +
+    shape/dtype — reassignment (the normalizer contract) changes it."""
+    if a is None:
+        return None
+    return (id(a), a.__array_interface__["data"][0], a.shape, str(a.dtype))
+
+
+def _put(a):
+    import jax.numpy as jnp
+    return None if a is None else jnp.asarray(a)
+
+
+def _cached_device_put(container, build):
+    """Shared CacheMode.DEVICE machinery: rebuild the device tuple only when
+    the container's ``_device_key()`` changes."""
+    key = container._device_key()
+    if getattr(container, "_dev_key", None) != key:
+        container._dev = build()
+        container._dev_key = key
+    return container._dev
+
+
 class DataSet:
     """features/labels (+ optional masks). Masks follow reference semantics:
     features_mask/labels_mask are [batch, T] 0/1 arrays for sequence data."""
@@ -30,6 +53,24 @@ class DataSet:
         return int(self.features.shape[0])
 
     numExamples = num_examples
+
+    # ------------------------------------------------- device residency
+    def _device_key(self):
+        return (_array_key(self.features), _array_key(self.labels),
+                _array_key(self.features_mask), _array_key(self.labels_mask))
+
+    def device_arrays(self):
+        """``CacheMode.DEVICE`` (reference ``nn/conf/CacheMode.java``):
+        transfer features/labels/masks to the device ONCE and reuse the
+        HBM-resident copies across fits/epochs — repeated fits of the same
+        DataSet skip the host→device transfer entirely (which dominates
+        small-step training over a slow host link). The cache is keyed on
+        the arrays' identity + data pointer, so normalizers (which reassign
+        ``ds.features``) invalidate it; in-place writes into the SAME buffer
+        are not detected — reassign or construct a new DataSet instead."""
+        return _cached_device_put(
+            self, lambda: (_put(self.features), _put(self.labels),
+                           _put(self.features_mask), _put(self.labels_mask)))
 
     def get_features(self):
         return self.features
@@ -103,6 +144,23 @@ class MultiDataSet:
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])
+
+    def _device_key(self):
+        def ks(seq):
+            return (None if seq is None
+                    else tuple(_array_key(a) for a in seq))
+        return (ks(self.features), ks(self.labels), ks(self.features_masks),
+                ks(self.labels_masks))
+
+    def device_arrays(self):
+        """``CacheMode.DEVICE`` for the multi-stream container — see
+        :meth:`DataSet.device_arrays`."""
+        def puts(seq):
+            return None if seq is None else tuple(_put(a) for a in seq)
+        return _cached_device_put(
+            self, lambda: (puts(self.features), puts(self.labels),
+                           puts(self.features_masks),
+                           puts(self.labels_masks)))
 
     @staticmethod
     def merge(datasets: Sequence["MultiDataSet"]) -> "MultiDataSet":

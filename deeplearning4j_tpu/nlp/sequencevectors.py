@@ -64,34 +64,61 @@ class InMemoryLookupTable:
 
 
 # ------------------------------------------------------------- jitted kernels
+#
+# Transfer discipline (the tunnel's per-device_put latency dominated training
+# before): pairs arrive as ONE packed [2, B] int32 array of fixed batch shape
+# (the tail batch is padded; ``n_valid`` masks the padding on-device), the
+# vocab-wide Huffman tables live in HBM and are gathered on-device, and the
+# negative-sampling labels are synthesized on-device — so a batch costs one
+# 64 KB transfer instead of seven, and one compiled shape serves every batch.
+
 @partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0, syn1, centers, points, codes, mask, lr):
+def _hs_step(syn0, syn1, packed, hs_points, hs_codes, hs_mask):
     """Hierarchical-softmax skip-gram/CBOW update, batched.
 
-    centers: [B] input row ids (center word for SG, averaged context handled
-    upstream for CBOW); points: [B, L] inner-node rows; codes: [B, L] 0/1;
-    mask: [B, L] validity. Classic w2v update rule: g = (1 - code - σ(h·v)).
+    packed: [2, B+1] int32 — columns 0..B-1 are (input row ids;
+    Huffman-target word ids); the LAST column carries the batch scalars
+    (n_valid; lr float bit-cast to int32) so the whole batch arrives in ONE
+    host→device transfer (each transfer costs ~5 ms of tunnel latency
+    regardless of size). hs_points/codes/mask: [V, L] device-resident vocab
+    tables. Classic w2v update rule: g = (1 - code - σ(h·v)).
     """
+    n_valid = packed[0, -1]
+    lr = jax.lax.bitcast_convert_type(packed[1, -1], jnp.float32)
+    centers, targets = packed[0, :-1], packed[1, :-1]
+    points = hs_points[targets]                        # [B, L]
+    codes = hs_codes[targets]
+    wmask = (jnp.arange(centers.shape[0]) < n_valid).astype(syn0.dtype)
+    mask = hs_mask[targets] * wmask[:, None]
     h = syn0[centers]                                  # [B, d]
     v = syn1[points]                                   # [B, L, d]
     f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, v))  # [B, L]
     g = (1.0 - codes - f) * mask * lr                  # [B, L]
     dh = jnp.einsum("bl,bld->bd", g, v)                # [B, d]
     dv = g[..., None] * h[:, None, :]                  # [B, L, d]
-    syn0 = syn0.at[centers].add(dh)
+    syn0 = syn0.at[centers].add(dh * wmask[:, None])
     syn1 = syn1.at[points.reshape(-1)].add(
         dv.reshape(-1, dv.shape[-1]) * mask.reshape(-1, 1))
     return syn0, syn1
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
-def _ns_step(syn0, syn1neg, centers, targets, labels, lr):
-    """Negative-sampling update: targets [B, K+1] (positive + K negatives),
-    labels [B, K+1] (1 for positive, 0 negatives)."""
+def _ns_step(syn0, syn1neg, packed):
+    """Negative-sampling update, single-transfer like :func:`_hs_step`.
+
+    packed: [B+1, K+2] int32 — rows 0..B-1 are (center; positive target; K
+    negatives); the LAST row carries (n_valid; lr bit-cast; 0...). Labels
+    are synthesized on-device (column 0 = 1); rows ≥ n_valid are padding."""
+    n_valid = packed[-1, 0]
+    lr = jax.lax.bitcast_convert_type(packed[-1, 1], jnp.float32)
+    centers = packed[:-1, 0]                            # [B]
+    targets = packed[:-1, 1:]                           # [B, K+1]
     h = syn0[centers]                                   # [B, d]
     v = syn1neg[targets]                                # [B, K+1, d]
     f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, v))
-    g = (labels - f) * lr                               # [B, K+1]
+    labels = jnp.zeros_like(f).at[:, 0].set(1.0)
+    wmask = (jnp.arange(centers.shape[0]) < n_valid).astype(syn0.dtype)
+    g = (labels - f) * lr * wmask[:, None]              # [B, K+1]
     dh = jnp.einsum("bk,bkd->bd", g, v)
     dv = g[..., None] * h[:, None, :]
     syn0 = syn0.at[centers].add(dh)
@@ -154,6 +181,7 @@ class SequenceVectors:
                 self._hs_mask[i, :k] = 1.0
         if self.negative > 0:
             self._neg_table = self._build_unigram_table()
+        self._hs_points_dev = None  # rebuilt tables invalidate device copies
         return self
 
     buildVocab = build_vocab
@@ -284,32 +312,45 @@ class SequenceVectors:
             out.append(i)
         return out
 
+    def _ensure_device_tables(self):
+        """Huffman/vocab tables → HBM once; per-batch gathers run on-device."""
+        if getattr(self, "_hs_points_dev", None) is None and self.use_hs:
+            self._hs_points_dev = jnp.asarray(self._hs_points)
+            self._hs_codes_dev = jnp.asarray(self._hs_codes)
+            self._hs_mask_dev = jnp.asarray(self._hs_mask)
+
     def _apply_pairs(self, rows, targets, lr, rng):
-        """Update syn0[rows] against targets' objective."""
+        """Update syn0[rows] against targets' objective. Fixed-shape batches
+        (tail padded, masked on-device) + packed single-transfer pairs: one
+        compiled kernel and one small H2D per batch."""
         lt = self.lookup_table
         rows = np.ascontiguousarray(rows, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
+        n = len(rows)
+        B = max(self.batch_size, n)
+        if n < B:
+            rows = np.concatenate([rows, np.zeros(B - n, np.int32)])
+            targets = np.concatenate([targets, np.zeros(B - n, np.int32)])
         if self.use_hs:
-            # batched Huffman lookup: three gathers from the vocab-wide
-            # tables (see build_vocab) — no per-target Python loop
-            points = self._hs_points[targets]
-            codes = self._hs_codes[targets]
-            mask = self._hs_mask[targets]
+            self._ensure_device_tables()
+            meta = np.array([n, np.float32(lr).view(np.int32)], np.int32)
+            packed = jnp.asarray(np.concatenate(
+                [np.stack([rows, targets]), meta[:, None]], axis=1))
             lt.syn0, lt.syn1 = _hs_step(
-                jnp.asarray(lt.syn0), jnp.asarray(lt.syn1),
-                jnp.asarray(rows), jnp.asarray(points), jnp.asarray(codes),
-                jnp.asarray(mask), jnp.float32(lr))
+                jnp.asarray(lt.syn0), jnp.asarray(lt.syn1), packed,
+                self._hs_points_dev, self._hs_codes_dev, self._hs_mask_dev)
         if self.negative > 0:
             K = self.negative
             negs = self._neg_table[rng.integers(0, len(self._neg_table),
-                                                size=(len(rows), K))]
-            tgt = np.concatenate([np.asarray(targets)[:, None], negs], axis=1)
-            labels = np.zeros_like(tgt, np.float32)
-            labels[:, 0] = 1.0
+                                                size=(B, K))]
+            body = np.concatenate([rows[:, None], targets[:, None], negs],
+                                  axis=1)                       # [B, K+2]
+            meta = np.zeros((1, K + 2), np.int32)
+            meta[0, 0] = n
+            meta[0, 1] = np.float32(lr).view(np.int32)
             lt.syn0, lt.syn1neg = _ns_step(
                 jnp.asarray(lt.syn0), jnp.asarray(lt.syn1neg),
-                jnp.asarray(rows), jnp.asarray(tgt), jnp.asarray(labels),
-                jnp.float32(lr))
+                jnp.asarray(np.concatenate([body, meta])))
 
     # ------------------------------------------------------------- inference
     def word_vector(self, word: str) -> Optional[np.ndarray]:

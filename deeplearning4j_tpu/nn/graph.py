@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .conf import BackpropType, GradientNormalization
+from .conf import BackpropType, CacheMode, GradientNormalization
 from .conf.graph import ComputationGraphConfiguration
 from .conf.layers import Layer
 from .conf.inputs import InputTypeConvolutional
@@ -326,14 +326,25 @@ class ComputationGraph:
 
     def _fit_batch(self, ds):
         mds = self._as_multi(ds)
-        inputs = tuple(jnp.asarray(f) for f in mds.features)
-        labels = tuple(jnp.asarray(l) for l in mds.labels)
-        fms = (None if mds.features_masks is None
-               else tuple(None if m is None else jnp.asarray(m)
-                          for m in mds.features_masks))
-        lms = (None if mds.labels_masks is None
-               else tuple(None if m is None else jnp.asarray(m)
-                          for m in mds.labels_masks))
+        if self.gc.cache_mode == CacheMode.DEVICE:
+            if isinstance(ds, DataSet):
+                # cache on the CALLER's DataSet — _as_multi builds a fresh
+                # wrapper per batch, so a wrapper-side cache would never hit
+                f, l, fm, lm = ds.device_arrays()
+                inputs, labels = (f,), (l,)
+                fms = None if fm is None else (fm,)
+                lms = None if lm is None else (lm,)
+            else:
+                inputs, labels, fms, lms = mds.device_arrays()
+        else:
+            inputs = tuple(jnp.asarray(f) for f in mds.features)
+            labels = tuple(jnp.asarray(l) for l in mds.labels)
+            fms = (None if mds.features_masks is None
+                   else tuple(None if m is None else jnp.asarray(m)
+                              for m in mds.features_masks))
+            lms = (None if mds.labels_masks is None
+                   else tuple(None if m is None else jnp.asarray(m)
+                              for m in mds.labels_masks))
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT
                 and all(x.ndim == 3 for x in inputs)
                 and inputs[0].shape[1] > self.conf.tbptt_fwd_length):

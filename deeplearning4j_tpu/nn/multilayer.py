@@ -27,7 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .conf import (MultiLayerConfiguration, BackpropType, GradientNormalization)
+from .conf import (MultiLayerConfiguration, BackpropType, CacheMode,
+                   GradientNormalization)
 from .conf.inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
                           InputTypeRecurrent)
 from jax.ad_checkpoint import checkpoint_name
@@ -312,10 +313,15 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, ds: DataSet):
-        f = jnp.asarray(ds.features)
-        l = jnp.asarray(ds.labels)
-        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if self.gc.cache_mode == CacheMode.DEVICE:
+            f, l, fm, lm = ds.device_arrays()
+        else:
+            f = jnp.asarray(ds.features)
+            l = jnp.asarray(ds.labels)
+            fm = (None if ds.features_mask is None
+                  else jnp.asarray(ds.features_mask))
+            lm = (None if ds.labels_mask is None
+                  else jnp.asarray(ds.labels_mask))
         self.last_batch_size = int(f.shape[0])
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT and f.ndim == 3
                 and f.shape[1] > self.conf.tbptt_fwd_length):

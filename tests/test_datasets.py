@@ -190,3 +190,79 @@ def test_mnist_synthetic_flag_propagates():
     ds = next(it)
     # zero-egress environment: no local MNIST → synthetic and flagged
     assert ds.synthetic == it.fetcher.is_synthetic
+
+
+def test_cache_mode_device_same_results_and_cached_transfer():
+    """CacheMode.DEVICE (reference ``nn/conf/CacheMode.java``): repeated fits
+    of one DataSet reuse the HBM-resident copy (one transfer), and training
+    results are identical to CacheMode.NONE."""
+    import numpy as np
+    from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                    DataSet, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    def build(cache):
+        b = (NeuralNetConfiguration.builder().seed(7)
+             .updater(Sgd(learning_rate=0.1)).activation("tanh"))
+        if cache:
+            b = b.cache_mode("device")
+        conf = (b.list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(16, 4)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    ds = DataSet(f, l)
+    net_a, net_b = build(True), build(False)
+    for _ in range(5):
+        net_a.fit(ds)
+        net_b.fit(ds)
+    for a, b in zip(__import__("jax").tree_util.tree_leaves(net_a.params),
+                    __import__("jax").tree_util.tree_leaves(net_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # the device copy is cached — same tuple across calls
+    assert ds.device_arrays() is ds.device_arrays()
+
+
+def test_cache_mode_device_invalidated_by_normalizer_reassign():
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    ds = DataSet(np.ones((4, 3), np.float32),
+                 np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+    first = ds.device_arrays()
+    ds.features = ds.features * 2.0  # normalizers reassign, as transform does
+    second = ds.device_arrays()
+    assert first is not second
+    np.testing.assert_allclose(np.asarray(second[0]), 2.0)
+
+
+def test_cache_mode_device_computation_graph_caches_on_dataset():
+    """CG fit(DataSet) must hit the cache stored on the caller's DataSet —
+    the per-batch MultiDataSet wrapper is a fresh object each call."""
+    import numpy as np
+    from deeplearning4j_tpu import NeuralNetConfiguration, DataSet, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = (NeuralNetConfiguration.builder().seed(3)
+         .updater(Sgd(learning_rate=0.1)).activation("tanh")
+         .cache_mode("device")
+         .graph_builder().add_inputs("in"))
+    g.add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+    g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"), "d")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(1)
+    ds = DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+    net.fit(ds)
+    first = ds.device_arrays()
+    net.fit(ds)
+    assert ds.device_arrays() is first  # cached across fits, on the DataSet
