@@ -13,8 +13,11 @@ from typing import List, Optional, Sequence
 
 
 def _array_key(a):
-    """Identity key for the device-residency cache: id + data pointer +
-    shape/dtype — reassignment (the normalizer contract) changes it."""
+    """Identity key for the device-residency cache. The cache RETAINS the
+    keyed host arrays (``_cached_device_put`` stores them alongside the key),
+    so a live key's ``id``/data pointer cannot be recycled by the allocator —
+    identity compare is therefore sound; reassignment (the normalizer
+    contract) always misses."""
     if a is None:
         return None
     return (id(a), a.__array_interface__["data"][0], a.shape, str(a.dtype))
@@ -25,13 +28,16 @@ def _put(a):
     return None if a is None else jnp.asarray(a)
 
 
-def _cached_device_put(container, build):
+def _cached_device_put(container, build, retain):
     """Shared CacheMode.DEVICE machinery: rebuild the device tuple only when
-    the container's ``_device_key()`` changes."""
+    the container's ``_device_key()`` changes. ``retain`` is the tuple of
+    host arrays the key describes — kept alive on the container so freed-
+    memory id reuse can never alias a stale key."""
     key = container._device_key()
     if getattr(container, "_dev_key", None) != key:
         container._dev = build()
         container._dev_key = key
+        container._dev_retained = retain
     return container._dev
 
 
@@ -70,7 +76,9 @@ class DataSet:
         are not detected — reassign or construct a new DataSet instead."""
         return _cached_device_put(
             self, lambda: (_put(self.features), _put(self.labels),
-                           _put(self.features_mask), _put(self.labels_mask)))
+                           _put(self.features_mask), _put(self.labels_mask)),
+            (self.features, self.labels, self.features_mask,
+             self.labels_mask))
 
     def get_features(self):
         return self.features
@@ -160,7 +168,10 @@ class MultiDataSet:
         return _cached_device_put(
             self, lambda: (puts(self.features), puts(self.labels),
                            puts(self.features_masks),
-                           puts(self.labels_masks)))
+                           puts(self.labels_masks)),
+            (tuple(self.features), tuple(self.labels),
+             None if self.features_masks is None else tuple(self.features_masks),
+             None if self.labels_masks is None else tuple(self.labels_masks)))
 
     @staticmethod
     def merge(datasets: Sequence["MultiDataSet"]) -> "MultiDataSet":

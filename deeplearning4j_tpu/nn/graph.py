@@ -325,18 +325,17 @@ class ComputationGraph:
                             None if ds.labels_mask is None else [ds.labels_mask])
 
     def _fit_batch(self, ds):
-        mds = self._as_multi(ds)
-        if self.gc.cache_mode == CacheMode.DEVICE:
-            if isinstance(ds, DataSet):
-                # cache on the CALLER's DataSet — _as_multi builds a fresh
-                # wrapper per batch, so a wrapper-side cache would never hit
-                f, l, fm, lm = ds.device_arrays()
-                inputs, labels = (f,), (l,)
-                fms = None if fm is None else (fm,)
-                lms = None if lm is None else (lm,)
-            else:
-                inputs, labels, fms, lms = mds.device_arrays()
+        if self.gc.cache_mode == CacheMode.DEVICE and isinstance(ds, DataSet):
+            # cache on the CALLER's DataSet — _as_multi builds a fresh
+            # wrapper per batch, so a wrapper-side cache would never hit
+            f, l, fm, lm = ds.device_arrays()
+            inputs, labels = (f,), (l,)
+            fms = None if fm is None else (fm,)
+            lms = None if lm is None else (lm,)
+        elif self.gc.cache_mode == CacheMode.DEVICE:
+            inputs, labels, fms, lms = self._as_multi(ds).device_arrays()
         else:
+            mds = self._as_multi(ds)
             inputs = tuple(jnp.asarray(f) for f in mds.features)
             labels = tuple(jnp.asarray(l) for l in mds.labels)
             fms = (None if mds.features_masks is None

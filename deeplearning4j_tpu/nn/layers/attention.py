@@ -18,8 +18,21 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
         key_mask=None):
     """q,k,v: [b, T, h, d]. Returns [b, T, h, d]. Scaled dot-product attention
     with f32 softmax accumulation (bf16-safe). ``key_mask``: [b, S] with 1 for
-    real keys, 0 for padding — padded keys are excluded from the softmax."""
-    d = q.shape[-1]
+    real keys, 0 for padding — padded keys are excluded from the softmax.
+
+    Long sequences route through the Pallas flash-attention kernel
+    (``ops/flash_attention.py``): blockwise online softmax, O(T) memory
+    instead of materializing the [b, h, T, T] logits. The dense path below
+    remains the oracle and the fallback (dropout / key masks / odd lengths).
+    """
+    from ...ops import flash_attention as _fa
+
+    T, d = q.shape[1], q.shape[-1]
+    if (q.shape == k.shape and _fa.supported(T, d, dropout_rate if train
+                                             else 0.0, key_mask)):
+        return _fa.flash_attention(
+            q.astype(compute_dtype), k.astype(compute_dtype),
+            v.astype(compute_dtype), causal=causal)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(compute_dtype),
                         k.astype(compute_dtype),
                         preferred_element_type=pet_dtype(compute_dtype))

@@ -1,0 +1,338 @@
+"""Pallas flash attention for TPU — the framework's hot-op custom kernel.
+
+``nn/layers/attention.py``'s dense ``mha`` materializes the [b, h, T, T]
+logits tensor: O(T²) HBM traffic and memory, which is exactly what caps
+long-context training. This module implements blockwise (flash) attention as
+Pallas TPU kernels — online softmax over K/V blocks streamed through VMEM,
+O(T) memory, with the standard FlashAttention-2 backward (recompute
+probabilities per block from the saved log-sum-exp instead of storing them).
+
+Streaming structure: every kernel runs on a 3-D grid (bh, out-block,
+in-block) whose innermost dimension walks the streamed blocks; the
+BlockSpec index maps stage exactly ONE 128-row block of each operand into
+VMEM per grid step (no full-sequence VMEM residency — T is bounded by HBM,
+not VMEM), and the running accumulators (m/l/acc, dq, dk/dv) live in VMEM
+scratch that persists across the innermost grid sweep: initialized at the
+first in-block, written out at the last.
+
+Layout: kernels work on [bh, T, d] (batch×heads flattened); the public
+:func:`flash_attention` takes the layer's [b, T, h, d] and
+transposes/reshapes at the boundary (XLA fuses these). f32 accumulation
+throughout; inputs/outputs keep the caller's dtype (bf16 on TPU).
+
+Used automatically by ``SelfAttentionLayer`` when applicable (TPU backend,
+no dropout, no key padding mask, T divisible by the 128 block) — the
+cuDNN-helper pattern (reference ``ConvolutionLayer.java:76`` reflective
+helper swap) realized as a Pallas kernel behind the same layer math, with
+the dense path as the always-available fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+try:  # TPU-specific memory spaces; absent on some backends
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+BLOCK = 128  # q/k block edge: MXU-aligned (lane dim 128)
+_NEG = -1e30
+
+
+def _vspec(block_shape, index_map):
+    if _VMEM is None:
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+
+
+def _scratch(shape, dtype=jnp.float32):
+    if pltpu is None:  # pragma: no cover - pallas-tpu unavailable
+        raise RuntimeError("flash attention needs pallas TPU support; "
+                           "supported() should have routed to the dense path")
+    return pltpu.VMEM(shape, dtype)
+
+
+def _when_visible(causal, cond, fn):
+    """Run ``fn`` only for visible blocks: always when not causal (static),
+    predicated on ``cond`` when causal."""
+    if causal:
+        pl.when(cond)(fn)
+    else:
+        fn()
+
+
+def _causal_mask(s, qi, kj, block):
+    Bq, Bk = s.shape
+    qpos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+    kpos = kj * block + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+    return jnp.where(kpos <= qpos, s, _NEG)
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                causal, scale, nk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, _NEG)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [Bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, kj, BLOCK)
+        m = m_s[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))       # [Bq]
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_s[:, 0] = l_s[:, 0] * alpha + jnp.sum(p, axis=-1)
+        m_s[:, 0] = m_new
+        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _when_visible(causal, kj <= qi, _compute)
+
+    @pl.when(kj == nk - 1)
+    def _():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to((m_s[:, 0] + jnp.log(l))[:, None],
+                                      lse_ref.shape[1:])
+
+
+def _fwd(q, k, v, causal, scale):
+    """q/k/v: [bh, T, d] → (o [bh, T, d], lse [bh, T, 8])."""
+    bh, T, d = q.shape
+    nq = T // BLOCK
+    kern = functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=nq)
+    if causal:
+        # invisible (kj > qj) steps clamp to the diagonal block: same index
+        # as the previous visible step → Pallas skips the DMA entirely
+        kv_idx = lambda i, qj, kj: (i, jnp.minimum(kj, qj), 0)
+    else:
+        kv_idx = lambda i, qj, kj: (i, kj, 0)
+    # lse is lane-padded to [bh, T, 8]: TPU block shapes need their last two
+    # dims (8·k, 128·m) or full-dim; a (1, BLOCK) slice of [bh, T] is
+    # unlowerable. 8 f32 lanes per position is noise next to q/k/v
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq, nq),
+        in_specs=[
+            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
+            _vspec((1, BLOCK, d), kv_idx),
+            _vspec((1, BLOCK, d), kv_idx),
+        ],
+        out_specs=(
+            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
+            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),
+        ),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, T, 8), jnp.float32)),
+        scratch_shapes=[_scratch((BLOCK, 8)), _scratch((BLOCK, 8)),
+                        _scratch((BLOCK, d))],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dq_ref,
+               dq_s, *, causal, scale, nk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, kj, BLOCK)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _when_visible(causal, kj <= qi, _compute)
+
+    @pl.when(kj == nk - 1)
+    def _():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dk_ref,
+                dv_ref, dk_s, dv_s, *, causal, scale, nq):
+    ki, qj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qj == 0)
+    def _():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qj, ki, BLOCK)
+        p = jnp.exp(s - lse[:, None])                     # [Bq, Bk]
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _when_visible(causal, qj >= ki, _compute)
+
+    @pl.when(qj == nq - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, res, g):
+    q, k, v, o, lse = res
+    bh, T, d = q.shape
+    nq = T // BLOCK
+    do = g.astype(q.dtype)
+    # Δ_i = Σ_d do·o — rowwise, cheap in plain XLA; lane-padded like lse
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (8,))
+
+    if causal:
+        kv_idx = lambda i, qj, kj: (i, jnp.minimum(kj, qj), 0)
+        q_idx = lambda i, kj, qj: (i, jnp.maximum(qj, kj), 0)
+    else:
+        kv_idx = lambda i, qj, kj: (i, kj, 0)
+        q_idx = lambda i, kj, qj: (i, qj, 0)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nq),
+        grid=(bh, nq, nq),
+        in_specs=[
+            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # q
+            _vspec((1, BLOCK, d), kv_idx),                         # k
+            _vspec((1, BLOCK, d), kv_idx),                         # v
+            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # do
+            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # delta
+            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # lse
+        ],
+        out_specs=_vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_scratch((BLOCK, d))],
+        interpret=_interpret(),
+    )(q, k, v, do, delta, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq),
+        grid=(bh, nq, nq),
+        in_specs=[
+            _vspec((1, BLOCK, d), q_idx),                          # q
+            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # k
+            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # v
+            _vspec((1, BLOCK, d), q_idx),                          # do
+            _vspec((1, BLOCK, 8), q_idx),                          # delta
+            _vspec((1, BLOCK, 8), q_idx),                          # lse
+        ],
+        out_specs=(
+            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
+            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
+        ),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        scratch_shapes=[_scratch((BLOCK, d)), _scratch((BLOCK, d))],
+        interpret=_interpret(),
+    )(q, k, v, do, delta, lse)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public entry
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    o, _ = _fwd(q, k, v, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    o, lse = _fwd(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+_FORCE_INTERPRET = False  # tests flip this to run kernels off-TPU
+
+
+def _interpret() -> bool:
+    if _FORCE_INTERPRET:
+        return True
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return True
+
+
+#: below this sequence length the dense einsum is faster on-chip (measured:
+#: T=2048 dense 12.4 ms vs flash 14.1 ms; T=8192 dense 490 ms vs flash 65 ms)
+MIN_SEQ = 4096
+
+
+def supported(T: int, d: int, dropout_rate: float, key_mask) -> bool:
+    """Whether the flash path applies: TPU backend (the interpreter would be
+    far slower than the dense einsum — except under the tests' forced
+    interpret mode), block-divisible sequence long enough to beat the dense
+    path, head dim within VMEM tiling, no dropout inside the softmax, no key
+    padding mask."""
+    min_seq = 2 * BLOCK if _FORCE_INTERPRET else MIN_SEQ
+    if not _FORCE_INTERPRET:
+        try:
+            if jax.default_backend() not in ("tpu", "axon"):
+                return False
+        except Exception:  # pragma: no cover
+            return False
+    return (T % BLOCK == 0 and T >= min_seq and d <= 256
+            and dropout_rate == 0.0 and key_mask is None)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Blockwise attention. q/k/v: [b, T, h, d] → [b, T, h, d]."""
+    b, T, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, T, d)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), bool(causal), float(scale))
+    return jnp.transpose(o.reshape(b, h, T, d), (0, 2, 1, 3))

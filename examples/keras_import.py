@@ -1,0 +1,41 @@
+"""Keras model import — the reference's deeplearning4j-modelimport flow:
+save any tf.keras model to legacy HDF5, import it as a TPU-native network,
+fine-tune or serve it.
+
+Run: python examples/keras_import.py  (needs tensorflow to build the h5)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import tensorflow as tf
+
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((8, 8, 3)),
+        tf.keras.layers.Conv2D(8, 3, activation="relu", name="c1"),
+        tf.keras.layers.MaxPooling2D(2, name="p1"),
+        tf.keras.layers.Flatten(name="f"),
+        tf.keras.layers.Dense(10, activation="softmax", name="out"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="adam")
+    m.save("/tmp/keras_model.h5")
+
+    from deeplearning4j_tpu.keras.model_import import KerasModelImport
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        "/tmp/keras_model.h5")
+    x = np.random.default_rng(0).normal(size=(4, 3, 8, 8)).astype(np.float32)
+    print("imported; output shape:", np.asarray(net.output(x)).shape)
+    # TPU f32 matmuls run as bf16 passes by default → ~1e-3 abs tolerance
+    # (the CPU golden tests pin 1e-5; tests/test_keras_golden.py)
+    print("matches Keras:", np.allclose(
+        np.asarray(net.output(x)),
+        m.predict(np.transpose(x, (0, 2, 3, 1)), verbose=0), atol=5e-3))
+
+
+if __name__ == "__main__":
+    main()

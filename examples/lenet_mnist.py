@@ -1,0 +1,49 @@
+"""LeNet on MNIST — the reference's canonical first example
+(deeplearning4j-examples LenetMnistExample), TPU-native: the whole train
+step (fwd + AD bwd + Adam + apply) is one compiled XLA program.
+
+Run: python examples/lenet_mnist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                InputType, Adam)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, PoolingType,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+from deeplearning4j_tpu.optimize.listeners import (PerformanceListener,
+                                                   ScoreIterationListener)
+
+
+def main():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Adam(learning_rate=1e-3))
+            .activation("relu")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+            .layer(SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                    kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(10), PerformanceListener(10))
+    print(net.summary())
+
+    train = MnistDataSetIterator(batch=128, train=True)
+    test = MnistDataSetIterator(batch=512, train=False)
+    net.fit(train, epochs=1)
+    print(net.evaluate(test).stats())
+
+
+if __name__ == "__main__":
+    main()

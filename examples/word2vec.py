@@ -1,0 +1,35 @@
+"""Word2Vec skip-gram — the reference's Word2VecRawTextExample, TPU-native:
+pair generation is vectorized on host, updates run as batched jitted kernels
+with HBM-resident Huffman tables and single-transfer batches.
+
+Run: python examples/word2vec.py [path/to/corpus.txt]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sys
+
+from deeplearning4j_tpu.nlp import Word2Vec, CollectionSentenceIterator
+
+SENTENCES = ["the king rules the kingdom", "the queen rules the kingdom",
+             "a dog chases a cat", "a cat chases a mouse",
+             "the king and the queen wear crowns"] * 200
+
+
+def main():
+    sentences = (open(sys.argv[1]).read().splitlines()
+                 if len(sys.argv) > 1 else SENTENCES)
+    w2v = (Word2Vec.builder()
+           .layer_size(100).window_size(5).min_word_frequency(2)
+           .epochs(3).seed(42)
+           .iterate(CollectionSentenceIterator(sentences))
+           .build())
+    w2v.fit()
+    print("king ~ queen:", w2v.similarity("king", "queen"))
+    print("nearest to king:", w2v.words_nearest("king", 5))
+
+
+if __name__ == "__main__":
+    main()
