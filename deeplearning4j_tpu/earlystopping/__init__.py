@@ -291,6 +291,21 @@ class EarlyStoppingTrainer:
         self.net = net
         self.iterator = train_iterator
 
+    def _train_one_epoch(self, c, reason, details):
+        """One epoch of training with per-iteration termination checks.
+        Overridden by the distributed trainer (epoch-granular master fit,
+        reference ``spark/earlystopping/BaseSparkEarlyStoppingTrainer.java``).
+        Returns (terminated, reason, details)."""
+        for ds in self.iterator:
+            self.net._fit_batch(ds)
+            last = float(self.net.score_)
+            for cond in c.iteration_termination_conditions:
+                if cond.terminate(last):
+                    reason = TerminationReason.IterationTerminationCondition
+                    details = f"{type(cond).__name__} at score {last}"
+                    return True, reason, details
+        return False, reason, details
+
     def fit(self) -> EarlyStoppingResult:
         c = self.config
         for cond in c.epoch_termination_conditions:
@@ -308,18 +323,8 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = None, ""
         while True:
-            iter_terminated = False
-            for ds in self.iterator:
-                self.net._fit_batch(ds)
-                last = float(self.net.score_)
-                for cond in c.iteration_termination_conditions:
-                    if cond.terminate(last):
-                        reason = TerminationReason.IterationTerminationCondition
-                        details = f"{type(cond).__name__} at score {last}"
-                        iter_terminated = True
-                        break
-                if iter_terminated:
-                    break
+            iter_terminated, reason, details = self._train_one_epoch(
+                c, reason, details)
             if iter_terminated:
                 break
             self.net.epoch_count += 1

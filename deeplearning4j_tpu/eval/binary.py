@@ -28,6 +28,17 @@ class EvaluationBinary:
             self.tn = np.zeros(n, np.int64)
             self.fn = np.zeros(n, np.int64)
 
+    def merge(self, other: "EvaluationBinary"):
+        """Sum per-label counts (reference ``EvaluationBinary.merge``)."""
+        if other.tp is None:
+            return self
+        if self.tp is None:
+            for f in ("tp", "fp", "tn", "fn"):
+                setattr(self, f, np.zeros_like(getattr(other, f)))
+        for f in ("tp", "fp", "tn", "fn"):
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        return self
+
     def eval(self, labels, predictions, mask=None):
         labels, predictions = _flatten_masked(labels, predictions, mask)
         if labels.ndim == 1:

@@ -59,6 +59,19 @@ class Evaluation:
             topn = np.argsort(-predictions, axis=-1)[:, :self.top_n]
             self.top_n_correct += int(np.sum(topn == actual[:, None]))
 
+    def merge(self, other: "Evaluation"):
+        """Combine another Evaluation's counts into this one (reference
+        ``Evaluation.merge`` — the reduce step of Spark's distributed
+        evaluation, ``IEvaluationReduceFunction.java``)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self._ensure(other.num_classes)
+        self.confusion.matrix += other.confusion.matrix
+        self.total += other.total
+        self.top_n_correct += other.top_n_correct
+        return self
+
     # ------------------------------------------------------------- metrics
     def _tp(self, i):
         return self.confusion.matrix[i, i]

@@ -125,6 +125,11 @@ class GlobalConfig:
     # when activation memory, not bandwidth, is the binding constraint
     # (very large batch/images).
     remat: str = "off"
+    # Reference 0.9.x ``Builder.iterations(n)``: n optimizer iterations per
+    # minibatch. TPU-native realization: the n steps compile into ONE XLA
+    # program (lax.scan over the step core), so small-model training pays
+    # the host→device dispatch latency once per n steps instead of per step.
+    iterations: int = 1
     # parity-only knobs
     training_workspace_mode: str = WorkspaceMode.ENABLED
     inference_workspace_mode: str = WorkspaceMode.ENABLED
@@ -273,6 +278,12 @@ class Builder:
     # each setter returns self ------------------------------------------------
     def seed(self, s):
         self._conf.seed = int(s)
+        return self
+
+    def iterations(self, n):
+        """n optimizer iterations per minibatch (reference 0.9.x
+        ``Builder.iterations``); compiled as one scanned XLA program."""
+        self._conf.iterations = int(n)
         return self
 
     def updater(self, u):

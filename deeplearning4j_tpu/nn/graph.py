@@ -32,6 +32,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from .layers import impl_for
 from .layers.base import remat_enabled, remat_policy
+from .multilayer import _n_iterations, _scan_iterations
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
@@ -272,13 +273,20 @@ class ComputationGraph:
 
     def _ensure_step(self):
         if self._jit_step is None:
-            self._jit_step = jax.jit(self._raw_step(), donate_argnums=(0, 2))
+            step = self._raw_step()
+            n_iter = _n_iterations(self.gc)
+            if n_iter > 1:
+                step = _scan_iterations(step, n_iter)
+            self._jit_step = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_step
 
     def _ensure_tbptt_step(self):
         if getattr(self, "_jit_tbptt_step", None) is None:
-            self._jit_tbptt_step = jax.jit(self._raw_step(with_rnn_state=True),
-                                           donate_argnums=(0, 2))
+            step = self._raw_step(with_rnn_state=True)
+            n_iter = _n_iterations(self.gc)
+            if n_iter > 1:
+                step = _scan_iterations(step, n_iter, with_rnn_state=True)
+            self._jit_tbptt_step = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_tbptt_step
 
     def _init_rnn_state(self, batch):
@@ -355,7 +363,7 @@ class ComputationGraph:
             self.params, self.states, self.updater_state, it, self._next_rng(),
             inputs, labels, fms, lms)
         self.score_ = loss
-        self.iteration_count += 1
+        self.iteration_count += _n_iterations(self.gc)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
         self.last_batch_size = int(inputs[0].shape[0])
@@ -382,7 +390,7 @@ class ComputationGraph:
              rnn_state) = step(self.params, self.states, self.updater_state,
                                it, self._next_rng(), f_c, l_c, fm_c, lm_c,
                                rnn_state)
-            self.iteration_count += 1
+            self.iteration_count += _n_iterations(self.gc)
         self.score_ = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))

@@ -45,6 +45,43 @@ log = logging.getLogger(__name__)
 _tm = jax.tree_util.tree_map
 
 
+def _n_iterations(gc):
+    """Configured optimizer iterations per minibatch/segment (0.9.x
+    ``iterations`` config), with the legacy-config fallback in ONE place."""
+    return int(getattr(gc, "iterations", 1) or 1)
+
+
+def _scan_iterations(step, n_iter, with_rnn_state=False):
+    """Wrap a train-step fn in a ``lax.scan`` running ``n_iter`` optimizer
+    iterations on the SAME minibatch inside one compiled program — the
+    TPU-native realization of the reference's 0.9.x ``iterations`` config
+    (``NeuralNetConfiguration.Builder.iterations``): small-model training
+    pays the dispatch latency once per n steps. Same signature as ``step``;
+    the iteration counter advances per scanned step and the rng is split so
+    dropout differs across iterations; returns the LAST loss (and, on the
+    TBPTT variant, the last rnn state — every iteration of a segment starts
+    from the same carried-in state, reference solver-per-segment
+    semantics)."""
+    def scanned(params, states, upd_state, iteration, rng, f, l, fm, lm,
+                rnn_state_in=None):
+        def body(carry, i):
+            params, states, upd_state, rng = carry
+            rng, key = jax.random.split(rng)
+            out = step(params, states, upd_state, iteration + i, key, f, l,
+                       fm, lm, rnn_state_in)
+            params, states, upd_state, loss = out[:4]
+            extra = out[4] if with_rnn_state else None
+            return (params, states, upd_state, rng), (loss, extra)
+        (params, states, upd_state, _), (losses, extras) = jax.lax.scan(
+            body, (params, states, upd_state, rng),
+            jnp.arange(n_iter, dtype=jnp.int32))
+        if with_rnn_state:
+            last_rnn = _tm(lambda x: x[-1], extras)
+            return params, states, upd_state, losses[-1], last_rnn
+        return params, states, upd_state, losses[-1]
+    return scanned
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -268,7 +305,11 @@ class MultiLayerNetwork:
         return out
 
     def _build_step(self, with_rnn_state):
-        return jax.jit(self._raw_step(with_rnn_state), donate_argnums=(0, 2))
+        step = self._raw_step(with_rnn_state)
+        n_iter = _n_iterations(self.gc)
+        if n_iter > 1:
+            step = _scan_iterations(step, n_iter, with_rnn_state)
+        return jax.jit(step, donate_argnums=(0, 2))
 
     def _ensure_step(self):
         if self._jit_step is None:
@@ -333,7 +374,7 @@ class MultiLayerNetwork:
             self.params, self.states, self.updater_state, it, self._next_rng(),
             f, l, fm, lm)
         self.score_ = loss
-        self.iteration_count += 1
+        self.iteration_count += _n_iterations(self.gc)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
 
@@ -363,10 +404,10 @@ class MultiLayerNetwork:
             (self.params, self.states, self.updater_state, loss,
              rnn_state) = step(self.params, self.states, self.updater_state, it,
                                self._next_rng(), f_c, l_c, fm_c, lm_c, rnn_state)
-            # one iteration per TBPTT segment (reference increments
-            # iterationCount per segment, so Adam bias correction and lr
-            # schedules see every applied update)
-            self.iteration_count += 1
+            # one iteration per TBPTT segment × iterations(n) applied per
+            # segment (reference increments iterationCount per applied
+            # update, so Adam bias correction and lr schedules see each one)
+            self.iteration_count += _n_iterations(self.gc)
         self.score_ = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))

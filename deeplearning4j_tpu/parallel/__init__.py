@@ -19,7 +19,9 @@ from .distributed import (ProcessLocalIterator, is_chief,
                           SharedTrainingMaster, SharedGradientsClusterTrainer,
                           DistributedMultiLayerNetwork,
                           DistributedComputationGraph, SparkDl4jMultiLayer,
-                          SparkComputationGraph, initialize_distributed)
+                          SparkComputationGraph, initialize_distributed,
+                          allgather_objects, DistributedDataSetLossCalculator,
+                          DistributedEarlyStoppingTrainer)
 from .sequence import ring_attention, ulysses_attention, full_attention
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
@@ -41,4 +43,6 @@ __all__ = [
     "megatron_rules", "tensor_parallel_step", "param_shardings",
     "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
     "EXPERT_AXIS", "expert_rules", "expert_parallel_step",
+    "allgather_objects", "DistributedDataSetLossCalculator",
+    "DistributedEarlyStoppingTrainer",
 ]

@@ -76,20 +76,27 @@ class ProcessLocalIterator:
     match)."""
 
     def __init__(self, iterator, process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None,
+                 drop_remainder: bool = True):
         self.it = iterator
         self.p = jax.process_index() if process_index is None else process_index
         self.P = jax.process_count() if process_count is None else process_count
+        # training needs equal step counts on every process (collective
+        # schedules must match) → drop the final partial window; evaluation/
+        # scoring has no per-batch collective, so the tail is kept and
+        # assigned to the low-indexed processes (full-stream metrics)
+        self.drop_remainder = drop_remainder
 
     def __iter__(self):
-        # rolling window of P batches — never materializes the stream (the
-        # final partial window is dropped so all processes see equal counts)
+        # rolling window of P batches — never materializes the stream
         chunk = []
         for b in self.it:
             chunk.append(b)
             if len(chunk) == self.P:
                 yield chunk[self.p]
                 chunk = []
+        if chunk and not self.drop_remainder and self.p < len(chunk):
+            yield chunk[self.p]
 
     def reset(self):
         if hasattr(self.it, "reset"):
@@ -316,7 +323,22 @@ class DistributedMultiLayerNetwork:
         return self.net
 
     def evaluate(self, iterator):
-        return self.net.evaluate(iterator)
+        """Distributed evaluation (reference
+        ``spark/impl/multilayer/evaluation/IEvaluateFlatMapFunction.java`` +
+        ``IEvaluationReduceFunction.java``): each process evaluates only its
+        round-robin shard of the stream, partial Evaluations are allgathered
+        and MERGED, and every process returns the identical cluster-wide
+        result."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return self.net.evaluate(iterator)
+        local = self.net.evaluate(
+            ProcessLocalIterator(iterator, drop_remainder=False))
+        merged = None
+        for part in allgather_objects(local):
+            merged = part if merged is None else merged.merge(part)
+        return merged
 
     def calculate_score(self, iterator, average: bool = True):
         """Reference ``calculateScore`` :332."""
@@ -338,3 +360,91 @@ class DistributedComputationGraph(DistributedMultiLayerNetwork):
 
 
 SparkComputationGraph = DistributedComputationGraph
+
+
+# -------------------------------------------------- cluster-wide reductions
+def allgather_objects(obj) -> list:
+    """Allgather arbitrary picklable host objects across processes (the
+    reduce transport for distributed evaluation/scoring). Single-process:
+    identity. Multi-process: length-prefixed pickle bytes through
+    ``jax.experimental.multihost_utils.process_allgather`` (two fixed-shape
+    collectives: max-length agreement, then padded payloads)."""
+    import pickle
+
+    if jax.process_count() <= 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray([data.size], np.int64))).reshape(-1)
+    m = int(sizes.max())
+    padded = np.zeros(m, np.uint8)
+    padded[:data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(jax.process_count(), m)
+    return [pickle.loads(gathered[i, :int(sizes[i])].tobytes())
+            for i in range(jax.process_count())]
+
+
+class DistributedDataSetLossCalculator:
+    """Cluster-wide validation loss (reference
+    ``spark/earlystopping/SparkDataSetLossCalculator.java``): each process
+    sums loss over its shard, partial (total, n) pairs are allgathered, and
+    every process computes the identical global average."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def minimize_score(self) -> bool:
+        return True
+
+    def calculate_score(self, net) -> float:
+        it = (ProcessLocalIterator(self.iterator, drop_remainder=False)
+              if jax.process_count() > 1 else self.iterator)
+        total, n = 0.0, 0
+        for ds in it:
+            b = ds.num_examples()
+            total += float(net.score(ds)) * b
+            n += b
+        parts = allgather_objects((total, n))
+        total = sum(t for t, _ in parts)
+        n = sum(c for _, c in parts)
+        return total / n if (self.average and n) else total
+
+    calculateScore = calculate_score
+
+
+from ..earlystopping import EarlyStoppingTrainer, TerminationReason  # noqa: E402
+
+
+class DistributedEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """Early stopping over the distributed facade (reference
+    ``spark/earlystopping/SparkEarlyStoppingTrainer.java``): each epoch runs
+    through the facade's TrainingMaster (process-sharded data, collective
+    sync), and scoring should use :class:`DistributedDataSetLossCalculator`
+    so conditions fire identically on every process."""
+
+    def __init__(self, config, dist_net: DistributedMultiLayerNetwork,
+                 train_iterator):
+        super().__init__(config, dist_net.net, train_iterator)
+        self.dist_net = dist_net
+
+    def _train_one_epoch(self, c, reason, details):
+        # the wrapper's fit already advances net.epoch_count; the base
+        # trainer loop increments it too, so restore to avoid double-count
+        before = self.net.epoch_count
+        self.dist_net.fit(self.iterator, epochs=1)
+        self.net.epoch_count = before
+        last = float(self.net.score_)
+        for cond in c.iteration_termination_conditions:
+            if cond.terminate(last):
+                reason = TerminationReason.IterationTerminationCondition
+                details = f"{type(cond).__name__} at score {last}"
+                return True, reason, details
+        return False, reason, details
+
+
+SparkEarlyStoppingTrainer = DistributedEarlyStoppingTrainer
+SparkDataSetLossCalculator = DistributedDataSetLossCalculator

@@ -115,6 +115,14 @@ class ParallelWrapper:
                  accumulator: Optional[GradientsAccumulator] = None,
                  mesh: Optional[Mesh] = None):
         self.net = net
+        if (int(getattr(net.gc, "iterations", 1) or 1) > 1
+                and not getattr(net, "_warned_pw_iterations", False)):
+            net._warned_pw_iterations = True
+            log.warning("iterations(%s) is ignored under ParallelWrapper "
+                        "(it re-jits the single-iteration step with mesh "
+                        "shardings); each dispatched batch runs one "
+                        "optimizer iteration",
+                        net.gc.iterations)
         devices = jax.devices()
         if workers is not None and workers < len(devices):
             devices = devices[:workers]

@@ -1,0 +1,140 @@
+"""Profiling utilities — the build's tracing subsystem (SURVEY.md §5).
+
+The reference has no in-framework tracer; deep profiling is delegated to
+ND4J's external ``OpProfiler`` and throughput to ``PerformanceListener``.
+Here the device is XLA, so the natural equivalents are:
+
+- :func:`trace` / :class:`ProfilerListener` — capture a ``jax.profiler``
+  device trace (viewable in TensorBoard/Perfetto) around a code block or a
+  chosen window of training iterations.
+- :func:`step_cost` — XLA's static cost model for a container's compiled
+  train step (flops / bytes accessed / peak memory), the numbers behind the
+  roofline analysis in PERF.md.
+- :class:`StepTimerListener` — honest per-iteration wall times using a
+  device→host value fetch as the barrier (``jax.block_until_ready`` can
+  return early on the axon tunnel — PERF.md addendum 2).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler device trace for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerListener(TrainingListener):
+    """Trace a window of training iterations: starts a jax.profiler trace at
+    ``start_iteration`` and stops it ``num_iterations`` later. Attach like
+    any listener (reference listener-bus pattern,
+    ``optimize/api/IterationListener.java``)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 3,
+                 num_iterations: int = 3):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = num_iterations
+        self._active = False
+        self.done = False
+
+    def iteration_done(self, model, iteration, score):
+        import jax
+
+        if self.done:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._until = iteration + self.num_iterations
+        elif self._active and iteration >= self._until:
+            jax.block_until_ready(model.params)
+            self.close()
+
+    def close(self):
+        """Stop the trace if still active — called automatically when the
+        window fills or the epoch ends, and safe to call explicitly when
+        training stops early (an active jax profiler trace is process-global;
+        leaking it breaks the next start_trace)."""
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+    def on_epoch_end(self, model, epoch):
+        self.close()
+
+
+class StepTimerListener(TrainingListener):
+    """Per-iteration wall-clock times with a value-fetch barrier."""
+
+    def __init__(self):
+        self.times_ms: List[float] = []
+        self._t0: Optional[float] = None
+
+    def iteration_done(self, model, iteration, score):
+        np.asarray(score)  # reliable completion barrier (axon: see PERF.md)
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self.times_ms.append((now - self._t0) * 1e3)
+        self._t0 = now
+
+    def summary(self) -> Dict[str, float]:
+        if not self.times_ms:
+            return {}
+        arr = np.asarray(self.times_ms)
+        return {"mean_ms": float(arr.mean()), "p50_ms": float(np.median(arr)),
+                "p95_ms": float(np.percentile(arr, 95)),
+                "n": float(arr.size)}
+
+
+def step_cost(net, ds) -> Dict[str, Any]:
+    """XLA cost analysis of the container's compiled train step on this
+    DataSet's shapes: {'flops', 'bytes_accessed', ...} plus derived
+    per-example numbers. Works for MultiLayerNetwork and ComputationGraph."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..datasets.dataset import DataSet
+
+    if isinstance(ds, DataSet):
+        f = jnp.asarray(ds.features)
+        l = jnp.asarray(ds.labels)
+        feats, labels = f, l
+        is_graph = hasattr(net, "conf") and hasattr(net.conf, "vertices")
+        if is_graph:
+            feats, labels = (f,), (l,)
+        batch = int(f.shape[0])
+    else:  # MultiDataSet
+        feats = tuple(jnp.asarray(x) for x in ds.features)
+        labels = tuple(jnp.asarray(x) for x in ds.labels)
+        batch = int(ds.features[0].shape[0])
+
+    raw = net._raw_step(False) if "with_rnn_state" in \
+        net._raw_step.__code__.co_varnames else net._raw_step()
+    lowered = jax.jit(raw).lower(
+        net.params, net.states, net.updater_state,
+        jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+        feats, labels, None, None)
+    ca = lowered.compile().cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    by = float(ca.get("bytes accessed", 0.0))
+    return {"flops": flops, "bytes_accessed": by, "batch": batch,
+            "gflop_per_example": flops / batch / 1e9,
+            "mb_per_example": by / batch / 1e6,
+            "raw": dict(ca)}

@@ -9,7 +9,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import sys
 
 from deeplearning4j_tpu.nlp import Word2Vec, CollectionSentenceIterator
 

@@ -64,3 +64,9 @@ np.save(os.path.join(outdir, f"params_{pid}.npy"), net.params_flat())
 with open(os.path.join(outdir, f"result_{pid}.txt"), "w") as fh:
     fh.write(f"{s0} {s1} {net.iteration_count} {int(is_chief())}\n")
 print(f"proc {pid}: score {s0:.4f} -> {s1:.4f}, chief={is_chief()}")
+
+# distributed evaluation: each process scores its shard, partial Evaluations
+# allgather+merge — every process must hold the identical cluster-wide result
+ev = dist.evaluate(ListDataSetIterator(batches))
+with open(os.path.join(outdir, f"eval_{pid}.txt"), "w") as fh:
+    fh.write(f"{ev.total} {ev.accuracy():.10f}\n")

@@ -1,0 +1,111 @@
+"""Distributed evaluation + early stopping (reference
+``spark/impl/multilayer/evaluation/`` map-partition evaluate + merge and
+``spark/earlystopping/`` trainers)."""
+import numpy as np
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.binary import EvaluationBinary
+from deeplearning4j_tpu.parallel import (DistributedDataSetLossCalculator,
+                                         DistributedEarlyStoppingTrainer,
+                                         DistributedMultiLayerNetwork,
+                                         ParameterAveragingTrainingMaster,
+                                         allgather_objects)
+
+
+def _onehot(rng, n, c):
+    return np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+
+
+def test_evaluation_merge_equals_joint_eval():
+    rng = np.random.default_rng(0)
+    l1, p1 = _onehot(rng, 30, 4), rng.random((30, 4)).astype(np.float32)
+    l2, p2 = _onehot(rng, 20, 4), rng.random((20, 4)).astype(np.float32)
+    a = Evaluation()
+    a.eval(l1, p1)
+    b = Evaluation()
+    b.eval(l2, p2)
+    joint = Evaluation()
+    joint.eval(np.concatenate([l1, l2]), np.concatenate([p1, p2]))
+    a.merge(b)
+    assert a.total == joint.total == 50
+    np.testing.assert_array_equal(a.confusion.matrix, joint.confusion.matrix)
+    assert abs(a.accuracy() - joint.accuracy()) < 1e-12
+    assert abs(a.f1() - joint.f1()) < 1e-12
+
+
+def test_regression_and_binary_merge():
+    rng = np.random.default_rng(1)
+    la, pa = rng.random((10, 3)), rng.random((10, 3))
+    lb, pb = rng.random((15, 3)), rng.random((15, 3))
+    r1 = RegressionEvaluation()
+    r1.eval(la, pa)
+    r2 = RegressionEvaluation()
+    r2.eval(lb, pb)
+    rj = RegressionEvaluation()
+    rj.eval(np.concatenate([la, lb]), np.concatenate([pa, pb]))
+    r1.merge(r2)
+    np.testing.assert_allclose(r1.mean_squared_error(0),
+                               rj.mean_squared_error(0), rtol=1e-12)
+
+    bl = (rng.random((25, 2)) > 0.5).astype(np.float32)
+    bp = rng.random((25, 2)).astype(np.float32)
+    e1 = EvaluationBinary()
+    e1.eval(bl[:10], bp[:10])
+    e2 = EvaluationBinary()
+    e2.eval(bl[10:], bp[10:])
+    ej = EvaluationBinary()
+    ej.eval(bl, bp)
+    e1.merge(e2)
+    np.testing.assert_array_equal(e1.tp, ej.tp)
+    np.testing.assert_array_equal(e1.fn, ej.fn)
+
+
+def test_allgather_objects_single_process_identity():
+    out = allgather_objects({"a": np.arange(3), "b": "x"})
+    assert len(out) == 1 and out[0]["b"] == "x"
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_distributed_loss_calculator_and_early_stopping():
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, InMemoryModelSaver,
+        MaxEpochsTerminationCondition)
+
+    rng = np.random.default_rng(3)
+    f = rng.normal(size=(32, 4)).astype(np.float32)
+    labels = (f[:, 0] > 0).astype(int)
+    l = np.eye(3, dtype=np.float32)[labels]
+    train = ListDataSetIterator([DataSet(f[:16], l[:16]),
+                                 DataSet(f[16:], l[16:])])
+    val = ListDataSetIterator([DataSet(f, l)])
+
+    net = _net()
+    dist = DistributedMultiLayerNetwork(
+        net, ParameterAveragingTrainingMaster(batch_size_per_worker=16))
+    calc = DistributedDataSetLossCalculator(val)
+    conf = EarlyStoppingConfiguration(
+        model_saver=InMemoryModelSaver(),
+        score_calculator=calc,
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)])
+    result = DistributedEarlyStoppingTrainer(conf, dist, train).fit()
+    assert result.best_model is not None
+    assert result.total_epochs >= 1
+    s = calc.calculate_score(net)
+    assert np.isfinite(s)
+    # distributed evaluate == local evaluate when single-process
+    ev = dist.evaluate(val)
+    assert 0.0 <= ev.accuracy() <= 1.0

@@ -1,0 +1,63 @@
+"""Profiling utilities (SURVEY.md §5 tracing subsystem)."""
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.utils.profiling import (ProfilerListener,
+                                                StepTimerListener, step_cost,
+                                                trace)
+
+
+def _net_and_ds():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+    return net, ds
+
+
+def test_step_timer_listener_collects_times():
+    net, ds = _net_and_ds()
+    timer = StepTimerListener()
+    net.set_listeners(timer)
+    for _ in range(6):
+        net.fit(ds)
+    s = timer.summary()
+    assert s["n"] >= 4 and s["mean_ms"] > 0 and s["p95_ms"] >= s["p50_ms"]
+
+
+def test_step_cost_reports_flops_and_bytes():
+    net, ds = _net_and_ds()
+    c = step_cost(net, ds)
+    assert c["flops"] > 0 and c["bytes_accessed"] > 0
+    assert c["gflop_per_example"] > 0 and c["batch"] == 16
+
+
+def test_profiler_listener_writes_trace(tmp_path):
+    net, ds = _net_and_ds()
+    prof = ProfilerListener(str(tmp_path), start_iteration=1,
+                            num_iterations=2)
+    net.set_listeners(prof)
+    for _ in range(6):
+        net.fit(ds)
+    assert prof.done
+    # a trace directory with at least one event file appeared
+    found = [p for p, _, files in os.walk(tmp_path) for f in files]
+    assert found, "no trace files written"
+
+
+def test_trace_context_manager(tmp_path):
+    import jax.numpy as jnp
+    with trace(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    assert any(files for _, _, files in os.walk(tmp_path))
