@@ -30,14 +30,9 @@ class EvaluationBinary:
 
     def merge(self, other: "EvaluationBinary"):
         """Sum per-label counts (reference ``EvaluationBinary.merge``)."""
-        if other.tp is None:
-            return self
-        if self.tp is None:
-            for f in ("tp", "fp", "tn", "fn"):
-                setattr(self, f, np.zeros_like(getattr(other, f)))
-        for f in ("tp", "fp", "tn", "fn"):
-            setattr(self, f, getattr(self, f) + getattr(other, f))
-        return self
+        from .roc import merge_summed_fields
+        return merge_summed_fields(self, other, ("tp", "fp", "tn", "fn"),
+                                   empty=lambda e: e.tp is None)
 
     def eval(self, labels, predictions, mask=None):
         labels, predictions = _flatten_masked(labels, predictions, mask)

@@ -18,20 +18,16 @@ class RegressionEvaluation:
         self.sum_pred_sq = None
         self.sum_label_pred = None
 
+    _STAT_FIELDS = ("sum_sq_err", "sum_abs_err", "sum_label", "sum_label_sq",
+                    "sum_pred", "sum_pred_sq", "sum_label_pred")
+
     def merge(self, other: "RegressionEvaluation"):
         """Sum another evaluation's sufficient statistics into this one
         (reference ``RegressionEvaluation.merge``)."""
-        if other.n == 0:
-            return self
-        if self.n == 0:
-            for f in ("sum_sq_err", "sum_abs_err", "sum_label",
-                      "sum_label_sq", "sum_pred", "sum_pred_sq",
-                      "sum_label_pred"):
-                setattr(self, f, np.zeros_like(getattr(other, f)))
+        from .roc import merge_summed_fields
+        merge_summed_fields(self, other, self._STAT_FIELDS,
+                            empty=lambda e: e.n == 0)
         self.n += other.n
-        for f in ("sum_sq_err", "sum_abs_err", "sum_label", "sum_label_sq",
-                  "sum_pred", "sum_pred_sq", "sum_label_pred"):
-            setattr(self, f, getattr(self, f) + getattr(other, f))
         return self
 
     def eval(self, labels, predictions, mask=None):

@@ -209,3 +209,19 @@ class ROCMultiClass:
         return float(np.mean([r.calculate_auc() for r in self._per_class]))
 
     calculateAverageAUC = calculate_average_auc
+
+
+def merge_summed_fields(dst, src, fields, empty):
+    """Shared evaluation-merge machinery: field-wise count summation with
+    empty-side handling (the reduce step of distributed evaluation). ``empty``
+    tests whether an evaluation has seen data yet."""
+    import numpy as np
+
+    if empty(src):
+        return dst
+    if empty(dst):
+        for f in fields:
+            setattr(dst, f, np.zeros_like(getattr(src, f)))
+    for f in fields:
+        setattr(dst, f, getattr(dst, f) + getattr(src, f))
+    return dst

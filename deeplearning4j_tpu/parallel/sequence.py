@@ -29,6 +29,9 @@ from jax import shard_map
 from .sharding import SEQUENCE_AXIS, pvary
 
 _NEG = -1e30
+#: within-device K/V chunk for the ring inner loop (keeps live logits at
+#: [b, h, Tl, 512] no matter how long the local shard is)
+_LOCAL_CHUNK = 512
 
 
 def _ring_inner(q, k, v, axis: str, causal: bool, scale: float):
@@ -44,13 +47,25 @@ def _ring_inner(q, k, v, axis: str, causal: bool, scale: float):
     perm = [(j, (j + 1) % n) for j in range(n)]
     iota_q = jnp.arange(Tl)
 
-    def body(i, carry):
-        m, l, acc, k, v = carry
-        blk = (p - i) % n  # which global block this device currently holds
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32)) * scale
+    # local K sub-chunking: without it each ring step materializes a
+    # [b, h, Tl, Tl] logits tensor — O(Tl²) memory that defeats the point of
+    # sharding long sequences. Chunk the arriving K/V block so the live
+    # logits stay [b, h, Tl, chunk] (flash-style blockwise softmax at BOTH
+    # levels: across devices via the ring, within a device via this scan).
+    # non-divisible shards fall back to one chunk (dynamic_slice clamps its
+    # start, which would double-count boundary keys)
+    chunk = _LOCAL_CHUNK if Tl % _LOCAL_CHUNK == 0 else Tl
+    n_chunks = Tl // chunk
+    iota_c = jnp.arange(chunk)
+
+    def one_chunk(c, carry, k, v, blk):
+        m, l, acc = carry
+        ks = lax.dynamic_slice_in_dim(k, c * chunk, chunk, axis=1)
+        vs = lax.dynamic_slice_in_dim(v, c * chunk, chunk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32)) * scale
         if causal:
             q_idx = p * Tl + iota_q               # global query positions
-            k_idx = blk * Tl + iota_q             # global key positions
+            k_idx = blk * Tl + c * chunk + iota_c  # global key positions
             mask = q_idx[:, None] >= k_idx[None, :]
             s = jnp.where(mask[None, None], s, _NEG)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -58,10 +73,18 @@ def _ring_inner(q, k, v, axis: str, causal: bool, scale: float):
         pexp = jnp.exp(s - m_new[..., None])
         l = l * corr + pexp.sum(axis=-1)
         acc = (acc * jnp.transpose(corr, (0, 2, 1))[..., None]
-               + jnp.einsum("bhqk,bkhd->bqhd", pexp, v.astype(jnp.float32)))
+               + jnp.einsum("bhqk,bkhd->bqhd", pexp, vs.astype(jnp.float32)))
+        return m_new, l, acc
+
+    def body(i, carry):
+        m, l, acc, k, v = carry
+        blk = (p - i) % n  # which global block this device currently holds
+        m, l, acc = lax.fori_loop(
+            0, n_chunks, lambda c, mc: one_chunk(c, mc, k, v, blk),
+            (m, l, acc))
         k = lax.ppermute(k, axis, perm)
         v = lax.ppermute(v, axis, perm)
-        return m_new, l, acc, k, v
+        return m, l, acc, k, v
 
     m, l, acc, k, v = lax.fori_loop(0, n, body, (m, l, acc, k, v))
     out = acc / jnp.transpose(l, (0, 2, 1))[..., None]

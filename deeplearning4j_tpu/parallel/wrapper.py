@@ -320,12 +320,41 @@ class ParallelWrapper:
                 log.info("Batch group of %d examples not divisible by %d "
                          "devices; training it unsharded", total,
                          self.workers_)
-                net._fit_batch(merged)
+                self._fit_unsharded(net, merged)
                 self.iteration_count += 1
                 self.last_score = float(net.score_)
                 yield None
                 continue
             yield group
+
+    def _fit_unsharded(self, net, merged):
+        """Train one unsharded fallback batch with exactly ONE optimizer
+        iteration — consistent with every sharded dispatch (the net's own
+        cached step may be an ``iterations(n)`` scan, which would give tail
+        batches n× the updates and desync the iteration accounting)."""
+        from ..nn.multilayer import _n_iterations
+
+        if _n_iterations(net.gc) <= 1:
+            net._fit_batch(merged)
+            return
+        if getattr(self, "_single_iter_step", None) is None:
+            self._single_iter_step = jax.jit(net._raw_step(False),
+                                             donate_argnums=(0, 2))
+        if self._is_graph:
+            mds = net._as_multi(merged)
+            f = tuple(jnp.asarray(x) for x in mds.features)
+            l = tuple(jnp.asarray(x) for x in mds.labels)
+        else:
+            f = jnp.asarray(merged.features)
+            l = jnp.asarray(merged.labels)
+        it = jnp.asarray(net.iteration_count, jnp.int32)
+        net.params, net.states, net.updater_state, loss = \
+            self._single_iter_step(net.params, net.states, net.updater_state,
+                                   it, net._next_rng(), f, l, None, None)
+        net.score_ = loss
+        net.iteration_count += 1
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration_count - 1, float(loss))
 
     def _ensure_shared_steps(self):
         """Two jitted halves around the host codec seam: compute the

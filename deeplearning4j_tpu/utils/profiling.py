@@ -125,8 +125,7 @@ def step_cost(net, ds) -> Dict[str, Any]:
         labels = tuple(jnp.asarray(x) for x in ds.labels)
         batch = int(ds.features[0].shape[0])
 
-    raw = net._raw_step(False) if "with_rnn_state" in \
-        net._raw_step.__code__.co_varnames else net._raw_step()
+    raw = net._raw_step(False)  # both containers take with_rnn_state
     lowered = jax.jit(raw).lower(
         net.params, net.states, net.updater_state,
         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
