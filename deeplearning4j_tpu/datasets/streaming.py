@@ -33,7 +33,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 

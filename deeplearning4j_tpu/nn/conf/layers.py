@@ -22,7 +22,6 @@ from typing import Any, List, Optional, Tuple
 from .serde import register
 from .inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
                      InputTypeFeedForward, InputTypeRecurrent)
-from ..weights import WeightInit
 
 __all__ = [
     "Layer", "BaseLayer", "FeedForwardLayer", "DenseLayer", "ConvolutionLayer",
@@ -36,6 +35,7 @@ __all__ = [
     "OutputLayer", "RnnOutputLayer", "LossLayer", "CenterLossOutputLayer",
     "AutoEncoder", "VariationalAutoencoder", "GlobalPoolingLayer",
     "Yolo2OutputLayer", "FrozenLayer", "ConvolutionMode", "SelfAttentionLayer",
+    "MoEDenseLayer",
 ]
 
 

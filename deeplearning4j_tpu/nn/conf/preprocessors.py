@@ -23,7 +23,7 @@ import dataclasses
 from typing import Optional
 
 from .serde import register
-from .inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+from .inputs import (InputTypeConvolutional,
                      InputTypeFeedForward, InputTypeRecurrent)
 
 __all__ = ["InputPreProcessor", "CnnToFeedForwardPreProcessor",

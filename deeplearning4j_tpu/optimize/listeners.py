@@ -5,12 +5,15 @@ TPU-native equivalents of reference ``optimize/api/IterationListener.java`` /
 (SURVEY.md §2.1 "Listeners"): ScoreIterationListener, PerformanceListener
 (samples/sec + batches/sec, ``PerformanceListener.java:19-23``),
 CollectScoresIterationListener, TimeIterationListener, EvaluativeListener,
-SleepyTrainingListener.
+SleepyTrainingListener, ParamAndGradientIterationListener.
 """
 from __future__ import annotations
 
 import logging
 import time
+from typing import Optional
+
+import numpy as np
 
 log = logging.getLogger(__name__)
 
@@ -141,3 +144,83 @@ class EvaluativeListener(TrainingListener):
             self.last_evaluation = model.evaluate(self.iterator)
             log.info("Evaluation at iteration %d:\n%s", iteration,
                      self.last_evaluation.stats())
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Per-iteration parameter/update statistics (reference
+    ``optimize/listeners/ParamAndGradientIterationListener.java``: mean,
+    min/max, mean-abs of params and gradients, tab-delimited to console/
+    file/log every N iterations).
+
+    The jitted step doesn't expose raw gradients to the listener bus (it
+    applies the updater in-graph), so the second stat family reports the
+    applied UPDATE (param delta between iterations — the reference's
+    gradient column is likewise the updater-transformed value by the time
+    listeners fire). Columns: score, then per-family mean/min/max/meanAbs.
+    """
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = True,
+                 print_mean_abs_value: bool = True, output_to_console: bool = True,
+                 file_path: Optional[str] = None, delimiter: str = "\t"):
+        self.frequency = max(1, iterations)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs = print_mean_abs_value
+        self.output_to_console = output_to_console
+        self.file_path = file_path
+        self.delimiter = delimiter
+        self.rows = []          # collected rows (always, for programmatic use)
+        self._prev_flat = None
+        self._wrote_header = False
+
+    def _stats(self, flat):
+        out = []
+        if self.print_mean:
+            out.append(float(flat.mean()))
+        if self.print_min_max:
+            out += [float(flat.min()), float(flat.max())]
+        if self.print_mean_abs:
+            out.append(float(np.abs(flat).mean()))
+        return out
+
+    def _header(self):
+        cols = ["iteration", "score"]
+        for fam in ("param", "update"):
+            if self.print_mean:
+                cols.append(f"{fam}Mean")
+            if self.print_min_max:
+                cols += [f"{fam}Min", f"{fam}Max"]
+            if self.print_mean_abs:
+                cols.append(f"{fam}MeanAbsValue")
+        return cols
+
+    def iteration_done(self, model, iteration, score):
+        import jax
+
+        flat = np.concatenate([np.asarray(x).ravel() for x in
+                               jax.tree_util.tree_leaves(model.params)])
+        if iteration % self.frequency != 0:
+            self._prev_flat = flat
+            return
+        update = (flat - self._prev_flat if self._prev_flat is not None
+                  else np.zeros_like(flat))
+        self._prev_flat = flat
+        row = [iteration, float(score)] + self._stats(flat) + \
+            self._stats(update)
+        self.rows.append(row)
+        lines = []
+        if self.print_header and not self._wrote_header:
+            lines.append(self.delimiter.join(self._header()))
+            self._wrote_header = True
+        lines.append(self.delimiter.join(str(v) for v in row))
+        text = "\n".join(lines)
+        if self.output_to_console:
+            print(text)
+        if self.file_path:
+            try:
+                with open(self.file_path, "a") as fh:
+                    fh.write(text + "\n")
+            except OSError as e:  # reference caps write-failure messages
+                log.warning("ParamAndGradientIterationListener write failed: %s", e)

@@ -28,7 +28,7 @@ from __future__ import annotations
 import dataclasses
 import shlex
 import subprocess
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 __all__ = ["TpuPodConfig", "TpuPodProvisioner", "HostProvisioner",
            "GcsStager", "ClusterSetup"]

@@ -73,3 +73,38 @@ def test_solver_sgd_dispatch():
     s0 = net.score(ds)
     Solver.builder().model(net).build().optimize(ds)
     assert net.score(ds) < s0
+
+
+def test_param_and_gradient_iteration_listener(tmp_path):
+    """Reference ParamAndGradientIterationListener: per-iteration param +
+    update stats, collected rows and tab-delimited file output."""
+    import os
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, DataSet, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener)
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=6))
+            .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    path = os.path.join(str(tmp_path), "stats.tsv")
+    lst = ParamAndGradientIterationListener(output_to_console=False,
+                                            file_path=path)
+    net.set_listeners(lst)
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+    for _ in range(3):
+        net.fit(ds)
+    assert len(lst.rows) == 3
+    # update stats are nonzero once training moves params
+    assert abs(lst.rows[1][-1]) > 0  # updateMeanAbsValue
+    lines = open(path).read().strip().splitlines()
+    assert lines[0].startswith("iteration\tscore\tparamMean")
+    assert len(lines) == 4  # header + 3 rows
