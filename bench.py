@@ -237,8 +237,44 @@ ALL_BENCHES = [
 ]
 
 
+def _await_backend(attempts=4, probe_timeout=120, retry_wait=120) -> bool:
+    """Guard against a wedged axon tunnel: PJRT client creation can hang
+    FOREVER when the relay holds a stale lease (observed twice in round 3,
+    PERF.md addendum). Probe ``jax.devices()`` in a subprocess under a
+    timeout, retrying a few times (the tunnel has recovered on its own
+    before); return False instead of letting the benchmark hang."""
+    import subprocess
+
+    for i in range(attempts):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=probe_timeout)
+            if probe.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        last = i == attempts - 1
+        print(f"# TPU backend unreachable (attempt {i + 1}/{attempts})"
+              + ("" if last else f"; retrying in {retry_wait}s"),
+              file=sys.stderr)
+        if not last:
+            time.sleep(retry_wait)
+    return False
+
+
 def main():
     run_all = "--all" in sys.argv
+    if not _await_backend():
+        # fail FAST and honestly rather than hang the driver: no number is
+        # fabricated; the error is machine-readable and the exit code is
+        # non-zero. BASELINE.json keeps the last real measurements.
+        print(json.dumps({"metric": "resnet50_imagenet_images_per_sec",
+                          "value": None, "unit": "images/sec",
+                          "vs_baseline": None,
+                          "error": "TPU backend init hang (wedged tunnel); "
+                                   "no measurement taken"}))
+        sys.exit(2)
     # prior published baseline read BEFORE any update — vs_baseline compares
     # against the previous round's number, not the one this run writes
     try:
