@@ -271,6 +271,17 @@ class SequenceVectors:
                 return empty, empty
             arr = np.asarray(pairs, np.int32)
             return self._orient_pairs(arr[:, 0], arr[:, 1])
+        c, t = self._window_pairs_arrays(idxs, rng)
+        return self._orient_pairs(c, t)
+
+    def _window_pairs_arrays(self, idxs, rng):
+        """Raw vectorized dynamic-window pairs (centers, contexts) — NO
+        orientation, no override dispatch; subclasses with custom pair
+        semantics (doc2vec) reuse this for their word-word portion."""
+        n = len(idxs)
+        if n < 2:
+            empty = np.empty(0, np.int32)
+            return empty, empty
         arr = np.asarray(idxs, np.int32)
         pos = np.arange(n)
         b = rng.integers(1, self.window + 1, size=n)
@@ -287,7 +298,7 @@ class SequenceVectors:
         offs = np.arange(total) - np.repeat(starts, counts)
         ctx_pos = np.repeat(lo, counts) + offs
         ctx_pos += (ctx_pos >= centers_pos)       # skip the center slot
-        return self._orient_pairs(arr[centers_pos], arr[ctx_pos])
+        return arr[centers_pos], arr[ctx_pos]
 
     def _orient_pairs(self, centers, contexts):
         """Skip-gram orientation: the CENTER row is updated against the

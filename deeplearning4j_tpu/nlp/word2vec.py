@@ -220,6 +220,29 @@ class ParagraphVectors(Word2Vec):
         else:
             yield from super()._sequence_pairs(idxs, rng)
 
+    def _sequence_pairs_arrays(self, idxs, rng):
+        """Vectorized doc2vec pair generation (same semantics as
+        ``_sequence_pairs``): the base class's fast array path is bypassed
+        whenever ``_sequence_pairs`` is overridden, which left PV on the
+        per-pair Python generator — the exact host bottleneck the
+        vectorization removed for Word2Vec."""
+        if not (idxs and self.vocab.word_at(idxs[0]).word.startswith("LBL::")):
+            c, t = self._window_pairs_arrays(idxs, rng)
+            return self._orient_pairs(c, t)
+        label, words = idxs[0], np.asarray(idxs[1:], np.int32)
+        if words.size == 0:
+            empty = np.empty(0, np.int32)
+            return empty, empty
+        lbl = np.full(words.size, label, np.int32)
+        # doc→word (DBOW) [+ word→doc for DM], then word-word skip-gram
+        # pairs over the rest via the raw vectorized window path
+        cs = [lbl] + ([words] if self.dm else [])
+        ts = [words] + ([lbl] if self.dm else [])
+        wc, wt = self._window_pairs_arrays(list(words), rng)
+        c = np.concatenate(cs + [wc])
+        t = np.concatenate(ts + [wt])
+        return self._orient_pairs(c, t)
+
     # ------------------------------------------------------------- doc query
     def doc_vector(self, label: str):
         return self.word_vector(self._label_token(label))
