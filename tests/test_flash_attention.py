@@ -113,3 +113,20 @@ def test_self_attention_layer_uses_flash_for_long_seq():
         net.fit(ds)
     assert np.isfinite(float(net.score_))
     assert float(net.score(ds)) < s0
+
+
+def test_supported_routing_contract():
+    """Routing rules: no flash off-TPU (unless tests force interpret), no
+    flash below MIN_SEQ on hardware, no dropout/key-mask/odd-length."""
+    # inside this module's autouse fixture _FORCE_INTERPRET is True:
+    assert fa.supported(256, 64, 0.0, None)
+    assert not fa.supported(250, 64, 0.0, None)     # not block-divisible
+    assert not fa.supported(256, 512, 0.0, None)    # head dim too large
+    assert not fa.supported(256, 64, 0.1, None)     # dropout in softmax
+    assert not fa.supported(256, 64, 0.0, object())  # key padding mask
+    # without forced interpret on the CPU test backend: never supported
+    fa._FORCE_INTERPRET = False
+    try:
+        assert not fa.supported(8192, 64, 0.0, None)
+    finally:
+        fa._FORCE_INTERPRET = True
