@@ -548,6 +548,10 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     head_dim: Optional[int] = None
     causal: bool = True
     dropout_rate: float = 0.0
+    #: KV-cache capacity for streaming inference (``rnn_time_step``) and
+    #: cross-segment TBPTT attention; static so the cached step keeps one
+    #: compiled shape. Streams beyond this length roll over the tail.
+    stream_max_length: int = 512
 
 
 @register

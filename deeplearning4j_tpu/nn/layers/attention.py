@@ -33,22 +33,37 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
         return _fa.flash_attention(
             q.astype(compute_dtype), k.astype(compute_dtype),
             v.astype(compute_dtype), causal=causal)
+    visible = None
+    if causal:
+        T, S = q.shape[1], k.shape[1]
+        visible = jnp.tril(jnp.ones((T, S), bool))[None, None]
+    if key_mask is not None:
+        km = (key_mask[:, None, None, :] > 0)
+        visible = km if visible is None else (visible & km)
+    return _dense_attention(q, k, v, visible, compute_dtype,
+                            dropout_rate=dropout_rate, rng=rng, train=train)
+
+
+def _dense_attention(q, k, v, visible, compute_dtype, dropout_rate=0.0,
+                     rng=None, train=False):
+    """Shared dense scaled-dot-product body (full-sequence AND KV-cache
+    streaming paths — one implementation so masking/dropout/numerics cannot
+    diverge). ``visible``: broadcastable-to-[b, h, Tq, Tk] bool mask or
+    None."""
+    d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(compute_dtype),
                         k.astype(compute_dtype),
                         preferred_element_type=pet_dtype(compute_dtype))
     logits = logits / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    if causal:
-        T, S = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((T, S), bool))
-        logits = jnp.where(mask, logits, -1e30)
-    if key_mask is not None:
-        logits = jnp.where(key_mask[:, None, None, :] > 0, logits, -1e30)
+    if visible is not None:
+        logits = jnp.where(visible, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     if train and dropout_rate > 0.0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(compute_dtype),
-                      v.astype(compute_dtype), preferred_element_type=pet_dtype(compute_dtype))
+                      v.astype(compute_dtype),
+                      preferred_element_type=pet_dtype(compute_dtype))
 
 
 @implements("SelfAttentionLayer")
@@ -72,6 +87,63 @@ class SelfAttentionImpl(LayerImpl):
         }
         return params, {}
 
+    #: training forward is scan-free — the stream state must not disable
+    #: the conv-net remat policy the way true RNN carries do (base.py)
+    scan_free_training = True
+
+    def init_stream_state(self, batch):
+        """KV cache for streaming inference / cross-segment TBPTT: circular
+        buffer of ``stream_max_length`` capacity (static shapes keep one
+        compiled step), per-slot global positions (-1 = empty/masked), and
+        the global token counter."""
+        c = self.conf
+        h, d = self._dims()
+        L = int(c.stream_max_length)
+        cd = self.compute_dtype
+        return (jnp.zeros((batch, L, h, d), cd),
+                jnp.zeros((batch, L, h, d), cd),
+                jnp.full((L,), -1, jnp.int32),
+                jnp.zeros((), jnp.int32))
+
+    def _cached_attention(self, q, k, v, carry, cd, key_mask, dropout_rate,
+                          rng, train):
+        """Streaming attention against the circular KV cache: this call's
+        k/v scatter into slots ``(n + i) % L`` (a SLIDING WINDOW — past
+        capacity the OLDEST entries are evicted), and attention sees every
+        retained key at a global position ≤ the query's (causal) or all
+        retained keys (non-causal). Exact match with full-sequence attention
+        while the stream fits the capacity; key-mask-padded tokens occupy
+        slots but are never visible. One shared dense body with ``mha`` —
+        masking/dropout semantics cannot diverge."""
+        k_c, v_c, pos_c, n = carry
+        b, T, h, d = q.shape
+        L = k_c.shape[1]
+        if T > L:
+            raise ValueError(
+                f"SelfAttentionLayer stream chunk of {T} tokens exceeds "
+                f"stream_max_length={L}; raise stream_max_length on the "
+                f"layer config (it must cover the TBPTT segment length)")
+        slots = (n + jnp.arange(T)) % L
+        k_c = k_c.at[:, slots].set(k.astype(k_c.dtype))
+        v_c = v_c.at[:, slots].set(v.astype(v_c.dtype))
+        new_pos = n + jnp.arange(T)
+        if key_mask is not None:
+            # padded tokens advance time but are never visible. Per-example
+            # masks with a SHARED slot-position table need a uniform mask;
+            # use the first example's (sequence iterators pad uniformly per
+            # chunk — per-example divergence falls back to -1 via minimum)
+            km = jnp.min(key_mask, axis=0)  # [T]
+            new_pos = jnp.where(km > 0, new_pos, -1)
+        pos_c = pos_c.at[slots].set(new_pos)
+        qpos = n + jnp.arange(T)                        # [T] global positions
+        if self.conf.causal:
+            visible = (pos_c[None, :] >= 0) & (pos_c[None, :] <= qpos[:, None])
+        else:
+            visible = jnp.broadcast_to(pos_c[None, :] >= 0, (T, L))
+        o = _dense_attention(q, k_c, v_c, visible[None, None], cd,
+                             dropout_rate=dropout_rate, rng=rng, train=train)
+        return o, (k_c, v_c, pos_c, n + T)
+
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
         c = self.conf
         h, d = self._dims()
@@ -81,8 +153,17 @@ class SelfAttentionImpl(LayerImpl):
         q = (x @ params["Wq"].astype(x.dtype)).reshape(b, T, h, d)
         k = (x @ params["Wk"].astype(x.dtype)).reshape(b, T, h, d)
         v = (x @ params["Wv"].astype(x.dtype)).reshape(b, T, h, d)
-        o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train,
-                key_mask=mask)
+        idx = getattr(self, "index", None)
+        carry = (ctx.get("rnn_state_in", {}).get(idx)
+                 if ctx is not None and idx is not None else None)
+        if carry is not None:
+            o, new_carry = self._cached_attention(
+                q, k, v, carry, cd, key_mask=mask,
+                dropout_rate=c.dropout_rate, rng=rng, train=train)
+            ctx.setdefault("rnn_state_out", {})[idx] = new_carry
+        else:
+            o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train,
+                    key_mask=mask)
         o = o.reshape(b, T, h * d)
         y = o @ params["Wo"].astype(o.dtype) + params["b"].astype(o.dtype)
         return self.activation(y).astype(self.out_dtype), state

@@ -191,7 +191,12 @@ def remat_enabled(gc, impls) -> bool:
     flat = [j for i in impls for j in unwrap(i)]
     has_conv = any(getattr(j.conf, "kernel_size", None) is not None
                    for j in flat)
-    has_rnn = any(hasattr(j, "init_stream_state") for j in flat)
+    # scan-carrying layers (true RNNs) defeat the named-saveable policy;
+    # attention has a stream state (KV cache) but its training forward is
+    # scan-free, so it must not disable remat for conv+attention nets
+    has_rnn = any(hasattr(j, "init_stream_state")
+                  and not getattr(j, "scan_free_training", False)
+                  for j in flat)
     return has_conv and not has_rnn
 
 

@@ -130,3 +130,70 @@ def test_supported_routing_contract():
         assert not fa.supported(8192, 64, 0.0, None)
     finally:
         fa._FORCE_INTERPRET = True
+
+
+def test_self_attention_rnn_time_step_kv_cache_matches_full():
+    """Streaming rnn_time_step with the KV cache must reproduce the full-
+    sequence causal forward, token by token (the attention analogue of the
+    reference's rnnTimeStep-vs-full consistency checks)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater(Adam(learning_rate=1e-3)).activation("identity")
+            .list()
+            .layer(SelfAttentionLayer(n_in=12, n_out=12, num_heads=3,
+                                      stream_max_length=32))
+            .layer(RnnOutputLayer(n_in=12, n_out=5, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(6)
+    T = 9
+    x = rng.normal(size=(2, T, 12)).astype(np.float32)
+    full = np.asarray(net.output(x))           # causal full-sequence forward
+
+    net.rnn_clear_previous_state()
+    stepped = []
+    for t in range(T):
+        y = np.asarray(net.rnn_time_step(x[:, t:t + 1, :]))
+        stepped.append(y[:, 0])
+    stepped = np.stack(stepped, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=2e-4, atol=2e-5)
+
+
+def test_self_attention_kv_cache_sliding_window_rollover():
+    """Past capacity, the cache must keep the MOST RECENT window (evict the
+    oldest), matching windowed full attention over the last L tokens."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+
+    L = 4
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=1e-3)).activation("identity")
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
+                                      stream_max_length=L))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(7)
+    T = 9
+    x = rng.normal(size=(1, T, 8)).astype(np.float32)
+
+    net.rnn_clear_previous_state()
+    stepped = []
+    for t in range(T):
+        stepped.append(np.asarray(net.rnn_time_step(x[:, t:t + 1, :]))[:, 0])
+    stepped = np.stack(stepped, axis=1)
+
+    # oracle: token t attends over the last min(t+1, L) tokens only
+    for t in range(T):
+        lo = max(0, t - L + 1)
+        window = x[:, lo:t + 1, :]
+        want = np.asarray(net.output(window))[:, -1]
+        np.testing.assert_allclose(stepped[:, t], want, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"token {t}")
