@@ -30,9 +30,8 @@ def expert_rules(net, axis: str = EXPERT_AXIS) -> Dict[str, P]:
     layers = getattr(net.conf, "layers", None)
     if layers is not None:  # MultiLayerNetwork
         it = [(str(i), l) for i, l in enumerate(layers)]
-    else:  # ComputationGraph
-        it = [(name, v.layer) for name, v in net.conf.vertices.items()
-              if getattr(v, "layer", None) is not None]
+    else:  # ComputationGraph: vertices map name → Layer config (or vertex)
+        it = list(net.conf.vertices.items())
     for key, layer in it:
         if type(layer).__name__ == "MoEDenseLayer":
             k = re.escape(key)  # CG vertex names may hold regex metachars

@@ -215,3 +215,59 @@ def test_moe_and_iterations_serde_round_trip(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(net.params),
                     jax.tree_util.tree_leaves(net2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_moe_in_computation_graph_aux_loss_and_training():
+    """MoEDenseLayer inside a ComputationGraph: aux loss flows through the
+    graph ctx into the objective, EP rules find vertex-named params, and the
+    graph trains."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    def build(aux):
+        g = (NeuralNetConfiguration.builder().seed(11)
+             .updater(Sgd(learning_rate=0.1)).activation("identity")
+             .graph_builder().add_inputs("in"))
+        g.add_layer("moe", MoEDenseLayer(n_in=6, n_out=8, num_experts=4,
+                                         top_k=2, aux_loss_weight=aux,
+                                         activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"), "moe")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+    rng = np.random.default_rng(8)
+    f = rng.normal(size=(16, 6)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    ds = DataSet(f, l)
+
+    net0, net1 = build(0.0), build(10.0)
+    assert float(net1.score(ds)) > float(net0.score(ds)) + 0.1  # aux in loss
+
+    from deeplearning4j_tpu.parallel import expert_rules
+    rules = expert_rules(net0)
+    assert any(k.startswith("^moe") for k in rules), rules
+
+    s0 = float(net0.score(ds))
+    for _ in range(30):
+        net0.fit(ds)
+    assert float(net0.score(ds)) < s0
+
+    # EP-sharded CG step == replicated step
+    net_a, net_b = build(1e-2), build(1e-2)
+    mesh = make_mesh(jax.devices()[:4], axes=(EXPERT_AXIS,))
+    step, place = expert_parallel_step(net_a, mesh)
+    place(net_a)
+    it = jax.device_put(jnp.asarray(0, jnp.int32), replicated(mesh))
+    key = jax.device_put(jax.random.PRNGKey(0), replicated(mesh))
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            it, key, (jnp.asarray(f),), (jnp.asarray(l),),
+                            None, None)
+    raw = jax.jit(net_b._raw_step(False))
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           (jnp.asarray(f),), (jnp.asarray(l),), None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
