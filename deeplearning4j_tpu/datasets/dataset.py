@@ -20,7 +20,10 @@ def _array_key(a):
     contract) always misses."""
     if a is None:
         return None
-    return (id(a), a.__array_interface__["data"][0], a.shape, str(a.dtype))
+    # jax.Array (and other duck-typed arrays) lack __array_interface__ —
+    # id + shape/dtype still pins identity because the key's array is retained
+    data_ptr = getattr(a, "__array_interface__", {"data": (0,)})["data"][0]
+    return (id(a), data_ptr, tuple(a.shape), str(a.dtype))
 
 
 def _put(a):

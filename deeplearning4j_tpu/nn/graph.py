@@ -246,16 +246,20 @@ class ComputationGraph:
 
         return step
 
-    def _raw_update_step(self):
+    def _raw_update_step(self, with_rnn_state=False):
         """Updater-transformed update without application — SHARED_GRADIENTS
         wire seam (see MultiLayerNetwork._raw_update_step)."""
         core = self._raw_update_core()
 
         def step(params, states, upd_state, iteration, rng, inputs, labels,
-                 input_masks, label_masks):
-            updates, new_states, new_upd, loss, _ = core(
+                 input_masks, label_masks, rnn_state_in=None):
+            updates, new_states, new_upd, loss, rnn_out = core(
                 params, states, upd_state, iteration, rng, inputs, labels,
-                input_masks, label_masks)
+                input_masks, label_masks, rnn_state_in)
+            if with_rnn_state:
+                rnn_out = (_tm(jax.lax.stop_gradient, rnn_out)
+                           if rnn_out else rnn_out)
+                return updates, new_states, new_upd, loss, rnn_out
             return updates, new_states, new_upd, loss
 
         return step
@@ -271,22 +275,31 @@ class ComputationGraph:
                 out[name] = apply_constraints(cons, params[name])
         return out
 
-    def _ensure_step(self):
+    def _build_step(self, with_rnn_state, single_iteration=False):
+        step = self._raw_step(with_rnn_state=with_rnn_state)
+        n_iter = 1 if single_iteration else _n_iterations(self.gc)
+        if n_iter > 1:
+            step = _scan_iterations(step, n_iter, with_rnn_state=with_rnn_state)
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _ensure_step(self, single_iteration=False):
+        if single_iteration and _n_iterations(self.gc) > 1:
+            if getattr(self, "_jit_step_single", None) is None:
+                self._jit_step_single = self._build_step(
+                    with_rnn_state=False, single_iteration=True)
+            return self._jit_step_single
         if self._jit_step is None:
-            step = self._raw_step()
-            n_iter = _n_iterations(self.gc)
-            if n_iter > 1:
-                step = _scan_iterations(step, n_iter)
-            self._jit_step = jax.jit(step, donate_argnums=(0, 2))
+            self._jit_step = self._build_step(with_rnn_state=False)
         return self._jit_step
 
-    def _ensure_tbptt_step(self):
+    def _ensure_tbptt_step(self, single_iteration=False):
+        if single_iteration and _n_iterations(self.gc) > 1:
+            if getattr(self, "_jit_tbptt_step_single", None) is None:
+                self._jit_tbptt_step_single = self._build_step(
+                    with_rnn_state=True, single_iteration=True)
+            return self._jit_tbptt_step_single
         if getattr(self, "_jit_tbptt_step", None) is None:
-            step = self._raw_step(with_rnn_state=True)
-            n_iter = _n_iterations(self.gc)
-            if n_iter > 1:
-                step = _scan_iterations(step, n_iter, with_rnn_state=True)
-            self._jit_tbptt_step = jax.jit(step, donate_argnums=(0, 2))
+            self._jit_tbptt_step = self._build_step(with_rnn_state=True)
         return self._jit_tbptt_step
 
     def _init_rnn_state(self, batch):
@@ -332,7 +345,10 @@ class ComputationGraph:
                             None if ds.features_mask is None else [ds.features_mask],
                             None if ds.labels_mask is None else [ds.labels_mask])
 
-    def _fit_batch(self, ds):
+    def _fit_batch(self, ds, single_iteration=False):
+        """One minibatch. ``single_iteration=True`` applies exactly ONE
+        optimizer update even under ``iterations(n)`` (ParallelWrapper
+        tail-batch fallback — see MultiLayerNetwork._fit_batch)."""
         if self.gc.cache_mode == CacheMode.DEVICE and isinstance(ds, DataSet):
             # cache on the CALLER's DataSet — _as_multi builds a fresh
             # wrapper per batch, so a wrapper-side cache would never hit
@@ -355,26 +371,29 @@ class ComputationGraph:
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT
                 and all(x.ndim == 3 for x in inputs)
                 and inputs[0].shape[1] > self.conf.tbptt_fwd_length):
-            self._fit_tbptt(inputs, labels, fms, lms)
+            self._fit_tbptt(inputs, labels, fms, lms,
+                            single_iteration=single_iteration)
             return
-        step = self._ensure_step()
+        step = self._ensure_step(single_iteration=single_iteration)
         it = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.states, self.updater_state, loss = step(
             self.params, self.states, self.updater_state, it, self._next_rng(),
             inputs, labels, fms, lms)
         self.score_ = loss
-        self.iteration_count += _n_iterations(self.gc)
+        self.iteration_count += (1 if single_iteration
+                                 else _n_iterations(self.gc))
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
         self.last_batch_size = int(inputs[0].shape[0])
 
-    def _fit_tbptt(self, inputs, labels, fms, lms):
+    def _fit_tbptt(self, inputs, labels, fms, lms, single_iteration=False):
         """Truncated BPTT over the DAG (reference CG ``doTruncatedBPTT``):
         time is chunked to ``tbptt_fwd_length``; per-recurrent-vertex (h, c)
         carries are detached between chunks."""
         T = int(inputs[0].shape[1])
         L = self.conf.tbptt_fwd_length
-        step = self._ensure_tbptt_step()
+        step = self._ensure_tbptt_step(single_iteration=single_iteration)
+        n_applied = 1 if single_iteration else _n_iterations(self.gc)
         rnn_state = self._init_rnn_state(int(inputs[0].shape[0]))
         loss = jnp.asarray(float("nan"))
         for start in range(0, T, L):
@@ -390,7 +409,7 @@ class ComputationGraph:
              rnn_state) = step(self.params, self.states, self.updater_state,
                                it, self._next_rng(), f_c, l_c, fm_c, lm_c,
                                rnn_state)
-            self.iteration_count += _n_iterations(self.gc)
+            self.iteration_count += n_applied
         self.score_ = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))

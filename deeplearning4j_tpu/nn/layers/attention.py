@@ -94,26 +94,31 @@ class SelfAttentionImpl(LayerImpl):
     def init_stream_state(self, batch):
         """KV cache for streaming inference / cross-segment TBPTT: circular
         buffer of ``stream_max_length`` capacity (static shapes keep one
-        compiled step), per-slot global positions (-1 = empty/masked), and
-        the global token counter."""
+        compiled step), PER-EXAMPLE per-slot global positions (-1 =
+        empty/masked — per-example so non-uniform key padding across the
+        batch stays exact), and the global token counter."""
         c = self.conf
         h, d = self._dims()
         L = int(c.stream_max_length)
         cd = self.compute_dtype
         return (jnp.zeros((batch, L, h, d), cd),
                 jnp.zeros((batch, L, h, d), cd),
-                jnp.full((L,), -1, jnp.int32),
+                jnp.full((batch, L), -1, jnp.int32),
                 jnp.zeros((), jnp.int32))
 
     def _cached_attention(self, q, k, v, carry, cd, key_mask, dropout_rate,
                           rng, train):
-        """Streaming attention against the circular KV cache: this call's
-        k/v scatter into slots ``(n + i) % L`` (a SLIDING WINDOW — past
-        capacity the OLDEST entries are evicted), and attention sees every
-        retained key at a global position ≤ the query's (causal) or all
-        retained keys (non-causal). Exact match with full-sequence attention
-        while the stream fits the capacity; key-mask-padded tokens occupy
-        slots but are never visible. One shared dense body with ``mha`` —
+        """Streaming attention against the circular KV cache (a SLIDING
+        WINDOW — past capacity the OLDEST entries are evicted).
+
+        Attention is computed BEFORE this chunk's writes land, over the
+        concatenation [retained cache keys | this chunk's keys], so a
+        multi-token chunk that rolls the buffer past capacity cannot evict
+        keys still inside the window of the chunk's EARLIER queries: each
+        causal query at global position p sees exactly the keys at positions
+        in (p - L, p], byte-identical to feeding the chunk one token at a
+        time. Key-mask-padded tokens advance time but are never visible,
+        tracked per example. One shared dense body with ``mha`` —
         masking/dropout semantics cannot diverge."""
         k_c, v_c, pos_c, n = carry
         b, T, h, d = q.shape
@@ -123,25 +128,31 @@ class SelfAttentionImpl(LayerImpl):
                 f"SelfAttentionLayer stream chunk of {T} tokens exceeds "
                 f"stream_max_length={L}; raise stream_max_length on the "
                 f"layer config (it must cover the TBPTT segment length)")
+        chunk_pos = jnp.broadcast_to(n + jnp.arange(T), (b, T))      # [b, T]
+        if key_mask is not None:
+            chunk_pos = jnp.where(key_mask > 0, chunk_pos, -1)
+        # attend over [cache | chunk] with position-based visibility
+        k_all = jnp.concatenate([k_c, k.astype(k_c.dtype)], axis=1)
+        v_all = jnp.concatenate([v_c, v.astype(v_c.dtype)], axis=1)
+        pos_all = jnp.concatenate([pos_c, chunk_pos], axis=1)        # [b, L+T]
+        qpos = n + jnp.arange(T)                        # [T] global positions
+        valid = pos_all[:, None, :] >= 0                # [b, Tq, L+T]
+        if self.conf.causal:
+            # window (p - L, p]: eviction emulated per query, not per chunk
+            visible = (valid
+                       & (pos_all[:, None, :] <= qpos[None, :, None])
+                       & (pos_all[:, None, :] > qpos[None, :, None] - L))
+        else:
+            # non-causal streaming: every key retained after this chunk's
+            # writes (positions > n + T - 1 - L), matching write-then-attend
+            visible = valid & (pos_all[:, None, :] > n + T - 1 - L)
+        o = _dense_attention(q, k_all, v_all, visible[:, None], cd,
+                             dropout_rate=dropout_rate, rng=rng, train=train)
+        # now land the chunk's writes (evicting the oldest slots)
         slots = (n + jnp.arange(T)) % L
         k_c = k_c.at[:, slots].set(k.astype(k_c.dtype))
         v_c = v_c.at[:, slots].set(v.astype(v_c.dtype))
-        new_pos = n + jnp.arange(T)
-        if key_mask is not None:
-            # padded tokens advance time but are never visible. Per-example
-            # masks with a SHARED slot-position table need a uniform mask;
-            # use the first example's (sequence iterators pad uniformly per
-            # chunk — per-example divergence falls back to -1 via minimum)
-            km = jnp.min(key_mask, axis=0)  # [T]
-            new_pos = jnp.where(km > 0, new_pos, -1)
-        pos_c = pos_c.at[slots].set(new_pos)
-        qpos = n + jnp.arange(T)                        # [T] global positions
-        if self.conf.causal:
-            visible = (pos_c[None, :] >= 0) & (pos_c[None, :] <= qpos[:, None])
-        else:
-            visible = jnp.broadcast_to(pos_c[None, :] >= 0, (T, L))
-        o = _dense_attention(q, k_c, v_c, visible[None, None], cd,
-                             dropout_rate=dropout_rate, rng=rng, train=train)
+        pos_c = pos_c.at[:, slots].set(chunk_pos)
         return o, (k_c, v_c, pos_c, n + T)
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):

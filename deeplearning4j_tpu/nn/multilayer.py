@@ -277,17 +277,24 @@ class MultiLayerNetwork:
 
         return step
 
-    def _raw_update_step(self):
+    def _raw_update_step(self, with_rnn_state=False):
         """Updater-transformed update without application — the
         SHARED_GRADIENTS wire seam: the reference encodes post-updater updates
         for peer broadcast (``SymmetricTrainer`` via
         ``EncodingHandler.java:136``), so the codec must see the update, not
-        the raw gradient."""
+        the raw gradient. ``with_rnn_state``: thread the detached RNN/KV
+        carry through (TBPTT segments under SHARED_GRADIENTS)."""
         core = self._raw_update_core()
 
-        def step(params, states, upd_state, iteration, rng, f, l, fm, lm):
-            updates, new_states, new_upd, loss, _ = core(
-                params, states, upd_state, iteration, rng, f, l, fm, lm)
+        def step(params, states, upd_state, iteration, rng, f, l, fm, lm,
+                 rnn_state_in=None):
+            updates, new_states, new_upd, loss, rnn_out = core(
+                params, states, upd_state, iteration, rng, f, l, fm, lm,
+                rnn_state_in)
+            if with_rnn_state:
+                rnn_out = (_tm(jax.lax.stop_gradient, rnn_out)
+                           if rnn_out else rnn_out)
+                return updates, new_states, new_upd, loss, rnn_out
             return updates, new_states, new_upd, loss
 
         return step
@@ -304,19 +311,29 @@ class MultiLayerNetwork:
                 out[str(i)] = apply_constraints(cons, params[str(i)])
         return out
 
-    def _build_step(self, with_rnn_state):
+    def _build_step(self, with_rnn_state, single_iteration=False):
         step = self._raw_step(with_rnn_state)
-        n_iter = _n_iterations(self.gc)
+        n_iter = 1 if single_iteration else _n_iterations(self.gc)
         if n_iter > 1:
             step = _scan_iterations(step, n_iter, with_rnn_state)
         return jax.jit(step, donate_argnums=(0, 2))
 
-    def _ensure_step(self):
+    def _ensure_step(self, single_iteration=False):
+        if single_iteration and _n_iterations(self.gc) > 1:
+            if getattr(self, "_jit_step_single", None) is None:
+                self._jit_step_single = self._build_step(
+                    with_rnn_state=False, single_iteration=True)
+            return self._jit_step_single
         if self._jit_step is None:
             self._jit_step = self._build_step(with_rnn_state=False)
         return self._jit_step
 
-    def _ensure_tbptt_step(self):
+    def _ensure_tbptt_step(self, single_iteration=False):
+        if single_iteration and _n_iterations(self.gc) > 1:
+            if getattr(self, "_jit_tbptt_step_single", None) is None:
+                self._jit_tbptt_step_single = self._build_step(
+                    with_rnn_state=True, single_iteration=True)
+            return self._jit_tbptt_step_single
         if self._jit_tbptt_step is None:
             self._jit_tbptt_step = self._build_step(with_rnn_state=True)
         return self._jit_tbptt_step
@@ -328,7 +345,15 @@ class MultiLayerNetwork:
     # ----------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs=1):
         """Train (reference ``fit(DataSetIterator)`` :1156). Accepts a DataSet,
-        a DataSetIterator, or (features, labels) arrays."""
+        a DataSetIterator, or (features, labels) arrays.
+
+        .. note:: Timing caution (remote/tunneled TPU backends): steps are
+           dispatched asynchronously and ``jax.block_until_ready`` has been
+           observed to return BEFORE the device program finishes on tunneled
+           backends. To time training reliably, gate on a device→host VALUE
+           fetch — e.g. ``float(net.score_)`` / ``np.asarray(loss)`` — or
+           attach :class:`deeplearning4j_tpu.utils.profiling.StepTimerListener`,
+           which does this for you (see PERF.md addendum 2)."""
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         if isinstance(data, DataSet):
@@ -353,7 +378,11 @@ class MultiLayerNetwork:
             self.epoch_count += 1
         return self
 
-    def _fit_batch(self, ds: DataSet):
+    def _fit_batch(self, ds: DataSet, single_iteration=False):
+        """One minibatch. ``single_iteration=True`` applies exactly ONE
+        optimizer update even when ``iterations(n)`` scans are configured —
+        the ParallelWrapper tail-batch fallback needs update-count parity
+        with its sharded dispatches (masks and TBPTT routing preserved)."""
         if self.gc.cache_mode == CacheMode.DEVICE:
             f, l, fm, lm = ds.device_arrays()
         else:
@@ -366,19 +395,20 @@ class MultiLayerNetwork:
         self.last_batch_size = int(f.shape[0])
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT and f.ndim == 3
                 and f.shape[1] > self.conf.tbptt_fwd_length):
-            self._fit_tbptt(f, l, fm, lm)
+            self._fit_tbptt(f, l, fm, lm, single_iteration=single_iteration)
             return
-        step = self._ensure_step()
+        step = self._ensure_step(single_iteration=single_iteration)
         it = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.states, self.updater_state, loss = step(
             self.params, self.states, self.updater_state, it, self._next_rng(),
             f, l, fm, lm)
         self.score_ = loss
-        self.iteration_count += _n_iterations(self.gc)
+        self.iteration_count += (1 if single_iteration
+                                 else _n_iterations(self.gc))
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))
 
-    def _fit_tbptt(self, f, l, fm, lm):
+    def _fit_tbptt(self, f, l, fm, lm, single_iteration=False):
         """Truncated BPTT (reference ``doTruncatedBPTT``): split time into
         chunks of tbptt_fwd_length, carry RNN state (detached) across chunks.
         Like the reference's practical behavior, the backward truncation equals
@@ -392,7 +422,8 @@ class MultiLayerNetwork:
             self._warned_tbptt = True
         T = f.shape[1]
         L = self.conf.tbptt_fwd_length
-        step = self._ensure_tbptt_step()
+        step = self._ensure_tbptt_step(single_iteration=single_iteration)
+        n_applied = 1 if single_iteration else _n_iterations(self.gc)
         rnn_state = self._init_rnn_state(int(f.shape[0]))
         for start in range(0, T, L):
             sl = slice(start, min(start + L, T))
@@ -407,7 +438,7 @@ class MultiLayerNetwork:
             # one iteration per TBPTT segment × iterations(n) applied per
             # segment (reference increments iterationCount per applied
             # update, so Adam bias correction and lr schedules see each one)
-            self.iteration_count += _n_iterations(self.gc)
+            self.iteration_count += n_applied
         self.score_ = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))

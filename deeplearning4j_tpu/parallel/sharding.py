@@ -99,6 +99,48 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):
                    donate_argnums=(0, 2) if donate else ())
 
 
+def _rnn_state_shardings(net, mesh: Mesh, axis: str):
+    """Sharding pytree for a container's RNN/KV stream state: leaves with a
+    batch dimension (LSTM (h, c), attention KV cache/positions) are sharded
+    along ``axis``; scalars (the attention global token counter) replicate."""
+    repl = replicated(mesh)
+    data = batch_sharded(mesh, axis)
+    template = net._init_rnn_state(1)
+    return jax.tree_util.tree_map(
+        lambda x: data if getattr(x, "ndim", 0) >= 1 else repl, template)
+
+
+def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
+                             donate=True):
+    """Sharded train step that also threads the detached RNN/KV carry —
+    the TBPTT segment step under data parallelism. Reference semantics:
+    ``ParallelWrapper`` workers run the full ``MultiLayerNetwork.fit`` loop
+    per replica (``trainer/DefaultTrainer.java:244``), truncated-BPTT
+    included, so the SPMD equivalent must segment time the same way."""
+    raw = net._raw_step(True)
+    repl = replicated(mesh)
+    data = batch_sharded(mesh, axis)
+    state_sh = _rnn_state_shardings(net, mesh, axis)
+    in_sh = (repl, repl, repl, repl, repl, data, data, data, data, state_sh)
+    out_sh = (repl, repl, repl, repl, state_sh)
+    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
+                   donate_argnums=(0, 2) if donate else ())
+
+
+def data_parallel_tbptt_update_step(net, mesh: Mesh, axis: str = DATA_AXIS):
+    """TBPTT segment variant of the SHARED_GRADIENTS update step: returns the
+    updater-transformed (un-applied) update plus the detached carry, so the
+    host codec seam can encode per segment."""
+    raw = net._raw_update_step(with_rnn_state=True)
+    repl = replicated(mesh)
+    data = batch_sharded(mesh, axis)
+    state_sh = _rnn_state_shardings(net, mesh, axis)
+    in_sh = (repl, repl, repl, repl, repl, data, data, data, data, state_sh)
+    out_sh = (repl, repl, repl, repl, state_sh)
+    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
+                   donate_argnums=(2,))
+
+
 def pvary(x, axis_names):
     """Mark ``x`` as device-varying over ``axis_names`` inside shard_map
     (vma typing). Wraps ``lax.pcast(..., to='varying')`` with a fallback to

@@ -30,8 +30,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
-                       shard_batch, put_replicated, data_parallel_step, pvary)
+                       shard_batch, put_replicated, data_parallel_step,
+                       data_parallel_tbptt_step,
+                       data_parallel_tbptt_update_step, pvary)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
+from ..nn.conf import BackpropType
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
@@ -160,6 +163,84 @@ class ParallelWrapper:
             self._sync_step = data_parallel_step(self.net, self.mesh)
         return self._sync_step
 
+    def _ensure_sync_tbptt_step(self):
+        if getattr(self, "_sync_tbptt_step", None) is None:
+            self._sync_tbptt_step = data_parallel_tbptt_step(self.net,
+                                                             self.mesh)
+        return self._sync_tbptt_step
+
+    # ------------------------------------------------------------ TBPTT
+    def _tbptt_applicable(self, f):
+        """True when this (possibly tuple-of-streams) feature batch should be
+        trained as TBPTT segments — same predicate the containers use in
+        ``_fit_batch``, so sharded, tail and single-device batches all get
+        identical truncation semantics (reference: every ParallelWrapper
+        worker runs the full fit loop, ``DefaultTrainer.java:244``)."""
+        conf = self.net.conf
+        if conf.backprop_type != BackpropType.TruncatedBPTT:
+            return False
+        xs = f if isinstance(f, tuple) else (f,)
+        return (all(x.ndim == 3 for x in xs)
+                and xs[0].shape[1] > conf.tbptt_fwd_length)
+
+    @staticmethod
+    def _tbptt_slices(f, l, fm, lm, sl):
+        f_c = _tm(lambda x: x[:, sl], f)
+        l_c = _tm(lambda x: x[:, sl] if x.ndim == 3 else x, l)
+        fm_c = None if fm is None else _tm(lambda m: m[:, sl], fm)
+        lm_c = None if lm is None else _tm(lambda m: m[:, sl], lm)
+        return f_c, l_c, fm_c, lm_c
+
+    def _stacked_n_segments(self, fs):
+        """Segments per micro-batch for [N, b, T, ...] stacked TBPTT data —
+        the stacked-shape sibling of ``_tbptt_applicable``."""
+        conf = self.net.conf
+        xs = jax.tree_util.tree_leaves(fs)
+        if (conf.backprop_type == BackpropType.TruncatedBPTT
+                and all(x.ndim == 4 for x in xs)
+                and xs[0].shape[2] > conf.tbptt_fwd_length):
+            return -(-xs[0].shape[2] // conf.tbptt_fwd_length)
+        return 1
+
+    def _fit_tbptt_segments(self, f, l, fm, lm, seg_step):
+        """Shared TBPTT segment loop for the sharded paths (mirrors the
+        containers' ``_fit_tbptt``: one optimizer update per segment, carry
+        detached between segments, one listener event per batch).
+        ``seg_step(itc, key, f_c, l_c, fm_c, lm_c, rnn) -> (loss, rnn)``
+        applies one segment's update however the training mode does."""
+        net = self.net
+        leaves = jax.tree_util.tree_leaves(f)
+        T, batch = int(leaves[0].shape[1]), int(leaves[0].shape[0])
+        L = net.conf.tbptt_fwd_length
+        rnn_state = net._init_rnn_state(batch)
+        loss = jnp.asarray(float("nan"))
+        for start in range(0, T, L):
+            sl = slice(start, min(start + L, T))
+            f_c, l_c, fm_c, lm_c = self._tbptt_slices(f, l, fm, lm, sl)
+            itc = jnp.asarray(net.iteration_count, jnp.int32)
+            key = put_replicated(net._next_rng(), self.mesh)
+            loss, rnn_state = seg_step(itc, key, f_c, l_c, fm_c, lm_c,
+                                       rnn_state)
+            net.iteration_count += 1
+        self.last_score = float(loss)
+        net.score_ = loss
+        self.iteration_count += 1
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration_count - 1, float(loss))
+
+    def _fit_sync_tbptt(self, f, l, fm, lm):
+        """TBPTT over the fused-psum sharded step."""
+        net = self.net
+        step = self._ensure_sync_tbptt_step()
+
+        def seg(itc, key, f_c, l_c, fm_c, lm_c, rnn):
+            (net.params, net.states, net.updater_state, loss, rnn) = step(
+                net.params, net.states, net.updater_state, itc, key, f_c,
+                l_c, fm_c, lm_c, rnn)
+            return loss, rnn
+
+        self._fit_tbptt_segments(f, l, fm, lm, seg)
+
     def _ensure_local_sgd_step(self):
         """shard_map local-SGD: [N, b, ...] micro-batch stack per device, N
         local updates, then pmean of params/updater-state/layer-state."""
@@ -168,12 +249,35 @@ class ParallelWrapper:
         net = self.net
         mesh = self.mesh
         raw = net._raw_step(False)
+        raw_t = net._raw_step(True)
+        conf = net.conf
         N = self.averaging_frequency
+
+        def one_micro(params, states, upd, it, k, f, l, fm, lm):
+            """One micro-batch on one device: TBPTT-segments when the traced
+            shapes call for it (``_tbptt_applicable`` is trace-time static),
+            else one full-BPTT update."""
+            if not self._tbptt_applicable(f):
+                return raw(params, states, upd, it, k, f, l, fm, lm)
+            xs = jax.tree_util.tree_leaves(f)
+            T, L = xs[0].shape[1], conf.tbptt_fwd_length
+            rnn = net._init_rnn_state(xs[0].shape[0])
+            rnn = _tm(lambda x: pvary(x, (DATA_AXIS,)), rnn)
+            loss = pvary(jnp.asarray(0.0, jnp.float32), (DATA_AXIS,))
+            for s_i, start in enumerate(range(0, T, L)):
+                sl = slice(start, min(start + L, T))
+                f_c, l_c, fm_c, lm_c = ParallelWrapper._tbptt_slices(
+                    f, l, fm, lm, sl)
+                params, states, upd, loss, rnn = raw_t(
+                    params, states, upd, it + s_i,
+                    jax.random.fold_in(k, s_i), f_c, l_c, fm_c, lm_c, rnn)
+            return params, states, upd, loss
 
         def local_run(params, states, upd, it0, rng, fs, ls, fms, lms):
             # runs per-device under shard_map: fs/ls/fms/lms [N, b_local, ...]
             dev = jax.lax.axis_index(DATA_AXIS)
             rng = jax.random.fold_in(rng, dev)
+            n_seg = self._stacked_n_segments(fs)
 
             def body(i, carry):
                 params, states, upd, _ = carry
@@ -183,8 +287,8 @@ class ParallelWrapper:
                                                              keepdims=False)
                 f, l, fm, lm = (_tm(idx, t) for t in (fs, ls, fms, lms))
                 k = jax.random.fold_in(rng, i)
-                params, states, upd, loss = raw(params, states, upd, it0 + i,
-                                                k, f, l, fm, lm)
+                params, states, upd, loss = one_micro(
+                    params, states, upd, it0 + i * n_seg, k, f, l, fm, lm)
                 return params, states, upd, loss
 
             # mark the carry as device-varying: replicas diverge locally
@@ -255,6 +359,9 @@ class ParallelWrapper:
             if group is None:
                 continue  # tail handled unsharded by _batch_groups
             f, l, fm, lm = self._global_batch(group)
+            if self._tbptt_applicable(f):
+                self._fit_sync_tbptt(f, l, fm, lm)
+                continue
             itc = jnp.asarray(net.iteration_count, jnp.int32)
             key = put_replicated(net._next_rng(), self.mesh)
             net.params, net.states, net.updater_state, loss = step(
@@ -329,32 +436,13 @@ class ParallelWrapper:
 
     def _fit_unsharded(self, net, merged):
         """Train one unsharded fallback batch with exactly ONE optimizer
-        iteration — consistent with every sharded dispatch (the net's own
-        cached step may be an ``iterations(n)`` scan, which would give tail
-        batches n× the updates and desync the iteration accounting)."""
-        from ..nn.multilayer import _n_iterations
-
-        if _n_iterations(net.gc) <= 1:
-            net._fit_batch(merged)
-            return
-        if getattr(self, "_single_iter_step", None) is None:
-            self._single_iter_step = jax.jit(net._raw_step(False),
-                                             donate_argnums=(0, 2))
-        if self._is_graph:
-            mds = net._as_multi(merged)
-            f = tuple(jnp.asarray(x) for x in mds.features)
-            l = tuple(jnp.asarray(x) for x in mds.labels)
-        else:
-            f = jnp.asarray(merged.features)
-            l = jnp.asarray(merged.labels)
-        it = jnp.asarray(net.iteration_count, jnp.int32)
-        net.params, net.states, net.updater_state, loss = \
-            self._single_iter_step(net.params, net.states, net.updater_state,
-                                   it, net._next_rng(), f, l, None, None)
-        net.score_ = loss
-        net.iteration_count += 1
-        for lst in net.listeners:
-            lst.iteration_done(net, net.iteration_count - 1, float(loss))
+        iteration per step dispatch — consistent with every sharded dispatch
+        (the net's own cached step may be an ``iterations(n)`` scan, which
+        would give tail batches n× the updates and desync the iteration
+        accounting). Routed through the container's own ``_fit_batch`` so
+        feature/label masks and TBPTT segmentation are preserved exactly as
+        on the sharded path (round-3 advisor finding)."""
+        net._fit_batch(merged, single_iteration=True)
 
     def _ensure_shared_steps(self):
         """Two jitted halves around the host codec seam: compute the
@@ -397,22 +485,48 @@ class ParallelWrapper:
             if group is None:
                 continue
             f, l, fm, lm = self._global_batch(group)
+            if self._tbptt_applicable(f):
+                self._fit_shared_tbptt(f, l, fm, lm, apply_step)
+                continue
             itc = jnp.asarray(net.iteration_count, jnp.int32)
             key = put_replicated(net._next_rng(), self.mesh)
             update, net.states, net.updater_state, loss = update_step(
                 net.params, net.states, net.updater_state, itc, key, f, l,
                 fm, lm)
-            # host hop: encode (residual kept) → decoded quantized update
-            decoded = self.accumulator.store_update(
-                _tm(np.asarray, update))
-            decoded = _tm(jnp.asarray, decoded)
-            net.params = apply_step(net.params, decoded)
+            self._apply_encoded(apply_step, update)
             self.last_score = float(loss)
             net.score_ = loss
             net.iteration_count += 1
             self.iteration_count += 1
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration_count - 1, float(loss))
+
+    def _apply_encoded(self, apply_step, update):
+        """Host hop: encode (residual kept) → apply the decoded quantized
+        update — what peers over DCN would receive."""
+        net = self.net
+        decoded = self.accumulator.store_update(_tm(np.asarray, update))
+        net.params = apply_step(net.params, _tm(jnp.asarray, decoded))
+
+    def _fit_shared_tbptt(self, f, l, fm, lm, apply_step):
+        """SHARED_GRADIENTS × TBPTT: every segment's updater-transformed
+        update passes through the threshold codec (one wire message per
+        applied update — reference ``SymmetricTrainer`` encodes per
+        iteration, and TBPTT iterations are per segment)."""
+        net = self.net
+        if getattr(self, "_shared_tbptt_step", None) is None:
+            self._shared_tbptt_step = data_parallel_tbptt_update_step(
+                net, self.mesh)
+        step = self._shared_tbptt_step
+
+        def seg(itc, key, f_c, l_c, fm_c, lm_c, rnn):
+            (update, net.states, net.updater_state, loss, rnn) = step(
+                net.params, net.states, net.updater_state, itc, key, f_c,
+                l_c, fm_c, lm_c, rnn)
+            self._apply_encoded(apply_step, update)
+            return loss, rnn
+
+        self._fit_tbptt_segments(f, l, fm, lm, seg)
 
     def _fit_local_sgd(self, it):
         """AVERAGING freq=N: collect N micro-batches, one fused local-SGD +
@@ -428,17 +542,21 @@ class ParallelWrapper:
                 continue
             fs, ls, fms, lms = self._stacked_batches(pending)
             pending = []
+            # TBPTT segments count as extra optimizer iterations per micro-
+            # batch (mirror of the trace-time predicate in one_micro)
+            n_seg = self._stacked_n_segments(fs)
             itc = jnp.asarray(net.iteration_count, jnp.int32)
             key = put_replicated(net._next_rng(), self.mesh)
             t0 = time.perf_counter()
             net.params, net.states, net.updater_state, loss = step(
                 net.params, net.states, net.updater_state, itc, key, fs, ls,
                 fms, lms)
-            jax.block_until_ready(net.params)
-            self.averaging_ms = (time.perf_counter() - t0) * 1e3
-            net.iteration_count += self.averaging_frequency
-            self.iteration_count += self.averaging_frequency
+            # value fetch = completion barrier (block_until_ready can return
+            # early on tunneled backends — see StepTimerListener docstring)
             self.last_score = float(loss)
+            self.averaging_ms = (time.perf_counter() - t0) * 1e3
+            net.iteration_count += self.averaging_frequency * n_seg
+            self.iteration_count += self.averaging_frequency
             net.score_ = loss
             if self.report_score_after_averaging:
                 for lst in net.listeners:

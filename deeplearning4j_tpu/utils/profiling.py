@@ -61,7 +61,11 @@ class ProfilerListener(TrainingListener):
             self._active = True
             self._until = iteration + self.num_iterations
         elif self._active and iteration >= self._until:
-            jax.block_until_ready(model.params)
+            # completion barrier: the fit loops evaluate float(loss) — a
+            # device→host VALUE fetch of this step's output — before
+            # dispatching listeners, so the traced step has already finished
+            # when we get here. (block_until_ready would NOT be a valid
+            # substitute on the axon tunnel — PERF.md addendum 2.)
             self.close()
 
     def close(self):
@@ -81,14 +85,25 @@ class ProfilerListener(TrainingListener):
 
 
 class StepTimerListener(TrainingListener):
-    """Per-iteration wall-clock times with a value-fetch barrier."""
+    """Per-iteration wall-clock times with a value-fetch barrier.
+
+    Why not ``jax.block_until_ready``: on remote/tunneled TPU backends (e.g.
+    the axon tunnel this project benches through) ``block_until_ready`` can
+    return before the device program actually finishes, silently producing
+    near-zero step times. Only a device→host VALUE fetch (``np.asarray`` /
+    ``float()`` of a result) is a reliable completion barrier. The fit loops
+    evaluate ``float(loss)`` before dispatching ``iteration_done``, so the
+    score this listener receives IS post-barrier — timing here is honest by
+    construction. User code timing its own steps outside a listener must do
+    its own value fetch (see PERF.md addendum 2)."""
 
     def __init__(self):
         self.times_ms: List[float] = []
         self._t0: Optional[float] = None
 
     def iteration_done(self, model, iteration, score):
-        np.asarray(score)  # reliable completion barrier (axon: see PERF.md)
+        # score arrives as a host float — the caller's float(loss) was the
+        # completion barrier for this step (see class docstring)
         now = time.perf_counter()
         if self._t0 is not None:
             self.times_ms.append((now - self._t0) * 1e3)

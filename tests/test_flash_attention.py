@@ -197,3 +197,79 @@ def test_self_attention_kv_cache_sliding_window_rollover():
         want = np.asarray(net.output(window))[:, -1]
         np.testing.assert_allclose(stepped[:, t], want, rtol=2e-4, atol=2e-5,
                                    err_msg=f"token {t}")
+
+
+def test_self_attention_kv_cache_chunked_rollover():
+    """Multi-token chunks that roll the cache past capacity must still give
+    every query its exact (p - L, p] window — the chunk's writes may not
+    evict keys its own earlier queries should see (round-3 advisor finding:
+    write-after-attend)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+
+    L, C, T = 4, 3, 9          # capacity 4, chunk width 3, stream length 9
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater(Adam(learning_rate=1e-3)).activation("identity")
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
+                                      stream_max_length=L))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(2, T, 8)).astype(np.float32)
+
+    net.rnn_clear_previous_state()
+    chunks = []
+    for s in range(0, T, C):
+        chunks.append(np.asarray(net.rnn_time_step(x[:, s:s + C, :])))
+    got = np.concatenate(chunks, axis=1)
+
+    # oracle: token t attends over the last min(t+1, L) tokens only
+    for t in range(T):
+        lo = max(0, t - L + 1)
+        want = np.asarray(net.output(x[:, lo:t + 1, :]))[:, -1]
+        np.testing.assert_allclose(got[:, t], want, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"token {t}")
+
+
+def test_self_attention_kv_cache_per_example_key_masks():
+    """Streaming with key masks that DIFFER across the batch: each example's
+    padded tokens must be invisible to that example only (round-3 advisor
+    finding: no min-collapse across the batch)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+
+    conf = (NeuralNetConfiguration.builder().seed(13)
+            .updater(Adam(learning_rate=1e-3)).activation("identity")
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
+                                      stream_max_length=16))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    impl = net.impls[0]
+    rng = np.random.default_rng(17)
+    b, T = 3, 6
+    x = jnp.asarray(rng.normal(size=(b, T, 8)), jnp.float32)
+    # example 0: all real; example 1: last two padded; example 2: middle padded
+    mask = jnp.asarray([[1, 1, 1, 1, 1, 1],
+                        [1, 1, 1, 1, 0, 0],
+                        [1, 1, 0, 0, 1, 1]], jnp.float32)
+    params, state = net.params["0"], net.states["0"]
+
+    def run(xs, m):
+        ctx = {"rnn_state_in": {0: impl.init_stream_state(xs.shape[0])}}
+        y, _ = impl.forward(params, state, xs, train=False, rng=None,
+                            mask=m, ctx=ctx)
+        return np.asarray(y)
+
+    got = run(x, mask)
+    for i in range(b):
+        want = run(x[i:i + 1], mask[i:i + 1])
+        np.testing.assert_allclose(got[i:i + 1], want, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"example {i}")

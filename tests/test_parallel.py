@@ -132,6 +132,171 @@ def test_parallel_wrapper_odd_batch_trains_unsharded():
     assert net.iteration_count == 1
 
 
+def test_parallel_wrapper_masked_tail_batch_single_iteration():
+    """An indivisible tail group of masked sequence data under iterations(n)>1
+    must (a) keep the masks and TBPTT segmentation (round-3 advisor medium:
+    _fit_unsharded dropped both) and (b) apply exactly ONE update per step
+    dispatch — identical to calling the container's own single-iteration
+    path directly."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(21)
+                .updater(Sgd(learning_rate=1e-2)).iterations(3)
+                .list()
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(23)
+    T = 8   # 2 TBPTT segments of 4
+    f = rng.normal(size=(5, T, 3)).astype(np.float32)   # 5 % 8 != 0 → tail
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (5, T))].astype(
+        np.float32)
+    m = (np.arange(T)[None, :] < rng.integers(3, T + 1, (5, 1))).astype(
+        np.float32)
+    ds = DataSet(f, l, features_mask=m, labels_mask=m)
+
+    via_wrapper = make()
+    pw = ParallelWrapper.Builder(via_wrapper).workers(8).build()
+    pw.fit(ListDataSetIterator([ds]))
+
+    direct = make()
+    direct._fit_batch(ds, single_iteration=True)
+
+    assert via_wrapper.iteration_count == direct.iteration_count == 2
+    for k in direct.params:
+        for p in direct.params[k]:
+            np.testing.assert_allclose(np.asarray(via_wrapper.params[k][p]),
+                                       np.asarray(direct.params[k][p]),
+                                       rtol=1e-5, atol=1e-6)
+
+    # and the masks genuinely matter: an unmasked run must differ
+    unmasked = make()
+    unmasked._fit_batch(DataSet(f, l), single_iteration=True)
+    diff = max(float(np.max(np.abs(np.asarray(direct.params[k][p])
+                                   - np.asarray(unmasked.params[k][p]))))
+               for k in direct.params for p in direct.params[k])
+    assert diff > 0.0
+
+
+def test_parallel_wrapper_sharded_tbptt_matches_direct():
+    """A DIVISIBLE batch group of TBPTT sequence data under the wrapper must
+    segment time exactly like the container's own fit loop (reference: every
+    ParallelWrapper worker runs the full fit loop incl. doTruncatedBPTT,
+    DefaultTrainer.java:244) — sharded and tail batches now share identical
+    truncation semantics (round-4 review finding)."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(29)
+                .updater(Sgd(learning_rate=1e-2))
+                .list()
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(31)
+    T = 8   # 2 TBPTT segments
+    f = rng.normal(size=(16, T, 3)).astype(np.float32)   # divisible by 8
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (16, T))].astype(
+        np.float32)
+    m = (np.arange(T)[None, :] < rng.integers(3, T + 1, (16, 1))).astype(
+        np.float32)
+    ds = DataSet(f, l, features_mask=m, labels_mask=m)
+
+    via_wrapper = make()
+    pw = ParallelWrapper.Builder(via_wrapper).workers(8).build()
+    pw.fit(ListDataSetIterator([ds]))
+
+    direct = make()
+    direct._fit_batch(ds)
+
+    assert via_wrapper.iteration_count == 2  # one update per segment
+    assert direct.iteration_count == 2
+    for k in direct.params:
+        for p in direct.params[k]:
+            np.testing.assert_allclose(np.asarray(via_wrapper.params[k][p]),
+                                       np.asarray(direct.params[k][p]),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_wrapper_shared_gradients_tbptt():
+    """SHARED_GRADIENTS with TBPTT sequence data: one codec round per applied
+    segment update, convergent, with the wire carrying encoded bytes."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(37)
+            .updater(Sgd(learning_rate=5e-2))
+            .list()
+            .backprop_type(BackpropType.TruncatedBPTT)
+            .t_bptt_forward_length(4).t_bptt_backward_length(4)
+            .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(39)
+    f = rng.normal(size=(16, 8, 3)).astype(np.float32)
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (16, 8))].astype(
+        np.float32)
+    m = (np.arange(8)[None, :] < rng.integers(4, 9, (16, 1))).astype(
+        np.float32)
+    ds = DataSet(f, l, features_mask=m, labels_mask=m)
+    acc = EncodedGradientsAccumulator(initial_threshold=1e-4)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .gradients_accumulator(acc).build())
+    s0 = float(net.score(ds))
+    pw.fit(ListDataSetIterator([ds]), epochs=4)
+    assert np.isfinite(pw.last_score)
+    assert net.iteration_count == 2 * 4   # 2 segments/epoch, one update each
+    assert acc.encoded_bytes() > 0
+    assert float(net.score(ds)) < s0
+
+
+def test_parallel_wrapper_local_sgd_tbptt_segments():
+    """averaging_frequency>1 with TBPTT sequence batches: the per-device
+    micro-steps segment time; loss falls and iteration accounting counts one
+    update per segment per micro-batch."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+
+    conf = (NeuralNetConfiguration.builder().seed(33)
+            .updater(Sgd(learning_rate=5e-2))
+            .list()
+            .backprop_type(BackpropType.TruncatedBPTT)
+            .t_bptt_forward_length(4).t_bptt_backward_length(4)
+            .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(35)
+    batches = []
+    for i in range(2):
+        f = rng.normal(size=(16, 8, 3)).astype(np.float32)
+        l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (16, 8))].astype(
+            np.float32)
+        batches.append(DataSet(f, l))
+    pw = ParallelWrapper.Builder(net).workers(8).averaging_frequency(2).build()
+    pw.fit(ListDataSetIterator(batches))
+    assert np.isfinite(pw.last_score)
+    # 2 micro-batches x 2 segments each = 4 applied updates
+    assert net.iteration_count == 4
+
+
 def test_parallel_wrapper_local_sgd_keeps_masks():
     """averaging_frequency>1 must thread sequence masks into the per-device
     steps (review finding: masks were silently dropped)."""
