@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..activations import get_activation
-from ..weights import init_weight, WeightInit
+from ..weights import init_weight, host_full, WeightInit
 from ..conf.layers import BaseLayer
 
 _IMPL_REGISTRY: Dict[str, Type["LayerImpl"]] = {}
@@ -114,7 +114,7 @@ class LayerImpl:
 
     def _init_b(self, shape, value=None):
         v = self.bias_init if value is None else value
-        return jnp.full(shape, v, self.dtype)
+        return host_full(shape, v, self.dtype)
 
     def maybe_dropout(self, x, train, rng):
         """Input dropout/noise (reference ``BaseLayer.preOutput`` input

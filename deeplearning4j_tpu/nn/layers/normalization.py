@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from .base import LayerImpl, implements, acc_dtype
+from ..weights import host_full
 
 
 @implements("BatchNormalization")
@@ -43,11 +44,11 @@ class BatchNormImpl(LayerImpl):
         n = c.n_out
         params = {}
         if not c.lock_gamma_beta:
-            params["gamma"] = jnp.full((n,), c.gamma, self.dtype)
-            params["beta"] = jnp.full((n,), c.beta, self.dtype)
+            params["gamma"] = host_full((n,), c.gamma, self.dtype)
+            params["beta"] = host_full((n,), c.beta, self.dtype)
         sd = acc_dtype(self.compute_dtype)  # stats precision
-        state = {"mean": jnp.zeros((n,), sd),
-                 "var": jnp.ones((n,), sd)}
+        state = {"mean": host_full((n,), 0, sd),
+                 "var": host_full((n,), 1, sd)}
         return params, state
 
     def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):

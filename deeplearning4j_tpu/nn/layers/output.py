@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import LayerImpl, NoParamLayerImpl, implements
+from ..weights import host_full
 from .feedforward import _dot
 from ..losses import get_loss
 
@@ -76,7 +77,8 @@ class CenterLossOutputLayerImpl(_OutputBase):
     def init(self, rng):
         params, _ = super().init(rng)
         c = self.conf
-        state = {"centers": jnp.zeros((c.n_out, c.n_in), jnp.float32)}
+        state = {"centers": host_full((c.n_out, c.n_in), 0,
+                                      jnp.float32)}
         return params, state
 
     def loss_on(self, params, state, x, labels, mask=None, train=True, rng=None):

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .base import LayerImpl, implements, impl_for, acc_dtype
+from ..weights import host_full
 from ..activations import get_activation
 
 
@@ -69,9 +70,9 @@ class _BaseLSTMImpl(LayerImpl):
         fb = getattr(c, "forget_gate_bias_init", 1.0)
         params["b"] = params["b"].at[H:2 * H].set(fb)
         if self.peepholes:
-            params["pi"] = jnp.zeros((H,), self.dtype)
-            params["pf"] = jnp.zeros((H,), self.dtype)
-            params["po"] = jnp.zeros((H,), self.dtype)
+            params["pi"] = host_full((H,), 0, self.dtype)
+            params["pf"] = host_full((H,), 0, self.dtype)
+            params["po"] = host_full((H,), 0, self.dtype)
         return params, {}
 
     def _run(self, params, x, mask, h0c0, reverse=False):

@@ -23,6 +23,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "IUpdater", "Sgd", "Adam", "AdaMax", "Nadam", "Nesterovs", "RmsProp",
@@ -198,6 +199,16 @@ class IUpdater:
         return d
 
 
+def _init_zeros(p):
+    """Host-backed zeros for EAGER updater-state init: avoids one tiny XLA
+    compile per distinct param shape (GoogLeNet has dozens — init-time cost
+    only). Inside a trace it stays a jnp zeros with no compile of its own."""
+    if isinstance(p, jax.core.Tracer):
+        return jnp.zeros_like(p)
+    from .weights import host_full
+    return host_full(np.shape(p), 0, p.dtype)
+
+
 @dataclasses.dataclass
 class NoOp(IUpdater):
     def apply_one(self, state, g, lr, t):
@@ -216,7 +227,7 @@ class Nesterovs(IUpdater):
     momentum: float = 0.9
 
     def init_one(self, p):
-        return jnp.zeros_like(p)
+        return _init_zeros(p)
 
     def apply_one(self, v, g, lr, t):
         # Matches ND4J NesterovsUpdater: vNew = mu*v - lr*g;
@@ -236,7 +247,7 @@ class Adam(IUpdater):
     epsilon: float = 1e-8
 
     def init_one(self, p):
-        return (jnp.zeros_like(p), jnp.zeros_like(p))
+        return (_init_zeros(p), _init_zeros(p))
 
     def apply_one(self, state, g, lr, t):
         m, v = state
@@ -256,7 +267,7 @@ class AMSGrad(IUpdater):
     epsilon: float = 1e-8
 
     def init_one(self, p):
-        return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros_like(p))
+        return (_init_zeros(p), _init_zeros(p), _init_zeros(p))
 
     def apply_one(self, state, g, lr, t):
         m, v, vmax = state
@@ -276,7 +287,7 @@ class AdaMax(IUpdater):
     epsilon: float = 1e-8
 
     def init_one(self, p):
-        return (jnp.zeros_like(p), jnp.zeros_like(p))
+        return (_init_zeros(p), _init_zeros(p))
 
     def apply_one(self, state, g, lr, t):
         m, u = state
@@ -295,7 +306,7 @@ class Nadam(IUpdater):
     epsilon: float = 1e-8
 
     def init_one(self, p):
-        return (jnp.zeros_like(p), jnp.zeros_like(p))
+        return (_init_zeros(p), _init_zeros(p))
 
     def apply_one(self, state, g, lr, t):
         m, v = state
@@ -315,7 +326,7 @@ class RmsProp(IUpdater):
     epsilon: float = 1e-8
 
     def init_one(self, p):
-        return jnp.zeros_like(p)
+        return _init_zeros(p)
 
     def apply_one(self, cache, g, lr, t):
         cache = self.rms_decay * cache + (1 - self.rms_decay) * (g * g)
@@ -328,7 +339,7 @@ class AdaGrad(IUpdater):
     epsilon: float = 1e-6
 
     def init_one(self, p):
-        return jnp.zeros_like(p)
+        return _init_zeros(p)
 
     def apply_one(self, hist, g, lr, t):
         hist = hist + g * g
@@ -341,7 +352,7 @@ class AdaDelta(IUpdater):
     epsilon: float = 1e-6
 
     def init_one(self, p):
-        return (jnp.zeros_like(p), jnp.zeros_like(p))
+        return (_init_zeros(p), _init_zeros(p))
 
     def apply_one(self, state, g, lr, t):
         msg, msdx = state

@@ -13,9 +13,53 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["WeightInit", "Distribution", "NormalDistribution", "UniformDistribution",
            "init_weight"]
+
+
+def _np_rng(rng):
+    """Host numpy Generator deterministically seeded from a jax PRNG key, or
+    None when the key is a tracer (init under jit keeps the jax.random path).
+
+    Why host sampling: eager ``jax.random.normal`` compiles one tiny XLA
+    program PER DISTINCT SHAPE. GoogLeNet's 57 convs have ~50 distinct
+    weight shapes → ~170 device compiles before training even starts (70 s
+    of an 81 s init on CPU; minutes over a remote TPU tunnel — the round-3
+    'GoogLeNet first-compile blowup' was mostly THIS). numpy sampling is
+    exact-deterministic from the same key and costs zero compiles."""
+    if isinstance(rng, jax.core.Tracer):
+        return None
+    arr = np.asarray(jax.random.key_data(rng)
+                     if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key)
+                     else rng).ravel()
+    return np.random.default_rng([int(x) for x in arr])
+
+
+def _normal(rng, shape, dtype, scale=1.0, shift=0.0):
+    """Sampling, scaling and shifting all happen host-side in the eager path:
+    an eager device multiply/add would compile one tiny program per distinct
+    shape, re-creating the init blowup _np_rng exists to kill."""
+    g = _np_rng(rng)
+    if g is None:
+        return jax.random.normal(rng, shape, dtype) * scale + shift
+    return jnp.asarray(
+        (g.standard_normal(size=shape) * scale + shift).astype(dtype))
+
+
+def _uniform(rng, shape, dtype, lo, hi):
+    g = _np_rng(rng)
+    if g is None:
+        return jax.random.uniform(rng, shape, dtype, lo, hi)
+    return jnp.asarray(g.uniform(lo, hi, size=shape).astype(dtype))
+
+
+def host_full(shape, value, dtype):
+    """Eager constant init without an XLA compile: numpy fill + device_put.
+    (Eager ``jnp.full``/``jnp.zeros`` compiles a tiny program per distinct
+    shape — see ``_np_rng``.)"""
+    return jnp.asarray(np.full(shape, value, dtype=np.dtype(dtype)))
 
 
 class WeightInit:
@@ -71,7 +115,7 @@ class NormalDistribution(Distribution):
     std: float = 1.0
 
     def sample(self, rng, shape, dtype):
-        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+        return _normal(rng, shape, dtype, scale=self.std, shift=self.mean)
 
 
 # Reference has both GaussianDistribution and NormalDistribution (synonyms).
@@ -86,7 +130,7 @@ class UniformDistribution(Distribution):
     upper: float = 1.0
 
     def sample(self, rng, shape, dtype):
-        return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+        return _uniform(rng, shape, dtype, self.lower, self.upper)
 
 
 @dataclasses.dataclass
@@ -94,7 +138,7 @@ class ConstantDistribution(Distribution):
     value: float = 0.0
 
     def sample(self, rng, shape, dtype):
-        return jnp.full(shape, self.value, dtype)
+        return host_full(shape, self.value, dtype)
 
 
 @dataclasses.dataclass
@@ -103,7 +147,12 @@ class BinomialDistribution(Distribution):
     p: float = 0.5
 
     def sample(self, rng, shape, dtype):
-        return jax.random.binomial(rng, self.trials, self.p, shape).astype(dtype)
+        g = _np_rng(rng)
+        if g is None:
+            return jax.random.binomial(rng, self.trials, self.p,
+                                       shape).astype(dtype)
+        return jnp.asarray(g.binomial(self.trials, self.p,
+                                      size=shape).astype(dtype))
 
 
 def init_weight(rng, shape, fan_in, fan_out, scheme=WeightInit.XAVIER,
@@ -123,40 +172,42 @@ def init_weight(rng, shape, fan_in, fan_out, scheme=WeightInit.XAVIER,
             raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
         return dist.sample(rng, shape, dtype)
     if scheme == WeightInit.ZERO:
-        return jnp.zeros(shape, dtype)
+        return host_full(shape, 0, dtype)
     if scheme == WeightInit.ONES:
-        return jnp.ones(shape, dtype)
+        return host_full(shape, 1, dtype)
     if scheme == WeightInit.IDENTITY:
         if len(shape) != 2 or shape[0] != shape[1]:
             raise ValueError("IDENTITY init requires a square 2-D shape")
-        return jnp.eye(shape[0], dtype=dtype)
+        return jnp.asarray(np.eye(shape[0], dtype=np.dtype(dtype)))
     if scheme == WeightInit.NORMAL:
-        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+        return _normal(rng, shape, dtype, scale=1.0 / math.sqrt(fan_in))
     if scheme == WeightInit.LECUN_NORMAL:
-        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / fan_in)
+        return _normal(rng, shape, dtype, scale=math.sqrt(1.0 / fan_in))
     if scheme == WeightInit.UNIFORM:
         a = math.sqrt(1.0 / fan_in)
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     if scheme == WeightInit.LECUN_UNIFORM:
         a = math.sqrt(3.0 / fan_in)
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     if scheme == WeightInit.XAVIER:
-        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+        return _normal(rng, shape, dtype,
+                       scale=math.sqrt(2.0 / (fan_in + fan_out)))
     if scheme == WeightInit.XAVIER_UNIFORM:
         a = math.sqrt(6.0 / (fan_in + fan_out))
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     if scheme == WeightInit.XAVIER_FAN_IN:
-        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+        return _normal(rng, shape, dtype, scale=1.0 / math.sqrt(fan_in))
     if scheme == WeightInit.XAVIER_LEGACY:
-        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / (fan_in + fan_out))
+        return _normal(rng, shape, dtype,
+                       scale=math.sqrt(1.0 / (fan_in + fan_out)))
     if scheme == WeightInit.RELU:
-        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / fan_in)
+        return _normal(rng, shape, dtype, scale=math.sqrt(2.0 / fan_in))
     if scheme == WeightInit.RELU_UNIFORM:
         a = math.sqrt(6.0 / fan_in)
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     if scheme == WeightInit.SIGMOID_UNIFORM:
         a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     if scheme.startswith("var_scaling"):
         if scheme.endswith("fan_in"):
             denom = fan_in
@@ -165,7 +216,7 @@ def init_weight(rng, shape, fan_in, fan_out, scheme=WeightInit.XAVIER,
         else:
             denom = 0.5 * (fan_in + fan_out)
         if "normal" in scheme:
-            return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / denom)
+            return _normal(rng, shape, dtype, scale=math.sqrt(1.0 / denom))
         a = math.sqrt(3.0 / denom)
-        return jax.random.uniform(rng, shape, dtype, -a, a)
+        return _uniform(rng, shape, dtype, -a, a)
     raise ValueError(f"Unknown weight init scheme '{scheme}'")

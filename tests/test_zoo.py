@@ -69,10 +69,17 @@ def test_resnet50_trains_small_input():
     assert np.isfinite(float(net.score_))
 
 
-def test_googlenet_builds_and_runs():
+def test_googlenet_builds_and_trains():
+    """GoogLeNet must FIT inside the smoke window, not just forward — the
+    round-3 'first-compile blowup' was ~170 per-shape eager init compiles
+    (fixed: host-side numpy init, nn/weights.py::_np_rng); this test pins
+    the regression."""
     net = GoogLeNet(num_classes=6, input_shape=(3, 64, 64)).init()
-    out = net.output(_img_batch(2, 3, 64, 64, 6).features)
-    assert np.asarray(out).shape == (2, 6)
+    ds = _img_batch(4, 3, 64, 64, 6)
+    net.fit(ds)
+    assert np.isfinite(float(net.score_))
+    out = net.output(ds.features)
+    assert np.asarray(out).shape == (4, 6)
 
 
 def test_facenet_center_loss_trains():
