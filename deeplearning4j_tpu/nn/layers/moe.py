@@ -59,6 +59,60 @@ class MoEDenseImpl(LayerImpl):
         gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
         return gates, probs
 
+    def _dense_combine(self, params, flat, gates, cd):
+        """Dense (Shazeer-style) path — every token through every expert,
+        gate-masked. O(n·E·F·O) FLOPs; the correctness oracle for the sparse
+        dispatch below."""
+        h = jnp.einsum("nf,efo->neo", flat.astype(cd),
+                       params["W"].astype(cd),
+                       preferred_element_type=pet_dtype(cd))
+        if "b" in params:
+            h = h + params["b"].astype(h.dtype)
+        # gate-weighted combine; reduction over E → psum when E is sharded
+        return jnp.einsum("ne,neo->no", gates.astype(h.dtype), h,
+                          preferred_element_type=pet_dtype(cd))
+
+    def _capacity(self, n):
+        c = self.conf
+        k = min(c.top_k, c.num_experts)
+        cap = -(-k * n * c.capacity_factor // c.num_experts)
+        return int(min(max(8, -(-cap // 8) * 8), max(8, -(-n // 8) * 8)))
+
+    def _sparse_combine(self, params, flat, gates, cd):
+        """Capacity-factor token dispatch (GShard/Switch one-hot einsum form):
+        each expert computes a fixed [C, F] buffer of its routed tokens, so
+        expert FLOPs are E·C·F·O ≈ (top_k/E)·dense instead of n·E·F·O.
+
+        Buffer positions are assigned slot-major (all rank-0 assignments
+        before rank-1), so when an expert overflows its capacity the LOWER-
+        gate assignments are the ones dropped. Dropped (token, expert) pairs
+        simply contribute zero — Switch-Transformer semantics. The dispatch
+        tensor stays one-hot/shardable: with ``W`` sharded over the mesh
+        'expert' axis the per-expert einsums partition and the combine
+        reduction lowers to a psum, same as the dense path."""
+        c = self.conf
+        n, E = flat.shape[0], c.num_experts
+        k = min(c.top_k, E)
+        C = self._capacity(n)
+        _, idxs = jax.lax.top_k(gates, k)                    # [n, k]
+        mask = jax.nn.one_hot(idxs, E, dtype=jnp.int32)      # [n, k, E]
+        mk = mask.transpose(1, 0, 2).reshape(k * n, E)       # slot-major
+        pos = jnp.cumsum(mk, axis=0) - 1                     # per-expert fill
+        pos_t = jnp.sum(pos * mk, axis=-1)                   # [k*n] buffer pos
+        keep = (pos_t < C) & (jnp.sum(mk, axis=-1) > 0)
+        slot = jax.nn.one_hot(pos_t, C, dtype=cd) * keep[:, None].astype(cd)
+        disp = (mk.astype(cd)[:, :, None] * slot[:, None, :])  # [k*n, E, C]
+        disp = disp.reshape(k, n, E, C).sum(axis=0)            # [n, E, C]
+        combine = disp * gates.astype(cd)[:, :, None]
+        expert_in = jnp.einsum("nec,nf->ecf", disp, flat.astype(cd),
+                               preferred_element_type=pet_dtype(cd))
+        h = jnp.einsum("ecf,efo->eco", expert_in, params["W"].astype(cd),
+                       preferred_element_type=pet_dtype(cd))
+        if "b" in params:
+            h = h + params["b"].astype(h.dtype)[:, None, :]
+        return jnp.einsum("nec,eco->no", combine, h,
+                          preferred_element_type=pet_dtype(cd))
+
     def forward(self, params, state, x, train=False, rng=None, mask=None,
                 ctx=None):
         c = self.conf
@@ -68,16 +122,10 @@ class MoEDenseImpl(LayerImpl):
         gates, probs = self._route(flat.astype(rdt), params["Wg"])
 
         cd = self.compute_dtype
-        # per-expert dense: [n, F] × [E, F, O] → [n, E, O]; expert dim E is
-        # a plain array axis, shardable over the mesh 'expert' axis
-        h = jnp.einsum("nf,efo->neo", flat.astype(cd),
-                       params["W"].astype(cd),
-                       preferred_element_type=pet_dtype(cd))
-        if "b" in params:
-            h = h + params["b"].astype(h.dtype)
-        # gate-weighted combine; reduction over E → psum when E is sharded
-        y = jnp.einsum("ne,neo->no", gates.astype(h.dtype), h,
-                       preferred_element_type=pet_dtype(cd))
+        if c.capacity_factor and c.capacity_factor > 0:
+            y = self._sparse_combine(params, flat, gates, cd)
+        else:
+            y = self._dense_combine(params, flat, gates, cd)
         y = y.reshape(x.shape[:-1] + (c.n_out,))
 
         if ctx is not None and c.aux_loss_weight > 0.0:

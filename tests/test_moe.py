@@ -271,3 +271,127 @@ def test_moe_in_computation_graph_aux_loss_and_training():
                     jax.tree_util.tree_leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------ sparse dispatch
+def _moe_impl(capacity_factor, top_k=2, experts=4, n_in=6, n_out=8, seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.1)).activation("identity")
+            .list()
+            .layer(MoEDenseLayer(n_in=n_in, n_out=n_out, num_experts=experts,
+                                 top_k=top_k, capacity_factor=capacity_factor,
+                                 activation="identity"))
+            .layer(OutputLayer(n_in=n_out, n_out=4, activation="softmax",
+                               loss=LossFunction.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    return net.impls[0], net.params["0"]
+
+
+def test_moe_sparse_dispatch_matches_dense_oracle():
+    """With ample capacity (no drops) the capacity-factor dispatch must equal
+    the dense gate-masked path token for token (VERDICT item 4 'done'
+    criterion: dispatch-vs-dense output parity)."""
+    impl_s, p = _moe_impl(capacity_factor=4.0)
+    impl_d, _ = _moe_impl(capacity_factor=0.0)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(33, 6)), jnp.float32)  # odd n on purpose
+    ys, _ = impl_s.forward(p, {}, x)
+    yd, _ = impl_d.forward(p, {}, x)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_sparse_dispatch_grads_match_dense_oracle():
+    impl_s, p = _moe_impl(capacity_factor=4.0)
+    impl_d, _ = _moe_impl(capacity_factor=0.0)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
+
+    def loss(params, impl):
+        y, _ = impl.forward(params, {}, x)
+        return jnp.sum(y ** 2)
+
+    gs = jax.grad(loss)(p, impl_s)
+    gd = jax.grad(loss)(p, impl_d)
+    for ks in gs:
+        np.testing.assert_allclose(np.asarray(gs[ks]), np.asarray(gd[ks]),
+                                   rtol=1e-3, atol=1e-4, err_msg=ks)
+
+
+def test_moe_sparse_overflow_drops_lowest_gate_assignments():
+    """At capacity_factor=tiny every expert keeps only its first C slot-major
+    (highest-gate-rank first) assignments; dropped pairs contribute zero, so
+    the output is bounded and finite, and differs from dense."""
+    impl_s, p = _moe_impl(capacity_factor=1e-6, top_k=2)
+    impl_d, _ = _moe_impl(capacity_factor=0.0, top_k=2)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(64, 6)), jnp.float32)
+    ys, _ = impl_s.forward(p, {}, x)
+    yd, _ = impl_d.forward(p, {}, x)
+    assert np.isfinite(np.asarray(ys)).all()
+    assert float(np.max(np.abs(np.asarray(ys)))) <= \
+        float(np.max(np.abs(np.asarray(yd)))) * 2 + 1.0
+    assert float(np.max(np.abs(np.asarray(ys) - np.asarray(yd)))) > 0
+
+
+def test_moe_sparse_dispatch_flops_drop():
+    """XLA cost-analysis FLOPs must drop ≈E/top_k-fold vs the dense path
+    (VERDICT item 4 'done' criterion). Config sized so the O(n·E·C·F)
+    dispatch einsums are small next to the E·C·F·O expert compute."""
+    # dispatch/combine einsums cost ≈ (n/O + n/F) of the expert compute, so
+    # keep tokens ≪ features for the asymptotic E/k drop to dominate
+    E, k, n, F, O = 8, 1, 128, 1024, 1024
+    impl_s, p = _moe_impl(capacity_factor=1.0, top_k=k, experts=E,
+                          n_in=F, n_out=O)
+    impl_d, _ = _moe_impl(capacity_factor=0.0, top_k=k, experts=E,
+                          n_in=F, n_out=O)
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(n, F)), jnp.float32)
+
+    def flops(impl):
+        fn = lambda params: impl.forward(params, {}, x)[0]
+        ca = jax.jit(fn).lower(p).compile().cost_analysis() or {}
+        return float(ca.get("flops", 0.0))
+
+    fd, fs = flops(impl_d), flops(impl_s)
+    assert fd > 0 and fs > 0
+    # dense ≈ 2nEFO; sparse ≈ 2ECFO + dispatch overhead. Demand ≥ E/k · 1/2.
+    assert fs < fd / (E / k) * 2.0, (fd, fs)
+    assert fd / fs > E / k / 2, (fd, fs, fd / fs)
+
+
+def test_moe_sparse_expert_parallel_matches_replicated():
+    """Sparse dispatch under EP sharding == replicated sparse step (the EP
+    dryrun criterion from VERDICT item 4)."""
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(23)
+                .updater(Sgd(learning_rate=0.1)).activation("identity")
+                .list()
+                .layer(MoEDenseLayer(n_in=6, n_out=8, num_experts=4, top_k=2,
+                                     capacity_factor=2.0, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                                   loss=LossFunction.MCXENT))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    net_a, net_b = make(), make()
+    mesh = make_mesh(jax.devices()[:4], axes=(EXPERT_AXIS,))
+    step, place = expert_parallel_step(net_a, mesh)
+    place(net_a)
+    rng = np.random.default_rng(15)
+    f = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
+    l = jnp.asarray(np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)])
+    it = jax.device_put(jnp.asarray(0, jnp.int32), replicated(mesh))
+    key = jax.device_put(jax.random.PRNGKey(0), replicated(mesh))
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            it, key, f, l, None, None)
+    raw = jax.jit(net_b._raw_step(False))
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           f, l, None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
