@@ -532,24 +532,29 @@ class TextGenerationLSTM(ZooModel):
 
     def __init__(self, total_unique_characters: Optional[int] = None,
                  num_classes: Optional[int] = None, seed: int = 123,
-                 lstm_size: int = 256, **kw):
+                 lstm_size: int = 256, num_layers: int = 2, **kw):
         n = total_unique_characters if total_unique_characters is not None \
             else (num_classes if num_classes is not None else 47)
         super().__init__(n, seed, **kw)
         self.lstm_size = lstm_size
+        # reference fixes 2 cells; the knob is net-new so the stacked
+        # identical middle cells can be pipeline-parallelized
+        # (parallel/pipeline.py::pipeline_parallel_step)
+        self.num_layers = max(2, int(num_layers))
 
     def conf(self):
         n = self.num_classes
-        return (self._builder(activation="tanh",
-                              weight_init=WeightInit.XAVIER)
-                .list()
-                .layer(GravesLSTM(n_in=n, n_out=self.lstm_size,
-                                  activation="tanh"))
-                .layer(GravesLSTM(n_in=self.lstm_size, n_out=self.lstm_size,
-                                  activation="tanh"))
-                .layer(RnnOutputLayer(n_in=self.lstm_size, n_out=n,
-                                      activation="softmax", loss="mcxent"))
-                .build())
+        b = (self._builder(activation="tanh",
+                           weight_init=WeightInit.XAVIER)
+             .list()
+             .layer(GravesLSTM(n_in=n, n_out=self.lstm_size,
+                               activation="tanh")))
+        for _ in range(self.num_layers - 1):
+            b.layer(GravesLSTM(n_in=self.lstm_size, n_out=self.lstm_size,
+                               activation="tanh"))
+        b.layer(RnnOutputLayer(n_in=self.lstm_size, n_out=n,
+                               activation="softmax", loss="mcxent"))
+        return b.build()
 
 
 # -------------------------------------------------------------- ModelSelector

@@ -25,6 +25,8 @@ from .distributed import (ProcessLocalIterator, is_chief,
 from .sequence import ring_attention, ulysses_attention, full_attention
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
+                       PipelinedNetwork, pipeline_parallel_step,
+                       partition_network,
                        stack_stage_params)
 from .expert import EXPERT_AXIS, expert_rules, expert_parallel_step
 
@@ -42,6 +44,7 @@ __all__ = [
     "ring_attention", "ulysses_attention", "full_attention",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
     "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
+    "PipelinedNetwork", "pipeline_parallel_step", "partition_network",
     "EXPERT_AXIS", "expert_rules", "expert_parallel_step",
     "allgather_objects", "DistributedDataSetLossCalculator",
     "DistributedEarlyStoppingTrainer",

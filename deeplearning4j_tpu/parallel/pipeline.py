@@ -41,7 +41,8 @@ _tm = jax.tree_util.tree_map
 
 def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                   mesh: Mesh, axis: str = PIPELINE_AXIS,
-                  data_axis: Optional[str] = None):
+                  data_axis: Optional[str] = None, squeeze_stage: bool = True,
+                  _needs_x_grad: bool = False):
     """Build ``pipelined(stacked_params, xs) -> ys``.
 
     ``stacked_params``: pytree whose leaves carry a leading stage dim of
@@ -51,14 +52,27 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     Returns ``ys`` ``[M, mb, ...]``, the last stage's outputs, replicated
     across ``axis``. When ``data_axis`` is given the microbatch dim stays
     sharded over it (combined DP×PP).
-    """
+
+    ``squeeze_stage=True`` (the classic one-block-per-stage case) strips the
+    local leading stage dim of extent 1 before calling ``stage_fn``. With
+    ``squeeze_stage=False`` the stage dim may pack SEVERAL layers per device
+    (leading extent B/S) and ``stage_fn`` receives the whole local slice —
+    how ``pipeline_parallel_step`` maps a B-layer homogeneous body onto S
+    stages."""
     S = mesh.shape[axis]
 
     def per_device(params, xs):
-        params = _tm(lambda p: p[0], params)      # [1, ...] local slice → stage
+        if squeeze_stage:
+            params = _tm(lambda p: p[0], params)  # [1, ...] local slice → stage
         idx = lax.axis_index(axis)
         M = xs.shape[0]
-        xs = pvary(xs, (axis,))
+        if not _needs_x_grad:
+            # mark the feed device-varying over the pipe axis. NOT done when
+            # upstream (entry) layers need ∂loss/∂xs: pvary's transpose is a
+            # psum over 'pipe', which under check_vma=False sees an untyped
+            # cotangent and rejects it — and with check_vma=False the
+            # varying mark is only documentation anyway.
+            xs = pvary(xs, (axis,))
         perm = [(j, (j + 1) % S) for j in range(S)]
         buf0 = jnp.zeros_like(xs[0])
 
@@ -180,3 +194,287 @@ class GPipe:
             self._step = self._build_step()
         it = jnp.asarray(iteration, jnp.int32)
         return self._step(params, upd_state, it, x, y)
+
+
+# ---------------------------------------------------------------------------
+# Container-level pipeline parallelism
+# ---------------------------------------------------------------------------
+def _layer_confs_equal(a, b):
+    import dataclasses
+    return (type(a) is type(b)
+            and dataclasses.asdict(a) == dataclasses.asdict(b))
+
+
+def partition_network(net, n_stages: int):
+    """Find the (start, length) of the body to pipeline: the longest run of
+    structurally IDENTICAL layer configs, trimmed to the largest multiple of
+    ``n_stages``. Everything before the run is the replicated entry,
+    everything after (plus any trimmed tail of the run) the replicated head
+    — the homogeneous-middle design production TPU pipelining uses (stacked
+    transformer blocks / stacked LSTM cells)."""
+    layers = net.conf.layers
+    n = len(layers)
+    best = (0, 0)
+    i = 0
+    while i < n:
+        j = i + 1
+        while j < n and _layer_confs_equal(layers[i], layers[j]):
+            j += 1
+        if j - i > best[1]:
+            best = (i, j - i)
+        i = j
+    start, run = best
+    body = (run // n_stages) * n_stages
+    if body < n_stages:
+        raise ValueError(
+            f"No homogeneous run of ≥ {n_stages} identical layers to map "
+            f"onto {n_stages} pipeline stages (longest run: {run} at layer "
+            f"{start}). Stack identical middle layers (e.g. "
+            f"TextGenerationLSTM(num_layers=...)) or use fewer stages.")
+    return start, body
+
+
+class PipelinedNetwork:
+    """Train a ``MultiLayerNetwork``'s homogeneous middle as GPipe stages
+    (VERDICT round-3 item 3: container-level pipeline parallelism).
+
+    The network is partitioned entry | body | head by
+    :func:`partition_network`; body layer params are STACKED on a leading
+    stage axis and sharded over the mesh ``pipe`` axis (B/S layers per
+    stage), entry/head stay replicated, and the body forward runs through
+    :func:`spmd_pipeline` — reverse-mode AD of that schedule is the reverse
+    pipeline, exactly like :class:`GPipe`. Combined DP×PP: pass a mesh with
+    a ``data`` axis too and the (micro)batch dim stays sharded over it.
+
+    Container-step semantics carried over: l1/l2 regularization,
+    ``minimize=False`` (sign flip), gradient normalization, per-layer
+    parameter constraints after each update. v1 constraints (checked
+    loudly): MultiLayerNetwork only, stateless layers (no BatchNorm running
+    stats), no masks, no per-layer updater overrides, no preprocessors
+    inside the body run; dropout/weight-noise inactive inside the pipelined
+    step; ``iterations(n)`` is ignored (one update per ``fit_batch``, like
+    ParallelWrapper).
+    """
+
+    def __init__(self, net, mesh: Mesh, n_microbatches: int,
+                 axis: str = PIPELINE_AXIS, data_axis: Optional[str] = None):
+        if not hasattr(net.conf, "layers"):
+            raise ValueError("PipelinedNetwork supports MultiLayerNetwork")
+        for i, s in net.states.items():
+            if s:
+                raise ValueError(
+                    f"layer {i} carries state ({list(s)}); stateful layers "
+                    f"(e.g. BatchNorm) are not pipelinable in v1")
+        for i, lc in enumerate(net.conf.layers):
+            if getattr(lc, "updater", None) is not None:
+                raise ValueError(
+                    f"layer {i} sets a per-layer updater override; the "
+                    f"pipelined step trains every partition with the "
+                    f"network-level updater (v1)")
+        if int(getattr(net.gc, "iterations", 1) or 1) > 1:
+            import logging
+            logging.getLogger(__name__).warning(
+                "iterations(%s) is ignored under PipelinedNetwork; each "
+                "fit_batch applies one optimizer iteration",
+                net.gc.iterations)
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+        self.net = net
+        self.mesh = mesh
+        self.axis = axis
+        self.data_axis = data_axis
+        self.n_microbatches = int(n_microbatches)
+        S = mesh.shape[axis]
+        self.n_stages = S
+        self.start, self.body_len = partition_network(net, S)
+        self.layers_per_stage = self.body_len // S
+        self.body_impl = net.impls[self.start]
+        for i in range(self.start, self.start + self.body_len):
+            if net.conf.preprocessor(i) is not None:
+                raise ValueError("preprocessors inside the pipelined body "
+                                 "are not supported")
+        self.updater = net.gc.updater
+        self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
+                                       squeeze_stage=False,
+                                       _needs_x_grad=self.start > 0)
+        self._step = None
+        self.iteration_count = 0
+        # partitioned + placed params and mirrored updater state
+        self.params = self._place(self._partition_params(net.params))
+        self.upd_state = self._place(
+            self.updater.init_state(self.params))
+
+    # -- param layout ------------------------------------------------------
+    def _partition_params(self, net_params):
+        s, b = self.start, self.body_len
+        n = len(self.net.impls)
+        entry = {str(i): net_params[str(i)] for i in range(s)}
+        head = {str(i): net_params[str(i)] for i in range(s + b, n)}
+        blocks = stack_stage_params([net_params[str(i)]
+                                     for i in range(s, s + b)])
+        return {"entry": entry, "blocks": blocks, "head": head}
+
+    def export_params(self):
+        """Back to the container's {layer-index: params} layout (for
+        ModelSerializer / evaluation on the unpipelined net)."""
+        s, b = self.start, self.body_len
+        n = len(self.net.impls)
+        out = {}
+        out.update({str(i): _tm(np.asarray, self.params["entry"][str(i)])
+                    for i in range(s)})
+        for j in range(b):
+            out[str(s + j)] = _tm(lambda p: np.asarray(p[j]),
+                                  self.params["blocks"])
+        out.update({str(i): _tm(np.asarray, self.params["head"][str(i)])
+                    for i in range(s + b, n)})
+        return out
+
+    def _shardings(self):
+        repl = NamedSharding(self.mesh, P())
+        blk = NamedSharding(self.mesh, P(self.axis))
+        return {"entry": repl, "blocks": blk, "head": repl}
+
+    def _place(self, tree):
+        sh = self._shardings()
+        # host round-trip = genuine copy: the jitted step DONATES these
+        # buffers, and device_put with an equal sharding can alias — donation
+        # must never invalidate the source container's params
+        return {k: _tm(lambda p: jax.device_put(np.asarray(p), sh[k]),
+                       tree[k])
+                for k in tree}
+
+    # -- forward pieces ----------------------------------------------------
+    def _stage_fn(self, params_slice, x):
+        """One pipeline stage = layers_per_stage sequential body layers
+        (leaves of ``params_slice`` carry the local [B/S, ...] stage dim)."""
+        for j in range(self.layers_per_stage):
+            p_j = _tm(lambda p: p[j], params_slice)
+            x, _ = self.body_impl.forward(p_j, {}, x, train=True, rng=None,
+                                          mask=None, ctx={})
+        return x
+
+    def _apply_range(self, params, x, lo, hi, ctx):
+        for i in range(lo, hi):
+            pre = self.net.conf.preprocessor(i)
+            if pre is not None:
+                x = pre(x, ctx)
+            impl = self.net.impls[i]
+            x, _ = impl.forward(params[str(i)], {}, x, train=True, rng=None,
+                                mask=None, ctx=ctx)
+        return x
+
+    def _loss(self, tree, f_mb, l_mb):
+        net, s, b = self.net, self.start, self.body_len
+        n = len(net.impls)
+        ctx = {}
+        # entry (replicated) per microbatch
+        entry = jax.vmap(lambda x: self._apply_range(tree["entry"], x, 0, s,
+                                                     ctx))(f_mb)
+        feats = self._pipeline(tree["blocks"], entry)
+        # head (replicated) per microbatch, then the output layer's loss
+        out_impl = net.impls[-1]
+
+        def head_loss(x, l):
+            x = self._apply_range(tree["head"], x, s + b, n - 1, ctx)
+            pre = net.conf.preprocessor(n - 1)
+            if pre is not None:
+                x = pre(x, ctx)
+            return out_impl.loss_on(tree["head"][str(n - 1)], {}, x, l,
+                                    mask=None, train=True, rng=None)
+
+        losses = jax.vmap(head_loss)(feats, l_mb)
+        # mean of per-microbatch means == global mean (equal-size chunks)
+        loss = jnp.mean(losses)
+        # l1/l2 (param-only → computable per partition; keeps loss parity
+        # with MultiLayerNetwork._loss_fn's reg term)
+        reg = 0.0
+        for i in range(s):
+            reg = reg + net.impls[i].regularization(tree["entry"][str(i)])
+        for j in range(b):   # unrolled: regularization may be a plain 0.0
+            reg = reg + self.body_impl.regularization(
+                _tm(lambda p: p[j], tree["blocks"]))
+        for i in range(s + b, n):
+            reg = reg + net.impls[i].regularization(tree["head"][str(i)])
+        return loss + reg
+
+    # -- the step ----------------------------------------------------------
+    def _layer_constraints(self, i):
+        lc = self.net.conf.layers[i]
+        return getattr(lc, "constraints", None) or \
+            getattr(getattr(lc, "inner", None), "constraints", None)
+
+    def _apply_constraints(self, tree):
+        """Per-layer parameter constraints after each update — same timing
+        as the containers' ``_apply_constraints``. Body constraints apply
+        per STAGE SLICE (norms must not mix layers across the stacked dim)."""
+        from ..nn.conf.dropout import apply_constraints
+
+        s, b = self.start, self.body_len
+        n = len(self.net.impls)
+        out = {"entry": dict(tree["entry"]), "blocks": tree["blocks"],
+               "head": dict(tree["head"])}
+        for i in list(range(s)) + list(range(s + b, n)):
+            cons = self._layer_constraints(i)
+            if cons:
+                part = "entry" if i < s else "head"
+                out[part][str(i)] = apply_constraints(cons,
+                                                      out[part][str(i)])
+        cons = self._layer_constraints(self.start)
+        if cons:
+            per_layer = [apply_constraints(cons,
+                                           _tm(lambda p: p[j],
+                                               tree["blocks"]))
+                         for j in range(b)]
+            out["blocks"] = stack_stage_params(per_layer)
+        return out
+
+    def _build_step(self):
+        from ..optimize.updater import normalize_gradients
+
+        gn_mode = self.net.gc.gradient_normalization
+        gn_thresh = self.net.gc.gradient_normalization_threshold
+        minimize = self.net.gc.minimize
+        upd = self.updater
+        M = self.n_microbatches
+
+        def step(tree, upd_state, it, f, l):
+            f_mb = f.reshape((M, f.shape[0] // M) + f.shape[1:])
+            l_mb = l.reshape((M, l.shape[0] // M) + l.shape[1:])
+            loss, grads = jax.value_and_grad(self._loss)(tree, f_mb, l_mb)
+            if not minimize:
+                grads = _tm(lambda g: -g, grads)
+            grads = normalize_gradients(grads, gn_mode, gn_thresh)
+            updates, new_state = upd.apply(upd_state, grads, it)
+            new_tree = _tm(lambda p, u: p - u.astype(p.dtype), tree, updates)
+            new_tree = self._apply_constraints(new_tree)
+            return new_tree, new_state, loss
+
+        sh = self._shardings()
+        repl = NamedSharding(self.mesh, P())
+        dsh = (NamedSharding(self.mesh, P(self.data_axis))
+               if self.data_axis else repl)
+        return jax.jit(step, in_shardings=(sh, sh, repl, dsh, dsh),
+                       out_shardings=(sh, sh, repl), donate_argnums=(0, 1))
+
+    def fit_batch(self, f, l):
+        """One pipelined optimizer step on a (features, labels) batch whose
+        leading dim divides into ``n_microbatches`` equal chunks."""
+        if self._step is None:
+            self._step = self._build_step()
+        it = jnp.asarray(self.iteration_count, jnp.int32)
+        self.params, self.upd_state, loss = self._step(
+            self.params, self.upd_state, it, jnp.asarray(f), jnp.asarray(l))
+        self.iteration_count += 1
+        return loss
+
+
+def pipeline_parallel_step(net, mesh: Mesh, n_microbatches: int = 4,
+                           axis: str = PIPELINE_AXIS,
+                           data_axis: Optional[str] = None):
+    """Container-level entry: partition ``net``'s homogeneous middle into
+    GPipe stages over ``mesh[axis]`` and return a :class:`PipelinedNetwork`
+    ready to ``fit_batch``. (Reference frame: the reference has no pipeline
+    parallelism at all — SURVEY.md §2.4; this is the net-new ``pp`` member
+    of the dp/tp/pp/sp/ep family, now reachable from a real container
+    instead of hand-written block functions.)"""
+    return PipelinedNetwork(net, mesh, n_microbatches, axis, data_axis)

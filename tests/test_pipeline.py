@@ -179,3 +179,96 @@ def test_gpipe_loss_decreases_over_steps():
         p, s, loss = gp.train_step(p, s, it, x, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+# ----------------------------------------------- container-level pipeline
+def test_pipeline_parallel_step_partition():
+    """partition_network finds the homogeneous middle and errors usefully
+    when there isn't one."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                                   LSTM, RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import partition_network
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(DenseLayer(n_in=8, n_out=8))
+            .layer(DenseLayer(n_in=8, n_out=8))
+            .layer(DenseLayer(n_in=8, n_out=8))
+            .layer(DenseLayer(n_in=8, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert partition_network(net, 2) == (1, 4)   # 4 identical middles
+    assert partition_network(net, 4) == (1, 4)
+    with pytest.raises(ValueError, match="homogeneous"):
+        partition_network(net, 8)
+
+
+def test_pipeline_parallel_zoo_lstm_loss_parity():
+    """TextGenerationLSTM(num_layers=5) pipelined over pipe=4 × data=2:
+    first-step loss AND updated params must match the unpipelined container
+    step (VERDICT round-3 item 3 'done' criterion)."""
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    model = TextGenerationLSTM(total_unique_characters=12, lstm_size=16,
+                               num_layers=5)
+    net = MultiLayerNetwork(model.conf()).init()
+    mesh = make_mesh(jax.devices(), axes=("pipe", "data"), shape=(4, 2))
+
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=4)
+    assert (pp.start, pp.body_len, pp.layers_per_stage) == (1, 4, 1)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 12, size=(8, 6))
+    f = np.eye(12, dtype=np.float32)[ids]
+    l = np.eye(12, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+
+    loss_pp = float(pp.fit_batch(f, l))
+
+    raw = jax.jit(net._raw_step(False))
+    p2, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(9),
+                             jnp.asarray(f), jnp.asarray(l), None, None)
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
+
+    exported = pp.export_params()
+    for k in p2:
+        for name in p2[k]:
+            np.testing.assert_allclose(
+                np.asarray(exported[k][name]), np.asarray(p2[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
+
+
+def test_pipeline_parallel_multi_layer_per_stage_and_training():
+    """B=4 body layers on S=2 stages (2 layers/stage); loss falls over
+    steps — the pipelined step is a real training loop, not just a forward."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2)).activation("tanh").list()
+            .layer(DenseLayer(n_in=6, n_out=16))
+            .layer(DenseLayer(n_in=16, n_out=16))
+            .layer(DenseLayer(n_in=16, n_out=16))
+            .layer(DenseLayer(n_in=16, n_out=16))
+            .layer(DenseLayer(n_in=16, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    assert (pp.body_len, pp.layers_per_stage) == (4, 2)
+
+    rng = np.random.default_rng(5)
+    f = rng.normal(size=(16, 6)).astype(np.float32)
+    labels = (f[:, 0] + f[:, 1] > 0).astype(int)
+    l = np.eye(4, dtype=np.float32)[labels]
+    losses = [float(pp.fit_batch(f, l)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
