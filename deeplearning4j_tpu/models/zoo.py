@@ -540,7 +540,10 @@ class TextGenerationLSTM(ZooModel):
         # reference fixes 2 cells; the knob is net-new so the stacked
         # identical middle cells can be pipeline-parallelized
         # (parallel/pipeline.py::pipeline_parallel_step)
-        self.num_layers = max(2, int(num_layers))
+        if int(num_layers) < 2:
+            raise ValueError(f"TextGenerationLSTM needs num_layers >= 2 "
+                             f"(got {num_layers})")
+        self.num_layers = int(num_layers)
 
     def conf(self):
         n = self.num_classes

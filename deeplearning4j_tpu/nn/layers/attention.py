@@ -22,8 +22,9 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
 
     Long sequences route through the Pallas flash-attention kernel
     (``ops/flash_attention.py``): blockwise online softmax, O(T) memory
-    instead of materializing the [b, h, T, T] logits. The dense path below
-    remains the oracle and the fallback (dropout / key masks / odd lengths).
+    instead of materializing the [b, h, T, T] logits — key-padding masks
+    included (streamed through the kernel). The dense path below remains
+    the oracle and the fallback (dropout / odd lengths).
     """
     from ...ops import flash_attention as _fa
 
@@ -32,7 +33,7 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
                                              else 0.0, key_mask)):
         return _fa.flash_attention(
             q.astype(compute_dtype), k.astype(compute_dtype),
-            v.astype(compute_dtype), causal=causal)
+            v.astype(compute_dtype), causal=causal, key_mask=key_mask)
     visible = None
     if causal:
         T, S = q.shape[1], k.shape[1]

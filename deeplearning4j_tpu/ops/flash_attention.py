@@ -21,10 +21,11 @@ transposes/reshapes at the boundary (XLA fuses these). f32 accumulation
 throughout; inputs/outputs keep the caller's dtype (bf16 on TPU).
 
 Used automatically by ``SelfAttentionLayer`` when applicable (TPU backend,
-no dropout, no key padding mask, T divisible by the 128 block) — the
-cuDNN-helper pattern (reference ``ConvolutionLayer.java:76`` reflective
-helper swap) realized as a Pallas kernel behind the same layer math, with
-the dense path as the always-available fallback.
+no dropout, T divisible by the 128 block; [b, T] key-padding masks ARE
+supported — streamed through the kernels) — the cuDNN-helper pattern
+(reference ``ConvolutionLayer.java:76`` reflective helper swap) realized as
+a Pallas kernel behind the same layer math, with the dense path as the
+always-available fallback.
 """
 from __future__ import annotations
 
@@ -75,8 +76,8 @@ def _causal_mask(s, qi, kj, block):
 
 
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                causal, scale, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_s, l_s,
+                acc_s, *, causal, scale, nk):
     qi, kj = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kj == 0)
@@ -93,6 +94,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, kj, BLOCK)
+        if km_ref is not None:
+            s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         m = m_s[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))       # [Bq]
         p = jnp.exp(s - m_new[:, None])
@@ -113,11 +116,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                                       lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, causal, scale):
-    """q/k/v: [bh, T, d] → (o [bh, T, d], lse [bh, T, 8])."""
+def _fwd(q, k, v, km, causal, scale):
+    """q/k/v: [bh, T, d], km: [bh, T, 8] key mask or None →
+    (o [bh, T, d], lse [bh, T, 8])."""
     bh, T, d = q.shape
     nq = T // BLOCK
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=nq)
+    if km is None:
+        # no-mask path stays byte-identical: shim rebinds km_ref=None so the
+        # masking `where` never enters the kernel
+        masked = kern
+        kern = lambda q_r, k_r, v_r, o_r, l_r, m_s, l_s, a_s:             masked(q_r, k_r, v_r, None, o_r, l_r, m_s, l_s, a_s)
     if causal:
         # invisible (kj > qj) steps clamp to the diagonal block: same index
         # as the previous visible step → Pallas skips the DMA entirely
@@ -127,14 +136,19 @@ def _fwd(q, k, v, causal, scale):
     # lse is lane-padded to [bh, T, 8]: TPU block shapes need their last two
     # dims (8·k, 128·m) or full-dim; a (1, BLOCK) slice of [bh, T] is
     # unlowerable. 8 f32 lanes per position is noise next to q/k/v
+    in_specs = [
+        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
+        _vspec((1, BLOCK, d), kv_idx),
+        _vspec((1, BLOCK, d), kv_idx),
+    ]
+    operands = [q, k, v]
+    if km is not None:
+        in_specs.append(_vspec((1, BLOCK, 8), kv_idx))
+        operands.append(km)
     return pl.pallas_call(
         kern,
         grid=(bh, nq, nq),
-        in_specs=[
-            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
-            _vspec((1, BLOCK, d), kv_idx),
-            _vspec((1, BLOCK, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=(
             _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
             _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),
@@ -144,12 +158,12 @@ def _fwd(q, k, v, causal, scale):
         scratch_shapes=[_scratch((BLOCK, 8)), _scratch((BLOCK, 8)),
                         _scratch((BLOCK, d))],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
 
 
 # ----------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dq_ref,
-               dq_s, *, causal, scale, nk):
+def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, delta_ref, lse_ref,
+               dq_ref, dq_s, *, causal, scale, nk):
     qi, kj = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kj == 0)
@@ -167,6 +181,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, kj, BLOCK)
+        if km_ref is not None:
+            s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -182,8 +198,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dk_ref,
-                dv_ref, dk_s, dv_s, *, causal, scale, nq):
+def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, delta_ref, lse_ref,
+                dk_ref, dv_ref, dk_s, dv_s, *, causal, scale, nq):
     ki, qj = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qj == 0)
@@ -202,6 +218,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dk_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qj, ki, BLOCK)
+        if km_ref is not None:
+            s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         p = jnp.exp(s - lse[:, None])                     # [Bq, Bk]
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -221,49 +239,85 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref, dk_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
-    bh, T, d = q.shape
-    nq = T // BLOCK
-    do = g.astype(q.dtype)
-    # Δ_i = Σ_d do·o — rowwise, cheap in plain XLA; lane-padded like lse
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (8,))
-
+def dq_block(q, k, v, km, do, delta, lse, causal, scale):
+    """dq for one q-shard against one k/v block ([bh, Tq, d] × [bh, Tk, d]).
+    ``delta``/``lse`` are the GLOBAL rowwise Δ and log-sum-exp ([bh, Tq, 8]
+    lane-padded) — with them, per-block probabilities recompute exactly, so
+    per-block gradients sum to the full-attention gradient. Used by the
+    in-kernel backward below AND per ring step by
+    ``parallel.sequence.ring_flash_attention``."""
+    bh, Tq, d = q.shape
+    nq, nk = Tq // BLOCK, k.shape[1] // BLOCK
+    kern = functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nk)
     if causal:
         kv_idx = lambda i, qj, kj: (i, jnp.minimum(kj, qj), 0)
-        q_idx = lambda i, kj, qj: (i, jnp.maximum(qj, kj), 0)
     else:
         kv_idx = lambda i, qj, kj: (i, kj, 0)
-        q_idx = lambda i, kj, qj: (i, qj, 0)
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nq),
-        grid=(bh, nq, nq),
-        in_specs=[
-            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # q
-            _vspec((1, BLOCK, d), kv_idx),                         # k
-            _vspec((1, BLOCK, d), kv_idx),                         # v
-            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # do
-            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # delta
-            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # lse
-        ],
+    specs = [
+        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # q
+        _vspec((1, BLOCK, d), kv_idx),                         # k
+        _vspec((1, BLOCK, d), kv_idx),                         # v
+    ]
+    ops = [q, k, v]
+    if km is None:
+        masked = kern
+        kern = lambda q_r, k_r, v_r, do_r, de_r, l_r, dq_r, dq_s: \
+            masked(q_r, k_r, v_r, None, do_r, de_r, l_r, dq_r, dq_s)
+    else:
+        specs.append(_vspec((1, BLOCK, 8), kv_idx))            # key mask
+        ops.append(km)
+    specs += [
+        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # do
+        _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # delta
+        _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # lse
+    ]
+    ops += [do, delta, lse]
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=specs,
         out_specs=_vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_scratch((BLOCK, d))],
         interpret=_interpret(),
-    )(q, k, v, do, delta, lse)
+    )(*ops)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq),
-        grid=(bh, nq, nq),
-        in_specs=[
-            _vspec((1, BLOCK, d), q_idx),                          # q
-            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # k
-            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # v
-            _vspec((1, BLOCK, d), q_idx),                          # do
-            _vspec((1, BLOCK, 8), q_idx),                          # delta
-            _vspec((1, BLOCK, 8), q_idx),                          # lse
-        ],
+
+def dkv_block(q, k, v, km, do, delta, lse, causal, scale):
+    """(dk, dv) for one k/v block against one q-shard; see :func:`dq_block`
+    for the global-``lse``/``delta`` contract."""
+    bh, Tk, d = k.shape
+    nq, nk = q.shape[1] // BLOCK, Tk // BLOCK
+    kern = functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq)
+    if causal:
+        q_idx = lambda i, kj, qj: (i, jnp.maximum(qj, kj), 0)
+    else:
+        q_idx = lambda i, kj, qj: (i, qj, 0)
+    specs = [
+        _vspec((1, BLOCK, d), q_idx),                          # q
+        _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # k
+        _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # v
+    ]
+    ops = [q, k, v]
+    if km is None:
+        masked = kern
+        kern = lambda q_r, k_r, v_r, do_r, de_r, l_r, dk_r, dv_r, dk_s, \
+            dv_s: masked(q_r, k_r, v_r, None, do_r, de_r, l_r, dk_r,
+                         dv_r, dk_s, dv_s)
+    else:
+        specs.append(_vspec((1, BLOCK, 8),
+                            lambda i, kj, qj: (i, kj, 0)))     # key mask
+        ops.append(km)
+    specs += [
+        _vspec((1, BLOCK, d), q_idx),                          # do
+        _vspec((1, BLOCK, 8), q_idx),                          # delta
+        _vspec((1, BLOCK, 8), q_idx),                          # lse
+    ]
+    ops += [do, delta, lse]
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nk, nq),
+        in_specs=specs,
         out_specs=(
             _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
             _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
@@ -272,20 +326,34 @@ def _bwd(causal, scale, res, g):
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         scratch_shapes=[_scratch((BLOCK, d)), _scratch((BLOCK, d))],
         interpret=_interpret(),
-    )(q, k, v, do, delta, lse)
-    return dq, dk, dv
+    )(*ops)
+
+
+def rowwise_delta(do, o):
+    """Δ_i = Σ_d do·o — rowwise, cheap in plain XLA; lane-padded like lse."""
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(delta[..., None], delta.shape + (8,))
+
+
+def _bwd(causal, scale, res, g):
+    q, k, v, km, o, lse = res
+    do = g.astype(q.dtype)
+    delta = rowwise_delta(do, o)
+    dq = dq_block(q, k, v, km, do, delta, lse, causal, scale)
+    dk, dv = dkv_block(q, k, v, km, do, delta, lse, causal, scale)
+    return dq, dk, dv, None if km is None else jnp.zeros_like(km)
 
 
 # ------------------------------------------------------------- public entry
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    o, _ = _fwd(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, km, causal, scale):
+    o, _ = _fwd(q, k, v, km, causal, scale)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    o, lse = _fwd(q, k, v, causal, scale)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, km, causal, scale):
+    o, lse = _fwd(q, k, v, km, causal, scale)
+    return o, (q, k, v, km, o, lse)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -312,8 +380,9 @@ def supported(T: int, d: int, dropout_rate: float, key_mask) -> bool:
     """Whether the flash path applies: TPU backend (the interpreter would be
     far slower than the dense einsum — except under the tests' forced
     interpret mode), block-divisible sequence long enough to beat the dense
-    path, head dim within VMEM tiling, no dropout inside the softmax, no key
-    padding mask."""
+    path, head dim within VMEM tiling, no dropout inside the softmax. A
+    [b, T] key-padding mask IS supported (streamed through the kernels,
+    round-3 VERDICT item 5); only dropout still falls back to dense."""
     min_seq = 2 * BLOCK if _FORCE_INTERPRET else MIN_SEQ
     if not _FORCE_INTERPRET:
         try:
@@ -321,12 +390,17 @@ def supported(T: int, d: int, dropout_rate: float, key_mask) -> bool:
                 return False
         except Exception:  # pragma: no cover
             return False
+    if key_mask is not None and getattr(key_mask, "ndim", None) != 2:
+        return False
     return (T % BLOCK == 0 and T >= min_seq and d <= 256
-            and dropout_rate == 0.0 and key_mask is None)
+            and dropout_rate == 0.0)
 
 
-def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
-    """Blockwise attention. q/k/v: [b, T, h, d] → [b, T, h, d]."""
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
+                    key_mask=None):
+    """Blockwise attention. q/k/v: [b, T, h, d] → [b, T, h, d].
+    ``key_mask``: optional [b, T] (1 = real key, 0 = padding) — masked keys
+    are excluded from the softmax inside the kernels (no dense fallback)."""
     b, T, h, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
@@ -334,5 +408,10 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, T, d)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), bool(causal), float(scale))
+    km = None
+    if key_mask is not None:
+        km = jnp.broadcast_to(jnp.asarray(key_mask, jnp.float32)[:, None, :],
+                              (b, h, T)).reshape(b * h, T)
+        km = jnp.broadcast_to(km[..., None], (b * h, T, 8))
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), km, bool(causal), float(scale))
     return jnp.transpose(o.reshape(b, h, T, d), (0, 2, 1, 3))

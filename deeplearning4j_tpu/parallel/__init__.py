@@ -22,7 +22,8 @@ from .distributed import (ProcessLocalIterator, is_chief,
                           SparkComputationGraph, initialize_distributed,
                           allgather_objects, DistributedDataSetLossCalculator,
                           DistributedEarlyStoppingTrainer)
-from .sequence import ring_attention, ulysses_attention, full_attention
+from .sequence import (ring_attention, ulysses_attention, full_attention,
+                       ring_flash_attention, ring_flash_supported)
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
                        PipelinedNetwork, pipeline_parallel_step,
@@ -42,6 +43,7 @@ __all__ = [
     "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",
     "ProcessLocalIterator", "is_chief",
     "ring_attention", "ulysses_attention", "full_attention",
+    "ring_flash_attention", "ring_flash_supported",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
     "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
     "PipelinedNetwork", "pipeline_parallel_step", "partition_network",

@@ -279,6 +279,9 @@ class PipelinedNetwork:
                 net.gc.iterations)
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+        if data_axis is not None and data_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{data_axis}' axis: "
+                             f"{mesh.axis_names}")
         self.net = net
         self.mesh = mesh
         self.axis = axis
@@ -398,6 +401,26 @@ class PipelinedNetwork:
         return loss + reg
 
     # -- the step ----------------------------------------------------------
+    def _to_layer_keyed(self, tree):
+        """{entry|blocks|head} tree → the container's per-layer-index keying
+        (body stages unstacked) so per-layer gradient-normalization modes see
+        the same grouping as MultiLayerNetwork."""
+        s, b = self.start, self.body_len
+        n = len(self.net.impls)
+        out = {str(i): tree["entry"][str(i)] for i in range(s)}
+        for j in range(b):
+            out[str(s + j)] = _tm(lambda p: p[j], tree["blocks"])
+        out.update({str(i): tree["head"][str(i)] for i in range(s + b, n)})
+        return out
+
+    def _from_layer_keyed(self, d):
+        s, b = self.start, self.body_len
+        n = len(self.net.impls)
+        return {"entry": {str(i): d[str(i)] for i in range(s)},
+                "blocks": stack_stage_params([d[str(s + j)]
+                                              for j in range(b)]),
+                "head": {str(i): d[str(i)] for i in range(s + b, n)}}
+
     def _layer_constraints(self, i):
         lc = self.net.conf.layers[i]
         return getattr(lc, "constraints", None) or \
@@ -443,7 +466,11 @@ class PipelinedNetwork:
             loss, grads = jax.value_and_grad(self._loss)(tree, f_mb, l_mb)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
-            grads = normalize_gradients(grads, gn_mode, gn_thresh)
+            if gn_mode:
+                # per-layer normalization modes must see the container's
+                # per-layer grouping, not {entry, blocks, head}
+                grads = self._from_layer_keyed(normalize_gradients(
+                    self._to_layer_keyed(grads), gn_mode, gn_thresh))
             updates, new_state = upd.apply(upd_state, grads, it)
             new_tree = _tm(lambda p, u: p - u.astype(p.dtype), tree, updates)
             new_tree = self._apply_constraints(new_tree)

@@ -144,6 +144,180 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     return fn(q, k, v)
 
 
+# --------------------------------------------------------------- ring-flash
+def _bh(x):
+    b, T, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, T, d)
+
+
+def _from_bh(x, b, h):
+    bh, T, d = x.shape
+    return jnp.transpose(x.reshape(b, h, T, d), (0, 2, 1, 3))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash_inner(q, k, v, axis, causal, scale):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis, causal, scale)
+    return out
+
+
+def _ring_flash_fwd_loop(q, k, v, axis, causal, scale):
+    """Per-device fwd: the Pallas flash kernel runs on each arriving K/V
+    ring block (O(1) VMEM — the [Tl, Tl] logits never materialize, unlike
+    ``_ring_inner``'s dense [b, h, Tl, chunk] chunks), and per-block
+    (o, lse) pairs merge with the standard log-sum-exp combine. Blocks a
+    causal query can't see at all are skipped via ``lax.cond`` (compute
+    AND DMA): the same bubble the in-kernel causal grid skip exploits."""
+    from ..ops import flash_attention as _fa
+
+    n = lax.psum(1, axis)
+    p = lax.axis_index(axis)
+    b, Tl, h, d = q.shape
+    qb, kb, vb = _bh(q), _bh(k), _bh(v)
+    bh = qb.shape[0]
+    m_run = pvary(jnp.full((bh, Tl), _NEG, jnp.float32), (axis,))
+    den = pvary(jnp.zeros((bh, Tl), jnp.float32), (axis,))
+    num = pvary(jnp.zeros((bh, Tl, d), jnp.float32), (axis,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        m_run, den, num, kc, vc = carry
+        blk = (p - i) % n
+
+        def diag(_):
+            o, lse = _fa._fwd(qb, kc, vc, None, True, scale)
+            return o, lse[..., 0]
+
+        def full(_):
+            o, lse = _fa._fwd(qb, kc, vc, None, False, scale)
+            return o, lse[..., 0]
+
+        def skip(_):
+            return (jnp.zeros_like(qb),
+                    jnp.full((bh, Tl), _NEG, jnp.float32))
+
+        if causal:
+            o_i, lse_i = lax.cond(
+                blk == p, diag,
+                lambda _: lax.cond(blk < p, full, skip, None), None)
+            valid = blk <= p
+        else:
+            o_i, lse_i = full(None)
+            valid = True
+        m_new = jnp.maximum(m_run, lse_i)
+        w_old = jnp.exp(m_run - m_new)
+        # gate, not just exp: when every lse so far is -NEG the subtraction
+        # is 0 and exp would say 1
+        w_new = jnp.where(jnp.logical_and(valid, lse_i > _NEG / 2),
+                          jnp.exp(lse_i - m_new), 0.0)
+        num = num * w_old[..., None] + o_i.astype(jnp.float32) \
+            * w_new[..., None]
+        den = den * w_old + w_new
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return m_new, den, num, kc, vc
+
+    m_run, den, num, _, _ = lax.fori_loop(0, n, body,
+                                          (m_run, den, num, kb, vb))
+    out = (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
+    lse_tot = m_run + jnp.log(jnp.maximum(den, 1e-30))
+    return _from_bh(out, b, h), (out, lse_tot)
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale):
+    y, (out_bh, lse) = _ring_flash_fwd_loop(q, k, v, axis, causal, scale)
+    return y, (q, k, v, out_bh, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, res, g):
+    """Ring backward: dk/dv accumulators TRAVEL WITH their k/v blocks around
+    the ring (n rotations return them home); per block the shared Pallas
+    backward kernels recompute probabilities from the GLOBAL lse/delta, so
+    the per-block gradients sum exactly to the full-attention gradient."""
+    from ..ops import flash_attention as _fa
+
+    q, k, v, out_bh, lse = res
+    n = lax.psum(1, axis)
+    p = lax.axis_index(axis)
+    b, Tl, h, d = q.shape
+    qb, kb, vb = _bh(q), _bh(k), _bh(v)
+    bh = qb.shape[0]
+    do = _bh(g).astype(qb.dtype)
+    delta = _fa.rowwise_delta(do, out_bh)
+    lse8 = jnp.broadcast_to(lse[..., None], lse.shape + (8,))
+    dq = pvary(jnp.zeros_like(qb), (axis,))
+    dk = pvary(jnp.zeros_like(kb), (axis,))
+    dv = pvary(jnp.zeros_like(vb), (axis,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        dq, dk, dv, kc, vc = carry
+        blk = (p - i) % n
+
+        def run(causal_blk):
+            def f(_):
+                dq_i = _fa.dq_block(qb, kc, vc, None, do, delta, lse8,
+                                    causal_blk, scale)
+                dk_i, dv_i = _fa.dkv_block(qb, kc, vc, None, do, delta,
+                                           lse8, causal_blk, scale)
+                return dq_i, dk_i, dv_i
+            return f
+
+        def skip(_):
+            return (jnp.zeros_like(qb), jnp.zeros_like(kb),
+                    jnp.zeros_like(vb))
+
+        if causal:
+            dq_i, dk_i, dv_i = lax.cond(
+                blk == p, run(True),
+                lambda _: lax.cond(blk < p, run(False), skip, None), None)
+        else:
+            dq_i, dk_i, dv_i = run(False)(None)
+        dq = dq + dq_i.astype(dq.dtype)
+        dk = dk + dk_i.astype(dk.dtype)
+        dv = dv + dv_i.astype(dv.dtype)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        dk = lax.ppermute(dk, axis, perm)
+        dv = lax.ppermute(dv, axis, perm)
+        return dq, dk, dv, kc, vc
+
+    dq, dk, dv, _, _ = lax.fori_loop(0, n, body, (dq, dk, dv, kb, vb))
+    return (_from_bh(dq, b, h).astype(q.dtype),
+            _from_bh(dk, b, h).astype(k.dtype),
+            _from_bh(dv, b, h).astype(v.dtype))
+
+
+_ring_flash_inner.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                         causal: bool = False):
+    """Ring attention with the Pallas flash kernel as the per-block compute
+    (round-3 VERDICT item 5: the sp path at O(T/n) HBM and O(1) VMEM —
+    ``ring_attention``'s dense per-chunk logits never materialize).
+    Same contract as :func:`ring_attention`; requires the local shard length
+    divisible by the flash block (128) and head_dim ≤ 256 — call
+    ``ring_flash_supported`` to pre-check, fall back to
+    :func:`ring_attention` otherwise."""
+    d = q.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+    spec = P(None, axis, None, None)
+    fn = shard_map(partial(_ring_flash_inner, axis=axis, causal=bool(causal),
+                           scale=scale),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_flash_supported(T: int, n_shards: int, d: int) -> bool:
+    from ..ops import flash_attention as _fa
+    Tl = T // max(1, n_shards)
+    return (T % max(1, n_shards) == 0 and Tl % _fa.BLOCK == 0 and d <= 256
+            and (_fa._FORCE_INTERPRET
+                 or _fa.supported(max(Tl, _fa.MIN_SEQ), d, 0.0, None)))
+
+
 def full_attention(q, k, v, causal: bool = False):
     """Single-device reference (testing oracle)."""
     d = q.shape[-1]

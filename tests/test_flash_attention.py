@@ -273,3 +273,104 @@ def test_self_attention_kv_cache_per_example_key_masks():
         want = run(x[i:i + 1], mask[i:i + 1])
         np.testing.assert_allclose(got[i:i + 1], want, rtol=2e-4, atol=2e-5,
                                    err_msg=f"example {i}")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_key_mask_matches_dense(causal):
+    """Key-padding masks stream through the flash kernels (round-3 VERDICT
+    item 5): forward must equal the dense masked oracle."""
+    q, k, v = _qkv(b=2, T=256, h=2, d=32, seed=21)
+    rng = np.random.default_rng(22)
+    km = jnp.asarray((rng.random((2, 256)) > 0.3).astype(np.float32))
+    got = fa.flash_attention(q, k, v, causal=causal, key_mask=km)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(32.0)
+    vis = km[:, None, None, :] > 0
+    if causal:
+        vis = vis & jnp.tril(jnp.ones((256, 256), bool))[None, None]
+    s = jnp.where(vis, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_key_mask_grads_match_dense():
+    q, k, v = _qkv(b=1, T=256, h=1, d=16, seed=23)
+    rng = np.random.default_rng(24)
+    km = jnp.asarray((rng.random((1, 256)) > 0.25).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True,
+                                          key_mask=km) ** 2)
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(16.0)
+        vis = (km[:, None, None, :] > 0) & \
+            jnp.tril(jnp.ones((256, 256), bool))[None, None]
+        p = jax.nn.softmax(jnp.where(vis, s, -1e30), axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_mha_routes_masked_to_flash(monkeypatch):
+    """supported() accepts a [b, T] array mask; mha with such a mask on a
+    block-divisible sequence must ACTUALLY take the flash path (spied) and
+    still match the dense masked computation."""
+    assert fa.supported(256, 64, 0.0, np.ones((2, 256), np.float32))
+    assert not fa.supported(256, 64, 0.0, object())   # not a [b, T] array
+    q, k, v = _qkv(b=2, T=256, h=2, d=32, seed=25)
+    rng = np.random.default_rng(26)
+    km = jnp.asarray((rng.random((2, 256)) > 0.4).astype(np.float32))
+    calls = []
+    real = fa.flash_attention
+    monkeypatch.setattr(fa, "flash_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    got = mha(q, k, v, True, jnp.float32, key_mask=km)
+    assert calls, "masked mha fell back to the dense path"
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(32.0)
+    vis = (km[:, None, None, :] > 0) & \
+        jnp.tril(jnp.ones((256, 256), bool))[None, None]
+    p = jax.nn.softmax(jnp.where(vis, s, -1e30), axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_flash_matches_full_attention():
+    """Flash kernel INSIDE the ring schedule (round-3 VERDICT item 5): the
+    sp path == dense full attention, forward and gradients, on a 4-device
+    sequence mesh."""
+    from deeplearning4j_tpu.parallel import (ring_flash_attention,
+                                             ring_flash_supported,
+                                             full_attention, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    assert ring_flash_supported(4 * 128, 4, 32)
+    assert not ring_flash_supported(4 * 100, 4, 32)   # shard not 128-divisible
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(31)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4 * 128, 2, 32)), jnp.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        got = ring_flash_attention(q, k, v, mesh, causal=causal)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_flash_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
