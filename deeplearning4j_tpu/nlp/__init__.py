@@ -14,7 +14,8 @@ from .distributed import (DistributedWord2Vec, DistributedGlove,
                           SparkWord2Vec, SparkGlove, partition_sentences)
 from .bagofwords import InvertedIndex, BagOfWordsVectorizer, TfidfVectorizer
 from .serializer import WordVectorSerializer, StaticWordVectors
-from .lang import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
+from .lang import (Lexicon,
+                   ChineseTokenizerFactory, JapaneseTokenizerFactory,
                    KoreanTokenizerFactory, UimaTokenizerFactory,
                    AnnotationPipeline)
 
@@ -28,6 +29,6 @@ __all__ = ["SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator"
            "SparkWord2Vec", "SparkGlove", "partition_sentences",
            "InvertedIndex", "BagOfWordsVectorizer", "TfidfVectorizer",
            "WordVectorSerializer", "StaticWordVectors",
-           "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+           "Lexicon", "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
            "KoreanTokenizerFactory", "UimaTokenizerFactory",
            "AnnotationPipeline"]

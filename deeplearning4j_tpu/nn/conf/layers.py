@@ -625,6 +625,33 @@ class AutoEncoder(FeedForwardLayer):
 
 @register
 @dataclasses.dataclass
+class RBM(FeedForwardLayer):
+    """Restricted Boltzmann Machine (reference ``nn/conf/layers/RBM.java:62``
+    config + ``nn/layers/feedforward/rbm/RBM.java:1`` CD-k impl — deprecated
+    there in favor of the VAE, ported for §2.1 layer-inventory completeness).
+
+    Supervised forward = ``propUp`` (hidden mean activation). Unsupervised
+    pretraining = CD-k contrastive divergence behind the standard pretrain
+    seam: ``pretrain_loss`` is the free-energy-difference surrogate
+    ``mean(F(v0) - F(v_k))`` with the k-step Gibbs chain stop-gradiented,
+    whose gradient IS the CD-k update ``⟨v0 h0⟩ - ⟨vk hk⟩`` (TPU-first: the
+    whole chain jits; no hand-written update rule).
+
+    ``hidden_unit``: binary | rectified | gaussian | identity;
+    ``visible_unit``: binary | gaussian | linear | identity (reference
+    enums; softmax units were never wired into the reference's gradient
+    path and are rejected here rather than silently mis-trained)."""
+    hidden_unit: str = "binary"
+    visible_unit: str = "binary"
+    k: int = 1
+    sparsity: float = 0.0
+
+    def is_pretrain_layer(self):
+        return True
+
+
+@register
+@dataclasses.dataclass
 class VariationalAutoencoder(FeedForwardLayer):
     """Reference ``nn/conf/layers/variational/VariationalAutoencoder.java`` /
     impl ``nn/layers/variational/VariationalAutoencoder.java`` (1163 LoC).

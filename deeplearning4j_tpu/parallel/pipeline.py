@@ -466,7 +466,8 @@ class PipelinedNetwork:
             loss, grads = jax.value_and_grad(self._loss)(tree, f_mb, l_mb)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
-            if gn_mode:
+            from ..nn.conf import GradientNormalization
+            if gn_mode not in (None, GradientNormalization.None_, "none"):
                 # per-layer normalization modes must see the container's
                 # per-layer grouping, not {entry, blocks, head}
                 grads = self._from_layer_keyed(normalize_gradients(

@@ -1,0 +1,135 @@
+"""RBM layer + CD-k pretraining (reference ``nn/conf/layers/RBM.java:62``,
+``nn/layers/feedforward/rbm/RBM.java:1`` — the last §2.1 layer-inventory row).
+Correctness bars: the free-energy surrogate's gradient IS the CD-k update,
+pretraining lowers reconstruction error through the container's pretrain
+seam, config serde round-trips, and supervised forward = propUp."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd, Adam)
+from deeplearning4j_tpu.nn.conf.layers import RBM, OutputLayer, DenseLayer
+
+
+def _rbm_net(n_in=12, n_hidden=8, k=1, hidden="binary", visible="binary",
+             seed=7, lr=0.1):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=lr))
+            .list()
+            .layer(RBM(n_in=n_in, n_out=n_hidden, k=k, hidden_unit=hidden,
+                       visible_unit=visible, activation="sigmoid"))
+            .layer(OutputLayer(n_in=n_hidden, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _binary_data(n=64, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    # two prototype patterns + bit noise: structure a CD-1 RBM can learn
+    protos = rng.random((2, d)) > 0.5
+    which = rng.integers(0, 2, n)
+    x = protos[which].astype(np.float32)
+    flip = rng.random((n, d)) < 0.05
+    x = np.where(flip, 1.0 - x, x).astype(np.float32)
+    l = np.eye(2, dtype=np.float32)[which]
+    return x, l
+
+
+def test_rbm_surrogate_gradient_is_cd_update():
+    """grad of mean(F(v0) - F(stop_grad(vk))) w.r.t. W must equal the
+    classic CD-k statistics <v0 h0> - <vk hk> (hand-computed)."""
+    net = _rbm_net()
+    impl = net.impls[0]
+    p = net.params["0"]
+    rng = np.random.default_rng(3)
+    x = jnp.asarray((rng.random((16, 12)) > 0.5).astype(np.float32))
+    key = jax.random.PRNGKey(5)
+
+    g = jax.grad(lambda pp: impl.pretrain_loss(pp, x, key))(p)
+
+    vk = impl.gibbs_chain(p, x, key, 1)
+    h0 = np.asarray(impl.prop_up(p, x))
+    hk = np.asarray(impl.prop_up(p, vk))
+    v0, vkn = np.asarray(x), np.asarray(vk)
+    n = v0.shape[0]
+    want_dW = -(v0.T @ h0) / n + (vkn.T @ hk) / n
+    want_db = -h0.mean(0) + hk.mean(0)
+    want_dvb = -v0.mean(0) + vkn.mean(0)
+    np.testing.assert_allclose(np.asarray(g["W"]), want_dW, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["b"]), want_db, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["vb"]), want_dvb, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rbm_pretrain_lowers_reconstruction_error():
+    net = _rbm_net(lr=0.5)
+    impl = net.impls[0]
+    x, _ = _binary_data()
+    ds = DataSet(x, np.zeros((64, 2), np.float32))
+    r0 = float(impl.reconstruction_error(net.params["0"], jnp.asarray(x)))
+    net.pretrain_layer(0, ListDataSetIterator([ds]), epochs=40)
+    r1 = float(impl.reconstruction_error(net.params["0"], jnp.asarray(x)))
+    assert r1 < r0 * 0.8, (r0, r1)
+
+
+def test_rbm_pretrain_then_finetune_through_fit():
+    """conf.pretrain(True): fit() runs layerwise CD pretraining first
+    (reference MultiLayerNetwork.fit :1172 pretrain branch), then the
+    supervised phase converges."""
+    x, l = _binary_data(seed=11)
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater(Adam(learning_rate=5e-3))
+            .list()
+            .pretrain(True)
+            .layer(RBM(n_in=12, n_out=8, k=1, activation="sigmoid"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, l)
+    s0 = float(net.score(ds))
+    for _ in range(30):
+        net.fit(ds)
+    assert float(net.score(ds)) < s0 * 0.6
+
+
+def test_rbm_gaussian_visible_and_rectified_hidden():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(32, 12)).astype(np.float32)
+    net = _rbm_net(hidden="rectified", visible="gaussian", lr=1e-2)
+    impl = net.impls[0]
+    ds = DataSet(x, np.zeros((32, 2), np.float32))
+    r0 = float(impl.reconstruction_error(net.params["0"], jnp.asarray(x)))
+    net.pretrain_layer(0, ListDataSetIterator([ds]), epochs=30)
+    r1 = float(impl.reconstruction_error(net.params["0"], jnp.asarray(x)))
+    assert np.isfinite(r1) and r1 < r0, (r0, r1)
+
+
+def test_rbm_forward_is_prop_up_and_serde_round_trips():
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    net = _rbm_net()
+    impl, p = net.impls[0], net.params["0"]
+    x = jnp.asarray(np.random.default_rng(1).random((5, 12)), jnp.float32)
+    y, _ = impl.forward(p, {}, x)
+    want = jax.nn.sigmoid(x @ p["W"] + p["b"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
+
+    conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+    l0 = conf2.layers[0]
+    assert (type(l0).__name__, l0.k, l0.hidden_unit, l0.visible_unit) == \
+        ("RBM", 1, "binary", "binary")
+    assert l0.is_pretrain_layer()
+
+
+def test_rbm_rejects_unknown_units():
+    with pytest.raises(ValueError, match="hidden_unit"):
+        _rbm_net(hidden="softmax")
+    with pytest.raises(ValueError, match="visible_unit"):
+        _rbm_net(visible="softmax")
