@@ -231,7 +231,10 @@ class RBMImpl(LayerImpl):
     def free_energy(self, params, v):
         """F(v); binary-visible term −v·vb, gaussian/linear ½‖v−vb‖²."""
         z = self._hidden_z(params, v)
-        if self.conf.hidden_unit == "gaussian":
+        if self.conf.hidden_unit in ("gaussian", "identity"):
+            # quadratic form: mean hidden activation is z for both, so the
+            # surrogate gradient carries the same h = z statistics prop_up
+            # reports (softplus would silently optimize a binary model)
             hidden = -0.5 * jnp.sum(z * z, axis=-1)
         else:
             hidden = -jnp.sum(jax.nn.softplus(z), axis=-1)

@@ -82,6 +82,34 @@ def _scan_iterations(step, n_iter, with_rnn_state=False):
     return scanned
 
 
+def _build_tbptt_scan(step, n_iter):
+    """Jit a with-rnn-state train step into ONE program running the whole
+    TBPTT segment loop (``lax.scan`` over stacked segments, params/updater/
+    RNN state carried, segments detached by the step itself). Shared by
+    MultiLayerNetwork AND ComputationGraph so the two containers' fused
+    TBPTT semantics cannot drift. Inputs are segment-stacked pytrees
+    ``[S, ...]`` (tuples of streams for the graph container ride through
+    untouched — scan maps over every leaf's leading dim)."""
+    if n_iter > 1:
+        step = _scan_iterations(step, n_iter, with_rnn_state=True)
+
+    def scanned(params, states, upd, it0, rng, f_s, l_s, fm_s, lm_s, rnn0):
+        def body(carry, xs):
+            params, states, upd, rnn, s = carry
+            f_c, l_c, fm_c, lm_c = xs
+            params, states, upd, loss, rnn = step(
+                params, states, upd, it0 + s * n_iter,
+                jax.random.fold_in(rng, s), f_c, l_c, fm_c, lm_c, rnn)
+            return (params, states, upd, rnn, s + 1), loss
+
+        init = (params, states, upd, rnn0, jnp.asarray(0, jnp.int32))
+        (params, states, upd, _, _), losses = jax.lax.scan(
+            body, init, (f_s, l_s, fm_s, lm_s))
+        return params, states, upd, losses[-1]
+
+    return jax.jit(scanned, donate_argnums=(0, 2))
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -338,6 +366,26 @@ class MultiLayerNetwork:
             self._jit_tbptt_step = self._build_step(with_rnn_state=True)
         return self._jit_tbptt_step
 
+    def _build_tbptt_scan_step(self, single_iteration=False):
+        """The WHOLE TBPTT loop as one jitted program: ``lax.scan`` over
+        stacked segments, carrying params/updater/RNN state (detached between
+        segments by the inner step). One device dispatch per minibatch
+        instead of one per segment — on a tunneled TPU each dispatch costs
+        ~5 ms, so a 200-char/50-TBPTT batch saves 3 of 4 round trips (the
+        LSTM-throughput lever from the round-3 VERDICT; same move as the
+        ``iterations(n)`` scan, applied to the segment dimension)."""
+        n_iter = 1 if single_iteration else _n_iterations(self.gc)
+        return _build_tbptt_scan(self._raw_step(True), n_iter)
+
+    def _ensure_tbptt_scan_step(self, single_iteration=False):
+        cache = getattr(self, "_jit_tbptt_scan", None)
+        if cache is None:
+            cache = self._jit_tbptt_scan = {}
+        key = bool(single_iteration)
+        if key not in cache:
+            cache[key] = self._build_tbptt_scan_step(single_iteration)
+        return cache[key]
+
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
         return k
@@ -422,23 +470,43 @@ class MultiLayerNetwork:
             self._warned_tbptt = True
         T = f.shape[1]
         L = self.conf.tbptt_fwd_length
-        step = self._ensure_tbptt_step(single_iteration=single_iteration)
         n_applied = 1 if single_iteration else _n_iterations(self.gc)
-        rnn_state = self._init_rnn_state(int(f.shape[0]))
-        for start in range(0, T, L):
-            sl = slice(start, min(start + L, T))
-            f_c = f[:, sl]
-            l_c = l[:, sl] if l.ndim == 3 else l
-            fm_c = None if fm is None else fm[:, sl]
-            lm_c = None if lm is None else lm[:, sl]
-            it = jnp.asarray(self.iteration_count, jnp.int32)
-            (self.params, self.states, self.updater_state, loss,
-             rnn_state) = step(self.params, self.states, self.updater_state, it,
-                               self._next_rng(), f_c, l_c, fm_c, lm_c, rnn_state)
+        if T % L == 0:
+            # fused path: scan over stacked equal segments, ONE dispatch
+            S, b = T // L, f.shape[0]
+            f_s = jnp.swapaxes(f.reshape(b, S, L, *f.shape[2:]), 0, 1)
+            l_s = (jnp.swapaxes(l.reshape(b, S, L, *l.shape[2:]), 0, 1)
+                   if l.ndim == 3 else jnp.broadcast_to(l, (S,) + l.shape))
+            fm_s = (None if fm is None
+                    else jnp.swapaxes(fm.reshape(b, S, L), 0, 1))
+            lm_s = (None if lm is None
+                    else jnp.swapaxes(lm.reshape(b, S, L), 0, 1))
+            scan_step = self._ensure_tbptt_scan_step(single_iteration)
+            it0 = jnp.asarray(self.iteration_count, jnp.int32)
+            (self.params, self.states, self.updater_state, loss) = scan_step(
+                self.params, self.states, self.updater_state, it0,
+                self._next_rng(), f_s, l_s, fm_s, lm_s,
+                self._init_rnn_state(int(b)))
             # one iteration per TBPTT segment × iterations(n) applied per
             # segment (reference increments iterationCount per applied
             # update, so Adam bias correction and lr schedules see each one)
-            self.iteration_count += n_applied
+            self.iteration_count += S * n_applied
+        else:
+            # ragged tail: per-segment dispatch (shapes differ per segment)
+            step = self._ensure_tbptt_step(single_iteration=single_iteration)
+            rnn_state = self._init_rnn_state(int(f.shape[0]))
+            for start in range(0, T, L):
+                sl = slice(start, min(start + L, T))
+                f_c = f[:, sl]
+                l_c = l[:, sl] if l.ndim == 3 else l
+                fm_c = None if fm is None else fm[:, sl]
+                lm_c = None if lm is None else lm[:, sl]
+                it = jnp.asarray(self.iteration_count, jnp.int32)
+                (self.params, self.states, self.updater_state, loss,
+                 rnn_state) = step(self.params, self.states,
+                                   self.updater_state, it, self._next_rng(),
+                                   f_c, l_c, fm_c, lm_c, rnn_state)
+                self.iteration_count += n_applied
         self.score_ = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration_count - 1, float(loss))

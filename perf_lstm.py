@@ -1,0 +1,134 @@
+"""GravesLSTM char-RNN perf experiments (PERF.md records results).
+
+The round-3 VERDICT flagged the char-RNN at ~1% MFU with no profile, sweep
+or roofline. This harness produces all three on real TPU hardware:
+
+  python perf_lstm.py sweep            # batch × width × tbptt sweep
+  python perf_lstm.py roofline         # XLA cost model + bound analysis
+  python perf_lstm.py profile DIR      # jax.profiler trace of steady state
+
+Run it when the axon tunnel is healthy; all timing gates on value fetches
+(`bench._sync`) because block_until_ready lies on the tunnel (PERF.md
+addendum 2).
+
+Structural lever already landed in round 4 (no hardware needed to justify):
+`MultiLayerNetwork._fit_tbptt` fuses the per-segment dispatch loop into one
+`lax.scan` program — a T=200/L=50 batch now costs ONE device dispatch
+instead of four (each ~5 ms over the tunnel).
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bench import _sync
+
+
+def _charrnn(batch, width, tbptt, seq_len, vocab=80):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, BackpropType
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu import Adam
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Adam(learning_rate=1e-3)).activation("tanh")
+            .compute_dtype("bfloat16").cache_mode("device")
+            .list()
+            .layer(GravesLSTM(n_in=vocab, n_out=width))
+            .layer(GravesLSTM(n_in=width, n_out=width))
+            .layer(RnnOutputLayer(n_in=width, n_out=vocab,
+                                  activation="softmax", loss="mcxent"))
+            .build())
+    conf.backprop_type = BackpropType.TruncatedBPTT
+    conf.tbptt_fwd_length = tbptt
+    conf.tbptt_back_length = tbptt
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=(batch, seq_len))
+    f = np.eye(vocab, dtype=np.float32)[ids]
+    l = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    return net, DataSet(f, l)
+
+
+def measure(batch=64, width=512, tbptt=50, seq_len=200, fits=3):
+    net, ds = _charrnn(batch, width, tbptt, seq_len)
+    net.fit(ds)                      # compile + warm
+    _sync(net.score_)
+    t0 = time.perf_counter()
+    for _ in range(fits):
+        net.fit(ds)
+    _sync(net.score_)
+    dt = time.perf_counter() - t0
+    return batch * seq_len * fits / dt
+
+
+def sweep():
+    print(f"{'batch':>6} {'width':>6} {'tbptt':>6} {'chars/s':>12}")
+    for batch in (64, 128, 256, 512):
+        for width in (512, 1024):
+            for tbptt in (50, 200):
+                try:
+                    r = measure(batch=batch, width=width, tbptt=tbptt)
+                    print(f"{batch:>6} {width:>6} {tbptt:>6} {r:>12,.0f}",
+                          flush=True)
+                except Exception as e:  # OOM etc.: record and continue
+                    print(f"{batch:>6} {width:>6} {tbptt:>6} FAILED {e}",
+                          flush=True)
+
+
+def roofline(batch=64, width=512, tbptt=50, seq_len=200):
+    """XLA cost model of one TBPTT batch + bound analysis at v5e peaks
+    (197 TFLOPS bf16, 819 GB/s HBM). (utils.profiling.step_cost covers the
+    plain step; this lowers the FUSED tbptt scan, which it cannot.)"""
+    net, ds = _charrnn(batch, width, tbptt, seq_len)
+    # cost of ONE fused-scan TBPTT batch: lower the scan step itself
+    S, b = seq_len // tbptt, batch
+    f = jnp.asarray(ds.features)
+    l = jnp.asarray(ds.labels)
+    f_s = jnp.swapaxes(f.reshape(b, S, tbptt, f.shape[-1]), 0, 1)
+    l_s = jnp.swapaxes(l.reshape(b, S, tbptt, l.shape[-1]), 0, 1)
+    scan_step = net._build_tbptt_scan_step()
+    lowered = scan_step.lower(net.params, net.states, net.updater_state,
+                              jnp.asarray(0, jnp.int32),
+                              jax.random.PRNGKey(0), f_s, l_s, None, None,
+                              net._init_rnn_state(b))
+    ca = lowered.compile().cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    by = float(ca.get("bytes accessed", 0.0))
+    chars = batch * seq_len
+    t_flops = flops / 197e12
+    t_hbm = by / 819e9
+    bound = "HBM-bandwidth" if t_hbm > t_flops else "compute"
+    print(f"per-batch: {flops/1e9:.1f} GFLOP, {by/1e9:.2f} GB accessed")
+    print(f"ideal times: compute {t_flops*1e3:.2f} ms, HBM {t_hbm*1e3:.2f} ms"
+          f" -> {bound}-bound")
+    ideal = chars / max(t_flops, t_hbm)
+    print(f"roofline chars/s: {ideal:,.0f}")
+    r = measure(batch=batch, width=width, tbptt=tbptt, seq_len=seq_len)
+    print(f"measured chars/s: {r:,.0f} ({100*r/ideal:.1f}% of roofline)")
+
+
+def profile(log_dir, batch=64, width=512):
+    net, ds = _charrnn(batch, width, 50, 200)
+    net.fit(ds)
+    _sync(net.score_)
+    jax.profiler.start_trace(log_dir)
+    net.fit(ds)
+    _sync(net.score_)       # value fetch BEFORE stop: trace must be complete
+    jax.profiler.stop_trace()
+    print("trace written to", log_dir)
+
+
+if __name__ == "__main__":
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "sweep"
+    if cmd == "sweep":
+        sweep()
+    elif cmd == "roofline":
+        roofline()
+    elif cmd == "profile":
+        profile(sys.argv[2] if len(sys.argv) > 2 else "/tmp/lstm_trace")
+    else:
+        raise SystemExit(f"unknown command {cmd}")

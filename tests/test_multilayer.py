@@ -330,3 +330,57 @@ def test_iterations_config_tbptt_scanned():
     # 2 segments x 2 iterations
     assert net.iteration_count == 4
     assert np.isfinite(float(net.score_))
+
+
+def test_tbptt_fused_scan_matches_per_segment_loop():
+    """The fused lax.scan TBPTT path (one dispatch per batch) must produce
+    the same params as dispatching each segment separately (round-4 LSTM
+    dispatch-latency lever; math identical, only the launch granularity
+    changes)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                    DataSet, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(41)
+                .updater(Sgd(learning_rate=1e-2)).list()
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(43)
+    T = 12  # 3 equal segments -> fused path
+    f = rng.normal(size=(6, T, 3)).astype(np.float32)
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (6, T))].astype(
+        np.float32)
+    m = (np.arange(T)[None, :] < rng.integers(6, T + 1, (6, 1))).astype(
+        np.float32)
+
+    fused = make()
+    fused._fit_batch(DataSet(f, l, features_mask=m, labels_mask=m))
+    assert fused.iteration_count == 3
+
+    manual = make()
+    step = manual._ensure_tbptt_step()
+    rnn = manual._init_rnn_state(6)
+    fj, lj, mj = jnp.asarray(f), jnp.asarray(l), jnp.asarray(m)
+    for s in range(3):
+        sl = slice(4 * s, 4 * (s + 1))
+        (manual.params, manual.states, manual.updater_state, loss,
+         rnn) = step(manual.params, manual.states, manual.updater_state,
+                     jnp.asarray(s, jnp.int32), manual._next_rng(),
+                     fj[:, sl], lj[:, sl], mj[:, sl], mj[:, sl], rnn)
+
+    for k in manual.params:
+        for p in manual.params[k]:
+            np.testing.assert_allclose(np.asarray(fused.params[k][p]),
+                                       np.asarray(manual.params[k][p]),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"{k}/{p}")
