@@ -54,17 +54,69 @@ class ZooModel:
             return MultiLayerNetwork(conf).init()
         return ComputationGraph(conf).init()
 
-    def pretrained_path(self) -> str:
-        from ..datasets.fetchers import data_dir
-        return os.path.join(data_dir(), "zoo", f"{self.name}.bin")
+    #: Remote weight registry (reference ``ZooModel.java:40-51`` download +
+    #: ``pretrainedChecksum`` verification, ``TrainedModels.java``): maps
+    #: pretrained-type → (url, sha256). Shipped EMPTY — this build runs with
+    #: zero network egress, so the table is the deployment seam: a real
+    #: installation fills it (or subclasses override) and
+    #: ``init_pretrained`` then downloads + checksum-verifies exactly like
+    #: the reference. ``None`` entries document the shape.
+    PRETRAINED_URLS: dict = {}
 
-    def init_pretrained(self):
-        path = self.pretrained_path()
+    def pretrained_url(self, pretrained_type: str = "imagenet"):
+        """(url, sha256) for a pretrained-type, or None (reference
+        ``pretrainedUrl``/``pretrainedChecksum``)."""
+        return self.PRETRAINED_URLS.get(pretrained_type)
+
+    def pretrained_path(self, pretrained_type: str = "imagenet") -> str:
+        from ..datasets.fetchers import data_dir
+        suffix = "" if pretrained_type == "imagenet" else f"_{pretrained_type}"
+        return os.path.join(data_dir(), "zoo", f"{self.name}{suffix}.bin")
+
+    @staticmethod
+    def _sha256(path: str) -> str:
+        import hashlib
+        h = hashlib.sha256()
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def init_pretrained(self, pretrained_type: str = "imagenet"):
+        """Restore pretrained weights (reference ``initPretrained``
+        :40-51: download to the local zoo dir, verify checksum, restore).
+        With the shipped empty URL table this loads only a locally placed
+        ModelSerializer zip; when ``PRETRAINED_URLS`` is filled (deployment
+        with egress) the file is fetched and sha256-verified first."""
+        path = self.pretrained_path(pretrained_type)
+        entry = self.pretrained_url(pretrained_type)
+        if not os.path.exists(path) and entry is not None:
+            url, sha = entry
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            import urllib.request
+            tmp = path + ".part"
+            urllib.request.urlretrieve(url, tmp)  # deployment-only: egress
+            if sha and self._sha256(tmp) != sha:
+                os.remove(tmp)
+                raise IOError(
+                    f"Checksum mismatch for {self.name} weights from {url} "
+                    f"(expected sha256 {sha}) — refusing corrupt download "
+                    f"(reference ZooModel checksum behavior)")
+            os.replace(tmp, path)
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"No pretrained weights for {self.name}: expected a "
-                f"ModelSerializer zip at {path} (no network egress — place "
-                f"the file there manually)")
+                f"ModelSerializer zip at {path}. This build runs with no "
+                f"network egress and an empty PRETRAINED_URLS registry — "
+                f"place the file there manually, or fill "
+                f"{type(self).__name__}.PRETRAINED_URLS with "
+                f"{{'{pretrained_type}': (url, sha256)}} in a deployment "
+                f"with egress.")
+        if entry is not None and entry[1]:
+            got = self._sha256(path)
+            if got != entry[1]:
+                raise IOError(f"Local weights {path} fail checksum "
+                              f"verification: sha256 {got} != {entry[1]}")
         from ..utils.model_serializer import ModelSerializer
         return ModelSerializer.restore_model(path)
 

@@ -106,3 +106,44 @@ def test_vgg_and_alexnet_configs_build():
         # count params analytically from configs (no init → no 550MB alloc)
     conf = AlexNet(num_classes=1000).conf()
     assert conf.layers[-1].n_in == 4096
+
+
+def test_pretrained_checksum_workflow(tmp_path, monkeypatch):
+    """The reference's download + checksum workflow (ZooModel.java:40-51):
+    a filled PRETRAINED_URLS entry is checksum-verified; corrupt files are
+    refused; a correct local file round-trips through ModelSerializer."""
+    import os
+    from deeplearning4j_tpu.models.zoo import LeNet
+    from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    m = LeNet(num_classes=10)
+    net = m.init()
+    path = m.pretrained_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    ModelSerializer.write_model(net, path)
+
+    # no registry entry: plain local load works
+    restored = m.init_pretrained()
+    a = np.asarray(net.params["0"]["W"])
+    np.testing.assert_array_equal(a, np.asarray(restored.params["0"]["W"]))
+
+    # registry entry with the CORRECT checksum: verification passes
+    good = m._sha256(path)
+    monkeypatch.setattr(LeNet, "PRETRAINED_URLS",
+                        {"imagenet": ("https://example.invalid/x.bin", good)})
+    m.init_pretrained()
+
+    # wrong checksum: local file is refused loudly
+    monkeypatch.setattr(LeNet, "PRETRAINED_URLS",
+                        {"imagenet": ("https://example.invalid/x.bin",
+                                      "0" * 64)})
+    with pytest.raises(IOError, match="checksum"):
+        m.init_pretrained()
+
+    # missing file + empty registry: actionable error naming the seam
+    m2 = LeNet(num_classes=10)
+    monkeypatch.setattr(LeNet, "PRETRAINED_URLS", {})
+    os.remove(path)
+    with pytest.raises(FileNotFoundError, match="PRETRAINED_URLS"):
+        m2.init_pretrained()
