@@ -63,6 +63,14 @@ class ZooModel:
     #: the reference. ``None`` entries document the shape.
     PRETRAINED_URLS: dict = {}
 
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        # every subclass gets its OWN registry dict: in-place item
+        # assignment (the documented deployment seam) must never leak one
+        # model's weights entry to every other zoo model
+        if "PRETRAINED_URLS" not in cls.__dict__:
+            cls.PRETRAINED_URLS = dict(cls.PRETRAINED_URLS)
+
     def pretrained_url(self, pretrained_type: str = "imagenet"):
         """(url, sha256) for a pretrained-type, or None (reference
         ``pretrainedUrl``/``pretrainedChecksum``)."""
@@ -90,6 +98,7 @@ class ZooModel:
         with egress) the file is fetched and sha256-verified first."""
         path = self.pretrained_path(pretrained_type)
         entry = self.pretrained_url(pretrained_type)
+        verified = False
         if not os.path.exists(path) and entry is not None:
             url, sha = entry
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -103,6 +112,7 @@ class ZooModel:
                     f"(expected sha256 {sha}) — refusing corrupt download "
                     f"(reference ZooModel checksum behavior)")
             os.replace(tmp, path)
+            verified = bool(sha)  # don't re-hash the bytes we just checked
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"No pretrained weights for {self.name}: expected a "
@@ -112,7 +122,7 @@ class ZooModel:
                 f"{type(self).__name__}.PRETRAINED_URLS with "
                 f"{{'{pretrained_type}': (url, sha256)}} in a deployment "
                 f"with egress.")
-        if entry is not None and entry[1]:
+        if entry is not None and entry[1] and not verified:
             got = self._sha256(path)
             if got != entry[1]:
                 raise IOError(f"Local weights {path} fail checksum "

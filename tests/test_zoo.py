@@ -147,3 +147,17 @@ def test_pretrained_checksum_workflow(tmp_path, monkeypatch):
     os.remove(path)
     with pytest.raises(FileNotFoundError, match="PRETRAINED_URLS"):
         m2.init_pretrained()
+
+
+def test_pretrained_registry_is_per_class():
+    """In-place item assignment on one model's registry (the documented
+    deployment seam) must not leak to other zoo models (review finding:
+    shared base-class dict)."""
+    from deeplearning4j_tpu.models.zoo import LeNet, AlexNet, ZooModel
+    LeNet.PRETRAINED_URLS["imagenet"] = ("https://example.invalid/l.bin",
+                                         "a" * 64)
+    try:
+        assert "imagenet" not in AlexNet.PRETRAINED_URLS
+        assert "imagenet" not in ZooModel.PRETRAINED_URLS
+    finally:
+        LeNet.PRETRAINED_URLS.pop("imagenet", None)
