@@ -27,6 +27,11 @@ flight feeding training/serving*. Here:
  - :class:`ServingRoute` — the DL4jServeRouteBuilder equivalent: consume
    feature arrays from one topic, run ``net.output``, publish predictions to
    another.
+
+For interop with REAL Kafka brokers, ``datasets/kafka.py`` implements the
+actual Kafka wire protocol (RecordBatch v2 + crc32c, Produce v3 / Fetch v4)
+and an :class:`~deeplearning4j_tpu.datasets.kafka.NDArrayKafkaClient`
+carrying these same ``NDArrayMessage`` payloads as record values.
 """
 from __future__ import annotations
 

@@ -1,0 +1,274 @@
+"""Kafka wire-protocol codec (reference ``NDArrayKafkaClient.java:1`` — the
+protocol-compatibility seam PARITY.md listed as open). The codec layer is
+verified against published CRC vectors and by byte-level round trips; the
+socket client is driven against an in-process stub broker speaking the same
+framing."""
+import io
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.kafka import (
+    crc32c, zigzag_encode, zigzag_decode, write_varint, read_varint,
+    Record, RecordBatch, request_frame, produce_request,
+    parse_produce_response, fetch_request, parse_fetch_response,
+    NDArrayKafkaClient, API_PRODUCE, API_FETCH)
+from deeplearning4j_tpu.datasets.streaming import NDArrayMessage
+
+
+def test_crc32c_published_vectors():
+    # the canonical Castagnoli check vector + empty/zeros cases
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA   # RFC 3720 test vector
+
+
+def test_zigzag_and_varint_round_trip():
+    for v in (0, -1, 1, -2, 2, 127, -128, 300, -300, 2 ** 31, -2 ** 31):
+        assert zigzag_decode(zigzag_encode(v)) == v
+        buf = io.BytesIO()
+        write_varint(buf, v)
+        buf.seek(0)
+        assert read_varint(buf) == v
+    # known encodings: zigzag(0)=0, zigzag(-1)=1, zigzag(1)=2
+    assert zigzag_encode(0) == 0 and zigzag_encode(-1) == 1
+    assert zigzag_encode(1) == 2
+
+
+def test_record_batch_round_trip_and_crc():
+    recs = [Record(b"value-%d" % i, key=b"k%d" % i,
+                   headers=[("h", b"x")], offset_delta=i)
+            for i in range(3)]
+    batch = RecordBatch(recs, base_offset=42, base_timestamp=1234)
+    wire = batch.encode()
+    back = RecordBatch.decode(wire)
+    assert back.base_offset == 42 and back.base_timestamp == 1234
+    assert [r.value for r in back.records] == [b"value-0", b"value-1",
+                                               b"value-2"]
+    assert [r.key for r in back.records] == [b"k0", b"k1", b"k2"]
+    assert back.records[0].headers == [("h", b"x")]
+
+    # flip one payload byte: crc must catch it
+    corrupt = bytearray(wire)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc"):
+        RecordBatch.decode(bytes(corrupt))
+
+
+def test_record_batch_layout_fields():
+    """Pin the v2 layout offsets: magic byte at 16, crc at 17-20 covering
+    attributes onward (a broker-compatibility regression guard)."""
+    wire = RecordBatch([Record(b"v")], base_offset=7).encode()
+    assert struct.unpack(">q", wire[:8])[0] == 7          # baseOffset
+    batch_len = struct.unpack(">i", wire[8:12])[0]
+    assert len(wire) == 12 + batch_len                    # framing exact
+    assert wire[16] == 2                                  # magic
+    crc = struct.unpack(">I", wire[17:21])[0]
+    assert crc == crc32c(wire[21:])
+    assert struct.unpack(">i", wire[53:57])[0] == -1      # baseSequence
+    assert struct.unpack(">i", wire[57:61])[0] == 1       # recordCount
+
+
+class _StubBroker:
+    """Minimal in-process broker: accepts Produce v3 (stores batches) and
+    Fetch v4 (returns them) over real sockets — same framing a live broker
+    speaks, so the client's socket path is genuinely exercised."""
+
+    def __init__(self):
+        self.store = {}
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while True:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client, args=(c,),
+                             daemon=True).start()
+
+    def _recv_exact(self, c, n):
+        data = b""
+        while len(data) < n:
+            chunk = c.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError
+            data += chunk
+        return data
+
+    def _client(self, c):
+        try:
+            while True:
+                size = struct.unpack(">i", self._recv_exact(c, 4))[0]
+                payload = self._recv_exact(c, size)
+                api_key, api_version, corr = struct.unpack(">hhi",
+                                                           payload[:8])
+                clen = struct.unpack(">h", payload[8:10])[0]
+                body = payload[10 + max(0, clen):]
+                if api_key == API_PRODUCE:
+                    resp = self._produce(body)
+                elif api_key == API_FETCH:
+                    resp = self._fetch(body)
+                else:
+                    resp = b""
+                out = struct.pack(">i", corr) + resp
+                c.sendall(struct.pack(">i", len(out)) + out)
+        except (ConnectionError, OSError):
+            pass
+
+    def _produce(self, body):
+        r = io.BytesIO(body)
+        tlen = struct.unpack(">h", r.read(2))[0]
+        r.read(max(0, tlen))
+        struct.unpack(">hi", r.read(6))          # acks, timeout
+        struct.unpack(">i", r.read(4))           # topic count (1)
+        tlen = struct.unpack(">h", r.read(2))[0]
+        topic = r.read(tlen).decode()
+        struct.unpack(">i", r.read(4))           # partition count (1)
+        pid = struct.unpack(">i", r.read(4))[0]
+        rlen = struct.unpack(">i", r.read(4))[0]
+        rec = r.read(rlen)
+        log = self.store.setdefault((topic, pid), [])
+        base = sum(len(RecordBatch.decode(b).records) for b in log)
+        # broker rewrites the base offset like a real log append
+        patched = struct.pack(">q", base) + rec[8:]
+        log.append(patched)
+        return (struct.pack(">i", 1)
+                + struct.pack(">h", len(topic)) + topic.encode()
+                + struct.pack(">i", 1)
+                + struct.pack(">ihqq", pid, 0, base, -1)
+                + struct.pack(">i", 0))
+
+    def _fetch(self, body):
+        r = io.BytesIO(body)
+        struct.unpack(">iiiib", r.read(17))      # replica/wait/min/max/iso
+        struct.unpack(">i", r.read(4))           # topic count
+        tlen = struct.unpack(">h", r.read(2))[0]
+        topic = r.read(tlen).decode()
+        struct.unpack(">i", r.read(4))           # partition count
+        pid = struct.unpack(">i", r.read(4))[0]
+        offset = struct.unpack(">q", r.read(8))[0]
+        struct.unpack(">i", r.read(4))           # max bytes
+        batches = []
+        total = 0
+        for wire in self.store.get((topic, pid), []):
+            b = RecordBatch.decode(wire)
+            if b.base_offset + len(b.records) > offset:
+                batches.append(wire)
+            total += len(b.records)
+        recs = b"".join(batches)
+        return (struct.pack(">i", 0)             # throttle
+                + struct.pack(">i", 1)
+                + struct.pack(">h", len(topic)) + topic.encode()
+                + struct.pack(">i", 1)
+                + struct.pack(">ihqq", pid, 0, total, -1)
+                + struct.pack(">i", 0)           # aborted txns
+                + struct.pack(">i", len(recs)) + recs)
+
+    def close(self):
+        self.sock.close()
+
+
+def test_ndarray_kafka_client_produce_fetch_round_trip():
+    broker = _StubBroker()
+    try:
+        client = NDArrayKafkaClient(f"127.0.0.1:{broker.port}", "tensors")
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.ones((2, 2), np.float64)
+        assert client.publish([a, b]) == 0
+        assert client.publish(np.full((5,), 7.0, np.float32)) == 1
+
+        consumer = NDArrayKafkaClient(f"127.0.0.1:{broker.port}", "tensors")
+        polled = consumer.poll()
+        assert len(polled) == 2
+        np.testing.assert_array_equal(polled[0][0], a)
+        np.testing.assert_array_equal(polled[0][1], b)
+        np.testing.assert_array_equal(polled[1][0], np.full((5,), 7.0,
+                                                            np.float32))
+        assert consumer.offset == 2
+        assert consumer.poll() == []             # nothing new past offset
+        client.close()
+        consumer.close()
+    finally:
+        broker.close()
+
+
+def test_produce_fetch_codec_round_trip_without_socket():
+    batch = RecordBatch([Record(NDArrayMessage.encode(
+        [np.zeros((2, 3), np.float32)]))])
+    body = produce_request("t", 0, batch)
+    # encode→parse our own fetch response embedding the same batch bytes
+    wire = batch.encode()
+    resp = (struct.pack(">i", 0) + struct.pack(">i", 1)
+            + struct.pack(">h", 1) + b"t" + struct.pack(">i", 1)
+            + struct.pack(">ihqq", 0, 0, 1, -1) + struct.pack(">i", 0)
+            + struct.pack(">i", len(wire)) + wire)
+    parsed = parse_fetch_response(resp)
+    recs = parsed["t"][0]["batches"][0].records
+    arrays = NDArrayMessage.decode(recs[0].value)
+    assert arrays[0].shape == (2, 3)
+    assert isinstance(body, bytes) and len(body) > len(wire)
+
+
+def test_batch_encode_assigns_sequential_offset_deltas():
+    wire = RecordBatch([Record(b"a"), Record(b"b"), Record(b"c")]).encode()
+    back = RecordBatch.decode(wire)
+    assert [r.offset_delta for r in back.records] == [0, 1, 2]
+    assert back.last_offset_delta == 2 and back.next_offset == 3
+
+
+def test_poll_advances_past_compacted_batches_and_skips_tombstones():
+    """A compacted batch (lastOffsetDelta > surviving records) must advance
+    the consumer past the gap, not re-fetch forever; tombstone (null-value)
+    records and control batches are skipped (review findings)."""
+    # compacted: base_offset 10, 1 surviving record, lastOffsetDelta 5
+    compacted = RecordBatch([Record(b"x")], base_offset=10,
+                            last_offset_delta=5)
+    assert RecordBatch.decode(compacted.encode()).next_offset == 16
+
+    tomb = RecordBatch([Record(None), Record(b"y")])
+    back = RecordBatch.decode(tomb.encode())
+    assert back.records[0].value is None and back.records[1].value == b"y"
+
+    ctrl = RecordBatch([Record(b"commit-marker")], attributes=0x20)
+    assert RecordBatch.decode(ctrl.encode()).is_control
+
+
+def test_publish_stamps_wallclock_timestamp():
+    import time
+    broker = _StubBroker()
+    try:
+        c = NDArrayKafkaClient(f"127.0.0.1:{broker.port}", "ts")
+        c.publish(np.zeros(3, np.float32))
+        wire = broker.store[("ts", 0)][0]
+        ts = RecordBatch.decode(wire).base_timestamp
+        assert abs(ts - time.time() * 1000) < 60_000   # within a minute
+        c.close()
+    finally:
+        broker.close()
+
+
+def test_crc32c_slice_by_8_matches_bytewise_tail():
+    # lengths straddling the 8-byte fast path, cross-checked against a
+    # reference byte-at-a-time implementation
+    import numpy as np
+    from deeplearning4j_tpu.datasets.kafka import _CRC32C_TABLES
+
+    def slow(data):
+        crc = 0xFFFFFFFF
+        for b in data:
+            crc = (crc >> 8) ^ _CRC32C_TABLES[0][(crc ^ b) & 0xFF]
+        return ~crc & 0xFFFFFFFF
+
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 8, 9, 63, 64, 1000):
+        data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        assert crc32c(data) == slow(data), n
