@@ -1,0 +1,65 @@
+"""Worker for the 2-process sharded-TBPTT test: the wrapper's fused-psum
+TBPTT segment loop is host-driven, so its collective schedule must stay in
+lock step across processes (a desync hangs — the failure mode
+``_batch_groups`` guards against).
+
+Usage: python multiproc_tbptt_worker.py <pid> <nproc> <port> <outdir>
+"""
+import sys
+import os
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), sys.argv[4])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from deeplearning4j_tpu.parallel import initialize_distributed, ParallelWrapper
+
+initialize_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                       process_id=pid)
+
+import numpy as np
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf import BackpropType
+
+conf = (NeuralNetConfiguration.builder().seed(7)
+        .updater(Sgd(learning_rate=5e-2))
+        .list()
+        .backprop_type(BackpropType.TruncatedBPTT)
+        .t_bptt_forward_length(4).t_bptt_backward_length(4)
+        .layer(LSTM(n_in=3, n_out=8, activation="tanh"))
+        .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+# identical full stream on both processes; each feeds only its local share
+rng = np.random.default_rng(1)
+batches = []
+for i in range(8):
+    f = rng.normal(size=(4, 8, 3)).astype(np.float32)     # T=8 → 2 segments
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 8))].astype(
+        np.float32)
+    m = (np.arange(8)[None, :] < rng.integers(4, 9, (4, 1))).astype(
+        np.float32)
+    batches.append(DataSet(f, l, features_mask=m, labels_mask=m))
+
+pw = ParallelWrapper.Builder(net).build()                 # global mesh: 4 dev
+eval_ds = DataSet.merge(batches)
+s0 = float(net.score(eval_ds))
+pw.fit(ListDataSetIterator(batches), epochs=3)
+s1 = float(net.score(eval_ds))
+
+flat = np.concatenate([np.asarray(x).ravel()
+                       for x in jax.tree_util.tree_leaves(net.params)])
+np.save(os.path.join(outdir, f"tbptt_params_{pid}.npy"), flat)
+with open(os.path.join(outdir, f"tbptt_result_{pid}.txt"), "w") as fh:
+    fh.write(f"{s0} {s1} {net.iteration_count}")
+print("worker", pid, "done", s0, "->", s1)
