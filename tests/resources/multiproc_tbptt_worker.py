@@ -57,9 +57,8 @@ s0 = float(net.score(eval_ds))
 pw.fit(ListDataSetIterator(batches), epochs=3)
 s1 = float(net.score(eval_ds))
 
-flat = np.concatenate([np.asarray(x).ravel()
-                       for x in jax.tree_util.tree_leaves(net.params)])
-np.save(os.path.join(outdir, f"tbptt_params_{pid}.npy"), flat)
+np.save(os.path.join(outdir, f"tbptt_params_{pid}.npy"),
+        np.asarray(net.params_flat()))
 with open(os.path.join(outdir, f"tbptt_result_{pid}.txt"), "w") as fh:
     fh.write(f"{s0} {s1} {net.iteration_count}")
 print("worker", pid, "done", s0, "->", s1)

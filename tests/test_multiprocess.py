@@ -24,22 +24,34 @@ def _free_port():
     return port
 
 
+def _run_workers(worker_path, tmp_path, port, n=2, timeout=540):
+    """Spawn n workers, wait, and assert all succeeded (killing survivors
+    when one hangs so a timeout cannot leak processes into the run)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers set their own config
+    procs = [subprocess.Popen(
+        [sys.executable, worker_path, str(pid), str(n), str(port),
+         str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(n)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+
 def test_two_process_training_identical_params(tmp_path):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_worker.py")
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # worker sets its own config
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    _run_workers(worker, tmp_path, port)
 
     p0 = np.load(tmp_path / "params_0.npy")
     p1 = np.load(tmp_path / "params_1.npy")
@@ -70,18 +82,7 @@ def test_two_process_distributed_nlp(tmp_path):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_nlp_worker.py")
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"nlp worker failed:\n{out[-4000:]}"
+    _run_workers(worker, tmp_path, port)
 
     w0 = np.load(tmp_path / "w2v_syn0_0.npy")
     w1 = np.load(tmp_path / "w2v_syn0_1.npy")
@@ -119,18 +120,7 @@ def test_shared_gradients_real_wire(tmp_path):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_wire_worker.py")
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"wire worker failed:\n{out[-4000:]}"
+    _run_workers(worker, tmp_path, port)
 
     p0 = np.load(tmp_path / "wire_params_0.npy")
     p1 = np.load(tmp_path / "wire_params_1.npy")
@@ -153,18 +143,7 @@ def test_two_process_sharded_tbptt(tmp_path):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_tbptt_worker.py")
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for pid in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    _run_workers(worker, tmp_path, port)
 
     p0 = np.load(tmp_path / "tbptt_params_0.npy")
     p1 = np.load(tmp_path / "tbptt_params_1.npy")
