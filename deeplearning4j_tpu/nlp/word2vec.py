@@ -118,10 +118,15 @@ class Word2Vec(SequenceVectors):
     def builder() -> "Word2Vec.Builder":
         return Word2Vec.Builder()
 
-    def __init__(self, **kw):
+    def __init__(self, *,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kw):
         super().__init__(**kw)
         self._iterator: Optional[SentenceIterator] = None
-        self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+        # constructor kwarg mirrors Builder.tokenizer_factory (e.g. a CJK
+        # factory with a user dictionary) so the short form works too
+        self._tokenizer: TokenizerFactory = (tokenizer_factory
+                                             or DefaultTokenizerFactory())
 
     def _sentences(self) -> Iterable[List[str]]:
         for sentence in self._iterator:

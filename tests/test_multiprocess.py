@@ -43,6 +43,7 @@ def _run_workers(worker_path, tmp_path, port, n=2, timeout=540):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                p.wait()          # reap: no zombies/open pipes left behind
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
 
