@@ -257,16 +257,26 @@ class CheckpointListener(TrainingListener):
         self.saved = self.checkpoints(directory)
         self._counter = 0
         for p in self.saved:
-            try:
-                self._counter = max(self._counter,
-                                    int(os.path.basename(p).split("-")[1]))
-            except (IndexError, ValueError):
-                pass
+            idx = self._index_of(p)
+            if idx is not None:
+                self._counter = max(self._counter, idx)
+        # orphaned .tmp from a hard crash mid-write: clean on adoption
+        for name in os.listdir(directory):
+            if name.startswith("checkpoint-") and name.endswith(".zip.tmp"):
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
+        # next-save threshold: iteration_count can advance by >1 per
+        # iteration_done (iterations(n) scans, TBPTT segments) — an exact
+        # modulo would fire at the lcm of stride and cadence instead
+        self._next_iter_save = self.every_iter
 
     # -- hooks ------------------------------------------------------------
     def iteration_done(self, model, iteration, score):
-        if self.every_iter and (iteration + 1) % self.every_iter == 0:
+        if self.every_iter and iteration + 1 >= self._next_iter_save:
             self._save(model, f"iter-{iteration + 1}")
+            self._next_iter_save = iteration + 1 + self.every_iter
 
     def on_epoch_end(self, model, epoch):
         if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
@@ -284,9 +294,10 @@ class CheckpointListener(TrainingListener):
             ModelSerializer.write_model(model, tmp,
                                         save_updater=self.save_updater)
             os.replace(tmp, path)  # atomic: a crash never leaves a torn file
-        except OSError as e:
-            # a failed save (disk full, permissions) must not abort the
-            # training loop — log and keep training; no torn files left
+        except Exception as e:
+            # a failed save (disk full, permissions, an unserializable
+            # config field) must not abort the training loop — log and
+            # keep training; no torn files left
             log.warning("CheckpointListener: save to %s failed: %s", path, e)
             try:
                 if os.path.exists(tmp):
@@ -305,13 +316,21 @@ class CheckpointListener(TrainingListener):
         return path
 
     @staticmethod
-    def checkpoints(directory):
-        """Checkpoint paths in save order (file index encodes it)."""
+    def _index_of(path):
+        try:
+            return int(os.path.basename(path).split("-")[1])
+        except (IndexError, ValueError):
+            return None
+
+    @classmethod
+    def checkpoints(cls, directory):
+        """Checkpoint paths in save order — sorted by the parsed numeric
+        file index (lexicographic order breaks past 99999 saves)."""
         if not os.path.isdir(directory):
             return []
-        names = sorted(n for n in os.listdir(directory)
-                       if n.startswith("checkpoint-") and n.endswith(".zip"))
-        return [os.path.join(directory, n) for n in names]
+        paths = [os.path.join(directory, n) for n in os.listdir(directory)
+                 if n.startswith("checkpoint-") and n.endswith(".zip")]
+        return sorted(paths, key=lambda p: (cls._index_of(p) or 0, p))
 
     @classmethod
     def last_checkpoint(cls, directory):

@@ -1,0 +1,123 @@
+"""Unit tests for bench.py's tunnel-recovery harness (round-3 VERDICT item
+1: the benchmark must survive a wedged axon tunnel — probe with backoff,
+isolate configs in subprocesses, persist partial results). The harness is
+what turns a recovered tunnel at driver time into numbers; these tests pin
+its logic without any TPU."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_await_backend_backoff_schedule(bench, monkeypatch):
+    """Probes retry with a growing (capped) backoff until the window is
+    spent; a recovering backend returns True immediately."""
+    sleeps = []
+    clock = {"t": 0.0}
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    def fake_monotonic():
+        return clock["t"]
+
+    calls = {"n": 0}
+
+    def fake_run(cmd, capture_output, timeout):
+        calls["n"] += 1
+        if calls["n"] >= 4:
+            return types.SimpleNamespace(returncode=0, stderr=b"")
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.time, "sleep", fake_sleep)
+    monkeypatch.setattr(bench.time, "monotonic", fake_monotonic)
+    import subprocess as sp
+    monkeypatch.setattr(sp, "run", fake_run)  # bench imports it lazily
+
+    assert bench._await_backend(max_wait_s=10_000) is True
+    assert calls["n"] == 4
+    assert sleeps == [60.0, 120.0, 240.0]        # doubling backoff
+
+    # window exhaustion: always-wedged backend gives False, no hang
+    calls["n"] = -10_000
+    sleeps.clear()
+    clock["t"] = 0.0
+    assert bench._await_backend(max_wait_s=500) is False
+    assert sum(sleeps) <= 500
+
+
+def test_run_one_subprocess_parses_result_line(bench, monkeypatch):
+    def fake_run(cmd, capture_output, timeout):
+        out = ("# some stderr-ish noise on stdout\n"
+               + json.dumps({"one": "lenet_mnist_images_per_sec",
+                             "value": 123.4}) + "\n")
+        return types.SimpleNamespace(returncode=0, stdout=out.encode(),
+                                     stderr=b"warning: xyz\n")
+
+    import subprocess as sp
+    monkeypatch.setattr(sp, "run", fake_run)
+    assert bench._run_one_subprocess("lenet_mnist_images_per_sec") == 123.4
+
+
+def test_run_one_subprocess_failure_and_timeout(bench, monkeypatch):
+    import subprocess as sp
+
+    monkeypatch.setattr(sp, "run", lambda *a, **k: types.SimpleNamespace(
+        returncode=1, stdout=b"", stderr=b"boom"))
+    assert bench._run_one_subprocess("x") is None
+
+    def raise_timeout(cmd, capture_output, timeout):
+        raise sp.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(sp, "run", raise_timeout)
+    assert bench._run_one_subprocess("x") is None
+
+
+def test_partial_results_persisted_per_config(bench, tmp_path, monkeypatch):
+    """_write_partial merges into BASELINE.json.published incrementally so
+    a later hang cannot lose earlier configs' numbers."""
+    doc = {"published": {"old_metric": 1.0}}
+    path = tmp_path / "BASELINE.json"
+    path.write_text(json.dumps(doc))
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path))
+    base_doc, base_val = bench._read_baseline()
+    assert base_val is None and base_doc["published"]["old_metric"] == 1.0
+
+    bench._write_partial(base_doc, {"resnet50_imagenet_images_per_sec": 42.0})
+    on_disk = json.loads(path.read_text())
+    assert on_disk["published"]["old_metric"] == 1.0
+    assert on_disk["published"]["resnet50_imagenet_images_per_sec"] == 42.0
+
+    bench._write_partial(base_doc, {"second_metric": 7.0})
+    on_disk = json.loads(path.read_text())
+    assert on_disk["published"]["second_metric"] == 7.0
+
+
+def test_headline_json_shape(bench, capsys):
+    bench._headline(2641.9, 2600.0)
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["metric"] == "resnet50_imagenet_images_per_sec"
+    assert doc["value"] == 2641.9
+    assert abs(doc["vs_baseline"] - 2641.9 / 2600.0) < 1e-3
+
+    bench._headline(None, None, error="wedged")
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["value"] is None and doc["error"] == "wedged"

@@ -166,6 +166,7 @@ def test_checkpoint_listener_adopts_existing_directory(tmp_path):
     """A fresh listener attached to a directory with pre-crash checkpoints
     must continue the file index (newest stays newest) and rotate the old
     files out (review finding: per-instance counter restarted at 0)."""
+    import os
     from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
                                     DataSet, Adam)
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
@@ -197,7 +198,8 @@ def test_checkpoint_listener_adopts_existing_directory(tmp_path):
                              save_every_n_epochs=0, keep_last=2)
     resumed.set_listeners(cl2)
     resumed.fit(ds)                                    # must be file 00004
-    files = [p.split("/")[-1] for p in CheckpointListener.checkpoints(d)]
+    files = [os.path.basename(p)
+             for p in CheckpointListener.checkpoints(d)]
     assert files[-1].startswith("checkpoint-00004-"), files
     assert len(files) == 2                             # old ones rotated out
     again = CheckpointListener.last_checkpoint(d)
