@@ -273,7 +273,7 @@ ALL_BENCHES = [
 ]
 
 
-def _await_backend(max_wait_s=None, probe_timeout=90) -> bool:
+def _await_backend(max_wait_s=None, probe_timeout=120) -> bool:
     """Guard against a wedged axon tunnel: PJRT client creation can hang
     FOREVER when the relay holds a stale lease (observed in rounds 3/4).
     Probe ``jax.devices()`` in a subprocess under a timeout, with a
@@ -308,7 +308,10 @@ def _await_backend(max_wait_s=None, probe_timeout=90) -> bool:
               f"elapsed): {msg}; retrying in {min(wait, remaining):.0f}s",
               file=sys.stderr)
         time.sleep(min(wait, remaining))
-        wait = min(wait * 2, 300.0)
+        # cap low: the round-4 tunnel FLAPPED (one transient recovery in
+        # hours of wedge) — frequent probes maximize the chance of catching
+        # an up-window, and each costs nothing while the backend is down
+        wait = min(wait * 2, 120.0)
 
 
 def _run_one_subprocess(name, timeout_s=2400):
