@@ -506,6 +506,13 @@ class NDArrayKafkaClient:
         for batch in part["batches"]:
             if not batch.is_control:             # skip transaction markers
                 for rec in batch.records:
+                    # the broker returns the WHOLE batch containing the
+                    # fetch offset: records before self.offset were already
+                    # delivered (mid-batch offsets happen after compaction
+                    # rewrites batch boundaries) — consumer contract says
+                    # discard them
+                    if batch.base_offset + rec.offset_delta < self.offset:
+                        continue
                     if rec.value is not None:    # skip tombstones
                         out.append(NDArrayMessage.decode(rec.value))
             # advance by lastOffsetDelta, NOT the surviving record count —

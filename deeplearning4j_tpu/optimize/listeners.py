@@ -243,11 +243,16 @@ class CheckpointListener(TrainingListener):
     deleted — set 0/None to keep everything)."""
 
     def __init__(self, directory: str, save_every_n_iterations: int = 0,
-                 save_every_n_epochs: int = 1, keep_last: int = 3,
-                 save_updater: bool = True):
+                 save_every_n_epochs: Optional[int] = None,
+                 keep_last: int = 3, save_updater: bool = True):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.every_iter = int(save_every_n_iterations or 0)
+        if save_every_n_epochs is None:
+            # default: epoch cadence only when no iteration cadence was
+            # requested — otherwise epoch saves would consume keep_last
+            # slots and evict the files the user actually asked for
+            save_every_n_epochs = 0 if self.every_iter else 1
         self.every_epoch = int(save_every_n_epochs or 0)
         self.keep_last = keep_last
         self.save_updater = save_updater

@@ -271,6 +271,13 @@ class PipelinedNetwork:
                     f"layer {i} sets a per-layer updater override; the "
                     f"pipelined step trains every partition with the "
                     f"network-level updater (v1)")
+            if getattr(lc, "aux_loss_weight", 0.0):
+                raise ValueError(
+                    f"layer {i} ({type(lc).__name__}) produces an "
+                    f"activation-dependent auxiliary loss "
+                    f"(aux_loss_weight={lc.aux_loss_weight}); the pipelined "
+                    f"step does not collect ctx['aux_loss'] (v1) — set "
+                    f"aux_loss_weight=0 or train unpipelined")
         if int(getattr(net.gc, "iterations", 1) or 1) > 1:
             import logging
             logging.getLogger(__name__).warning(

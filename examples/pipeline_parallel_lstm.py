@@ -26,7 +26,9 @@ net = MultiLayerNetwork(
                        num_layers=CELLS).conf()).init()
 
 n = len(jax.devices())
-pipe = 4 if n % 4 == 0 else max(1, n)
+body = CELLS - 1                 # identical middle cells available as stages
+pipe = max(s for s in range(1, min(n, body) + 1)
+           if n % s == 0 and body % s == 0)   # feasible stage count
 mesh = make_mesh(jax.devices(), axes=("pipe", "data"),
                  shape=(pipe, n // pipe))
 pp = pipeline_parallel_step(net, mesh, n_microbatches=4,

@@ -272,3 +272,26 @@ def test_crc32c_slice_by_8_matches_bytewise_tail():
     for n in (0, 1, 7, 8, 9, 63, 64, 1000):
         data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
         assert crc32c(data) == slow(data), n
+
+
+def test_poll_discards_records_below_fetch_offset():
+    """The broker returns the WHOLE batch containing the fetch offset; a
+    consumer positioned mid-batch must discard the records it has already
+    seen (review finding: Kafka consumer contract)."""
+    wire = RecordBatch([Record(NDArrayMessage.encode(
+        [np.full((2,), float(i), np.float32)])) for i in range(4)],
+        base_offset=10).encode()
+    resp = (struct.pack(">i", 0) + struct.pack(">i", 1)
+            + struct.pack(">h", 1) + b"t" + struct.pack(">i", 1)
+            + struct.pack(">ihqq", 0, 0, 14, -1) + struct.pack(">i", 0)
+            + struct.pack(">i", len(wire)) + wire)
+
+    client = NDArrayKafkaClient("127.0.0.1:1", "t")
+    client.offset = 12                      # mid-batch position
+    client._roundtrip = lambda api, ver, body: resp[4:] if False else resp
+    # _roundtrip returns the response body (correlation already stripped)
+    client._roundtrip = lambda api, ver, body: resp
+    msgs = client.poll()
+    # offsets 10, 11 discarded; 12, 13 delivered
+    assert [float(m[0][0]) for m in msgs] == [2.0, 3.0]
+    assert client.offset == 14

@@ -272,3 +272,26 @@ def test_pipeline_parallel_multi_layer_per_stage_and_training():
     l = np.eye(4, dtype=np.float32)[labels]
     losses = [float(pp.fit_batch(f, l)) for _ in range(40)]
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_pipeline_parallel_rejects_aux_loss_layers():
+    """MoE aux losses accumulate through the forward ctx, which the
+    pipelined step does not collect — must reject loudly (v1), not train
+    silently divergent semantics."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (MoEDenseLayer, OutputLayer)
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("relu").list()
+            .layer(MoEDenseLayer(n_in=6, n_out=8, num_experts=4, top_k=2,
+                                 aux_loss_weight=1e-2))
+            .layer(MoEDenseLayer(n_in=8, n_out=8, num_experts=4, top_k=2,
+                                 aux_loss_weight=1e-2))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    with pytest.raises(ValueError, match="aux"):
+        pipeline_parallel_step(net, mesh, n_microbatches=2)
