@@ -168,11 +168,22 @@ class SelfAttentionImpl(LayerImpl):
         idx = getattr(self, "index", None)
         carry = (ctx.get("rnn_state_in", {}).get(idx)
                  if ctx is not None and idx is not None else None)
+        from ...parallel.sequence import current_sp_axis
+        sp_axis = current_sp_axis()
         if carry is not None:
             o, new_carry = self._cached_attention(
                 q, k, v, carry, cd, key_mask=mask,
                 dropout_rate=c.dropout_rate, rng=rng, train=train)
             ctx.setdefault("rnn_state_out", {})[idx] = new_carry
+        elif sp_axis is not None:
+            # sequence-parallel step (parallel/sequence.py::
+            # sequence_parallel_step): this forward runs PER DEVICE inside
+            # shard_map with the time dim sharded over ``sp_axis`` — attend
+            # via the ring (flash kernel per block when shapes allow)
+            from ...parallel.sequence import sp_attend
+
+            o = sp_attend(q.astype(cd), k.astype(cd), v.astype(cd),
+                          sp_axis, bool(c.causal))
         else:
             o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train,
                     key_mask=mask)

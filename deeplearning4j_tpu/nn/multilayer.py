@@ -251,12 +251,19 @@ class MultiLayerNetwork:
         return loss + reg + aux, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _raw_update_core(self):
+    def _raw_update_core(self, grads_reduce=None):
         """Shared step core: loss → AD grads → gradient normalization →
         updater transform. Returns ``(updates, new_states, new_upd, loss,
         rnn_out)`` WITHOUT applying the update, so both ``_raw_step`` (apply
         in-graph) and ``_raw_update_step`` (ship the update through the
-        SHARED_GRADIENTS codec) stay in lock-step by construction."""
+        SHARED_GRADIENTS codec) stay in lock-step by construction.
+
+        ``grads_reduce(grads, loss, new_states) -> (grads, loss,
+        new_states)``: optional cross-device reduction hook applied right
+        after AD, BEFORE the minimize flip / normalization / updater —
+        the seam ``parallel.sequence.sequence_parallel_step`` uses to psum
+        time-sliced gradients while inheriting this core's remat/adapt/aux
+        behavior instead of duplicating it."""
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
@@ -275,6 +282,9 @@ class MultiLayerNetwork:
                 loss_fn = jax.checkpoint(loss_fn, policy=remat_policy())
             (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if grads_reduce is not None:
+                grads, loss, new_states = grads_reduce(grads, loss,
+                                                       new_states)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
             grads = normalize_gradients(grads, gn_mode, gn_thresh)

@@ -23,7 +23,8 @@ from .distributed import (ProcessLocalIterator, is_chief,
                           allgather_objects, DistributedDataSetLossCalculator,
                           DistributedEarlyStoppingTrainer)
 from .sequence import (ring_attention, ulysses_attention, full_attention,
-                       ring_flash_attention, ring_flash_supported)
+                       ring_flash_attention, ring_flash_supported,
+                       sequence_parallel_step)
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
                        PipelinedNetwork, pipeline_parallel_step,
@@ -44,6 +45,7 @@ __all__ = [
     "ProcessLocalIterator", "is_chief",
     "ring_attention", "ulysses_attention", "full_attention",
     "ring_flash_attention", "ring_flash_supported",
+    "sequence_parallel_step",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
     "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
     "PipelinedNetwork", "pipeline_parallel_step", "partition_network",

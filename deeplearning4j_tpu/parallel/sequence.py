@@ -318,6 +318,165 @@ def ring_flash_supported(T: int, n_shards: int, d: int) -> bool:
                  or _fa.supported(max(Tl, _fa.MIN_SEQ), d, 0.0, None)))
 
 
+import threading
+
+_SP_TLS = threading.local()
+
+
+def current_sp_axis():
+    """The sequence-parallel axis the CURRENT trace runs under, or None.
+    Set (trace-scoped, try/finally) by ``sequence_parallel_step``'s device
+    body — attention layers read it to route through the ring. A plain
+    attribute on layer impls would leak into later output()/fit() traces
+    and crash on the unbound axis name."""
+    return getattr(_SP_TLS, "axis", None)
+
+
+def sp_attend(q, k, v, axis: str, causal: bool):
+    """Per-device attention body for the sequence-parallel NET step: the
+    flash-in-ring path when the local shard suits the kernel (128-divisible,
+    head_dim ≤ 256, TPU or forced-interpret), else the dense-per-chunk ring.
+    Called from ``SelfAttentionLayer.forward`` inside ``shard_map`` —
+    q/k/v: [b, Tl, h, d] local shards."""
+    from ..ops import flash_attention as _fa
+
+    d = q.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+    Tl = q.shape[1]
+    flash_ok = (Tl % _fa.BLOCK == 0 and d <= 256
+                and (_fa._FORCE_INTERPRET or not _fa._interpret()))
+    if flash_ok:
+        return _ring_flash_inner(q, k, v, axis, causal, scale)
+    return _ring_inner(q, k, v, axis=axis, causal=causal, scale=scale)
+
+
+def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                           donate: bool = True):
+    """Container-level sequence parallelism: jit the network's train step
+    with the TIME dimension of inputs/labels/masks sharded over ``axis``
+    and ring(-flash) attention doing the cross-shard mixing.
+
+    Same ``(step, place)`` contract as
+    :func:`~deeplearning4j_tpu.parallel.tensor.tensor_parallel_step` —
+    params/updater state replicated, per-shard gradients ``pmean``-reduced
+    (equal shards ⇒ mean-of-means == the global-batch gradient, the same
+    argument the loss makes), so the sp net trains numerically like the
+    unsharded net.
+
+    v1 constraints (checked loudly): MultiLayerNetwork with NO
+    time-recurrent layers (LSTM scans cannot split the time dim — that is
+    what TBPTT is for), no global pooling over time, no masks at step time,
+    and the per-device attention is causal/dense exact via the ring. The
+    reference has nothing to map here (SURVEY §5: long context is
+    TBPTT-only); this is the net-new ``sp`` member completing container
+    integration for all five mesh axes."""
+    if not hasattr(net.conf, "layers"):
+        raise ValueError("sequence_parallel_step supports MultiLayerNetwork")
+    for i, lc in enumerate(net.conf.layers):
+        # validate the WRAPPED layer too (FrozenLayer/Bidirectional etc.
+        # carry the real config on .inner)
+        for cand in (lc, getattr(lc, "inner", None)):
+            if cand is None:
+                continue
+            name = type(cand).__name__
+            if name in ("LSTM", "GravesLSTM", "GravesBidirectionalLSTM",
+                        "SimpleRnn", "Bidirectional"):
+                raise ValueError(
+                    f"layer {i} ({name}) is time-recurrent; the time dim "
+                    f"cannot be sharded across devices — use TBPTT/dp for "
+                    f"RNNs")
+            if name == "GlobalPoolingLayer":
+                raise ValueError(
+                    f"layer {i} (GlobalPoolingLayer) reduces over the "
+                    f"sharded time dim; unsupported in the sp step (v1)")
+            if getattr(cand, "aux_loss_weight", 0.0):
+                raise ValueError(
+                    f"layer {i} ({name}) has an activation-dependent aux "
+                    f"loss; its token statistics do not decompose across "
+                    f"time shards (v1) — set aux_loss_weight=0")
+            if (getattr(cand, "dropout", None)
+                    or getattr(cand, "dropout_rate", 0.0)
+                    or name == "DropoutLayer"):
+                raise ValueError(
+                    f"layer {i} ({name}) uses dropout; the sp step's "
+                    f"replicated rng would draw the SAME mask on every time "
+                    f"shard (and attention-softmax dropout is not threaded "
+                    f"through the ring) — unsupported in v1")
+
+    n_shards = mesh.shape[axis]
+
+    # the framework's sequence losses SUM over time (mean over batch,
+    # reference convention) — a time shard therefore holds an additive
+    # SLICE of the loss, and the cross-shard reduction is psum. The l1/l2
+    # term rides inside _loss_fn identically on every shard, so the psum
+    # counts it n times; has_reg subtracts the (n-1) extra copies from
+    # both the loss and its gradient (reg is param-only — cheap).
+    has_reg = any(getattr(impl, "l1", 0) or getattr(impl, "l2", 0)
+                  or getattr(impl, "l1_bias", 0)
+                  or getattr(impl, "l2_bias", 0) for impl in net.impls)
+
+    def reg_fn(p):
+        r = 0.0
+        for i, impl in enumerate(net.impls):
+            r = r + impl.regularization(p[str(i)])
+        return r
+
+    def sp_reduce(grads, loss, new_states):
+        grads = lax.psum(grads, axis)            # time-sliced additive loss
+        loss = lax.psum(loss, axis)
+        if has_reg:
+            # the replicated l1/l2 term was psum'd n times; subtract the
+            # n-1 extra copies from the loss and its gradient (param-only)
+            def reg_loss(p):
+                return reg_fn(p)
+            reg_val, reg_grads = jax.value_and_grad(reg_loss)(
+                _sp_reduce_params[0])
+            extra = n_shards - 1
+            grads = jax.tree_util.tree_map(
+                lambda g, rg: g - extra * rg, grads, reg_grads)
+            loss = loss - extra * reg_val
+        # allowed layers are stateless today; pmean keeps any future
+        # float state replicated-consistent rather than silently racy
+        new_states = lax.pmean(new_states, axis)
+        return grads, loss, new_states
+
+    _sp_reduce_params = [None]                  # closed over by sp_reduce
+    core = net._raw_update_core(grads_reduce=sp_reduce)
+
+    def device_step(params, states, upd, it, rng, f, l):
+        # trace-scoped routing flag for SelfAttentionLayer (see
+        # current_sp_axis): set only while THIS body traces, so later
+        # output()/fit() traces keep the dense path
+        _sp_reduce_params[0] = params
+        _SP_TLS.axis = axis
+        try:
+            updates, new_states, new_upd, loss, _ = core(
+                params, states, upd, it, rng, f, l, None, None)
+        finally:
+            _SP_TLS.axis = None
+            _sp_reduce_params[0] = None
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p - u.astype(p.dtype), params, updates)
+        new_params = net._apply_constraints(new_params)
+        return new_params, new_states, new_upd, loss
+
+    repl = P()
+    tsh = P(None, axis)                          # [b, T, F] sharded on time
+    fn = shard_map(device_step, mesh=mesh,
+                   in_specs=(repl, repl, repl, repl, repl, tsh, tsh),
+                   out_specs=(repl, repl, repl, repl),
+                   check_vma=False)
+    step = jax.jit(fn, donate_argnums=(0, 2) if donate else ())
+
+    def place(model):
+        r = NamedSharding(mesh, P())
+        model.params = jax.device_put(model.params, r)
+        model.states = jax.device_put(model.states, r)
+        model.updater_state = jax.device_put(model.updater_state, r)
+
+    return step, place
+
+
 def full_attention(q, k, v, causal: bool = False):
     """Single-device reference (testing oracle)."""
     d = q.shape[-1]

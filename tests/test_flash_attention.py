@@ -374,3 +374,134 @@ def test_ring_flash_matches_full_attention():
     for a, b in zip(gr, gf):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_sequence_parallel_net_step_matches_unsharded():
+    """Container-level sequence parallelism (sequence_parallel_step): the
+    TIME-sharded net step — ring(-flash) attention inside shard_map,
+    psum-reduced time-sliced gradients, replicated-reg correction — must
+    equal the unsharded step's loss AND updated params (completes container
+    integration for the last of the five mesh axes)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer, DenseLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    def make(l2=1e-3):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-3))
+                .activation("identity").l2(l2).list()
+                .layer(SelfAttentionLayer(n_in=16, n_out=16, num_heads=2,
+                                          causal=True))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+                .layer(RnnOutputLayer(n_in=16, n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(0)
+    T = 4 * 128                      # local shard 128 → flash-in-ring path
+    f = rng.normal(size=(2, T, 16)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, T))].astype(
+        np.float32)
+
+    net_a = make()
+    step, place = sequence_parallel_step(net_a, mesh)
+    place(net_a)
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                            jnp.asarray(f), jnp.asarray(l))
+    net_b = make()
+    raw = jax.jit(net_b._raw_step(False))
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           jnp.asarray(f), jnp.asarray(l), None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-4)
+
+
+def test_sequence_parallel_step_rejects_recurrent_and_aux():
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (LSTM, RnnOutputLayer,
+                                                   MoEDenseLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(LSTM(n_in=4, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    with pytest.raises(ValueError, match="time-recurrent"):
+        sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)
+
+    conf2 = (NeuralNetConfiguration.builder().seed(1)
+             .updater(Sgd(learning_rate=0.1)).activation("identity").list()
+             .layer(MoEDenseLayer(n_in=4, n_out=8, num_experts=4, top_k=2,
+                                  aux_loss_weight=1e-2))
+             .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+             .build())
+    with pytest.raises(ValueError, match="aux"):
+        sequence_parallel_step(MultiLayerNetwork(conf2).init(), mesh)
+
+
+def test_sequence_parallel_flag_does_not_leak_to_dense_paths():
+    """sequence_parallel_step's routing flag is trace-scoped: after building
+    and running the sp step, output()/score() on the same net must use the
+    normal dense path, not crash on an unbound axis (review finding)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+    from deeplearning4j_tpu import DataSet
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-3)).activation("identity").list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
+                                      causal=True))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    step, place = sequence_parallel_step(net, mesh)
+    place(net)
+    rng = np.random.default_rng(9)
+    f = rng.normal(size=(2, 4 * 128, 8)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4 * 128))].astype(
+        np.float32)
+    net.params, net.states, net.updater_state, _ = step(
+        net.params, net.states, net.updater_state, jnp.asarray(0, jnp.int32),
+        jax.random.PRNGKey(0), jnp.asarray(f), jnp.asarray(l))
+    # dense-path entry points after sp training: must work unchanged
+    out = np.asarray(net.output(f[:, :64]))
+    assert out.shape == (2, 64, 3) and np.isfinite(out).all()
+    assert np.isfinite(float(net.score(DataSet(f[:, :64], l[:, :64]))))
+
+
+def test_sequence_parallel_step_rejects_dropout():
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("identity").list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
+                                      dropout_rate=0.1))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    with pytest.raises(ValueError, match="dropout"):
+        sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)
