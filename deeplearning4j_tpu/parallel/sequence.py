@@ -351,7 +351,7 @@ def sp_attend(q, k, v, axis: str, causal: bool):
 
 
 def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
-                           donate: bool = True):
+                           data_axis=None, donate: bool = True):
     """Container-level sequence parallelism: jit the network's train step
     with the TIME dimension of inputs/labels/masks sharded over ``axis``
     and ring(-flash) attention doing the cross-shard mixing.
@@ -369,7 +369,9 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     and the per-device attention is causal/dense exact via the ring. The
     reference has nothing to map here (SURVEY §5: long context is
     TBPTT-only); this is the net-new ``sp`` member completing container
-    integration for all five mesh axes."""
+    integration for all five mesh axes. ``data_axis``: optional second
+    mesh axis for combined DP×SP — the batch dim shards over it and the
+    gradient reduction becomes psum over time × pmean over batch."""
     if not hasattr(net.conf, "layers"):
         raise ValueError("sequence_parallel_step supports MultiLayerNetwork")
     for i, lc in enumerate(net.conf.layers):
@@ -424,6 +426,10 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     def sp_reduce(grads, loss, new_states):
         grads = lax.psum(grads, axis)            # time-sliced additive loss
         loss = lax.psum(loss, axis)
+        if data_axis is not None:
+            # batch-mean losses: shards over the data axis average
+            grads = lax.pmean(grads, data_axis)
+            loss = lax.pmean(loss, data_axis)
         if has_reg:
             # the replicated l1/l2 term was psum'd n times; subtract the
             # n-1 extra copies from the loss and its gradient (param-only)
@@ -438,6 +444,8 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
         # allowed layers are stateless today; pmean keeps any future
         # float state replicated-consistent rather than silently racy
         new_states = lax.pmean(new_states, axis)
+        if data_axis is not None:
+            new_states = lax.pmean(new_states, data_axis)
         return grads, loss, new_states
 
     _sp_reduce_params = [None]                  # closed over by sp_reduce
@@ -460,8 +468,11 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
         new_params = net._apply_constraints(new_params)
         return new_params, new_states, new_upd, loss
 
+    if data_axis is not None and data_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{data_axis}' axis: "
+                         f"{mesh.axis_names}")
     repl = P()
-    tsh = P(None, axis)                          # [b, T, F] sharded on time
+    tsh = P(data_axis, axis)          # [b, T, F]: batch × time sharded
     fn = shard_map(device_step, mesh=mesh,
                    in_specs=(repl, repl, repl, repl, repl, tsh, tsh),
                    out_specs=(repl, repl, repl, repl),
