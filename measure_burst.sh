@@ -1,0 +1,32 @@
+#!/bin/bash
+# One TPU up-window → every round-4 measurement, in priority order.
+# Each stage is independently useful; a re-wedge mid-burst keeps earlier
+# results (bench.py persists per-config partials itself).
+cd "$(dirname "$0")"
+echo "=== burst start $(date -u +%H:%M:%S) ==="
+
+echo "--- stage 1: headline ResNet50 ---"
+BENCH_PROBE_WINDOW_S=${BURST_WINDOW:-14400} python bench.py
+rc=$?
+echo "headline rc=$rc"
+if [ $rc -ne 0 ]; then
+  echo "backend never came up; burst aborted"
+  exit $rc
+fi
+
+echo "--- stage 2: bench --all ($(date -u +%H:%M:%S)) ---"
+BENCH_PROBE_WINDOW_S=600 python bench.py --all
+echo "all rc=$?"
+
+echo "--- stage 3: flash hardware check ($(date -u +%H:%M:%S)) ---"
+python perf_flash_check.py
+echo "flash rc=$?"
+
+echo "--- stage 4: LSTM roofline ($(date -u +%H:%M:%S)) ---"
+python perf_lstm.py roofline
+echo "roofline rc=$?"
+
+echo "--- stage 5: LSTM sweep ($(date -u +%H:%M:%S)) ---"
+python perf_lstm.py sweep
+echo "sweep rc=$?"
+echo "=== burst done $(date -u +%H:%M:%S) ==="
