@@ -199,9 +199,11 @@ class ComputationGraph:
         return total + reg + aux, (new_states, ctx.get("rnn_state_out"))
 
     # ---------------------------------------------------------- train step
-    def _raw_update_core(self):
+    def _raw_update_core(self, grads_reduce=None):
         """Shared step core (see MultiLayerNetwork._raw_update_core): returns
-        ``(updates, new_states, new_upd, loss, rnn_out)`` without applying."""
+        ``(updates, new_states, new_upd, loss, rnn_out)`` without applying.
+        ``grads_reduce``: optional cross-device reduction hook (same seam as
+        the MLN core — ``sequence_parallel_step`` uses it)."""
         gn_mode = self.gc.gradient_normalization
         gn_thresh = self.gc.gradient_normalization_threshold
         minimize = self.gc.minimize
@@ -220,6 +222,9 @@ class ComputationGraph:
                 loss_fn = jax.checkpoint(loss_fn, policy=remat_policy())
             (loss, (new_states, rnn_out)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if grads_reduce is not None:
+                grads, loss, new_states = grads_reduce(grads, loss,
+                                                       new_states)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
             grads = normalize_gradients(grads, gn_mode, gn_thresh)

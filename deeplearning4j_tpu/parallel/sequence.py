@@ -371,10 +371,20 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     TBPTT-only); this is the net-new ``sp`` member completing container
     integration for all five mesh axes. ``data_axis``: optional second
     mesh axis for combined DP×SP — the batch dim shards over it and the
-    gradient reduction becomes psum over time × pmean over batch."""
-    if not hasattr(net.conf, "layers"):
-        raise ValueError("sequence_parallel_step supports MultiLayerNetwork")
-    for i, lc in enumerate(net.conf.layers):
+    gradient reduction becomes psum over time × pmean over batch.
+
+    Works for MultiLayerNetwork AND ComputationGraph (the graph step takes
+    tuples of input/label streams; every stream's time dim shards)."""
+    is_graph = not hasattr(net.conf, "layers")
+    if is_graph and not hasattr(net.conf, "vertices"):
+        raise ValueError("sequence_parallel_step supports MultiLayerNetwork "
+                         "and ComputationGraph")
+    layer_items = (list(net.conf.vertices.items()) if is_graph
+                   else list(enumerate(net.conf.layers)))
+    _TIME_COLLAPSING = ("GlobalPoolingLayer", "LastTimeStepVertex",
+                        "LastTimeStep", "ReshapeVertex",
+                        "DuplicateToTimeSeriesVertex")
+    for i, lc in layer_items:
         # validate the WRAPPED layer too (FrozenLayer/Bidirectional etc.
         # carry the real config on .inner)
         for cand in (lc, getattr(lc, "inner", None)):
@@ -387,10 +397,11 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                     f"layer {i} ({name}) is time-recurrent; the time dim "
                     f"cannot be sharded across devices — use TBPTT/dp for "
                     f"RNNs")
-            if name == "GlobalPoolingLayer":
+            if name in _TIME_COLLAPSING:
                 raise ValueError(
-                    f"layer {i} (GlobalPoolingLayer) reduces over the "
-                    f"sharded time dim; unsupported in the sp step (v1)")
+                    f"layer/vertex {i} ({name}) collapses or reshapes the "
+                    f"sharded time dim — per-shard results would silently "
+                    f"diverge; unsupported in the sp step (v1)")
             if getattr(cand, "aux_loss_weight", 0.0):
                 raise ValueError(
                     f"layer {i} ({name}) has an activation-dependent aux "
@@ -413,14 +424,17 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     # term rides inside _loss_fn identically on every shard, so the psum
     # counts it n times; has_reg subtracts the (n-1) extra copies from
     # both the loss and its gradient (reg is param-only — cheap).
+    impl_items = (list(net.impls.items()) if is_graph
+                  else [(str(i), im) for i, im in enumerate(net.impls)])
     has_reg = any(getattr(impl, "l1", 0) or getattr(impl, "l2", 0)
                   or getattr(impl, "l1_bias", 0)
-                  or getattr(impl, "l2_bias", 0) for impl in net.impls)
+                  or getattr(impl, "l2_bias", 0)
+                  for _, impl in impl_items)
 
     def reg_fn(p):
         r = 0.0
-        for i, impl in enumerate(net.impls):
-            r = r + impl.regularization(p[str(i)])
+        for key, impl in impl_items:
+            r = r + impl.regularization(p[key])
         return r
 
     def sp_reduce(grads, loss, new_states):
@@ -452,6 +466,15 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     core = net._raw_update_core(grads_reduce=sp_reduce)
 
     def device_step(params, states, upd, it, rng, f, l):
+        # every input/label stream must be [b, T, ...]: the time-dim spec is
+        # applied as a pytree prefix, so a rank-2 static/label stream would
+        # silently get its FEATURE dim sharded instead
+        for leaf in jax.tree_util.tree_leaves((f, l)):
+            if leaf.ndim < 3:
+                raise ValueError(
+                    f"sp step streams must be rank-3 [b, T, ...] (got shape "
+                    f"{leaf.shape}); static side-inputs / non-temporal "
+                    f"labels are unsupported in v1")
         # trace-scoped routing flag for SelfAttentionLayer (see
         # current_sp_axis): set only while THIS body traces, so later
         # output()/fit() traces keep the dense path

@@ -552,3 +552,53 @@ def test_sequence_parallel_step_dp_sp_composition():
                     jax.tree_util.tree_leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-3, atol=3e-4)
+
+
+def test_sequence_parallel_step_computation_graph():
+    """sequence_parallel_step on a ComputationGraph (tuple streams, vertex
+    validation/reg by name): sp step == unsharded step, incl. l2."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, Adam
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer, DenseLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    def make():
+        g = (NeuralNetConfiguration.builder().seed(5)
+             .updater(Adam(learning_rate=1e-3))
+             .activation("identity").l2(1e-3).graph_builder()
+             .add_inputs("in"))
+        g.add_layer("attn", SelfAttentionLayer(n_in=16, n_out=16, num_heads=2,
+                                               causal=True), "in")
+        g.add_layer("ff", DenseLayer(n_in=16, n_out=16, activation="relu"),
+                    "attn")
+        g.add_layer("out", RnnOutputLayer(n_in=16, n_out=4,
+                                          activation="softmax",
+                                          loss="mcxent"), "ff")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(0)
+    T = 4 * 128
+    f = rng.normal(size=(2, T, 16)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, T))].astype(
+        np.float32)
+
+    net_a = make()
+    step, place = sequence_parallel_step(net_a, mesh)
+    place(net_a)
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                            (jnp.asarray(f),), (jnp.asarray(l),))
+    net_b = make()
+    raw = jax.jit(net_b._raw_step())
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           (jnp.asarray(f),), (jnp.asarray(l),), None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-4)
