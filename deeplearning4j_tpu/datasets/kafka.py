@@ -40,6 +40,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .dataset import DataSet, DataSetIterator
+
 # ------------------------------------------------------------------- crc32c
 _CRC32C_POLY = 0x82F63B78
 _CRC32C_TABLES = [[0] * 256 for _ in range(8)]
@@ -526,3 +528,46 @@ class NDArrayKafkaClient:
                 self._sock.close()
             finally:
                 self._sock = None
+
+
+class KafkaDataSetIterator(DataSetIterator):
+    """``DataSetIterator`` over a real Kafka topic: each record's value is an
+    ``NDArrayMessage`` of (features, labels[, masks]) — the reference's
+    record→DataSet conversion role (``streaming/conversion``) against the
+    real wire protocol instead of the in-process broker. Polls until
+    ``num_batches`` (None → until a poll returns nothing after
+    ``max_empty_polls`` tries)."""
+
+    def __init__(self, client: NDArrayKafkaClient,
+                 num_batches: Optional[int] = None, convert=None,
+                 max_empty_polls: int = 3):
+        self.client = client
+        self.num_batches = num_batches
+        self.convert = convert
+        self.max_empty_polls = max_empty_polls
+        self._queue: List = []
+        self._seen = 0
+
+    def __next__(self):
+        if self.num_batches is not None and self._seen >= self.num_batches:
+            raise StopIteration
+        empty = 0
+        while not self._queue:
+            msgs = self.client.poll()
+            if msgs:
+                self._queue.extend(msgs)
+                break
+            empty += 1
+            if empty >= self.max_empty_polls:
+                raise StopIteration
+        parts = self._queue.pop(0)
+        self._seen += 1
+        if self.convert is not None:
+            return self.convert(parts)
+        return DataSet(*parts[:4])
+
+    def reset(self):
+        self._seen = 0  # the topic offset does not rewind; counting restarts
+
+    def async_supported(self):
+        return True
