@@ -568,6 +568,3 @@ class KafkaDataSetIterator(DataSetIterator):
 
     def reset(self):
         self._seen = 0  # the topic offset does not rewind; counting restarts
-
-    def async_supported(self):
-        return True

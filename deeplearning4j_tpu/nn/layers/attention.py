@@ -23,17 +23,27 @@ def mha(q, k, v, causal, compute_dtype, dropout_rate=0.0, rng=None, train=False,
     Long sequences route through the Pallas flash-attention kernel
     (``ops/flash_attention.py``): blockwise online softmax, O(T) memory
     instead of materializing the [b, h, T, T] logits — key-padding masks
-    included (streamed through the kernel). The dense path below remains
-    the oracle and the fallback (dropout / odd lengths).
+    AND train-time attention dropout included (both run in-kernel; the
+    dropout mask is regenerated blockwise from a counter-hash PRNG). The
+    dense path below remains the oracle and the fallback (short or
+    non-block-divisible sequences).
     """
     from ...ops import flash_attention as _fa
 
     T, d = q.shape[1], q.shape[-1]
-    if (q.shape == k.shape and _fa.supported(T, d, dropout_rate if train
-                                             else 0.0, key_mask)):
+    rate = dropout_rate if (train and rng is not None) else 0.0
+    if q.shape == k.shape and _fa.supported(T, d, rate, key_mask):
+        seed = None
+        if rate > 0.0:
+            # per-step scalar seed for the in-kernel counter-hash dropout
+            # PRNG (derived from the layer rng, so each train step draws a
+            # fresh mask exactly like the dense path's jax.random.bernoulli)
+            seed = jax.random.randint(rng, (), 0, jnp.iinfo(jnp.int32).max,
+                                      dtype=jnp.int32)
         return _fa.flash_attention(
             q.astype(compute_dtype), k.astype(compute_dtype),
-            v.astype(compute_dtype), causal=causal, key_mask=key_mask)
+            v.astype(compute_dtype), causal=causal, key_mask=key_mask,
+            dropout_rate=rate, dropout_seed=seed)
     visible = None
     if causal:
         T, S = q.shape[1], k.shape[1]

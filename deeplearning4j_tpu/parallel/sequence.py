@@ -185,11 +185,11 @@ def _ring_flash_fwd_loop(q, k, v, axis, causal, scale):
         blk = (p - i) % n
 
         def diag(_):
-            o, lse = _fa._fwd(qb, kc, vc, None, True, scale)
+            o, lse = _fa._fwd(qb, kc, vc, None, None, True, scale, 0.0)
             return o, lse[..., 0]
 
         def full(_):
-            o, lse = _fa._fwd(qb, kc, vc, None, False, scale)
+            o, lse = _fa._fwd(qb, kc, vc, None, None, False, scale, 0.0)
             return o, lse[..., 0]
 
         def skip(_):

@@ -117,13 +117,15 @@ def test_self_attention_layer_uses_flash_for_long_seq():
 
 def test_supported_routing_contract():
     """Routing rules: no flash off-TPU (unless tests force interpret), no
-    flash below MIN_SEQ on hardware, no dropout/key-mask/odd-length."""
+    flash below MIN_SEQ on hardware / odd lengths; dropout and [b, T] key
+    masks run IN-kernel (no dense fallback)."""
     # inside this module's autouse fixture _FORCE_INTERPRET is True:
     assert fa.supported(256, 64, 0.0, None)
     assert not fa.supported(250, 64, 0.0, None)     # not block-divisible
     assert not fa.supported(256, 512, 0.0, None)    # head dim too large
-    assert not fa.supported(256, 64, 0.1, None)     # dropout in softmax
-    assert not fa.supported(256, 64, 0.0, object())  # key padding mask
+    assert fa.supported(256, 64, 0.1, None)         # in-kernel dropout
+    assert not fa.supported(256, 64, 1.0, None)     # degenerate rate
+    assert not fa.supported(256, 64, 0.0, object())  # non-[b,T] mask object
     # without forced interpret on the CPU test backend: never supported
     fa._FORCE_INTERPRET = False
     try:

@@ -1,0 +1,158 @@
+"""In-kernel flash-attention dropout vs a dense oracle fed the EXACT mask.
+
+The kernels never materialize the [T, T] keep mask — they regenerate it
+blockwise from (seed, bh, qpos, kpos) via the counter-hash PRNG. The tests
+materialize the same mask with ``fa.dropout_keep_mask`` (same arithmetic,
+full-range iotas) and check the flash forward AND custom-VJP gradients
+against a dense reference using that mask — so the online-softmax dropout
+algebra (undropped denominator, dropped accumulator, unchanged delta) is
+verified end to end, in interpret mode on CPU (the same int32 ops the TPU
+runs; no TPU-only PRNG primitive is involved)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeplearning4j_tpu.ops.flash_attention as fa
+from deeplearning4j_tpu.nn.layers.attention import mha
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = fa._FORCE_INTERPRET
+    fa._FORCE_INTERPRET = True
+    yield
+    fa._FORCE_INTERPRET = old
+
+
+def _qkv(b=1, T=256, h=2, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, T, h, d)), jnp.float32)
+                 for _ in range(3))
+
+
+def _dense_with_mask(q, k, v, keep_bh, rate, causal, key_mask=None):
+    """Dense attention applying the GIVEN [b*h, T, T] keep mask to the
+    normalized probabilities — the oracle for the in-kernel dropout."""
+    b, T, h, d = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(d))
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    keep = keep_bh.reshape(b, h, T, T)
+    p = p * keep / (1.0 - rate)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("rate", [0.1, 0.5])
+def test_dropout_forward_matches_masked_oracle(causal, rate):
+    q, k, v = _qkv()
+    b, T, h, d = q.shape
+    seed = 1234
+    out = fa.flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                             dropout_seed=seed)
+    keep = fa.dropout_keep_mask(b * h, T, T, seed, rate)
+    want = _dense_with_mask(q, k, v, keep, rate, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_dropout_grads_match_masked_oracle(causal):
+    q, k, v = _qkv(b=1, T=256, h=1, d=16, seed=3)
+    b, T, h, d = q.shape
+    rate, seed = 0.3, 99
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                               dropout_seed=seed)
+        return jnp.sum(o ** 2)
+
+    keep = fa.dropout_keep_mask(b * h, T, T, seed, rate)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_with_mask(q, k, v, keep, rate, causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, want in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(want),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_dropout_with_key_mask_matches_oracle():
+    """Dropout composes with the in-kernel key-padding mask."""
+    q, k, v = _qkv(b=2, T=256, h=1, d=16, seed=5)
+    b, T = q.shape[0], q.shape[1]
+    rate, seed = 0.25, 7
+    key_mask = jnp.asarray(
+        np.repeat(np.arange(T)[None, :] < [[200], [256]], 1, 0), jnp.float32)
+    out = fa.flash_attention(q, k, v, causal=False, key_mask=key_mask,
+                             dropout_rate=rate, dropout_seed=seed)
+    keep = fa.dropout_keep_mask(b * q.shape[2], T, T, seed, rate)
+    want = _dense_with_mask(q, k, v, keep, rate, False, key_mask=key_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dropout_deterministic_and_seed_sensitive():
+    q, k, v = _qkv()
+    a = fa.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=11)
+    b = fa.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=11)
+    c = fa.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=12)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-6
+
+
+def test_keep_mask_statistics():
+    """Marginal keep probability ≈ 1 - rate, and the mask decorrelates
+    across rows/cols (the hash must not stripe)."""
+    rate = 0.3
+    keep = np.asarray(fa.dropout_keep_mask(4, 256, 256, 42, rate))
+    n = keep.size
+    sd = np.sqrt(rate * (1 - rate) / n)
+    assert abs(keep.mean() - (1 - rate)) < 5 * sd
+    # row/col means individually binomial: no row or column collapses
+    assert abs(keep.mean(axis=-1) - (1 - rate)).max() < 0.15
+    assert abs(keep.mean(axis=-2) - (1 - rate)).max() < 0.15
+
+
+def test_seed_traced_under_jit():
+    """The seed may be a traced value (per-step dropout under one compiled
+    step — no recompile per seed)."""
+    q, k, v = _qkv(b=1, T=256, h=1, d=16)
+
+    @jax.jit
+    def f(s):
+        return fa.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=s)
+
+    a = f(jnp.int32(1))
+    b = f(jnp.int32(2))
+    assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-6
+
+
+def test_mha_routes_training_dropout_to_flash(monkeypatch):
+    """mha with train-time dropout now routes through the flash kernel
+    (the last dense-fallback trigger is gone)."""
+    calls = {}
+    real = fa.flash_attention
+
+    def spy(*a, **kw):
+        calls["rate"] = kw.get("dropout_rate")
+        calls["seed"] = kw.get("dropout_seed")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa, "flash_attention", spy)
+    q, k, v = _qkv(b=1, T=256, h=1, d=16)
+    out = mha(q, k, v, causal=True, compute_dtype=jnp.float32,
+              dropout_rate=0.4, rng=jax.random.PRNGKey(0), train=True)
+    assert calls["rate"] == 0.4 and calls["seed"] is not None
+    assert np.isfinite(np.asarray(out)).all()
+    # eval mode: no dropout arguments reach the kernel
+    mha(q, k, v, causal=True, compute_dtype=jnp.float32,
+        dropout_rate=0.4, rng=jax.random.PRNGKey(0), train=False)
+    assert calls["rate"] == 0.0
