@@ -201,9 +201,9 @@ def test_pipeline_parallel_step_partition():
                                loss="mcxent"))
             .build())
     net = MultiLayerNetwork(conf).init()
-    assert partition_network(net, 2) == (1, 4)   # 4 identical middles
-    assert partition_network(net, 4) == (1, 4)
-    with pytest.raises(ValueError, match="homogeneous"):
+    assert partition_network(net, 2) == (1, 4, 1)   # 4 identical middles
+    assert partition_network(net, 4) == (1, 4, 1)
+    with pytest.raises(ValueError, match="periodic"):
         partition_network(net, 8)
 
 
@@ -295,3 +295,152 @@ def test_pipeline_parallel_rejects_aux_loss_layers():
     mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
     with pytest.raises(ValueError, match="aux"):
         pipeline_parallel_step(net, mesh, n_microbatches=2)
+
+
+def _dense_bn_net(seed=11, n_blocks=3, feat=16):
+    """Entry Dense + n_blocks × (Dense→BatchNorm) + Output: a PERIOD-2 body
+    with BatchNorm state inside it."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                                   BatchNormalization)
+
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+         .layer(DenseLayer(n_in=6, n_out=feat)))
+    for _ in range(n_blocks):
+        b = (b.layer(DenseLayer(n_in=feat, n_out=feat))
+             .layer(BatchNormalization(n_in=feat, n_out=feat)))
+    b = b.layer(OutputLayer(n_in=feat, n_out=4, activation="softmax",
+                            loss="mcxent"))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def test_partition_network_periodic_blocks():
+    """partition_network finds period-2 Dense→BatchNorm blocks (v2: stacked
+    BLOCKS pipeline, not just runs of one identical layer)."""
+    from deeplearning4j_tpu.parallel import partition_network
+
+    net = _dense_bn_net(n_blocks=3)
+    # layers: [D(6,16), D,BN, D,BN, D,BN, Out] — lag-2 run covers layers
+    # 1..6 (len 6), trimmed to 2 stages × 1 block = 4 layers
+    assert partition_network(net, 2) == (1, 4, 2)
+
+
+def test_pipeline_parallel_batchnorm_body_matches_scan_oracle():
+    """BatchNorm INSIDE the pipelined body (v2 stateful stages): loss,
+    updated params AND final running stats must equal a hand-rolled
+    per-microbatch scan oracle with the same GPipe semantics (batch stats
+    per microbatch, running stats folded in microbatch order)."""
+    import jax
+    from jax import lax
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    net = _dense_bn_net(n_blocks=3)
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    M = 2
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=M)
+    assert (pp.start, pp.body_len, pp.period) == (1, 4, 2)
+
+    rng = np.random.default_rng(4)
+    f = rng.normal(size=(8, 6)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    loss_pp = float(pp.fit_batch(f, l))
+
+    # oracle: scan microbatches through ALL layers sequentially (states
+    # thread in microbatch order), mean loss, SGD update
+    impls, n = net.impls, len(net.impls)
+    f_mb = jnp.asarray(f).reshape(M, -1, 6)
+    l_mb = jnp.asarray(l).reshape(M, -1, 4)
+
+    def total_loss(params, states):
+        def mb(st, xy):
+            x, y = xy
+            new_st = dict(st)
+            for i in range(n - 1):
+                x, ns = impls[i].forward(params[str(i)], st[str(i)], x,
+                                         train=True, rng=None, mask=None,
+                                         ctx={})
+                new_st[str(i)] = ns
+            loss = impls[-1].loss_on(params[str(n - 1)], st[str(n - 1)], x,
+                                     y, mask=None, train=True, rng=None)
+            return new_st, loss
+        st_fin, losses = lax.scan(mb, states, (f_mb, l_mb))
+        return jnp.mean(losses), st_fin
+
+    (loss_ref, st_fin), grads = jax.value_and_grad(
+        total_loss, has_aux=True)(net.params, net.states)
+    np.testing.assert_allclose(loss_pp, float(loss_ref), rtol=1e-5)
+
+    lr = 0.05
+    exported = pp.export_params()
+    for k in net.params:
+        for name in net.params[k]:
+            want = np.asarray(net.params[k][name]) - lr * np.asarray(
+                grads[k][name])
+            np.testing.assert_allclose(np.asarray(exported[k][name]), want,
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"{k}/{name}")
+    stats = pp.export_states()
+    for k in st_fin:
+        for name in st_fin[k]:
+            np.testing.assert_allclose(
+                np.asarray(stats[k][name]), np.asarray(st_fin[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"state {k}/{name}")
+
+
+def test_pipeline_parallel_batchnorm_body_trains():
+    """The stateful pipelined step is a real training loop: loss falls and
+    the BatchNorm running stats move off their init."""
+    import jax
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    net = _dense_bn_net(seed=2, n_blocks=2)
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    rng = np.random.default_rng(9)
+    f = rng.normal(size=(16, 6)).astype(np.float32)
+    labels = (f[:, 0] - f[:, 2] > 0).astype(int)
+    l = np.eye(4, dtype=np.float32)[labels]
+    losses = [float(pp.fit_batch(f, l)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    stats = pp.export_states()
+    bn_idx = str(pp.start + 1)  # first BN in the body
+    assert np.abs(np.asarray(stats[bn_idx]["mean"])).max() > 1e-4
+
+
+def test_pipeline_parallel_stateful_dp_pp_state_reconciled():
+    """DP×PP with a stateful body: each data shard folds BatchNorm stats
+    from its own microbatch shard; the step must reconcile them (pmean over
+    the data axis — the reference ParallelWrapper's worker-state averaging).
+    With each data shard fed IDENTICAL rows, the reconciled stats must equal
+    the PP-only run on one shard's worth of data exactly."""
+    import jax
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    rng = np.random.default_rng(21)
+    half = rng.normal(size=(4, 6)).astype(np.float32)   # M=2 × mb/2=2 rows
+    lhalf = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+    # [M=2, mb=4] with the mb dim sharded over data=2: rows [0:2] go to
+    # shard 0 and [2:4] to shard 1 within each microbatch — duplicate them
+    f = np.concatenate([half[0:2], half[0:2], half[2:4], half[2:4]])
+    l = np.concatenate([lhalf[0:2], lhalf[0:2], lhalf[2:4], lhalf[2:4]])
+
+    net_pp = _dense_bn_net(seed=13, n_blocks=2)
+    mesh_pp = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp_only = pipeline_parallel_step(net_pp, mesh_pp, n_microbatches=2)
+    pp_only.fit_batch(np.concatenate([half[0:2], half[2:4]]),
+                      np.concatenate([lhalf[0:2], lhalf[2:4]]))
+    want = pp_only.export_states()
+
+    net_dp = _dense_bn_net(seed=13, n_blocks=2)
+    mesh_dp = make_mesh(jax.devices()[:4], axes=("pipe", "data"),
+                        shape=(2, 2))
+    dp_pp = pipeline_parallel_step(net_dp, mesh_dp, n_microbatches=2,
+                                   data_axis="data")
+    dp_pp.fit_batch(f, l)
+    got = dp_pp.export_states()
+    for k in want:
+        for name in want[k]:
+            np.testing.assert_allclose(
+                np.asarray(got[k][name]), np.asarray(want[k][name]),
+                rtol=2e-5, atol=1e-6, err_msg=f"state {k}/{name}")
