@@ -42,6 +42,19 @@ log = logging.getLogger(__name__)
 _tm = jax.tree_util.tree_map
 
 
+def fused_softmax_skip_set(conf, impls):
+    """Output-layer vertices whose forwards the loss pass SKIPS: ``loss_on``
+    consumes their *input* activations so the fused softmax/xent path
+    applies to preoutput. Only safe when nothing downstream consumes the
+    output activation. Shared by ``ComputationGraph._loss_fn`` and the
+    pipeline-parallel head (``parallel/pipeline.py``) so the rule cannot
+    diverge between the two loss paths."""
+    consumed = {i for ins in conf.vertex_inputs.values() for i in ins}
+    return frozenset(n for n in conf.network_outputs
+                     if hasattr(impls.get(n), "loss_on")
+                     and n not in consumed)
+
+
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -163,13 +176,7 @@ class ComputationGraph:
     def _loss_fn(self, params, states, inputs, labels, input_masks, label_masks,
                  train, rng, rnn_state_in=None):
         conf = self.conf
-        # skip output-layer forwards: loss_on consumes their *input*
-        # activations so the fused softmax/xent path applies to preoutput.
-        # Only safe when nothing downstream consumes the output activation.
-        consumed = {i for ins in conf.vertex_inputs.values() for i in ins}
-        out_set = frozenset(n for n in conf.network_outputs
-                            if hasattr(self.impls.get(n), "loss_on")
-                            and n not in consumed)
+        out_set = fused_softmax_skip_set(conf, self.impls)
         acts, new_states, masks, ctx = self._apply_graph(
             params, states, inputs, input_masks, train, rng, skip=out_set,
             rnn_state_in=rnn_state_in)

@@ -27,8 +27,9 @@ from .sequence import (ring_attention, ulysses_attention, full_attention,
                        sequence_parallel_step)
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
-                       PipelinedNetwork, pipeline_parallel_step,
-                       partition_network,
+                       PipelinedNetwork, PipelinedGraph,
+                       pipeline_parallel_step,
+                       partition_network, partition_graph,
                        stack_stage_params)
 from .expert import EXPERT_AXIS, expert_rules, expert_parallel_step
 
@@ -48,7 +49,8 @@ __all__ = [
     "sequence_parallel_step",
     "megatron_rules", "tensor_parallel_step", "param_shardings",
     "PIPELINE_AXIS", "GPipe", "spmd_pipeline", "stack_stage_params",
-    "PipelinedNetwork", "pipeline_parallel_step", "partition_network",
+    "PipelinedNetwork", "PipelinedGraph", "pipeline_parallel_step",
+    "partition_network", "partition_graph",
     "EXPERT_AXIS", "expert_rules", "expert_parallel_step",
     "allgather_objects", "DistributedDataSetLossCalculator",
     "DistributedEarlyStoppingTrainer",

@@ -242,6 +242,28 @@ def _layer_confs_equal(a, b):
             and dataclasses.asdict(a) == dataclasses.asdict(b))
 
 
+def _best_periodic_run(confs, n_stages: int, max_period: int):
+    """Longest lag-p periodic run over a list of layer configs, trimmed to a
+    multiple of ``p * n_stages``: returns (offset, usable_len, period) with
+    usable_len == 0 when nothing fits. Smaller periods win ties."""
+    n = len(confs)
+    best = (0, 0, 1)                          # (offset, usable_len, period)
+    for p in range(1, max(1, min(max_period, n // max(1, n_stages))) + 1):
+        j = 0
+        while j + p < n:
+            if not _layer_confs_equal(confs[j], confs[j + p]):
+                j += 1
+                continue
+            a = j                              # maximal lag-p match run
+            while j + p < n and _layer_confs_equal(confs[j], confs[j + p]):
+                j += 1
+            run = (j + p) - a                  # segment [a, a + run)
+            usable = (run // (p * n_stages)) * (p * n_stages)
+            if usable > best[1]:
+                best = (a, usable, p)
+    return best
+
+
 def partition_network(net, n_stages: int, max_period: int = 8):
     """Find ``(start, length, period)`` of the body to pipeline: the longest
     PERIODIC run of layer configs — ``layers[j] == layers[j + period]``
@@ -252,23 +274,8 @@ def partition_network(net, n_stages: int, max_period: int = 8):
     preserving the SPMD stage-homogeneity rule. Everything before the run is
     the replicated entry, everything after (plus any trimmed tail) the
     replicated head. Smaller periods win ties (simplest stage program)."""
-    layers = net.conf.layers
-    n = len(layers)
-    best = (0, 0, 1)                          # (start, usable_len, period)
-    for p in range(1, max(1, min(max_period, n // max(1, n_stages))) + 1):
-        j = 0
-        while j + p < n:
-            if not _layer_confs_equal(layers[j], layers[j + p]):
-                j += 1
-                continue
-            a = j                              # maximal lag-p match run
-            while j + p < n and _layer_confs_equal(layers[j], layers[j + p]):
-                j += 1
-            run = (j + p) - a                  # segment [a, a + run)
-            usable = (run // (p * n_stages)) * (p * n_stages)
-            if usable > best[1]:
-                best = (a, usable, p)
-    start, body, period = best
+    start, body, period = _best_periodic_run(net.conf.layers, n_stages,
+                                             max_period)
     if body < n_stages:
         raise ValueError(
             f"No periodic run of ≥ {n_stages} repeated layers/blocks to map "
@@ -278,7 +285,197 @@ def partition_network(net, n_stages: int, max_period: int = 8):
     return start, body, period
 
 
-class PipelinedNetwork:
+def _graph_consumers(conf):
+    """vertex/input name → list of vertex names consuming it."""
+    consumers = {}
+    for name, ins in conf.vertex_inputs.items():
+        for i in ins:
+            consumers.setdefault(i, []).append(name)
+    return consumers
+
+
+def partition_graph(cg, n_stages: int, max_period: int = 8):
+    """ComputationGraph counterpart of :func:`partition_network`: find the
+    best pipelinable CHAIN of layer vertices. A chain is a maximal path
+    v₀ → v₁ → … where every vᵢ is a single-input Layer vertex, every
+    interior vᵢ has exactly one consumer (no branches escape the chain) and
+    none is a network output; the chain's layer configs are then trimmed to
+    the longest lag-p periodic run (same rule as the MLN partition).
+    Returns (chain_names list, period)."""
+    conf = cg.conf
+    from ..nn.conf.layers import Layer
+
+    consumers = _graph_consumers(conf)
+
+    def chainable(name):
+        v = conf.vertices.get(name)
+        return (isinstance(v, Layer)
+                and len(conf.vertex_inputs.get(name, ())) == 1
+                and name not in conf.network_outputs
+                and conf.input_preprocessors.get(name) is None)
+
+    chains, seen = [], set()
+    for name in cg.topo:
+        if name in seen or not chainable(name):
+            continue
+        # only start where the predecessor cannot extend the chain backward
+        prev = conf.vertex_inputs[name][0]
+        if (chainable(prev) and consumers.get(prev, []) == [name]):
+            continue
+        chain, cur = [name], name
+        seen.add(name)
+        while True:
+            cons = consumers.get(cur, [])
+            if len(cons) != 1 or not chainable(cons[0]):
+                break
+            cur = cons[0]
+            chain.append(cur)
+            seen.add(cur)
+        chains.append(chain)
+
+    best = None                               # (names, period)
+    for chain in chains:
+        confs = [conf.vertices[n] for n in chain]
+        off, ln, p = _best_periodic_run(confs, n_stages, max_period)
+        if ln >= n_stages and (best is None or ln > len(best[0])):
+            best = (chain[off:off + ln], p)
+    if best is None:
+        raise ValueError(
+            f"No periodic chain of ≥ {n_stages} repeated layer vertices to "
+            f"map onto {n_stages} pipeline stages. Pipeline-parallel CGs "
+            f"need a linear run of repeated single-input layer vertices "
+            f"(e.g. stacked transformer blocks); use fewer stages or "
+            f"restructure the graph.")
+    return best
+
+
+class _PipelinedBase:
+    """Shared machinery for the container-level pipeline trainers
+    (:class:`PipelinedNetwork` for MultiLayerNetwork, :class:`PipelinedGraph`
+    for ComputationGraph): {entry, blocks, head} placement, the jitted
+    donated train step (microbatch split → loss+AD → updater → constraints),
+    and the container-layout import/export. Subclasses provide the
+    partitioning, the stage/entry/head forward pieces and the loss."""
+
+    def _init_common(self, net, mesh, n_microbatches, axis, data_axis):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+        if data_axis is not None and data_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{data_axis}' axis: "
+                             f"{mesh.axis_names}")
+        if int(getattr(net.gc, "iterations", 1) or 1) > 1:
+            import logging
+            logging.getLogger(__name__).warning(
+                "iterations(%s) is ignored under %s; each fit_batch applies "
+                "one optimizer iteration", net.gc.iterations,
+                type(self).__name__)
+        self.net = net
+        self.mesh = mesh
+        self.axis = axis
+        self.data_axis = data_axis
+        self.n_microbatches = int(n_microbatches)
+        self.n_stages = mesh.shape[axis]
+        self.updater = net.gc.updater
+        self._step = None
+        self.iteration_count = 0
+
+    def _check_layer_conf(self, where, lc):
+        if getattr(lc, "updater", None) is not None:
+            raise ValueError(
+                f"{where} sets a per-layer updater override; the pipelined "
+                f"step trains every partition with the network-level updater")
+        if getattr(lc, "aux_loss_weight", 0.0):
+            raise ValueError(
+                f"{where} ({type(lc).__name__}) produces an activation-"
+                f"dependent auxiliary loss (aux_loss_weight="
+                f"{lc.aux_loss_weight}); the pipelined step does not collect "
+                f"ctx['aux_loss'] — set aux_loss_weight=0 or train "
+                f"unpipelined")
+
+    # -- placement ---------------------------------------------------------
+    def _shardings(self):
+        repl = NamedSharding(self.mesh, P())
+        blk = NamedSharding(self.mesh, P(self.axis))
+        return {"entry": repl, "blocks": blk, "head": repl}
+
+    def _place(self, tree):
+        sh = self._shardings()
+        # host round-trip = genuine copy: the jitted step DONATES these
+        # buffers, and device_put with an equal sharding can alias — donation
+        # must never invalidate the source container's params
+        return {k: _tm(lambda p: jax.device_put(np.asarray(p), sh[k]),
+                       tree[k])
+                for k in tree}
+
+    # -- container-layout import/export ------------------------------------
+    def _from_layer_keyed(self, d):
+        return self._partition_tree(d)
+
+    def export_params(self):
+        """Back to the container's per-layer/vertex keying (for
+        ModelSerializer / evaluation on the unpipelined net)."""
+        return {k: _tm(np.asarray, v)
+                for k, v in self._to_layer_keyed(self.params).items()}
+
+    def export_states(self):
+        """Trained layer state (BatchNorm running stats, …) back to the
+        container's per-layer/vertex keying."""
+        return {k: _tm(np.asarray, v)
+                for k, v in self._to_layer_keyed(self.states).items()}
+
+    # -- the step ----------------------------------------------------------
+    def _build_step(self):
+        from ..optimize.updater import normalize_gradients
+
+        gn_mode = self.net.gc.gradient_normalization
+        gn_thresh = self.net.gc.gradient_normalization_threshold
+        minimize = self.net.gc.minimize
+        upd = self.updater
+        M = self.n_microbatches
+
+        def step(tree, states, upd_state, it, f, l):
+            mb = lambda t: _tm(
+                lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), t)
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(tree, states, mb(f), mb(l))
+            if not minimize:
+                grads = _tm(lambda g: -g, grads)
+            from ..nn.conf import GradientNormalization
+            if gn_mode not in (None, GradientNormalization.None_, "none"):
+                # per-layer normalization modes must see the container's
+                # per-layer grouping, not {entry, blocks, head}
+                grads = self._from_layer_keyed(normalize_gradients(
+                    self._to_layer_keyed(grads), gn_mode, gn_thresh))
+            updates, new_state = upd.apply(upd_state, grads, it)
+            new_tree = _tm(lambda p, u: p - u.astype(p.dtype), tree, updates)
+            new_tree = self._apply_constraints(new_tree)
+            return new_tree, new_states, new_state, loss
+
+        sh = self._shardings()
+        repl = NamedSharding(self.mesh, P())
+        dsh = (NamedSharding(self.mesh, P(self.data_axis))
+               if self.data_axis else repl)
+        return jax.jit(step, in_shardings=(sh, sh, sh, repl, dsh, dsh),
+                       out_shardings=(sh, sh, sh, repl),
+                       donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, f, l):
+        """One pipelined optimizer step on a (features, labels) batch — each
+        a single array (MultiLayerNetwork) or tuple of arrays
+        (ComputationGraph) whose leading dim divides into
+        ``n_microbatches`` equal chunks."""
+        if self._step is None:
+            self._step = self._build_step()
+        it = jnp.asarray(self.iteration_count, jnp.int32)
+        f = _tm(jnp.asarray, f)
+        l = _tm(jnp.asarray, l)
+        self.params, self.states, self.upd_state, loss = self._step(
+            self.params, self.states, self.upd_state, it, f, l)
+        self.iteration_count += 1
+        return loss
+
+
+class PipelinedNetwork(_PipelinedBase):
     """Train a ``MultiLayerNetwork``'s homogeneous middle as GPipe stages
     (VERDICT round-3 item 3: container-level pipeline parallelism).
 
@@ -313,38 +510,12 @@ class PipelinedNetwork:
     def __init__(self, net, mesh: Mesh, n_microbatches: int,
                  axis: str = PIPELINE_AXIS, data_axis: Optional[str] = None):
         if not hasattr(net.conf, "layers"):
-            raise ValueError("PipelinedNetwork supports MultiLayerNetwork")
+            raise ValueError("PipelinedNetwork supports MultiLayerNetwork; "
+                             "ComputationGraph pipelines via PipelinedGraph")
         for i, lc in enumerate(net.conf.layers):
-            if getattr(lc, "updater", None) is not None:
-                raise ValueError(
-                    f"layer {i} sets a per-layer updater override; the "
-                    f"pipelined step trains every partition with the "
-                    f"network-level updater (v1)")
-            if getattr(lc, "aux_loss_weight", 0.0):
-                raise ValueError(
-                    f"layer {i} ({type(lc).__name__}) produces an "
-                    f"activation-dependent auxiliary loss "
-                    f"(aux_loss_weight={lc.aux_loss_weight}); the pipelined "
-                    f"step does not collect ctx['aux_loss'] (v1) — set "
-                    f"aux_loss_weight=0 or train unpipelined")
-        if int(getattr(net.gc, "iterations", 1) or 1) > 1:
-            import logging
-            logging.getLogger(__name__).warning(
-                "iterations(%s) is ignored under PipelinedNetwork; each "
-                "fit_batch applies one optimizer iteration",
-                net.gc.iterations)
-        if axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
-        if data_axis is not None and data_axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{data_axis}' axis: "
-                             f"{mesh.axis_names}")
-        self.net = net
-        self.mesh = mesh
-        self.axis = axis
-        self.data_axis = data_axis
-        self.n_microbatches = int(n_microbatches)
-        S = mesh.shape[axis]
-        self.n_stages = S
+            self._check_layer_conf(f"layer {i}", lc)
+        self._init_common(net, mesh, n_microbatches, axis, data_axis)
+        S = self.n_stages
         self.start, self.body_len, self.period = partition_network(net, S)
         self.layers_per_stage = self.body_len // S
         self.repeats_per_stage = self.layers_per_stage // self.period
@@ -354,13 +525,10 @@ class PipelinedNetwork:
             if net.conf.preprocessor(i) is not None:
                 raise ValueError("preprocessors inside the pipelined body "
                                  "are not supported")
-        self.updater = net.gc.updater
         self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
                                        squeeze_stage=False,
                                        _needs_x_grad=self.start > 0,
                                        stateful=True)
-        self._step = None
-        self.iteration_count = 0
         # partitioned + placed params/states and mirrored updater state
         self.params = self._place(self._partition_tree(net.params))
         self.states = self._place(self._partition_tree(net.states))
@@ -381,32 +549,6 @@ class PipelinedNetwork:
             [net_tree[str(s + r * p + l)] for r in range(b // p)])
             for l in range(p)}
         return {"entry": entry, "blocks": blocks, "head": head}
-
-    def export_params(self):
-        """Back to the container's {layer-index: params} layout (for
-        ModelSerializer / evaluation on the unpipelined net)."""
-        return {k: _tm(np.asarray, v)
-                for k, v in self._to_layer_keyed(self.params).items()}
-
-    def export_states(self):
-        """Trained layer state (BatchNorm running stats, …) back to the
-        container's {layer-index: state} layout."""
-        return {k: _tm(np.asarray, v)
-                for k, v in self._to_layer_keyed(self.states).items()}
-
-    def _shardings(self):
-        repl = NamedSharding(self.mesh, P())
-        blk = NamedSharding(self.mesh, P(self.axis))
-        return {"entry": repl, "blocks": blk, "head": repl}
-
-    def _place(self, tree):
-        sh = self._shardings()
-        # host round-trip = genuine copy: the jitted step DONATES these
-        # buffers, and device_put with an equal sharding can alias — donation
-        # must never invalidate the source container's params
-        return {k: _tm(lambda p: jax.device_put(np.asarray(p), sh[k]),
-                       tree[k])
-                for k in tree}
 
     # -- forward pieces ----------------------------------------------------
     def _stage_fn(self, params_slice, state_slice, x):
@@ -528,13 +670,15 @@ class PipelinedNetwork:
         out.update({str(i): tree["head"][str(i)] for i in range(s + b, n)})
         return out
 
-    def _from_layer_keyed(self, d):
-        return self._partition_tree(d)
-
     def _layer_constraints(self, i):
         lc = self.net.conf.layers[i]
         return getattr(lc, "constraints", None) or \
             getattr(getattr(lc, "inner", None), "constraints", None)
+
+    def fit_batch(self, f, l):
+        """One pipelined step; user-facing conv features are NCHW and
+        adapted to internal NHWC exactly like ``MultiLayerNetwork.fit``."""
+        return super().fit_batch(self.net._adapt_input(jnp.asarray(f)), l)
 
     def _apply_constraints(self, tree):
         """Per-layer parameter constraints after each update — same timing
@@ -564,52 +708,259 @@ class PipelinedNetwork:
                 out["blocks"][str(l)] = stack_stage_params(per_rep)
         return out
 
-    def _build_step(self):
-        from ..optimize.updater import normalize_gradients
 
-        gn_mode = self.net.gc.gradient_normalization
-        gn_thresh = self.net.gc.gradient_normalization_threshold
-        minimize = self.net.gc.minimize
-        upd = self.updater
-        M = self.n_microbatches
+class PipelinedGraph(_PipelinedBase):
+    """Pipeline-parallel training for a ``ComputationGraph``: the best
+    periodic CHAIN of single-input layer vertices (found by
+    :func:`partition_graph` — e.g. stacked transformer blocks) becomes the
+    GPipe body; the rest of the DAG splits into the replicated entry
+    (everything the body does NOT depend on transitively downstream) and the
+    replicated head (everything downstream of the chain end), so skip
+    connections AROUND the body and multi-input/multi-output graphs work.
+    Entry/head run per microbatch (vmapped when stateless, scanned when
+    stateful); losses follow the container's multi-output sum with the
+    fused-softmax skip. Same GPipe-standard caveat as
+    :class:`PipelinedNetwork`: batch statistics are per microbatch."""
 
-        def step(tree, states, upd_state, it, f, l):
-            f_mb = f.reshape((M, f.shape[0] // M) + f.shape[1:])
-            l_mb = l.reshape((M, l.shape[0] // M) + l.shape[1:])
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(tree, states, f_mb, l_mb)
-            if not minimize:
-                grads = _tm(lambda g: -g, grads)
-            from ..nn.conf import GradientNormalization
-            if gn_mode not in (None, GradientNormalization.None_, "none"):
-                # per-layer normalization modes must see the container's
-                # per-layer grouping, not {entry, blocks, head}
-                grads = self._from_layer_keyed(normalize_gradients(
-                    self._to_layer_keyed(grads), gn_mode, gn_thresh))
-            updates, new_state = upd.apply(upd_state, grads, it)
-            new_tree = _tm(lambda p, u: p - u.astype(p.dtype), tree, updates)
-            new_tree = self._apply_constraints(new_tree)
-            return new_tree, new_states, new_state, loss
+    def __init__(self, net, mesh: Mesh, n_microbatches: int,
+                 axis: str = PIPELINE_AXIS, data_axis: Optional[str] = None):
+        conf = net.conf
+        if not hasattr(conf, "vertices"):
+            raise ValueError("PipelinedGraph needs a ComputationGraph")
+        from ..nn.conf.layers import Layer
 
-        sh = self._shardings()
-        repl = NamedSharding(self.mesh, P())
-        dsh = (NamedSharding(self.mesh, P(self.data_axis))
-               if self.data_axis else repl)
-        return jax.jit(step, in_shardings=(sh, sh, sh, repl, dsh, dsh),
-                       out_shardings=(sh, sh, sh, repl),
-                       donate_argnums=(0, 1, 2))
+        for name, v in conf.vertices.items():
+            if isinstance(v, Layer):
+                self._check_layer_conf(f"vertex '{name}'", v)
+        self._init_common(net, mesh, n_microbatches, axis, data_axis)
+        self.body, self.period = partition_graph(net, self.n_stages)
+        self.body_len = len(self.body)
+        self.layers_per_stage = self.body_len // self.n_stages
+        self.repeats_per_stage = self.layers_per_stage // self.period
+        self.body_impls = [net.impls[n] for n in self.body[:self.period]]
+        body_set = set(self.body)
+        # head = everything downstream of the chain end; entry = the rest
+        consumers = _graph_consumers(conf)
+        reach, stack = set(), [self.body[-1]]
+        while stack:
+            for c in consumers.get(stack.pop(), ()):
+                if c not in reach:
+                    reach.add(c)
+                    stack.append(c)
+        self.head_names = [n for n in net.topo
+                           if n in reach and n not in body_set]
+        self.entry_names = [n for n in net.topo
+                            if n not in reach and n not in body_set]
+        self.body_input = conf.vertex_inputs[self.body[0]][0]
+        from ..nn.graph import fused_softmax_skip_set
+        self._skip_outputs = fused_softmax_skip_set(conf, net.impls)
+        # outputs NOT downstream of the body (auxiliary heads fed from the
+        # entry): loss still computed, but their params/state live in the
+        # entry tree. An entry-side output with running state updates
+        # (update_state, e.g. CenterLoss) cannot update exactly per
+        # microbatch from the head pass — reject loudly.
+        self._entry_outputs = frozenset(n for n in conf.network_outputs
+                                        if n not in reach
+                                        and n not in body_set)
+        for n in self._entry_outputs:
+            impl = net.impls.get(n)
+            if (impl is not None and hasattr(impl, "update_state")
+                    and jax.tree_util.tree_leaves(net.states.get(n, {}))):
+                raise ValueError(
+                    f"auxiliary output '{n}' on the entry side carries "
+                    f"running state (update_state); train unpipelined or "
+                    f"restructure so it sits downstream of the body")
+        self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
+                                       squeeze_stage=False,
+                                       _needs_x_grad=True, stateful=True)
+        self.params = self._place(self._partition_tree(net.params))
+        self.states = self._place(self._partition_tree(net.states))
+        self.upd_state = self._place(self.updater.init_state(self.params))
 
-    def fit_batch(self, f, l):
-        """One pipelined optimizer step on a (features, labels) batch whose
-        leading dim divides into ``n_microbatches`` equal chunks."""
-        if self._step is None:
-            self._step = self._build_step()
-        it = jnp.asarray(self.iteration_count, jnp.int32)
-        self.params, self.states, self.upd_state, loss = self._step(
-            self.params, self.states, self.upd_state, it, jnp.asarray(f),
-            jnp.asarray(l))
-        self.iteration_count += 1
-        return loss
+    # -- param/state layout ------------------------------------------------
+    def _partition_tree(self, net_tree):
+        p = self.period
+        entry = {n: net_tree[n] for n in self.entry_names
+                 if n in net_tree}
+        head = {n: net_tree[n] for n in self.head_names if n in net_tree}
+        blocks = {str(l): stack_stage_params(
+            [net_tree[self.body[r * p + l]]
+             for r in range(self.body_len // p)])
+            for l in range(p)}
+        return {"entry": entry, "blocks": blocks, "head": head}
+
+    def _to_layer_keyed(self, tree):
+        p = self.period
+        out = dict(tree["entry"])
+        for r in range(self.body_len // p):
+            for l in range(p):
+                out[self.body[r * p + l]] = _tm(lambda q: q[r],
+                                                tree["blocks"][str(l)])
+        out.update(tree["head"])
+        return out
+
+    # -- forward pieces ----------------------------------------------------
+    def _stage_fn(self, params_slice, state_slice, x):
+        new_state = {str(l): state_slice[str(l)] for l in range(self.period)}
+        for j in range(self.repeats_per_stage):
+            for l, impl in enumerate(self.body_impls):
+                p_j = _tm(lambda q: q[j], params_slice[str(l)])
+                s_j = _tm(lambda q: q[j], new_state[str(l)])
+                x, ns = impl.forward(p_j, s_j, x, train=True, rng=None,
+                                     mask=None, ctx={})
+                new_state[str(l)] = _tm(lambda buf, v: buf.at[j].set(v),
+                                        new_state[str(l)], ns)
+        return x, new_state
+
+    def _apply_vertices(self, names, params, states, acts, ctx):
+        """Run the given vertices (already topo-ordered) functionally over
+        ``acts``; returns (acts, new_states) for the sub-DAG."""
+        from ..nn.conf.layers import Layer
+
+        conf = self.net.conf
+        new_st = dict(states)
+        acts = dict(acts)
+        for name in names:
+            if name in self._skip_outputs:
+                continue
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, Layer):
+                x = xs[0]
+                pre = conf.input_preprocessors.get(name)
+                if pre is not None:
+                    x = pre(x, ctx)
+                impl = self.net.impls[name]
+                y, ns = impl.forward(params[name], states[name], x,
+                                     train=True, rng=None, mask=None,
+                                     ctx=ctx)
+                new_st[name] = ns
+                acts[name] = y
+            else:
+                acts[name] = v.forward(xs, ctx)
+        return acts, new_st
+
+    def _entry_apply(self, params, states, inputs_mb):
+        """Entry sub-DAG per microbatch → stacked activations for every
+        entry vertex (the head may consume any of them — skip connections
+        around the body)."""
+        conf = self.net.conf
+
+        def step(st, inputs):
+            acts = dict(zip(conf.network_inputs, inputs))
+            ctx = {"inputs": acts, "input_masks": {}}
+            acts, new_st = self._apply_vertices(self.entry_names, params, st,
+                                                acts, ctx)
+            return new_st, acts
+
+        if not jax.tree_util.tree_leaves(states):
+            return states, jax.vmap(lambda i: step(states, i)[1])(inputs_mb)
+        return lax.scan(step, states, inputs_mb)
+
+    def _head_apply(self, params, states, entry_params, entry_states,
+                    entry_acts, feats, l_mb):
+        """Head sub-DAG + the container's multi-output summed loss per
+        microbatch; returns (final head state, per-microbatch losses).
+        Entry-side auxiliary outputs resolve their params from
+        ``entry_params`` (their state is empty — checked at construction)."""
+        conf = self.net.conf
+        impls = self.net.impls
+
+        def step(st, xy):
+            acts, feat, labels = xy
+            acts = dict(acts)
+            acts[self.body[-1]] = feat
+            ctx = {"inputs": {k: acts.get(k) for k in conf.network_inputs},
+                   "input_masks": {}}
+            acts, new_st = self._apply_vertices(self.head_names, params, st,
+                                                acts, ctx)
+            total = 0.0
+            for out_name, lbl in zip(conf.network_outputs, labels):
+                impl = impls.get(out_name)
+                if impl is None or not hasattr(impl, "loss_on"):
+                    raise ValueError(f"Output vertex '{out_name}' is not an "
+                                     f"output layer")
+                entry_side = out_name in self._entry_outputs
+                p_o = (entry_params if entry_side else params)[out_name]
+                s_o = (entry_states if entry_side else st)[out_name]
+                x = acts[conf.vertex_inputs[out_name][0]]
+                pre = conf.input_preprocessors.get(out_name)
+                if pre is not None:
+                    x = pre(x, ctx)
+                total = total + impl.loss_on(p_o, s_o, x, lbl, mask=None,
+                                             train=True, rng=None)
+                if not entry_side and hasattr(impl, "update_state"):
+                    new_st[out_name] = impl.update_state(
+                        s_o, jax.lax.stop_gradient(x), lbl)
+            return new_st, total
+
+        if not jax.tree_util.tree_leaves(states):
+            return states, jax.vmap(
+                lambda a, f, l: step(states, (a, f, l))[1])(
+                    entry_acts, feats, l_mb)
+        return lax.scan(step, states, (entry_acts, feats, l_mb))
+
+    def _loss(self, tree, states, inputs_mb, labels_mb):
+        p = self.period
+        entry_st, entry_acts = self._entry_apply(tree["entry"],
+                                                 states["entry"], inputs_mb)
+        feats, blocks_st = self._pipeline(tree["blocks"], states["blocks"],
+                                          entry_acts[self.body_input])
+        head_st, losses = self._head_apply(tree["head"], states["head"],
+                                           tree["entry"], states["entry"],
+                                           entry_acts, feats, labels_mb)
+        loss = jnp.mean(losses)
+        reg = 0.0
+        for part, names in (("entry", self.entry_names),
+                            ("head", self.head_names)):
+            for n in names:
+                impl = self.net.impls.get(n)
+                if impl is not None:
+                    reg = reg + impl.regularization(tree[part][n])
+        for r in range(self.body_len // p):
+            for l in range(p):
+                reg = reg + self.body_impls[l].regularization(
+                    _tm(lambda q: q[r], tree["blocks"][str(l)]))
+        return loss + reg, {"entry": entry_st, "blocks": blocks_st,
+                            "head": head_st}
+
+    def _apply_constraints(self, tree):
+        from ..nn.conf.dropout import apply_constraints
+
+        def cons_of(name):
+            v = self.net.conf.vertices[name]
+            return getattr(v, "constraints", None) or \
+                getattr(getattr(v, "inner", None), "constraints", None)
+
+        out = {"entry": dict(tree["entry"]), "blocks": dict(tree["blocks"]),
+               "head": dict(tree["head"])}
+        for part in ("entry", "head"):
+            for n in list(out[part]):
+                cons = cons_of(n)
+                if cons:
+                    out[part][n] = apply_constraints(cons, out[part][n])
+        for l in range(self.period):
+            cons = cons_of(self.body[l])
+            if cons:
+                per_rep = [apply_constraints(cons,
+                                             _tm(lambda q: q[r],
+                                                 tree["blocks"][str(l)]))
+                           for r in range(self.body_len // self.period)]
+                out["blocks"][str(l)] = stack_stage_params(per_rep)
+        return out
+
+    def fit_batch(self, inputs, labels):
+        """One pipelined step; ``inputs``/``labels`` are tuples of arrays
+        (the ComputationGraph convention) — single arrays are wrapped.
+        User-facing conv inputs are NCHW (the container boundary rule) and
+        adapted to internal NHWC exactly like ``ComputationGraph.fit``."""
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        if not isinstance(labels, (tuple, list)):
+            labels = (labels,)
+        inputs = self.net._adapt_inputs(tuple(jnp.asarray(i)
+                                              for i in inputs))
+        return super().fit_batch(tuple(inputs), tuple(labels))
 
 
 def pipeline_parallel_step(net, mesh: Mesh, n_microbatches: int = 4,
@@ -617,8 +968,11 @@ def pipeline_parallel_step(net, mesh: Mesh, n_microbatches: int = 4,
                            data_axis: Optional[str] = None):
     """Container-level entry: partition ``net``'s homogeneous middle into
     GPipe stages over ``mesh[axis]`` and return a :class:`PipelinedNetwork`
-    ready to ``fit_batch``. (Reference frame: the reference has no pipeline
+    (MultiLayerNetwork) or :class:`PipelinedGraph` (ComputationGraph) ready
+    to ``fit_batch``. (Reference frame: the reference has no pipeline
     parallelism at all — SURVEY.md §2.4; this is the net-new ``pp`` member
-    of the dp/tp/pp/sp/ep family, now reachable from a real container
+    of the dp/tp/pp/sp/ep family, reachable from BOTH real containers
     instead of hand-written block functions.)"""
+    if hasattr(net.conf, "vertices"):
+        return PipelinedGraph(net, mesh, n_microbatches, axis, data_axis)
     return PipelinedNetwork(net, mesh, n_microbatches, axis, data_axis)

@@ -444,3 +444,136 @@ def test_pipeline_parallel_stateful_dp_pp_state_reconciled():
             np.testing.assert_allclose(
                 np.asarray(got[k][name]), np.asarray(want[k][name]),
                 rtol=2e-5, atol=1e-6, err_msg=f"state {k}/{name}")
+
+
+def _chain_graph(n_mid=4, feat=16, skip=False, seed=17):
+    """CG: in → d0 → [mid]*n_mid → (optional ElementWise skip with d0) →
+    out. The mid run is the pipelinable chain."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, ComputationGraph, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+    from deeplearning4j_tpu import InputType
+
+    gb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(Sgd(learning_rate=0.05)).activation("tanh")
+          .graph_builder().add_inputs("in")
+          .add_layer("d0", DenseLayer(n_out=feat, activation="relu"), "in"))
+    prev = "d0"
+    for i in range(n_mid):
+        gb = gb.add_layer(f"mid{i}", DenseLayer(n_out=feat), prev)
+        prev = f"mid{i}"
+    if skip:
+        gb = gb.add_vertex("sum", ElementWiseVertex("add"), "d0", prev)
+        prev = "sum"
+    gb = (gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), prev)
+          .set_outputs("out").set_input_types(InputType.feed_forward(8)))
+    return ComputationGraph(gb.build()).init()
+
+
+def test_partition_graph_finds_chain():
+    from deeplearning4j_tpu.parallel import partition_graph
+
+    net = _chain_graph(n_mid=4)
+    names, period = partition_graph(net, 2)
+    assert names == ["mid0", "mid1", "mid2", "mid3"] and period == 1
+    # a skip consumer around the body must not break chain detection
+    net2 = _chain_graph(n_mid=4, skip=True)
+    names2, _ = partition_graph(net2, 2)
+    assert names2 == ["mid0", "mid1", "mid2", "mid3"]
+
+
+@pytest.mark.parametrize("skip", [False, True])
+def test_pipelined_graph_matches_unpipelined_step(skip):
+    """PipelinedGraph loss + updated params == the unpipelined CG step
+    (stateless body → microbatch-mean equals full-batch loss), INCLUDING a
+    skip connection around the pipelined body."""
+    import jax
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    net = _chain_graph(n_mid=4, skip=skip)
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    assert pp.body == ["mid0", "mid1", "mid2", "mid3"]
+    if skip:
+        assert "sum" in pp.head_names and "d0" in pp.entry_names
+
+    rng = np.random.default_rng(23)
+    f = rng.normal(size=(8, 8)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    loss_pp = float(pp.fit_batch(f, l))
+
+    raw = jax.jit(net._raw_step(False))
+    p2, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(1),
+                             (jnp.asarray(f),), (jnp.asarray(l),),
+                             None, None)
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
+    exported = pp.export_params()
+    for k in p2:
+        for name in p2[k]:
+            np.testing.assert_allclose(
+                np.asarray(exported[k][name]), np.asarray(p2[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
+
+
+def test_pipelined_graph_trains():
+    import jax
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    net = _chain_graph(n_mid=4, skip=True, seed=5)
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    rng = np.random.default_rng(31)
+    f = rng.normal(size=(16, 8)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[(f[:, 0] > 0).astype(int)]
+    losses = [float(pp.fit_batch(f, l)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_pipelined_graph_aux_output_from_entry():
+    """A multi-output CG whose second (auxiliary) output hangs off the ENTRY
+    branch — not downstream of the pipelined body — must train with the
+    summed loss matching the unpipelined step (inception-aux-head shape)."""
+    import jax
+    from deeplearning4j_tpu import (NeuralNetConfiguration, ComputationGraph,
+                                    Sgd, InputType)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    gb = (NeuralNetConfiguration.builder().seed(3)
+          .updater(Sgd(learning_rate=0.05)).activation("tanh")
+          .graph_builder().add_inputs("in")
+          .add_layer("d0", DenseLayer(n_out=12, activation="relu"), "in")
+          .add_layer("aux", OutputLayer(n_out=3, activation="softmax",
+                                        loss="mcxent"), "d0"))
+    prev = "d0"
+    for i in range(4):
+        gb = gb.add_layer(f"mid{i}", DenseLayer(n_out=12), prev)
+        prev = f"mid{i}"
+    gb = (gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), prev)
+          .set_outputs("out", "aux").set_input_types(InputType.feed_forward(8)))
+    net = ComputationGraph(gb.build()).init()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    assert "aux" in pp._entry_outputs
+
+    rng = np.random.default_rng(41)
+    f = rng.normal(size=(8, 8)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    loss_pp = float(pp.fit_batch(f, (l, l)))
+
+    raw = jax.jit(net._raw_step(False))
+    p2, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(1),
+                             (jnp.asarray(f),), (jnp.asarray(l),
+                                                 jnp.asarray(l)),
+                             None, None)
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
+    exported = pp.export_params()
+    for k in p2:
+        for name in p2[k]:
+            np.testing.assert_allclose(
+                np.asarray(exported[k][name]), np.asarray(p2[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
