@@ -42,10 +42,20 @@ _tm = jax.tree_util.tree_map
 def spmd_pipeline(stage_fn: Callable[..., Any],
                   mesh: Mesh, axis: str = PIPELINE_AXIS,
                   data_axis: Optional[str] = None, squeeze_stage: bool = True,
-                  _needs_x_grad: bool = False, stateful: bool = False):
+                  _needs_x_grad: bool = False, stateful: bool = False,
+                  with_masks: bool = False, with_rng: bool = False):
     """Build ``pipelined(stacked_params, xs) -> ys`` (stateless) or
     ``pipelined(stacked_params, stacked_state, xs) -> (ys, new_state)``
     (``stateful=True``).
+
+    ``with_masks=True`` adds a ``masks`` argument ([M, mb, ...] like ``xs``,
+    no stage transform): at tick t, stage s receives the mask of the
+    microbatch it is processing (t − s) — how padded-sequence masking rides
+    the schedule. ``with_rng=True`` adds a PRNG ``key`` argument; each tick
+    hands ``stage_fn`` a key folded per (stage, microbatch), giving
+    dropout/weight-noise inside the pipeline the same per-microbatch
+    freshness as the container step. The extra arguments are appended to
+    ``stage_fn``'s signature in the order (…, x[, mask][, key]).
 
     ``stacked_params``: pytree whose leaves carry a leading stage dim of
     extent S = mesh.shape[axis] (sharded over ``axis``). ``xs``: microbatches
@@ -71,7 +81,7 @@ def spmd_pipeline(stage_fn: Callable[..., Any],
     stages."""
     S = mesh.shape[axis]
 
-    def per_device(params, state, xs):
+    def per_device(params, state, xs, masks, key):
         if squeeze_stage:
             params = _tm(lambda p: p[0], params)  # [1, ...] local slice → stage
             if stateful:
@@ -95,14 +105,25 @@ def spmd_pipeline(stage_fn: Callable[..., Any],
             x_t = jnp.where(t < M, xs[jnp.minimum(t, M - 1)],
                             jnp.zeros_like(xs[0]))
             inp = jnp.where(idx == 0, x_t, buf)
+            args = [inp]
+            mi = jnp.clip(t - idx, 0, M - 1)   # microbatch this stage holds
+            if with_masks:
+                args.append(None if masks is None
+                            else _tm(lambda m: m[mi], masks))
+            if with_rng:
+                # distinct stream per (stage, microbatch) — folding by mi
+                # (not t) keeps a microbatch's noise independent of WHERE in
+                # the schedule it meets each stage
+                args.append(jax.random.fold_in(jax.random.fold_in(key, idx),
+                                               mi))
             if stateful:
-                out, st_new = stage_fn(params, st, inp)
+                out, st_new = stage_fn(params, st, *args)
                 # state advances only while this stage is processing a real
                 # microbatch (bubble ticks compute on garbage buffers)
                 live = jnp.logical_and(t >= idx, t < idx + M)
                 st = _tm(lambda a, b: jnp.where(live, b, a), st, st_new)
             else:
-                out = stage_fn(params, inp)
+                out = stage_fn(params, *args)
             nxt = lax.ppermute(out, axis, perm)
             return (nxt, st), out
 
@@ -127,14 +148,25 @@ def spmd_pipeline(stage_fn: Callable[..., Any],
 
     pspec = _leading_axis_spec(axis)
     xspec = P(None, data_axis) if data_axis else P()
-    if stateful:
-        return shard_map(per_device, mesh=mesh,
-                         in_specs=(pspec, pspec, xspec),
-                         out_specs=(xspec, pspec), check_vma=False)
-    stateless = lambda params, xs: per_device(params, {}, xs)
-    return shard_map(stateless, mesh=mesh,
-                     in_specs=(pspec, xspec), out_specs=xspec,
-                     check_vma=False)
+    repl = P()
+
+    def wrapper(params, *rest):
+        i = 0
+        state = rest[i] if stateful else {}
+        i += int(stateful)
+        xs = rest[i]
+        i += 1
+        masks = rest[i] if with_masks else None
+        i += int(with_masks)
+        key = rest[i] if with_rng else None
+        return per_device(params, state, xs, masks, key)
+
+    specs = ([pspec] + ([pspec] if stateful else []) + [xspec]
+             + ([xspec] if with_masks else [])
+             + ([repl] if with_rng else []))
+    out_specs = (xspec, pspec) if stateful else xspec
+    return shard_map(wrapper, mesh=mesh, in_specs=tuple(specs),
+                     out_specs=out_specs, check_vma=False)
 
 
 def _leading_axis_spec(axis: str):
@@ -378,6 +410,9 @@ class _PipelinedBase:
         self.updater = net.gc.updater
         self._step = None
         self.iteration_count = 0
+        # per-step dropout/weight-noise stream, seeded like the container
+        self._base_key = jax.random.PRNGKey(
+            int(getattr(net.gc, "seed", None) or 0))
 
     def _check_layer_conf(self, where, lc):
         if getattr(lc, "updater", None) is not None:
@@ -423,6 +458,30 @@ class _PipelinedBase:
         return {k: _tm(np.asarray, v)
                 for k, v in self._to_layer_keyed(self.states).items()}
 
+    # -- the shared body stage -------------------------------------------
+    def _stage_fn(self, params_slice, state_slice, x, *rest):
+        """One pipeline stage = repeats_per_stage repeats of the period-p
+        block (leaves carry the local [R/S, ...] repeat dim). ``rest`` is
+        (mask, key) when the pipeline streams masks (MLN) or just (key,)
+        (CG); ``key`` is the per-(stage, microbatch) PRNG key driving
+        dropout/weight noise exactly like the container's per-layer keys.
+        Returns the activations and the functionally-updated state
+        slice."""
+        mask = rest[0] if len(rest) == 2 else None
+        key = rest[-1]
+        new_state = {str(l): state_slice[str(l)] for l in range(self.period)}
+        for j in range(self.repeats_per_stage):
+            for l, impl in enumerate(self.body_impls):
+                k = jax.random.fold_in(key, j * self.period + l)
+                p_j = _tm(lambda q: q[j], params_slice[str(l)])
+                s_j = _tm(lambda q: q[j], new_state[str(l)])
+                p_n = impl.noised_params(p_j, True, k)
+                x, ns = impl.forward(p_n, s_j, x, train=True, rng=k,
+                                     mask=mask, ctx={})
+                new_state[str(l)] = _tm(lambda buf, v: buf.at[j].set(v),
+                                        new_state[str(l)], ns)
+        return x, new_state
+
     # -- the step ----------------------------------------------------------
     def _build_step(self):
         from ..optimize.updater import normalize_gradients
@@ -433,11 +492,12 @@ class _PipelinedBase:
         upd = self.updater
         M = self.n_microbatches
 
-        def step(tree, states, upd_state, it, f, l):
+        def step(tree, states, upd_state, it, key, f, l, fm, lm):
             mb = lambda t: _tm(
                 lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), t)
             (loss, new_states), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(tree, states, mb(f), mb(l))
+                self._loss, has_aux=True)(tree, states, mb(f), mb(l),
+                                          mb(fm), mb(lm), key)
             if not minimize:
                 grads = _tm(lambda g: -g, grads)
             from ..nn.conf import GradientNormalization
@@ -455,22 +515,28 @@ class _PipelinedBase:
         repl = NamedSharding(self.mesh, P())
         dsh = (NamedSharding(self.mesh, P(self.data_axis))
                if self.data_axis else repl)
-        return jax.jit(step, in_shardings=(sh, sh, sh, repl, dsh, dsh),
+        return jax.jit(step,
+                       in_shardings=(sh, sh, sh, repl, repl, dsh, dsh, dsh,
+                                     dsh),
                        out_shardings=(sh, sh, sh, repl),
                        donate_argnums=(0, 1, 2))
 
-    def fit_batch(self, f, l):
+    def fit_batch(self, f, l, features_mask=None, labels_mask=None):
         """One pipelined optimizer step on a (features, labels) batch — each
         a single array (MultiLayerNetwork) or tuple of arrays
         (ComputationGraph) whose leading dim divides into
-        ``n_microbatches`` equal chunks."""
+        ``n_microbatches`` equal chunks. Optional masks ride the schedule
+        with their microbatch."""
         if self._step is None:
             self._step = self._build_step()
         it = jnp.asarray(self.iteration_count, jnp.int32)
+        key = jax.random.fold_in(self._base_key, self.iteration_count)
         f = _tm(jnp.asarray, f)
         l = _tm(jnp.asarray, l)
+        fm = _tm(jnp.asarray, features_mask)
+        lm = _tm(jnp.asarray, labels_mask)
         self.params, self.states, self.upd_state, loss = self._step(
-            self.params, self.states, self.upd_state, it, f, l)
+            self.params, self.states, self.upd_state, it, key, f, l, fm, lm)
         self.iteration_count += 1
         return loss
 
@@ -500,11 +566,13 @@ class PipelinedNetwork(_PipelinedBase):
 
     Container-step semantics carried over: l1/l2 regularization,
     ``minimize=False`` (sign flip), gradient normalization, per-layer
-    parameter constraints after each update. Remaining constraints (checked
-    loudly): MultiLayerNetwork only, no masks, no per-layer updater
-    overrides, no preprocessors inside the body run; dropout/weight-noise
-    inactive inside the pipelined step; ``iterations(n)`` is ignored (one
-    update per ``fit_batch``, like ParallelWrapper).
+    parameter constraints after each update, [b, T] feature/label MASKS
+    (each microbatch's mask rides the schedule with it), and dropout/
+    weight-noise (per-(stage, microbatch, layer) folded keys — same
+    freshness as the container's per-layer keys). Remaining constraints
+    (checked loudly): no per-layer updater overrides, no preprocessors
+    inside the body run; ``iterations(n)`` is ignored (one update per
+    ``fit_batch``, like ParallelWrapper).
     """
 
     def __init__(self, net, mesh: Mesh, n_microbatches: int,
@@ -528,7 +596,8 @@ class PipelinedNetwork(_PipelinedBase):
         self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
                                        squeeze_stage=False,
                                        _needs_x_grad=self.start > 0,
-                                       stateful=True)
+                                       stateful=True, with_masks=True,
+                                       with_rng=True)
         # partitioned + placed params/states and mirrored updater state
         self.params = self._place(self._partition_tree(net.params))
         self.states = self._place(self._partition_tree(net.states))
@@ -551,22 +620,7 @@ class PipelinedNetwork(_PipelinedBase):
         return {"entry": entry, "blocks": blocks, "head": head}
 
     # -- forward pieces ----------------------------------------------------
-    def _stage_fn(self, params_slice, state_slice, x):
-        """One pipeline stage = repeats_per_stage repeats of the period-p
-        block (leaves carry the local [R/S, ...] repeat dim). Returns the
-        activations and the functionally-updated state slice."""
-        new_state = {str(l): state_slice[str(l)] for l in range(self.period)}
-        for j in range(self.repeats_per_stage):
-            for l, impl in enumerate(self.body_impls):
-                p_j = _tm(lambda q: q[j], params_slice[str(l)])
-                s_j = _tm(lambda q: q[j], new_state[str(l)])
-                x, ns = impl.forward(p_j, s_j, x, train=True, rng=None,
-                                     mask=None, ctx={})
-                new_state[str(l)] = _tm(lambda buf, v: buf.at[j].set(v),
-                                        new_state[str(l)], ns)
-        return x, new_state
-
-    def _entry_apply(self, params, states, f_mb):
+    def _entry_apply(self, params, states, f_mb, fm_mb, keys_mb):
         """Entry layers over the [M, mb, ...] microbatches. Stateless entry
         (the common case) applies as ONE vmapped computation; a stateful
         entry (BatchNorm running stats) goes through ``lax.scan`` so state
@@ -574,24 +628,31 @@ class PipelinedNetwork(_PipelinedBase):
         live-tick order."""
         s = self.start
 
-        def step(st, x):
+        def step(st, xmk):
+            x, m, k = xmk
             ctx = {}
             new_st = dict(st)
             for i in range(s):
+                ki = jax.random.fold_in(k, i)
                 pre = self.net.conf.preprocessor(i)
                 if pre is not None:
                     x = pre(x, ctx)
-                x, ns = self.net.impls[i].forward(
-                    params[str(i)], st[str(i)], x, train=True, rng=None,
-                    mask=None, ctx=ctx)
+                impl = self.net.impls[i]
+                p_n = impl.noised_params(params[str(i)], True, ki)
+                x, ns = impl.forward(p_n, st[str(i)], x, train=True, rng=ki,
+                                     mask=m, ctx=ctx)
                 new_st[str(i)] = ns
             return new_st, x
 
         if not jax.tree_util.tree_leaves(states):
-            return states, jax.vmap(lambda x: step(states, x)[1])(f_mb)
-        return lax.scan(step, states, f_mb)
+            return states, jax.vmap(
+                lambda x, m, k: step(states, (x, m, k))[1],
+                in_axes=(0, None if fm_mb is None else 0, 0))(
+                    f_mb, fm_mb, keys_mb)
+        return lax.scan(step, states, (f_mb, fm_mb, keys_mb))
 
-    def _head_apply(self, params, states, feats, l_mb):
+    def _head_apply(self, params, states, feats, l_mb, fm_mb, lm_mb,
+                    keys_mb):
         """Head layers + output loss per microbatch; returns
         (final head state, per-microbatch losses). Stateless head → one
         vmapped computation; stateful → scan threading state in microbatch
@@ -601,22 +662,28 @@ class PipelinedNetwork(_PipelinedBase):
         out_impl = net.impls[-1]
 
         def step(st, xy):
-            x, l = xy
+            x, l, fm, lm, k = xy
             ctx = {}
             new_st = dict(st)
             for i in range(s + b, n - 1):
+                ki = jax.random.fold_in(k, i)
                 pre = net.conf.preprocessor(i)
                 if pre is not None:
                     x = pre(x, ctx)
-                x, ns = net.impls[i].forward(params[str(i)], st[str(i)], x,
-                                             train=True, rng=None, mask=None,
-                                             ctx=ctx)
+                impl = net.impls[i]
+                p_n = impl.noised_params(params[str(i)], True, ki)
+                x, ns = impl.forward(p_n, st[str(i)], x, train=True, rng=ki,
+                                     mask=fm, ctx=ctx)
                 new_st[str(i)] = ns
             pre = net.conf.preprocessor(n - 1)
             if pre is not None:
                 x = pre(x, ctx)
+            # container mask rule (MultiLayerNetwork._loss_fn): label mask,
+            # else the feature mask for sequence outputs
+            mask = lm if lm is not None else (fm if x.ndim == 3 else None)
             loss = out_impl.loss_on(params[str(n - 1)], st[str(n - 1)], x, l,
-                                    mask=None, train=True, rng=None)
+                                    mask=mask, train=True,
+                                    rng=jax.random.fold_in(k, n - 1))
             if hasattr(out_impl, "update_state"):
                 # e.g. CenterLoss EMA centers — updated outside AD
                 new_st[str(n - 1)] = out_impl.update_state(
@@ -625,17 +692,26 @@ class PipelinedNetwork(_PipelinedBase):
 
         if not jax.tree_util.tree_leaves(states):
             return states, jax.vmap(
-                lambda x, l: step(states, (x, l))[1])(feats, l_mb)
-        return lax.scan(step, states, (feats, l_mb))
+                lambda x, l, fm, lm, k: step(states, (x, l, fm, lm, k))[1],
+                in_axes=(0, 0, None if fm_mb is None else 0,
+                         None if lm_mb is None else 0, 0))(
+                    feats, l_mb, fm_mb, lm_mb, keys_mb)
+        return lax.scan(step, states, (feats, l_mb, fm_mb, lm_mb, keys_mb))
 
-    def _loss(self, tree, states, f_mb, l_mb):
+    def _loss(self, tree, states, f_mb, l_mb, fm_mb, lm_mb, key):
         s, b, p = self.start, self.body_len, self.period
+        M = f_mb.shape[0]
+        S = self.n_stages
+        # disjoint streams: body stages fold (idx < S, mi); entry/head fold
+        # (S, m) / (S + 1, m)
+        ek = jax.random.split(jax.random.fold_in(key, S), M)
+        hk = jax.random.split(jax.random.fold_in(key, S + 1), M)
         entry_st, entry = self._entry_apply(tree["entry"], states["entry"],
-                                            f_mb)
+                                            f_mb, fm_mb, ek)
         feats, blocks_st = self._pipeline(tree["blocks"], states["blocks"],
-                                          entry)
+                                          entry, fm_mb, key)
         head_st, losses = self._head_apply(tree["head"], states["head"],
-                                           feats, l_mb)
+                                           feats, l_mb, fm_mb, lm_mb, hk)
         # mean of per-microbatch means == global mean (equal-size chunks)
         loss = jnp.mean(losses)
         # l1/l2 (param-only → computable per partition; keeps loss parity
@@ -675,10 +751,14 @@ class PipelinedNetwork(_PipelinedBase):
         return getattr(lc, "constraints", None) or \
             getattr(getattr(lc, "inner", None), "constraints", None)
 
-    def fit_batch(self, f, l):
+    def fit_batch(self, f, l, features_mask=None, labels_mask=None):
         """One pipelined step; user-facing conv features are NCHW and
-        adapted to internal NHWC exactly like ``MultiLayerNetwork.fit``."""
-        return super().fit_batch(self.net._adapt_input(jnp.asarray(f)), l)
+        adapted to internal NHWC exactly like ``MultiLayerNetwork.fit``.
+        ``features_mask``/``labels_mask``: [b, T] sequence masks — streamed
+        through every entry/body/head layer and the output loss, same
+        semantics as the container's masked ``fit``."""
+        return super().fit_batch(self.net._adapt_input(jnp.asarray(f)), l,
+                                 features_mask, labels_mask)
 
     def _apply_constraints(self, tree):
         """Per-layer parameter constraints after each update — same timing
@@ -772,7 +852,8 @@ class PipelinedGraph(_PipelinedBase):
                     f"restructure so it sits downstream of the body")
         self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
                                        squeeze_stage=False,
-                                       _needs_x_grad=True, stateful=True)
+                                       _needs_x_grad=True, stateful=True,
+                                       with_rng=True)
         self.params = self._place(self._partition_tree(net.params))
         self.states = self._place(self._partition_tree(net.states))
         self.upd_state = self._place(self.updater.init_state(self.params))
@@ -800,27 +881,16 @@ class PipelinedGraph(_PipelinedBase):
         return out
 
     # -- forward pieces ----------------------------------------------------
-    def _stage_fn(self, params_slice, state_slice, x):
-        new_state = {str(l): state_slice[str(l)] for l in range(self.period)}
-        for j in range(self.repeats_per_stage):
-            for l, impl in enumerate(self.body_impls):
-                p_j = _tm(lambda q: q[j], params_slice[str(l)])
-                s_j = _tm(lambda q: q[j], new_state[str(l)])
-                x, ns = impl.forward(p_j, s_j, x, train=True, rng=None,
-                                     mask=None, ctx={})
-                new_state[str(l)] = _tm(lambda buf, v: buf.at[j].set(v),
-                                        new_state[str(l)], ns)
-        return x, new_state
-
-    def _apply_vertices(self, names, params, states, acts, ctx):
+    def _apply_vertices(self, names, params, states, acts, ctx, key):
         """Run the given vertices (already topo-ordered) functionally over
-        ``acts``; returns (acts, new_states) for the sub-DAG."""
+        ``acts``; returns (acts, new_states) for the sub-DAG. ``key`` seeds
+        per-vertex dropout/weight-noise streams (folded by position)."""
         from ..nn.conf.layers import Layer
 
         conf = self.net.conf
         new_st = dict(states)
         acts = dict(acts)
-        for name in names:
+        for pos, name in enumerate(names):
             if name in self._skip_outputs:
                 continue
             v = conf.vertices[name]
@@ -831,8 +901,10 @@ class PipelinedGraph(_PipelinedBase):
                 if pre is not None:
                     x = pre(x, ctx)
                 impl = self.net.impls[name]
-                y, ns = impl.forward(params[name], states[name], x,
-                                     train=True, rng=None, mask=None,
+                k = jax.random.fold_in(key, pos)
+                p_n = impl.noised_params(params[name], True, k)
+                y, ns = impl.forward(p_n, states[name], x,
+                                     train=True, rng=k, mask=None,
                                      ctx=ctx)
                 new_st[name] = ns
                 acts[name] = y
@@ -840,25 +912,27 @@ class PipelinedGraph(_PipelinedBase):
                 acts[name] = v.forward(xs, ctx)
         return acts, new_st
 
-    def _entry_apply(self, params, states, inputs_mb):
+    def _entry_apply(self, params, states, inputs_mb, keys_mb):
         """Entry sub-DAG per microbatch → stacked activations for every
         entry vertex (the head may consume any of them — skip connections
         around the body)."""
         conf = self.net.conf
 
-        def step(st, inputs):
+        def step(st, xk):
+            inputs, k = xk
             acts = dict(zip(conf.network_inputs, inputs))
             ctx = {"inputs": acts, "input_masks": {}}
             acts, new_st = self._apply_vertices(self.entry_names, params, st,
-                                                acts, ctx)
+                                                acts, ctx, k)
             return new_st, acts
 
         if not jax.tree_util.tree_leaves(states):
-            return states, jax.vmap(lambda i: step(states, i)[1])(inputs_mb)
-        return lax.scan(step, states, inputs_mb)
+            return states, jax.vmap(
+                lambda i, k: step(states, (i, k))[1])(inputs_mb, keys_mb)
+        return lax.scan(step, states, (inputs_mb, keys_mb))
 
     def _head_apply(self, params, states, entry_params, entry_states,
-                    entry_acts, feats, l_mb):
+                    entry_acts, feats, l_mb, keys_mb):
         """Head sub-DAG + the container's multi-output summed loss per
         microbatch; returns (final head state, per-microbatch losses).
         Entry-side auxiliary outputs resolve their params from
@@ -867,15 +941,16 @@ class PipelinedGraph(_PipelinedBase):
         impls = self.net.impls
 
         def step(st, xy):
-            acts, feat, labels = xy
+            acts, feat, labels, key = xy
             acts = dict(acts)
             acts[self.body[-1]] = feat
             ctx = {"inputs": {k: acts.get(k) for k in conf.network_inputs},
                    "input_masks": {}}
             acts, new_st = self._apply_vertices(self.head_names, params, st,
-                                                acts, ctx)
+                                                acts, ctx, key)
             total = 0.0
-            for out_name, lbl in zip(conf.network_outputs, labels):
+            for oi, (out_name, lbl) in enumerate(zip(conf.network_outputs,
+                                                     labels)):
                 impl = impls.get(out_name)
                 if impl is None or not hasattr(impl, "loss_on"):
                     raise ValueError(f"Output vertex '{out_name}' is not an "
@@ -887,8 +962,9 @@ class PipelinedGraph(_PipelinedBase):
                 pre = conf.input_preprocessors.get(out_name)
                 if pre is not None:
                     x = pre(x, ctx)
+                ko = jax.random.fold_in(key, len(self.head_names) + oi)
                 total = total + impl.loss_on(p_o, s_o, x, lbl, mask=None,
-                                             train=True, rng=None)
+                                             train=True, rng=ko)
                 if not entry_side and hasattr(impl, "update_state"):
                     new_st[out_name] = impl.update_state(
                         s_o, jax.lax.stop_gradient(x), lbl)
@@ -896,19 +972,25 @@ class PipelinedGraph(_PipelinedBase):
 
         if not jax.tree_util.tree_leaves(states):
             return states, jax.vmap(
-                lambda a, f, l: step(states, (a, f, l))[1])(
-                    entry_acts, feats, l_mb)
-        return lax.scan(step, states, (entry_acts, feats, l_mb))
+                lambda a, f, l, k: step(states, (a, f, l, k))[1])(
+                    entry_acts, feats, l_mb, keys_mb)
+        return lax.scan(step, states, (entry_acts, feats, l_mb, keys_mb))
 
-    def _loss(self, tree, states, inputs_mb, labels_mb):
+    def _loss(self, tree, states, inputs_mb, labels_mb, fm_mb, lm_mb, key):
+        del fm_mb, lm_mb  # CG masks unsupported (rejected in fit_batch)
         p = self.period
+        M = inputs_mb[0].shape[0]
+        S = self.n_stages
+        ek = jax.random.split(jax.random.fold_in(key, S), M)
+        hk = jax.random.split(jax.random.fold_in(key, S + 1), M)
         entry_st, entry_acts = self._entry_apply(tree["entry"],
-                                                 states["entry"], inputs_mb)
+                                                 states["entry"], inputs_mb,
+                                                 ek)
         feats, blocks_st = self._pipeline(tree["blocks"], states["blocks"],
-                                          entry_acts[self.body_input])
+                                          entry_acts[self.body_input], key)
         head_st, losses = self._head_apply(tree["head"], states["head"],
                                            tree["entry"], states["entry"],
-                                           entry_acts, feats, labels_mb)
+                                           entry_acts, feats, labels_mb, hk)
         loss = jnp.mean(losses)
         reg = 0.0
         for part, names in (("entry", self.entry_names),
@@ -949,11 +1031,15 @@ class PipelinedGraph(_PipelinedBase):
                 out["blocks"][str(l)] = stack_stage_params(per_rep)
         return out
 
-    def fit_batch(self, inputs, labels):
+    def fit_batch(self, inputs, labels, features_mask=None,
+                  labels_mask=None):
         """One pipelined step; ``inputs``/``labels`` are tuples of arrays
         (the ComputationGraph convention) — single arrays are wrapped.
         User-facing conv inputs are NCHW (the container boundary rule) and
         adapted to internal NHWC exactly like ``ComputationGraph.fit``."""
+        if features_mask is not None or labels_mask is not None:
+            raise ValueError("PipelinedGraph does not support masks yet; "
+                             "train unpipelined for masked graphs")
         if not isinstance(inputs, (tuple, list)):
             inputs = (inputs,)
         if not isinstance(labels, (tuple, list)):

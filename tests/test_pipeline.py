@@ -577,3 +577,128 @@ def test_pipelined_graph_aux_output_from_entry():
             np.testing.assert_allclose(
                 np.asarray(exported[k][name]), np.asarray(p2[k][name]),
                 rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
+
+
+def test_pipeline_parallel_masked_sequences_match_raw_step():
+    """[b, T] feature/label masks ride the schedule: the pipelined masked
+    LSTM step must reproduce the container's masked step (loss + params) —
+    padding must never train as real tokens (the round-3 ADVICE class of
+    bug, now on the pp path)."""
+    import jax
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+            .layer(LSTM(n_in=5, n_out=8))
+            .layer(LSTM(n_in=8, n_out=8))
+            .layer(LSTM(n_in=8, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+
+    rng = np.random.default_rng(6)
+    f = rng.normal(size=(4, 6, 5)).astype(np.float32)
+    ids = rng.integers(0, 4, size=(4, 6))
+    l = np.eye(4, dtype=np.float32)[ids]
+    fm = (np.arange(6)[None, :] < [[6], [4], [5], [3]]).astype(np.float32)
+
+    loss_pp = float(pp.fit_batch(f, l, features_mask=fm, labels_mask=fm))
+
+    raw = jax.jit(net._raw_step(False))
+    p2, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(2),
+                             jnp.asarray(f), jnp.asarray(l),
+                             jnp.asarray(fm), jnp.asarray(fm))
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
+    exported = pp.export_params()
+    for k in p2:
+        for name in p2[k]:
+            np.testing.assert_allclose(
+                np.asarray(exported[k][name]), np.asarray(p2[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
+    # and masking matters: an unmasked run gives a DIFFERENT loss
+    net2 = MultiLayerNetwork(conf).init()
+    pp2 = pipeline_parallel_step(net2, make_mesh(jax.devices()[:2],
+                                                 axes=("pipe",)),
+                                 n_microbatches=2)
+    loss_unmasked = float(pp2.fit_batch(f, l))
+    assert abs(loss_unmasked - loss_pp) > 1e-6
+
+
+def test_pipeline_parallel_dropout_active_and_deterministic():
+    """Dropout INSIDE the pipelined step: per-(stage, microbatch) folded
+    keys make it active (loss differs from the dropout-free conf), fresh
+    per iteration, and reproducible given the same seed/iteration."""
+    import jax
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    def build(drop):
+        b = (NeuralNetConfiguration.builder().seed(11)
+             .updater(Sgd(learning_rate=0.0)).activation("tanh").list()
+             .layer(DenseLayer(n_in=6, n_out=16)))
+        for _ in range(4):
+            b = b.layer(DenseLayer(n_in=16, n_out=16, dropout=drop))
+        b = b.layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                loss="mcxent"))
+        return MultiLayerNetwork(b.build()).init()
+
+    rng = np.random.default_rng(8)
+    f = rng.normal(size=(8, 6)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+
+    pp_a = pipeline_parallel_step(build(0.5), mesh, n_microbatches=2)
+    pp_b = pipeline_parallel_step(build(0.5), mesh, n_microbatches=2)
+    pp_none = pipeline_parallel_step(build(0.0), mesh, n_microbatches=2)
+
+    la0 = float(pp_a.fit_batch(f, l))
+    lb0 = float(pp_b.fit_batch(f, l))
+    ln0 = float(pp_none.fit_batch(f, l))
+    assert la0 == lb0                      # same seed+iteration → same mask
+    assert abs(la0 - ln0) > 1e-6           # dropout is ACTIVE
+    la1 = float(pp_a.fit_batch(f, l))      # lr=0: only the mask changes
+    assert abs(la1 - la0) > 1e-9           # fresh mask per iteration
+
+
+def test_pipelined_graph_output_dropout_active():
+    """OutputLayer input-dropout configured on a CG must stay ACTIVE inside
+    the pipelined step (it gets a folded key, not rng=None)."""
+    import jax
+    from deeplearning4j_tpu import NeuralNetConfiguration, ComputationGraph, Sgd, InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    def build(drop):
+        gb = (NeuralNetConfiguration.builder().seed(9)
+              .updater(Sgd(learning_rate=0.0)).activation("tanh")
+              .graph_builder().add_inputs("in")
+              .add_layer("d0", DenseLayer(n_out=12), "in"))
+        prev = "d0"
+        for i in range(4):
+            gb = gb.add_layer(f"mid{i}", DenseLayer(n_out=12), prev)
+            prev = f"mid{i}"
+        gb = (gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent", dropout=drop),
+                           prev)
+              .set_outputs("out").set_input_types(InputType.feed_forward(8)))
+        return ComputationGraph(gb.build()).init()
+
+    rng = np.random.default_rng(12)
+    f = rng.normal(size=(8, 8)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    la = float(pipeline_parallel_step(build(0.5), mesh,
+                                      n_microbatches=2).fit_batch(f, l))
+    lb = float(pipeline_parallel_step(build(0.5), mesh,
+                                      n_microbatches=2).fit_batch(f, l))
+    ln = float(pipeline_parallel_step(build(0.0), mesh,
+                                      n_microbatches=2).fit_batch(f, l))
+    assert la == lb                       # deterministic given seed/iter
+    assert abs(la - ln) > 1e-6            # dropout fires in the head loss
