@@ -99,6 +99,19 @@ class _BaseLSTMImpl(LayerImpl):
                 if self.peepholes else None)
         rw = params["RW"].astype(ad)
 
+        # persistent-kernel fast path: the whole time loop as ONE Pallas
+        # grid with RW resident in VMEM (ops/lstm_cell.py) — kills the
+        # per-step HBM weight stream that bounds the scan path. The scan
+        # below remains the oracle/fallback (odd dims, other activations).
+        from ...ops import lstm_cell as _lk
+
+        gate_name = getattr(c, "gate_activation", "sigmoid")
+        if _lk.supported(b, T, H, self.activation_name, str(gate_name)):
+            y, (hT, cT) = _lk.lstm_scan(xp, rw, peep, h0, c0, mask)
+            if reverse:
+                y = jnp.flip(y, axis=1)
+            return y.astype(self.out_dtype), (hT, cT)
+
         def step(carry, inp):
             h, cc = carry
             xp_t, m_t = inp

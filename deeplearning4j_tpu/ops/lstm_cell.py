@@ -1,0 +1,397 @@
+"""Pallas persistent-LSTM kernel — the recurrent hot loop with the
+recurrent weights VMEM-RESIDENT across the whole sequence.
+
+Why: the container LSTM (``nn/layers/recurrent.py``) hoists the input
+projection out of the scan (one big MXU gemm), but the remaining sequential
+chain ``z_t = xp_t + h @ RW`` re-streams ``RW [H, 4H]`` from HBM every
+timestep: at char-RNN shapes (H=512 → 2 MB bf16) that is T × 2 MB per layer
+per direction, and the step is weight-bandwidth-bound at ~1% MFU — exactly
+the workload the reference dedicates ``CudnnLSTMHelper.java`` (persistent
+RNN) to. These kernels run the whole time loop on a 1-D Pallas grid with
+``RW`` (and its transpose, in the backward) loaded into VMEM ONCE
+(constant index_map → the DMA is issued for step 0 and skipped after),
+h/c carried in VMEM scratch, and only the per-step activations
+([b, 4H] / [b, H]) streamed — turning the weight stream from O(T·H·4H)
+into O(H·4H).
+
+Backward is the standard LSTM BPTT, hand-written (the cuDNN-helper pattern
+the repo already uses for flash attention: custom kernel behind the same
+layer math, ``lax.scan`` path as the always-available oracle/fallback):
+the forward saves the post-activation gates [T, b, 4H] and the cell
+sequence (cuDNN "reserve space"), the reverse kernel carries (dh, dc) and
+emits per-step pre-activation gradients dz [T, b, 4H]; everything
+batched-over-time (dW, dRW, dx, db, h_prev) happens OUTSIDE as single MXU
+gemms. Supports the Graves peephole variant (``pi/pf/po``) and per-step
+[b] sequence masks — both GravesLSTM semantics from the reference
+(``GravesLSTM.java``, ``LSTMHelpers.java:206-212``).
+
+Layout: time-major [T, b, ...] inside the kernels (grid walks T); the
+public :func:`lstm_scan` takes the layer's batch-major arrays. f32
+accumulation throughout; tanh cell activation and sigmoid gates (the
+``supported()`` contract — other activations fall back to the scan).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .flash_attention import _vspec, _scratch, _interpret, pltpu
+
+__all__ = ["lstm_scan", "supported"]
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(xp_ref, rw_ref, peep_ref, m_ref, h0_ref, c0_ref,
+                ys_ref, gates_ref, cseq_ref, hc_ref,
+                h_s, c_s, *, T, H, peep):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[...].astype(jnp.float32)
+        c_s[:] = c0_ref[...].astype(jnp.float32)
+
+    h = h_s[:]
+    c = c_s[:]
+    rw = rw_ref[...].astype(jnp.float32)                  # resident [H, 4H]
+    z = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h, rw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [b, 4H]
+    zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
+                      z[:, 3 * H:])
+    if peep:
+        pi = peep_ref[0].astype(jnp.float32)              # [H]
+        pf = peep_ref[1].astype(jnp.float32)
+        po = peep_ref[2].astype(jnp.float32)
+        zi = zi + c * pi[None, :]
+        zf = zf + c * pf[None, :]
+    i = _sig(zi)
+    f = _sig(zf)
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    if peep:
+        zo = zo + c_new * po[None, :]
+    o = _sig(zo)
+    h_new = o * jnp.tanh(c_new)
+    if m_ref is not None:
+        m = m_ref[0, :, 0][:, None]                       # [b, 1]
+        h_new = m * h_new + (1.0 - m) * h
+        c_new = m * c_new + (1.0 - m) * c
+    h_s[:] = h_new
+    c_s[:] = c_new
+    ys_ref[0] = h_new.astype(ys_ref.dtype)
+    if gates_ref is not None:  # reserve space for BPTT (training fwd only)
+        gates_ref[0] = jnp.concatenate([i, f, o, g], axis=-1
+                                       ).astype(gates_ref.dtype)
+        cseq_ref[0] = c_new.astype(cseq_ref.dtype)
+
+    @pl.when(t == T - 1)
+    def _():
+        hc_ref[0] = h_new.astype(hc_ref.dtype)
+        hc_ref[1] = c_new.astype(hc_ref.dtype)
+
+
+def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
+    """xp: [T, b, 4H] (input projection + bias), rw: [H, 4H], peep: [8, H]
+    or None, h0/c0: [b, H], mask: [T, b, 8] or None →
+    (ys [T, b, H], gates [T, b, 4H], cseq [T, b, H], hcT [2, b, H]);
+    ``save_reserve=False`` (inference primal) omits the gates/cseq reserve
+    outputs entirely — no dead HBM writes on the non-training path — and
+    returns (ys, None, None, hcT)."""
+    T, b, H4 = xp.shape
+    H = H4 // 4
+    kern = functools.partial(_fwd_kernel, T=T, H=H, peep=peep is not None)
+    const3 = lambda t: (0, 0, 0)
+    const2 = lambda t: (0, 0)
+    specs = [
+        _vspec((1, b, H4), lambda t: (t, 0, 0)),          # xp (streamed)
+        _vspec((H, H4), const2),                          # rw (resident)
+    ]
+    ops = [xp, rw]
+    if peep is not None:
+        specs.append(_vspec((8, H), const2))              # peepholes
+        ops.append(peep)
+    has_mask = mask is not None
+    if has_mask:
+        specs.append(_vspec((1, b, 8), lambda t: (t, 0, 0)))
+        ops.append(mask)
+    specs += [_vspec((b, H), const2), _vspec((b, H), const2)]   # h0, c0
+    ops += [h0, c0]
+
+    def shim(*refs):
+        n_in = 2 + int(peep is not None) + int(has_mask) + 2
+        ins, rest = refs[:n_in], refs[n_in:]
+        pos = 2
+        peep_ref = ins[pos] if peep is not None else None
+        pos += int(peep is not None)
+        m_ref = ins[pos] if has_mask else None
+        pos += int(has_mask)
+        if save_reserve:
+            ys_ref, gates_ref, cseq_ref, hc_ref, h_s, c_s = rest
+        else:
+            (ys_ref, hc_ref, h_s, c_s), gates_ref, cseq_ref = rest, None, \
+                None
+        return kern(ins[0], ins[1], peep_ref, m_ref, ins[pos], ins[pos + 1],
+                    ys_ref, gates_ref, cseq_ref, hc_ref, h_s, c_s)
+
+    ad = jnp.float32
+    out_specs = [_vspec((1, b, H), lambda t: (t, 0, 0))]  # ys
+    out_shape = [jax.ShapeDtypeStruct((T, b, H), xp.dtype)]
+    if save_reserve:
+        out_specs += [
+            _vspec((1, b, H4), lambda t: (t, 0, 0)),      # gates (reserve)
+            _vspec((1, b, H), lambda t: (t, 0, 0)),       # c sequence
+        ]
+        out_shape += [jax.ShapeDtypeStruct((T, b, H4), ad),
+                      jax.ShapeDtypeStruct((T, b, H), ad)]
+    out_specs.append(_vspec((2, b, H), const3))           # final (h, c)
+    out_shape.append(jax.ShapeDtypeStruct((2, b, H), ad))
+    res = pl.pallas_call(
+        shim,
+        grid=(T,),
+        in_specs=specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=[_scratch((b, H)), _scratch((b, H))],
+        interpret=_interpret(),
+    )(*ops)
+    if save_reserve:
+        return res
+    ys, hc = res
+    return ys, None, None, hc
+
+
+# ----------------------------------------------------------------- backward
+def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
+                m_ref, c0_ref, dhT_ref, dcT_ref,
+                dz_ref, dh0_ref, dc0_ref, dpeep_ref,
+                dh_s, dc_s, dp_s, *, T, H, peep):
+    t = pl.program_id(0)          # walks 0..T-1; operands indexed T-1-t
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[:] = dhT_ref[...].astype(jnp.float32)
+        dc_s[:] = dcT_ref[...].astype(jnp.float32)
+        if peep:
+            dp_s[:] = jnp.zeros_like(dp_s)
+
+    rt_is_first = t == T - 1      # reverse step at sequence start
+    gts = gates_ref[0].astype(jnp.float32)
+    i, f, o, g = (gts[:, :H], gts[:, H:2 * H], gts[:, 2 * H:3 * H],
+                  gts[:, 3 * H:])
+    c_out = cseq_ref[0].astype(jnp.float32)
+    # c_prev: cseq[rt-1] for rt > 0 (streamed via clamped index), c0 at rt=0
+    c_prev = jnp.where(rt_is_first, c0_ref[...].astype(jnp.float32),
+                       cprev_ref[0].astype(jnp.float32))
+    dh_tot = dy_ref[0].astype(jnp.float32) + dh_s[:]
+    dc_tot = dc_s[:]
+    if m_ref is not None:
+        m = m_ref[0, :, 0][:, None]
+    else:
+        m = None
+    dh_c = dh_tot if m is None else m * dh_tot
+    dc_c = dc_tot if m is None else m * dc_tot
+    tc = jnp.tanh(c_out)
+    do = dh_c * tc
+    dzo = do * o * (1.0 - o)
+    dc = dc_c + dh_c * o * (1.0 - tc * tc)
+    if peep:
+        pi = peep_ref[0].astype(jnp.float32)
+        pf = peep_ref[1].astype(jnp.float32)
+        po = peep_ref[2].astype(jnp.float32)
+        dc = dc + dzo * po[None, :]
+    di = dc * g
+    df = dc * c_prev
+    dg = dc * i
+    dzi = di * i * (1.0 - i)
+    dzf = df * f * (1.0 - f)
+    dzg = dg * (1.0 - g * g)
+    dc_prev = dc * f
+    if peep:
+        dc_prev = dc_prev + dzi * pi[None, :] + dzf * pf[None, :]
+        # peephole grads accumulate across steps ([8, H] scratch rows 0-2)
+        dp_s[0] = dp_s[0] + jnp.sum(dzi * c_prev, axis=0)
+        dp_s[1] = dp_s[1] + jnp.sum(dzf * c_prev, axis=0)
+        dp_s[2] = dp_s[2] + jnp.sum(dzo * c_out, axis=0)
+    dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)   # [b, 4H]
+    rwt = rwt_ref[...].astype(jnp.float32)                # resident [4H, H]
+    dh_prev = jax.lax.dot_general(dz, rwt, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    if m is not None:
+        dh_prev = dh_prev + (1.0 - m) * dh_tot
+        dc_prev = m * dc_prev + (1.0 - m) * dc_tot
+    dh_s[:] = dh_prev
+    dc_s[:] = dc_prev
+    dz_ref[0] = dz.astype(dz_ref.dtype)
+
+    @pl.when(t == T - 1)
+    def _():
+        dh0_ref[...] = dh_prev.astype(dh0_ref.dtype)
+        dc0_ref[...] = dc_prev.astype(dc0_ref.dtype)
+        if peep:
+            dpeep_ref[...] = dp_s[:].astype(dpeep_ref.dtype)
+        else:
+            dpeep_ref[...] = jnp.zeros(dpeep_ref.shape, dpeep_ref.dtype)
+
+
+def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
+    T, b, H = dy.shape
+    H4 = 4 * H
+    kern = functools.partial(_bwd_kernel, T=T, H=H, peep=peep is not None)
+    rev = lambda t: (T - 1 - t, 0, 0)
+    # c_prev stream: block rt-1, clamped at 0 (selected against c0 in-kernel)
+    rev_prev = lambda t: (jnp.maximum(T - 1 - t - 1, 0), 0, 0)
+    const2 = lambda t: (0, 0)
+    specs = [
+        _vspec((1, b, H), rev),                           # dy
+        _vspec((1, b, H4), rev),                          # gates
+        _vspec((1, b, H), rev),                           # c sequence
+        _vspec((1, b, H), rev_prev),                      # c_{t-1} stream
+        _vspec((H4, H), const2),                          # rw^T (resident)
+    ]
+    ops = [dy, gates, cseq, cseq, rwt]
+    if peep is not None:
+        specs.append(_vspec((8, H), const2))
+        ops.append(peep)
+    has_mask = mask is not None
+    if has_mask:
+        specs.append(_vspec((1, b, 8), rev))
+        ops.append(mask)
+    specs += [_vspec((b, H), const2)] * 3                 # c0, dhT, dcT
+    ops += [c0, dhT, dcT]
+
+    def shim(*refs):
+        n_in = 5 + int(peep is not None) + int(has_mask) + 3
+        ins, rest = refs[:n_in], refs[n_in:]
+        pos = 5
+        peep_ref = ins[pos] if peep is not None else None
+        pos += int(peep is not None)
+        m_ref = ins[pos] if has_mask else None
+        pos += int(has_mask)
+        return kern(ins[0], ins[1], ins[2], ins[3], ins[4], peep_ref, m_ref,
+                    ins[pos], ins[pos + 1], ins[pos + 2], *rest)
+
+    ad = jnp.float32
+    return pl.pallas_call(
+        shim,
+        grid=(T,),
+        in_specs=specs,
+        out_specs=(
+            _vspec((1, b, H4), rev),                      # dz per step
+            _vspec((b, H), const2),                       # dh0
+            _vspec((b, H), const2),                       # dc0
+            _vspec((8, H), const2),                       # dpeep
+        ),
+        out_shape=(jax.ShapeDtypeStruct((T, b, H4), ad),
+                   jax.ShapeDtypeStruct((b, H), ad),
+                   jax.ShapeDtypeStruct((b, H), ad),
+                   jax.ShapeDtypeStruct((8, H), ad)),
+        scratch_shapes=[_scratch((b, H)), _scratch((b, H)),
+                        _scratch((8, H))],
+        interpret=_interpret(),
+    )(*ops)
+
+
+# ------------------------------------------------------------- public entry
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _lstm(xp, rw, peep, h0, c0, mask):
+    # primal (inference) path: no reserve tensors — the BPTT residuals are
+    # only materialized by _lstm_fwd under differentiation
+    ys, _, _, hc = _fwd(xp, rw, peep, h0, c0, mask, save_reserve=False)
+    return ys, hc[0], hc[1]
+
+
+def _lstm_fwd(xp, rw, peep, h0, c0, mask):
+    ys, gates, cseq, hc = _fwd(xp, rw, peep, h0, c0, mask)
+    return (ys, hc[0], hc[1]), (rw, peep, h0, c0, mask, ys, gates, cseq)
+
+
+def _lstm_bwd(res, grads):
+    rw, peep, h0, c0, mask, ys, gates, cseq = res
+    dy, dhT, dcT = grads
+    T, b, H = dy.shape
+    dy = dy.astype(jnp.float32)
+    rwt = jnp.swapaxes(rw, 0, 1)
+    dz, dh0, dc0, dpeep = _bwd_call(dy, gates, cseq, rwt, peep, mask,
+                                    c0.astype(jnp.float32),
+                                    dhT.astype(jnp.float32),
+                                    dcT.astype(jnp.float32))
+    # batched-over-time pieces as single MXU gemms (outside the kernel):
+    # z_t = xp_t + h_{t-1} @ RW  →  dxp = dz,  dRW = Σ_t h_{t-1}ᵀ dz_t
+    h_prev = jnp.concatenate([h0.astype(ys.dtype)[None], ys[:-1]], axis=0)
+    drw = jnp.einsum("tbh,tbg->hg", h_prev.astype(jnp.float32), dz,
+                     preferred_element_type=jnp.float32).astype(rw.dtype)
+    dxp = dz                                              # z = xp + h @ RW
+    dpeep_out = None if peep is None else dpeep.astype(peep.dtype)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return (dxp, drw, dpeep_out, dh0, dc0, dmask)
+
+
+_lstm.defvjp(_lstm_fwd, _lstm_bwd)
+
+
+#: kernel contract: tanh cell activation + sigmoid gates, TPU-tileable dims
+def supported(b: int, T: int, H: int, activation: str,
+              gate_activation: str) -> bool:
+    """Whether the persistent kernel applies: TPU backend (or the tests'
+    forced interpret mode), tanh/sigmoid activations (the kernel hard-codes
+    them), lane-aligned width and sublane-aligned batch. Everything else
+    falls back to the ``lax.scan`` oracle path. Escape hatch:
+    ``DL4J_TPU_NO_PERSISTENT_LSTM=1`` forces the scan path (first-hardware
+    insurance — the kernel is interpret-verified, and this keeps a
+    one-variable rollback if a Mosaic lowering gap surfaces on a new
+    jaxlib)."""
+    import os
+    if os.environ.get("DL4J_TPU_NO_PERSISTENT_LSTM"):
+        return False
+    from . import flash_attention as _fa
+    if not _fa._FORCE_INTERPRET:
+        try:
+            if jax.default_backend() not in ("tpu", "axon"):
+                return False
+        except Exception:  # pragma: no cover
+            return False
+    # VMEM budget: the point of the kernel is a RESIDENT f32 [H, 4H] weight
+    # block (fwd; its transpose in the bwd kernel) — cap it well under a
+    # core's VMEM so wide nets fall back to the scan instead of failing a
+    # Mosaic allocation (H=512 → 4 MB ✓, H=768 → 9.4 MB ✓, H=1024 → 16 MB ✗
+    # until a bf16-resident variant lands).
+    if H * 4 * H * 4 > 12 * 2 ** 20 or b > 1024:
+        return False
+    return (activation == "tanh" and gate_activation == "sigmoid"
+            and H % 128 == 0 and b % 8 == 0 and T >= 1)
+
+
+def lstm_scan(xp, rw, peep, h0, c0, mask=None):
+    """Persistent-LSTM sequence step. ``xp``: [b, T, 4H] hoisted input
+    projection (+bias), ``rw``: [H, 4H], ``peep``: (pi, pf, po) tuple or
+    None, ``h0``/``c0``: [b, H], ``mask``: [b, T] (1 = real step) or None.
+    Returns (ys [b, T, H], (hT, cT)) in f32 accumulation dtype — a drop-in
+    for the ``lax.scan`` recurrent loop with the weight stream eliminated."""
+    b, T, H4 = xp.shape
+    H = H4 // 4
+    xp_tm = jnp.swapaxes(xp, 0, 1)                        # time-major
+    pk = None
+    if peep is not None:
+        pk = jnp.zeros((8, H), jnp.float32)
+        pk = pk.at[0].set(peep[0].astype(jnp.float32))
+        pk = pk.at[1].set(peep[1].astype(jnp.float32))
+        pk = pk.at[2].set(peep[2].astype(jnp.float32))
+    mk = None
+    if mask is not None:
+        mk = jnp.broadcast_to(
+            jnp.swapaxes(jnp.asarray(mask, jnp.float32), 0, 1)[..., None],
+            (T, b, 8))
+    ys, hT, cT = _lstm(xp_tm.astype(jnp.float32), rw.astype(jnp.float32),
+                       pk, h0.astype(jnp.float32), c0.astype(jnp.float32),
+                       mk)
+    return jnp.swapaxes(ys, 0, 1), (hT, cT)

@@ -88,6 +88,10 @@ def spmd_pipeline(stage_fn: Callable[..., Any],
                 state = _tm(lambda s: s[0], state)
         idx = lax.axis_index(axis)
         M = xs.shape[0]
+        if with_rng and data_axis is not None:
+            # decorrelate noise across data shards (the container DP path
+            # folds by data-axis index too — wrapper.py's per-worker rng)
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
         if not _needs_x_grad:
             # mark the feed device-varying over the pipe axis. NOT done when
             # upstream (entry) layers need ∂loss/∂xs: pvary's transpose is a

@@ -26,6 +26,10 @@ echo "--- stage 4: LSTM roofline ($(date -u +%H:%M:%S)) ---"
 python perf_lstm.py roofline
 echo "roofline rc=$?"
 
+echo "--- stage 4b: LSTM persistent-kernel A/B ($(date -u +%H:%M:%S)) ---"
+python perf_lstm.py ab
+echo "ab rc=$?"
+
 echo "--- stage 5: LSTM sweep ($(date -u +%H:%M:%S)) ---"
 python perf_lstm.py sweep
 echo "sweep rc=$?"

@@ -65,6 +65,37 @@ def measure(batch=64, width=512, tbptt=50, seq_len=200, fits=3):
     return batch * seq_len * fits / dt
 
 
+def kernel_ab(batch=64, width=512, tbptt=50, seq_len=200):
+    """A/B: persistent Pallas LSTM kernel (RW VMEM-resident,
+    ops/lstm_cell.py) vs the lax.scan path — same config, same data, by
+    toggling the kernel's DL4J_TPU_NO_PERSISTENT_LSTM escape hatch around
+    the two legs (the operator's own setting is restored afterwards; if
+    they exported the hatch as a rollback, the kernel leg is skipped)."""
+    import os
+    prior = os.environ.get("DL4J_TPU_NO_PERSISTENT_LSTM")
+    try:
+        if prior:
+            print("escape hatch set by operator: skipping the kernel leg",
+                  flush=True)
+            r_kernel = None
+        else:
+            r_kernel = measure(batch=batch, width=width, tbptt=tbptt,
+                               seq_len=seq_len)
+            print(f"persistent-kernel chars/s: {r_kernel:,.0f}", flush=True)
+        os.environ["DL4J_TPU_NO_PERSISTENT_LSTM"] = "1"
+        r_scan = measure(batch=batch, width=width, tbptt=tbptt,
+                         seq_len=seq_len)
+        print(f"lax.scan        chars/s: {r_scan:,.0f}", flush=True)
+        if r_kernel is not None:
+            print(f"kernel speedup: {r_kernel / max(r_scan, 1e-9):.2f}x",
+                  flush=True)
+    finally:
+        if prior is None:
+            os.environ.pop("DL4J_TPU_NO_PERSISTENT_LSTM", None)
+        else:
+            os.environ["DL4J_TPU_NO_PERSISTENT_LSTM"] = prior
+
+
 def sweep():
     print(f"{'batch':>6} {'width':>6} {'tbptt':>6} {'chars/s':>12}")
     for batch in (64, 128, 256, 512):
@@ -126,6 +157,8 @@ if __name__ == "__main__":
     cmd = sys.argv[1] if len(sys.argv) > 1 else "sweep"
     if cmd == "sweep":
         sweep()
+    elif cmd == "ab":
+        kernel_ab()
     elif cmd == "roofline":
         roofline()
     elif cmd == "profile":

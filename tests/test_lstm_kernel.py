@@ -1,0 +1,179 @@
+"""Persistent-LSTM Pallas kernel vs the ``lax.scan`` oracle (the
+cuDNN-helper cross-validation pattern, SURVEY.md §4.4 — here for the
+recurrent hot loop: forward, full BPTT gradients, peepholes, masks).
+
+Interpret mode on CPU runs the exact kernel arithmetic the TPU executes
+(no TPU-only primitives are used)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import deeplearning4j_tpu.ops.flash_attention as fa
+import deeplearning4j_tpu.ops.lstm_cell as lk
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = fa._FORCE_INTERPRET
+    fa._FORCE_INTERPRET = True
+    yield
+    fa._FORCE_INTERPRET = old
+
+
+def _scan_oracle(xp, rw, peep, h0, c0, mask=None):
+    """The recurrent.py scan body, verbatim semantics."""
+    b, T, H4 = xp.shape
+    H = H4 // 4
+
+    def step(carry, inp):
+        h, cc = carry
+        xp_t, m_t = inp
+        z = xp_t + h @ rw
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        if peep is not None:
+            zi = zi + cc * peep[0]
+            zf = zf + cc * peep[1]
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf)
+        g = jnp.tanh(zg)
+        c_new = f * cc + i * g
+        zo2 = zo + c_new * peep[2] if peep is not None else zo
+        o = jax.nn.sigmoid(zo2)
+        h_new = o * jnp.tanh(c_new)
+        if m_t is not None:
+            mm = m_t[:, None].astype(h_new.dtype)
+            h_new = mm * h_new + (1 - mm) * h
+            c_new = mm * c_new + (1 - mm) * cc
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(xp, 0, 1)
+    if mask is not None:
+        ms = jnp.swapaxes(mask, 0, 1)
+        (hT, cT), ys = lax.scan(step, (h0, c0), (xs, ms))
+    else:
+        (hT, cT), ys = lax.scan(lambda cr, xt: step(cr, (xt, None)),
+                                (h0, c0), xs)
+    return jnp.swapaxes(ys, 0, 1), (hT, cT)
+
+
+def _inputs(b=8, T=5, H=128, peep=False, mask=False, seed=0):
+    rng = np.random.default_rng(seed)
+    xp = jnp.asarray(rng.normal(size=(b, T, 4 * H)) * 0.5, jnp.float32)
+    rw = jnp.asarray(rng.normal(size=(H, 4 * H)) / np.sqrt(H), jnp.float32)
+    pp = (tuple(jnp.asarray(rng.normal(size=(H,)) * 0.3, jnp.float32)
+                for _ in range(3)) if peep else None)
+    h0 = jnp.asarray(rng.normal(size=(b, H)) * 0.2, jnp.float32)
+    c0 = jnp.asarray(rng.normal(size=(b, H)) * 0.2, jnp.float32)
+    mk = None
+    if mask:
+        lens = rng.integers(1, T + 1, size=b)
+        mk = jnp.asarray((np.arange(T)[None, :] < lens[:, None]),
+                         jnp.float32)
+    return xp, rw, pp, h0, c0, mk
+
+
+@pytest.mark.parametrize("peep", [False, True])
+@pytest.mark.parametrize("mask", [False, True])
+def test_forward_matches_scan(peep, mask):
+    xp, rw, pp, h0, c0, mk = _inputs(peep=peep, mask=mask)
+    ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
+    want_ys, (whT, wcT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(want_ys),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(whT),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(wcT),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("peep", [False, True])
+@pytest.mark.parametrize("mask", [False, True])
+def test_grads_match_scan(peep, mask):
+    """Hand-written BPTT kernel == AD of the scan, for every input: xp
+    (→ dW/dx/db outside), RW, peepholes, h0, c0 — including carry grads
+    through hT/cT."""
+    xp, rw, pp, h0, c0, mk = _inputs(b=8, T=4, H=128, peep=peep, mask=mask,
+                                     seed=3)
+
+    def loss_k(xp, rw, pp, h0, c0):
+        ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
+        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
+
+    def loss_s(xp, rw, pp, h0, c0):
+        ys, (hT, cT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
+        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
+
+    argnums = (0, 1, 3, 4) if pp is None else (0, 1, 2, 3, 4)
+    gk = jax.grad(loss_k, argnums=argnums)(xp, rw, pp, h0, c0)
+    gs = jax.grad(loss_s, argnums=argnums)(xp, rw, pp, h0, c0)
+    for a, want in zip(jax.tree_util.tree_leaves(gk),
+                       jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_layer_routes_through_kernel_and_matches():
+    """GravesLSTM layer forward routes through the persistent kernel when
+    supported (spied) and reproduces the scan path bit-for-bit at the layer
+    level; unsupported widths fall back to the scan."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    def build(H):
+        conf = (NeuralNetConfiguration.builder().seed(2)
+                .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+                .layer(GravesLSTM(n_in=10, n_out=H))
+                .layer(RnnOutputLayer(n_in=H, n_out=6, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    f = rng.normal(size=(8, 7, 10)).astype(np.float32)
+    ids = rng.integers(0, 6, size=(8, 7))
+    l = np.eye(6, dtype=np.float32)[ids]
+
+    calls = []
+    real = lk.lstm_scan
+    import deeplearning4j_tpu.ops.lstm_cell as lk_mod
+    lk_mod.lstm_scan = lambda *a, **k: (calls.append(1) or real(*a, **k))
+    try:
+        net = build(128)
+        out_kernel = np.asarray(net.output(f))
+        assert calls, "kernel path not taken for H=128"
+        # force the scan path by clearing support, same params
+        import deeplearning4j_tpu.ops.flash_attention as fa_mod
+        fa_mod._FORCE_INTERPRET = False   # off-TPU → supported() False
+        try:
+            out_scan = np.asarray(net.output(f))
+        finally:
+            fa_mod._FORCE_INTERPRET = True
+        np.testing.assert_allclose(out_kernel, out_scan, rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        lk_mod.lstm_scan = real
+
+    # training through the kernel converges
+    from deeplearning4j_tpu import DataSet
+    net2 = build(128)
+    ds = DataSet(f, l)
+    s0 = float(net2.score(ds))
+    for _ in range(10):
+        net2.fit(ds)
+    assert float(net2.score(ds)) < s0
+
+
+def test_tbptt_stream_state_continuity():
+    """Segment-wise execution through the kernel (h0/c0 carried between
+    calls) equals one full-sequence run — the TBPTT contract."""
+    xp, rw, pp, h0, c0, _ = _inputs(b=8, T=6, H=128, peep=True, seed=9)
+    full, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0)
+    y1, (h1, c1) = lk.lstm_scan(xp[:, :3], rw, pp, h0, c0)
+    y2, (h2, c2) = lk.lstm_scan(xp[:, 3:], rw, pp, h1, c1)
+    np.testing.assert_allclose(np.asarray(jnp.concatenate([y1, y2], 1)),
+                               np.asarray(full), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h2), np.asarray(hT), rtol=1e-5,
+                               atol=1e-5)
