@@ -466,13 +466,12 @@ class _PipelinedBase:
     def _stage_fn(self, params_slice, state_slice, x, *rest):
         """One pipeline stage = repeats_per_stage repeats of the period-p
         block (leaves carry the local [R/S, ...] repeat dim). ``rest`` is
-        (mask, key) when the pipeline streams masks (MLN) or just (key,)
-        (CG); ``key`` is the per-(stage, microbatch) PRNG key driving
-        dropout/weight noise exactly like the container's per-layer keys.
-        Returns the activations and the functionally-updated state
-        slice."""
-        mask = rest[0] if len(rest) == 2 else None
-        key = rest[-1]
+        (mask, key) — both pipelines stream masks (the MLN's [b, T] mask,
+        the CG's propagated body-input mask; None when unmasked); ``key``
+        is the per-(stage, microbatch) PRNG key driving dropout/weight
+        noise exactly like the container's per-layer keys. Returns the
+        activations and the functionally-updated state slice."""
+        mask, key = rest
         new_state = {str(l): state_slice[str(l)] for l in range(self.period)}
         for j in range(self.repeats_per_stage):
             for l, impl in enumerate(self.body_impls):
@@ -857,7 +856,7 @@ class PipelinedGraph(_PipelinedBase):
         self._pipeline = spmd_pipeline(self._stage_fn, mesh, axis, data_axis,
                                        squeeze_stage=False,
                                        _needs_x_grad=True, stateful=True,
-                                       with_rng=True)
+                                       with_masks=True, with_rng=True)
         self.params = self._place(self._partition_tree(net.params))
         self.states = self._place(self._partition_tree(net.states))
         self.upd_state = self._place(self.updater.init_state(self.params))
@@ -885,73 +884,96 @@ class PipelinedGraph(_PipelinedBase):
         return out
 
     # -- forward pieces ----------------------------------------------------
-    def _apply_vertices(self, names, params, states, acts, ctx, key):
+    def _apply_vertices(self, names, params, states, acts, masks, ctx, key):
         """Run the given vertices (already topo-ordered) functionally over
-        ``acts``; returns (acts, new_states) for the sub-DAG. ``key`` seeds
-        per-vertex dropout/weight-noise streams (folded by position)."""
+        ``acts``; returns (acts, masks, new_states) for the sub-DAG. ``key``
+        seeds per-vertex dropout/weight-noise streams (folded by position).
+        ``masks`` propagates [b, T] sequence masks exactly like
+        ``ComputationGraph._apply_graph`` (layers carry their single input's
+        mask; vertices combine via ``propagate_mask``)."""
         from ..nn.conf.layers import Layer
 
         conf = self.net.conf
         new_st = dict(states)
         acts = dict(acts)
+        masks = dict(masks)
         for pos, name in enumerate(names):
             if name in self._skip_outputs:
                 continue
             v = conf.vertices[name]
-            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            in_names = conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
             if isinstance(v, Layer):
                 x = xs[0]
                 pre = conf.input_preprocessors.get(name)
                 if pre is not None:
                     x = pre(x, ctx)
+                m = masks.get(in_names[0])
                 impl = self.net.impls[name]
                 k = jax.random.fold_in(key, pos)
                 p_n = impl.noised_params(params[name], True, k)
                 y, ns = impl.forward(p_n, states[name], x,
-                                     train=True, rng=k, mask=None,
+                                     train=True, rng=k, mask=m,
                                      ctx=ctx)
                 new_st[name] = ns
                 acts[name] = y
+                masks[name] = m
             else:
                 acts[name] = v.forward(xs, ctx)
-        return acts, new_st
+                masks[name] = v.propagate_mask(
+                    [masks.get(i) for i in in_names])
+        return acts, masks, new_st
 
-    def _entry_apply(self, params, states, inputs_mb, keys_mb):
-        """Entry sub-DAG per microbatch → stacked activations for every
-        entry vertex (the head may consume any of them — skip connections
-        around the body)."""
+    def _entry_apply(self, params, states, inputs_mb, fm_mb, keys_mb):
+        """Entry sub-DAG per microbatch → stacked activations AND propagated
+        masks for every entry vertex (the head may consume any of them —
+        skip connections around the body). ``fm_mb``: per-network-input
+        [M, mb, T] masks (or None)."""
         conf = self.net.conf
+        n_in = len(conf.network_inputs)
 
         def step(st, xk):
-            inputs, k = xk
+            inputs, in_masks, k = xk
             acts = dict(zip(conf.network_inputs, inputs))
-            ctx = {"inputs": acts, "input_masks": {}}
-            acts, new_st = self._apply_vertices(self.entry_names, params, st,
-                                                acts, ctx, k)
-            return new_st, acts
+            masks = dict(zip(conf.network_inputs,
+                             in_masks or [None] * n_in))
+            ctx = {"inputs": acts, "input_masks": masks}
+            acts, masks, new_st = self._apply_vertices(
+                self.entry_names, params, st, acts, masks, ctx, k)
+            return new_st, (acts, masks)
 
         if not jax.tree_util.tree_leaves(states):
-            return states, jax.vmap(
-                lambda i, k: step(states, (i, k))[1])(inputs_mb, keys_mb)
-        return lax.scan(step, states, (inputs_mb, keys_mb))
+            acts, masks = jax.vmap(
+                lambda i, m, k: step(states, (i, m, k))[1])(
+                    inputs_mb, fm_mb, keys_mb)
+            return states, acts, masks
+        st, (acts, masks) = lax.scan(step, states,
+                                     (inputs_mb, fm_mb, keys_mb))
+        return st, acts, masks
 
     def _head_apply(self, params, states, entry_params, entry_states,
-                    entry_acts, feats, l_mb, keys_mb):
+                    entry_acts, entry_masks, feats, l_mb, lm_mb, keys_mb):
         """Head sub-DAG + the container's multi-output summed loss per
         microbatch; returns (final head state, per-microbatch losses).
         Entry-side auxiliary outputs resolve their params from
-        ``entry_params`` (their state is empty — checked at construction)."""
+        ``entry_params`` (their state is empty — checked at construction).
+        ``entry_masks``: per-microbatch propagated masks of the entry
+        vertices; the body is a chain of layers so its output carries the
+        body input's mask unchanged (``_apply_graph``'s layer rule)."""
         conf = self.net.conf
         impls = self.net.impls
 
         def step(st, xy):
-            acts, feat, labels, key = xy
+            acts, in_masks, feat, labels, lmasks, key = xy
             acts = dict(acts)
             acts[self.body[-1]] = feat
+            masks = dict(in_masks)
+            masks[self.body[-1]] = in_masks.get(self.body_input)
             ctx = {"inputs": {k: acts.get(k) for k in conf.network_inputs},
-                   "input_masks": {}}
-            acts, new_st = self._apply_vertices(self.head_names, params, st,
-                                                acts, ctx, key)
+                   "input_masks": {k: masks.get(k)
+                                   for k in conf.network_inputs}}
+            acts, masks, new_st = self._apply_vertices(
+                self.head_names, params, st, acts, masks, ctx, key)
             total = 0.0
             for oi, (out_name, lbl) in enumerate(zip(conf.network_outputs,
                                                      labels)):
@@ -962,12 +984,18 @@ class PipelinedGraph(_PipelinedBase):
                 entry_side = out_name in self._entry_outputs
                 p_o = (entry_params if entry_side else params)[out_name]
                 s_o = (entry_states if entry_side else st)[out_name]
-                x = acts[conf.vertex_inputs[out_name][0]]
+                in_name = conf.vertex_inputs[out_name][0]
+                x = acts[in_name]
                 pre = conf.input_preprocessors.get(out_name)
                 if pre is not None:
                     x = pre(x, ctx)
+                # container mask rule (ComputationGraph._loss_fn): label
+                # mask, else the propagated mask for sequence outputs
+                lm = None if lmasks is None else lmasks[oi]
+                mask = lm if lm is not None else (
+                    masks.get(in_name) if x.ndim == 3 else None)
                 ko = jax.random.fold_in(key, len(self.head_names) + oi)
-                total = total + impl.loss_on(p_o, s_o, x, lbl, mask=None,
+                total = total + impl.loss_on(p_o, s_o, x, lbl, mask=mask,
                                              train=True, rng=ko)
                 if not entry_side and hasattr(impl, "update_state"):
                     new_st[out_name] = impl.update_state(
@@ -976,25 +1004,28 @@ class PipelinedGraph(_PipelinedBase):
 
         if not jax.tree_util.tree_leaves(states):
             return states, jax.vmap(
-                lambda a, f, l, k: step(states, (a, f, l, k))[1])(
-                    entry_acts, feats, l_mb, keys_mb)
-        return lax.scan(step, states, (entry_acts, feats, l_mb, keys_mb))
+                lambda a, m, f, l, lm, k: step(states,
+                                               (a, m, f, l, lm, k))[1])(
+                    entry_acts, entry_masks, feats, l_mb, lm_mb, keys_mb)
+        return lax.scan(step, states, (entry_acts, entry_masks, feats, l_mb,
+                                       lm_mb, keys_mb))
 
     def _loss(self, tree, states, inputs_mb, labels_mb, fm_mb, lm_mb, key):
-        del fm_mb, lm_mb  # CG masks unsupported (rejected in fit_batch)
         p = self.period
         M = inputs_mb[0].shape[0]
         S = self.n_stages
         ek = jax.random.split(jax.random.fold_in(key, S), M)
         hk = jax.random.split(jax.random.fold_in(key, S + 1), M)
-        entry_st, entry_acts = self._entry_apply(tree["entry"],
-                                                 states["entry"], inputs_mb,
-                                                 ek)
+        entry_st, entry_acts, entry_masks = self._entry_apply(
+            tree["entry"], states["entry"], inputs_mb, fm_mb, ek)
         feats, blocks_st = self._pipeline(tree["blocks"], states["blocks"],
-                                          entry_acts[self.body_input], key)
+                                          entry_acts[self.body_input],
+                                          entry_masks.get(self.body_input),
+                                          key)
         head_st, losses = self._head_apply(tree["head"], states["head"],
                                            tree["entry"], states["entry"],
-                                           entry_acts, feats, labels_mb, hk)
+                                           entry_acts, entry_masks, feats,
+                                           labels_mb, lm_mb, hk)
         loss = jnp.mean(losses)
         reg = 0.0
         for part, names in (("entry", self.entry_names),
@@ -1039,18 +1070,30 @@ class PipelinedGraph(_PipelinedBase):
                   labels_mask=None):
         """One pipelined step; ``inputs``/``labels`` are tuples of arrays
         (the ComputationGraph convention) — single arrays are wrapped.
-        User-facing conv inputs are NCHW (the container boundary rule) and
-        adapted to internal NHWC exactly like ``ComputationGraph.fit``."""
-        if features_mask is not None or labels_mask is not None:
-            raise ValueError("PipelinedGraph does not support masks yet; "
-                             "train unpipelined for masked graphs")
-        if not isinstance(inputs, (tuple, list)):
-            inputs = (inputs,)
-        if not isinstance(labels, (tuple, list)):
-            labels = (labels,)
+        ``features_mask``/``labels_mask``: per-input / per-output [b, T]
+        sequence masks (single arrays wrapped), propagated through
+        entry/body/head with ``ComputationGraph._apply_graph``'s rules and
+        applied to each output loss — same semantics as the container's
+        masked ``fit``. User-facing conv inputs are NCHW (the container
+        boundary rule) and adapted to internal NHWC exactly like
+        ``ComputationGraph.fit``."""
+        def as_tuple(t):
+            return None if t is None else (
+                tuple(t) if isinstance(t, (tuple, list)) else (t,))
+
+        inputs = as_tuple(inputs)
+        labels = as_tuple(labels)
+        fm = as_tuple(features_mask)
+        lm = as_tuple(labels_mask)
+        if fm is not None and len(fm) != len(self.net.conf.network_inputs):
+            raise ValueError(f"features_mask needs one entry per network "
+                             f"input ({len(self.net.conf.network_inputs)})")
+        if lm is not None and len(lm) != len(self.net.conf.network_outputs):
+            raise ValueError(f"labels_mask needs one entry per network "
+                             f"output ({len(self.net.conf.network_outputs)})")
         inputs = self.net._adapt_inputs(tuple(jnp.asarray(i)
                                               for i in inputs))
-        return super().fit_batch(tuple(inputs), tuple(labels))
+        return super().fit_batch(tuple(inputs), tuple(labels), fm, lm)
 
 
 def pipeline_parallel_step(net, mesh: Mesh, n_microbatches: int = 4,

@@ -702,3 +702,102 @@ def test_pipelined_graph_output_dropout_active():
                                       n_microbatches=2).fit_batch(f, l))
     assert la == lb                       # deterministic given seed/iter
     assert abs(la - ln) > 1e-6            # dropout fires in the head loss
+
+
+def test_pipelined_graph_masked_sequences_match_raw_step():
+    """PipelinedGraph masks: per-input [b, T] feature masks propagate
+    through entry → body → head with ComputationGraph._apply_graph's rules
+    and the label mask gates each output loss — the pipelined masked step
+    must reproduce the container's masked CG step (loss + params)."""
+    import jax
+    from deeplearning4j_tpu import (NeuralNetConfiguration, ComputationGraph,
+                                    Sgd, InputType)
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    def build():
+        gb = (NeuralNetConfiguration.builder().seed(14)
+              .updater(Sgd(learning_rate=0.1)).activation("tanh")
+              .graph_builder().add_inputs("in")
+              .add_layer("l0", LSTM(n_out=8), "in"))
+        prev = "l0"
+        for i in range(4):
+            gb = gb.add_layer(f"mid{i}", LSTM(n_out=8), prev)
+            prev = f"mid{i}"
+        gb = (gb.add_layer("out", RnnOutputLayer(n_out=4,
+                                                 activation="softmax",
+                                                 loss="mcxent"), prev)
+              .set_outputs("out")
+              .set_input_types(InputType.recurrent(5)))
+        return ComputationGraph(gb.build()).init()
+
+    net = build()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+    assert pp.body == ["mid0", "mid1", "mid2", "mid3"]
+
+    rng = np.random.default_rng(7)
+    f = rng.normal(size=(4, 6, 5)).astype(np.float32)
+    ids = rng.integers(0, 4, size=(4, 6))
+    l = np.eye(4, dtype=np.float32)[ids]
+    fm = (np.arange(6)[None, :] < [[6], [4], [5], [3]]).astype(np.float32)
+
+    loss_pp = float(pp.fit_batch(f, l, features_mask=fm, labels_mask=fm))
+
+    raw = jax.jit(net._raw_step(False))
+    p2, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                             jnp.asarray(0, jnp.int32),
+                             jax.random.PRNGKey(2),
+                             (jnp.asarray(f),), (jnp.asarray(l),),
+                             (jnp.asarray(fm),), (jnp.asarray(fm),))
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
+    exported = pp.export_params()
+    for k in p2:
+        for name in p2[k]:
+            np.testing.assert_allclose(
+                np.asarray(exported[k][name]), np.asarray(p2[k][name]),
+                rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
+    # and masking matters: an unmasked run gives a DIFFERENT loss
+    pp2 = pipeline_parallel_step(build(), make_mesh(jax.devices()[:2],
+                                                    axes=("pipe",)),
+                                 n_microbatches=2)
+    loss_unmasked = float(pp2.fit_batch(f, l))
+    assert abs(loss_unmasked - loss_pp) > 1e-6
+
+
+def test_pipelined_graph_label_mask_only_fallback():
+    """With no label mask, a 3-dim output falls back to the PROPAGATED
+    feature mask (the container's mask rule) — pipelined == raw."""
+    import jax
+    from deeplearning4j_tpu import (NeuralNetConfiguration, ComputationGraph,
+                                    Sgd, InputType)
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step, make_mesh
+
+    gb = (NeuralNetConfiguration.builder().seed(15)
+          .updater(Sgd(learning_rate=0.1)).activation("tanh")
+          .graph_builder().add_inputs("in")
+          .add_layer("l0", LSTM(n_out=6), "in"))
+    prev = "l0"
+    for i in range(2):
+        gb = gb.add_layer(f"mid{i}", LSTM(n_out=6), prev)
+        prev = f"mid{i}"
+    gb = (gb.add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                             loss="mcxent"), prev)
+          .set_outputs("out").set_input_types(InputType.recurrent(4)))
+    net = ComputationGraph(gb.build()).init()
+    mesh = make_mesh(jax.devices()[:2], axes=("pipe",))
+    pp = pipeline_parallel_step(net, mesh, n_microbatches=2)
+
+    rng = np.random.default_rng(9)
+    f = rng.normal(size=(4, 5, 4)).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 5))]
+    fm = (np.arange(5)[None, :] < [[5], [3], [4], [2]]).astype(np.float32)
+
+    loss_pp = float(pp.fit_batch(f, l, features_mask=fm))
+    raw = jax.jit(net._raw_step(False))
+    _, _, _, loss_raw = raw(net.params, net.states, net.updater_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(2),
+                            (jnp.asarray(f),), (jnp.asarray(l),),
+                            (jnp.asarray(fm),), None)
+    np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
