@@ -81,9 +81,14 @@ class _BaseLSTMImpl(LayerImpl):
         act = self.activation
         gate_act = get_activation(getattr(c, "gate_activation", "sigmoid"))
         b, T, _ = x.shape
+        # the step mask is data, not a differentiable input: stop_gradient
+        # here so the scan path's AD agrees with the persistent kernel's
+        # custom_vjp (which returns a zero mask cotangent) — no silent
+        # kernel-vs-fallback gradient divergence for soft masks
+        mask = None if mask is None else lax.stop_gradient(mask)
         if reverse:
             x = jnp.flip(x, axis=1)
-            mask = None if mask is None else jnp.flip(mask, axis=1)
+            mask = jnp.flip(mask, axis=1) if mask is not None else None
         ad = acc_dtype(self.compute_dtype)
         # hoisted input projection: [b*T, nIn] @ [nIn, 4H] on the MXU
         xp = (x.reshape(b * T, -1).astype(self.compute_dtype)

@@ -200,7 +200,12 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
         m = None
     dh_c = dh_tot if m is None else m * dh_tot
     dc_c = dc_tot if m is None else m * dc_tot
-    tc = jnp.tanh(c_out)
+    # cseq stores the POST-mask c_eff (it is the next step's c_prev); the
+    # tanh/peephole-o in the forward used the PRE-mask candidate — recompute
+    # it from the saved gates so masked-step gradients are exact for any
+    # mask value in [0, 1], not just binary
+    c_cand = c_out if m is None else f * c_prev + i * g
+    tc = jnp.tanh(c_cand)
     do = dh_c * tc
     dzo = do * o * (1.0 - o)
     dc = dc_c + dh_c * o * (1.0 - tc * tc)
@@ -221,14 +226,17 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
         # peephole grads accumulate across steps ([8, H] scratch rows 0-2)
         dp_s[0] = dp_s[0] + jnp.sum(dzi * c_prev, axis=0)
         dp_s[1] = dp_s[1] + jnp.sum(dzf * c_prev, axis=0)
-        dp_s[2] = dp_s[2] + jnp.sum(dzo * c_out, axis=0)
+        dp_s[2] = dp_s[2] + jnp.sum(dzo * c_cand, axis=0)
     dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)   # [b, 4H]
     rwt = rwt_ref[...].astype(jnp.float32)                # resident [4H, H]
     dh_prev = jax.lax.dot_general(dz, rwt, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
     if m is not None:
+        # dc/dz already carry the m factor (via dh_c/dc_c) — only the
+        # straight-through (1-m) residual is added here; an extra m factor
+        # would double-scale fractional masks (binary masks hide it: m² = m)
         dh_prev = dh_prev + (1.0 - m) * dh_tot
-        dc_prev = m * dc_prev + (1.0 - m) * dc_tot
+        dc_prev = dc_prev + (1.0 - m) * dc_tot
     dh_s[:] = dh_prev
     dc_s[:] = dc_prev
     dz_ref[0] = dz.astype(dz_ref.dtype)
@@ -360,12 +368,14 @@ def supported(b: int, T: int, H: int, activation: str,
                 return False
         except Exception:  # pragma: no cover
             return False
-    # VMEM budget: the point of the kernel is a RESIDENT f32 [H, 4H] weight
-    # block (fwd; its transpose in the bwd kernel) — cap it well under a
-    # core's VMEM so wide nets fall back to the scan instead of failing a
-    # Mosaic allocation (H=512 → 4 MB ✓, H=768 → 9.4 MB ✓, H=1024 → 16 MB ✗
-    # until a bf16-resident variant lands).
-    if H * 4 * H * 4 > 12 * 2 ** 20 or b > 1024:
+    # VMEM budget: resident f32 [H, 4H] weights (16H² bytes; the bwd kernel
+    # holds the transpose) PLUS the batch-dependent per-step blocks — xp/ys/
+    # gates/cseq/dz streams (double-buffered by the pipeline), h0/c0/dhT/dcT
+    # and the h/c scratch. Worst case (bwd) ≈ 16H² + ~120·b·H bytes; cap the
+    # SUM under a core's VMEM so oversized configs fall back to the scan
+    # instead of failing a Mosaic allocation (b=64,H=512 → 7.9 MB ✓;
+    # b=256,H=512 → 19.7 MB ✗ → scan; H=1024 needs a bf16-resident variant).
+    if 16 * H * H + 120 * b * H > 12 * 2 ** 20 or b > 1024:
         return False
     return (activation == "tanh" and gate_activation == "sigmoid"
             and H % 128 == 0 and b % 8 == 0 and T >= 1)
@@ -374,7 +384,10 @@ def supported(b: int, T: int, H: int, activation: str,
 def lstm_scan(xp, rw, peep, h0, c0, mask=None):
     """Persistent-LSTM sequence step. ``xp``: [b, T, 4H] hoisted input
     projection (+bias), ``rw``: [H, 4H], ``peep``: (pi, pf, po) tuple or
-    None, ``h0``/``c0``: [b, H], ``mask``: [b, T] (1 = real step) or None.
+    None, ``h0``/``c0``: [b, H], ``mask``: [b, T] (1 = real step, values in
+    [0, 1]) or None. The mask is NON-differentiable (the custom_vjp returns
+    a zero cotangent for it); callers differentiating through a soft mask
+    must stop_gradient it on their fallback path too (recurrent.py does).
     Returns (ys [b, T, H], (hT, cT)) in f32 accumulation dtype — a drop-in
     for the ``lax.scan`` recurrent loop with the weight stream eliminated."""
     b, T, H4 = xp.shape
