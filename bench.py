@@ -40,6 +40,23 @@ _PROBE_SRC = ("import os, jax\n"
               "jax.devices()\n")
 
 
+def _hb():
+    """Heartbeat for the parent's wedge watchdog: touch the file named by
+    ``BENCH_HB`` (set by ``_run_one_subprocess``) at each progress point —
+    value fetches (``_sync``), device contact at child start, and the slow
+    host-side milestones (h5 generation, Keras import). The one phase that
+    CANNOT beat is a single in-flight XLA compile RPC, which is why the
+    stale threshold defaults well above any compile observed on the tunnel
+    (longest: low minutes) — see ``_run_one_subprocess``."""
+    path = os.environ.get("BENCH_HB")
+    if path:
+        try:
+            with open(path, "w") as fh:
+                fh.write(str(time.time()))
+        except OSError:
+            pass
+
+
 def _sync(x):
     """Reliable completion barrier: materialize the VALUE of (a leaf of) ``x``
     on the host. Under the axon TPU tunnel ``jax.block_until_ready`` can
@@ -49,7 +66,9 @@ def _sync(x):
     queued step's compute."""
     import jax
     leaf = jax.tree_util.tree_leaves(x)[-1]
-    return np.asarray(leaf)
+    out = np.asarray(leaf)
+    _hb()                     # value fetched ⇒ genuine progress
+    return out
 
 
 def _time_steps(step_fn, n_warmup=3, n_timed=10):
@@ -227,6 +246,7 @@ def _inception_v3_h5():
                                           input_shape=(299, 299, 3),
                                           classes=1000)
     m.save(path)
+    _hb()       # minutes of host-side work — not a wedge
     return path
 
 
@@ -240,6 +260,7 @@ def bench_keras_import_parallel(batch_per_step=128, iters=10):
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
 
     net = KerasModelImport.import_keras_model_and_weights(_inception_v3_h5())
+    _hb()       # 313-layer import parsed — host-side progress
     net.gc.compute_dtype = "bfloat16"
     rng = np.random.default_rng(0)
     n_dev = len(jax.devices())
@@ -319,16 +340,59 @@ def _run_one_subprocess(name, timeout_s=2400):
     loses only that config, not the whole sweep (round-3 VERDICT: 'emit
     partial results per-config so one hang doesn't zero the sweep').
     The generous timeout only fires when genuinely wedged — normal compiles
-    are well under it (killing a healthy compile can wedge the tunnel)."""
+    are well under it (killing a healthy compile can wedge the tunnel).
+    A HEARTBEAT watchdog cuts wedge detection from ``timeout_s`` to
+    ``BENCH_HB_STALE_S`` (default 1200 s): the child touches ``BENCH_HB``
+    at every value fetch (``_sync``), at device contact on startup, and at
+    the slow host-side milestones, so a stale file means no progress for
+    that long — kill early and let the caller re-probe (the round-4 tunnel
+    FLAPPED; a fast kill catches more up-windows). Tradeoff, accepted
+    deliberately: a single compile RPC cannot beat, so a compile longer
+    than the threshold would be killed as wedged (observed compiles are
+    minutes at worst; raise BENCH_HB_STALE_S if a model ever legitimately
+    needs more — killing a healthy compile can wedge the tunnel, which is
+    why the threshold is generous and the caller re-probes after every
+    kill)."""
     import subprocess
+    import tempfile
 
+    stale_s = float(os.environ.get("BENCH_HB_STALE_S", 1200))
+    hb = tempfile.NamedTemporaryFile(prefix=f"bench_hb_{name}_",
+                                     delete=False)
+    hb.close()
+    env = dict(os.environ, BENCH_HB=hb.name)
     try:
-        p = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--one", name],
-            capture_output=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        print(f"# {name} TIMED OUT after {timeout_s}s (tunnel wedged "
-              f"mid-run?)", file=sys.stderr)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        t0 = time.monotonic()
+        start_wall = time.time()
+        timed_out = stale = False
+        while True:
+            try:
+                out, err = proc.communicate(timeout=15)
+                break
+            except subprocess.TimeoutExpired:
+                last_beat = max(os.path.getmtime(hb.name), start_wall)
+                if time.monotonic() - t0 > timeout_s:
+                    timed_out = True
+                elif time.time() - last_beat > stale_s:
+                    stale = True
+                else:
+                    continue
+                proc.kill()
+                out, err = proc.communicate()
+                break
+        p = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
+    finally:
+        try:
+            os.unlink(hb.name)
+        except OSError:
+            pass
+    if timed_out or stale:
+        why = (f"TIMED OUT after {timeout_s}s" if timed_out
+               else f"heartbeat stale > {stale_s:.0f}s")
+        print(f"# {name} {why} (tunnel wedged mid-run?)", file=sys.stderr)
         return None
     sys.stderr.write(p.stderr.decode(errors="replace"))
     if p.returncode != 0:
@@ -386,6 +450,9 @@ def main():
         # child mode: run exactly one config in-process, print a result line
         name = sys.argv[sys.argv.index("--one") + 1]
         fn = next(f for n, _, f in ALL_BENCHES if n == name)
+        import jax
+        jax.devices()    # device contact proven before the first beat
+        _hb()
         print(json.dumps({"one": name, "value": round(fn(), 1)}))
         return
 

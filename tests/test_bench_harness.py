@@ -65,31 +65,82 @@ def test_await_backend_backoff_schedule(bench, monkeypatch):
     assert sum(sleeps) <= 500
 
 
-def test_run_one_subprocess_parses_result_line(bench, monkeypatch):
-    def fake_run(cmd, capture_output, timeout):
-        out = ("# some stderr-ish noise on stdout\n"
-               + json.dumps({"one": "lenet_mnist_images_per_sec",
-                             "value": 123.4}) + "\n")
-        return types.SimpleNamespace(returncode=0, stdout=out.encode(),
-                                     stderr=b"warning: xyz\n")
+class _FakeProc:
+    """Scripted stand-in for subprocess.Popen: ``hangs`` controls how many
+    communicate() calls raise TimeoutExpired before completing."""
 
+    def __init__(self, returncode=0, stdout=b"", stderr=b"", hangs=0):
+        self.args = ["python", "bench.py"]
+        self.returncode = returncode
+        self._out, self._err = stdout, stderr
+        self._hangs = hangs
+        self.killed = False
+
+    def communicate(self, timeout=None):
+        import subprocess as sp
+        if self._hangs > 0 and not self.killed:
+            self._hangs -= 1
+            raise sp.TimeoutExpired(self.args, timeout)
+        return self._out, self._err
+
+    def kill(self):
+        self.killed = True
+
+
+def test_run_one_subprocess_parses_result_line(bench, monkeypatch):
+    out = ("# some stderr-ish noise on stdout\n"
+           + json.dumps({"one": "lenet_mnist_images_per_sec",
+                         "value": 123.4}) + "\n").encode()
     import subprocess as sp
-    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(sp, "Popen", lambda *a, **k: _FakeProc(
+        stdout=out, stderr=b"warning: xyz\n"))
     assert bench._run_one_subprocess("lenet_mnist_images_per_sec") == 123.4
 
 
 def test_run_one_subprocess_failure_and_timeout(bench, monkeypatch):
     import subprocess as sp
 
-    monkeypatch.setattr(sp, "run", lambda *a, **k: types.SimpleNamespace(
-        returncode=1, stdout=b"", stderr=b"boom"))
+    monkeypatch.setattr(sp, "Popen",
+                        lambda *a, **k: _FakeProc(returncode=1,
+                                                  stderr=b"boom"))
     assert bench._run_one_subprocess("x") is None
 
-    def raise_timeout(cmd, capture_output, timeout):
-        raise sp.TimeoutExpired(cmd, timeout)
+    # hard timeout: communicate never completes until killed
+    monkeypatch.setattr(sp, "Popen", lambda *a, **k: _FakeProc(hangs=10**9))
+    assert bench._run_one_subprocess("x", timeout_s=0.0) is None
 
-    monkeypatch.setattr(sp, "run", raise_timeout)
-    assert bench._run_one_subprocess("x") is None
+
+def test_run_one_subprocess_heartbeat_stale_kill(bench, monkeypatch):
+    """A child whose heartbeat file never advances past spawn time is
+    killed after BENCH_HB_STALE_S even though the hard timeout is far away
+    (the wedge watchdog); a child that still beats is left running."""
+    import subprocess as sp
+
+    procs = []
+
+    def fake_popen(*a, **k):
+        procs.append(_FakeProc(hangs=3))
+        return procs[-1]
+
+    monkeypatch.setattr(sp, "Popen", fake_popen)
+    monkeypatch.setenv("BENCH_HB_STALE_S", "-1")   # instantly stale
+    assert bench._run_one_subprocess("x", timeout_s=10**9) is None
+    assert procs[-1].killed
+
+    # heartbeat advancing (getmtime = now) → no stale kill, child finishes
+    monkeypatch.setenv("BENCH_HB_STALE_S", "3600")
+    import time as _time
+    monkeypatch.setattr(bench.os.path, "getmtime",
+                        lambda p: _time.time())
+    out = json.dumps({"one": "x", "value": 5.0}).encode()
+
+    def fake_popen2(*a, **k):
+        procs.append(_FakeProc(stdout=out, hangs=2))
+        return procs[-1]
+
+    monkeypatch.setattr(sp, "Popen", fake_popen2)
+    assert bench._run_one_subprocess("x", timeout_s=10**9) == 5.0
+    assert not procs[-1].killed
 
 
 def test_partial_results_persisted_per_config(bench, tmp_path, monkeypatch):

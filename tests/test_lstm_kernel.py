@@ -89,30 +89,32 @@ def test_forward_matches_scan(peep, mask):
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("peep", [False, True])
-@pytest.mark.parametrize("mask", [False, True])
-def test_grads_match_scan(peep, mask):
-    """Hand-written BPTT kernel == AD of the scan, for every input: xp
-    (→ dW/dx/db outside), RW, peepholes, h0, c0 — including carry grads
+def _assert_grads_match(xp, rw, pp, h0, c0, mk):
+    """Gradient parity harness shared by the binary- and fractional-mask
+    tests: hand-written BPTT kernel == AD of the scan, for every input —
+    xp (→ dW/dx/db outside), RW, peepholes, h0, c0, including carry grads
     through hT/cT."""
-    xp, rw, pp, h0, c0, mk = _inputs(b=8, T=4, H=128, peep=peep, mask=mask,
-                                     seed=3)
-
-    def loss_k(xp, rw, pp, h0, c0):
-        ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
-        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
-
-    def loss_s(xp, rw, pp, h0, c0):
-        ys, (hT, cT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
-        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
+    def loss(run):
+        def f(xp, rw, pp, h0, c0):
+            ys, (hT, cT) = run(xp, rw, pp, h0, c0, mk)
+            return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
+        return f
 
     argnums = (0, 1, 3, 4) if pp is None else (0, 1, 2, 3, 4)
-    gk = jax.grad(loss_k, argnums=argnums)(xp, rw, pp, h0, c0)
-    gs = jax.grad(loss_s, argnums=argnums)(xp, rw, pp, h0, c0)
+    gk = jax.grad(loss(lk.lstm_scan), argnums=argnums)(xp, rw, pp, h0, c0)
+    gs = jax.grad(loss(_scan_oracle), argnums=argnums)(xp, rw, pp, h0, c0)
     for a, want in zip(jax.tree_util.tree_leaves(gk),
                        jax.tree_util.tree_leaves(gs)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("peep", [False, True])
+@pytest.mark.parametrize("mask", [False, True])
+def test_grads_match_scan(peep, mask):
+    xp, rw, pp, h0, c0, mk = _inputs(b=8, T=4, H=128, peep=peep, mask=mask,
+                                     seed=3)
+    _assert_grads_match(xp, rw, pp, h0, c0, mk)
 
 
 def test_layer_routes_through_kernel_and_matches():
@@ -195,27 +197,12 @@ def test_grads_match_scan_fractional_mask(peep):
     rng = np.random.default_rng(13)
     mk = jnp.asarray(rng.uniform(0.1, 0.9, size=(8, 4)), jnp.float32)
 
-    def loss_k(xp, rw, pp, h0, c0):
-        ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
-        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
-
-    def loss_s(xp, rw, pp, h0, c0):
-        ys, (hT, cT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
-        return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3)
-
     # forward parity first (cseq stores post-mask c; candidate recomputed)
     np.testing.assert_allclose(
         np.asarray(lk.lstm_scan(xp, rw, pp, h0, c0, mk)[0]),
         np.asarray(_scan_oracle(xp, rw, pp, h0, c0, mk)[0]),
         rtol=1e-5, atol=1e-5)
-
-    argnums = (0, 1, 3, 4) if pp is None else (0, 1, 2, 3, 4)
-    gk = jax.grad(loss_k, argnums=argnums)(xp, rw, pp, h0, c0)
-    gs = jax.grad(loss_s, argnums=argnums)(xp, rw, pp, h0, c0)
-    for a, want in zip(jax.tree_util.tree_leaves(gk),
-                       jax.tree_util.tree_leaves(gs)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(want),
-                                   rtol=2e-4, atol=2e-4)
+    _assert_grads_match(xp, rw, pp, h0, c0, mk)
 
 
 def test_supported_vmem_budget_counts_batch_blocks():
