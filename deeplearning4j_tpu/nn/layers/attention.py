@@ -189,11 +189,22 @@ class SelfAttentionImpl(LayerImpl):
             # sequence-parallel step (parallel/sequence.py::
             # sequence_parallel_step): this forward runs PER DEVICE inside
             # shard_map with the time dim sharded over ``sp_axis`` — attend
-            # via the ring (flash kernel per block when shapes allow)
+            # via the ring (flash kernel per block when shapes allow).
+            # Attention dropout runs IN the ring kernels at global
+            # coordinates: rng is replicated across shards, so every shard
+            # derives the same seed — the same derivation as mha's flash
+            # path, giving each train step a fresh mask
             from ...parallel.sequence import sp_attend
 
+            rate = c.dropout_rate if (train and rng is not None) else 0.0
+            seed = None
+            if rate > 0.0:
+                seed = jax.random.randint(rng, (), 0,
+                                          jnp.iinfo(jnp.int32).max,
+                                          dtype=jnp.int32)
             o = sp_attend(q.astype(cd), k.astype(cd), v.astype(cd),
-                          sp_axis, bool(c.causal))
+                          sp_axis, bool(c.causal), dropout_rate=rate,
+                          dropout_seed=seed)
         else:
             o = mha(q, k, v, c.causal, cd, c.dropout_rate, rng, train,
                     key_mask=mask)

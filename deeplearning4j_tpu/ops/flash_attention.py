@@ -90,18 +90,34 @@ def _keep_from_coords(seed, bh, qpos, kpos, rate):
 
 
 def _block_keep(seed_ref, bh, qi, kj, rate):
-    """[BLOCK, BLOCK] keep mask for attention block (bh, qi, kj)."""
-    qpos = qi * BLOCK + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 0)
-    kpos = kj * BLOCK + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 1)
+    """[BLOCK, BLOCK] keep mask for attention block (bh, qi, kj). The SMEM
+    seed operand is [3] i32: (seed, q_offset, k_offset) — the offsets make
+    the hashed coordinates GLOBAL, so a kernel running on a ring shard
+    draws bit-identical decisions to a single kernel over the full
+    sequence (``parallel.sequence.ring_flash_attention`` passes each ring
+    step's shard offsets; single-device callers pass 0, 0)."""
+    qpos = (seed_ref[1] + qi * BLOCK
+            + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 0))
+    kpos = (seed_ref[2] + kj * BLOCK
+            + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 1))
     return _keep_from_coords(seed_ref[0], bh, qpos, kpos, rate)
 
 
-def dropout_keep_mask(bh, Tq, Tk, seed, rate):
+def seed3(seed, q_off=0, k_off=0):
+    """Pack the kernels' [3] i32 SMEM dropout operand:
+    (seed, global q offset, global k offset)."""
+    return jnp.stack([jnp.asarray(seed, jnp.int32).reshape(()),
+                      jnp.asarray(q_off, jnp.int32).reshape(()),
+                      jnp.asarray(k_off, jnp.int32).reshape(())])
+
+
+def dropout_keep_mask(bh, Tq, Tk, seed, rate, q_off=0, k_off=0):
     """Materialize the exact [bh, Tq, Tk] keep mask the kernels regenerate
     blockwise — test/debug oracle only (O(T²) memory, which the kernels
-    never allocate)."""
-    qpos = jnp.arange(Tq, dtype=jnp.int32)[:, None]
-    kpos = jnp.arange(Tk, dtype=jnp.int32)[None, :]
+    never allocate). ``q_off``/``k_off`` shift the hashed coordinates the
+    way the ring passes shard offsets."""
+    qpos = q_off + jnp.arange(Tq, dtype=jnp.int32)[:, None]
+    kpos = k_off + jnp.arange(Tk, dtype=jnp.int32)[None, :]
     seed = jnp.asarray(seed, jnp.int32).reshape(())
     return jax.vmap(lambda i: _keep_from_coords(
         seed, i, qpos, kpos, rate))(jnp.arange(bh, dtype=jnp.int32))
@@ -193,8 +209,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
 
 
 def _fwd(q, k, v, km, seed, causal, scale, rate):
-    """q/k/v: [bh, T, d], km: [bh, T, 8] key mask or None, seed: [1] i32 or
-    None (rate > 0) → (o [bh, T, d], lse [bh, T, 8])."""
+    """q/k/v: [bh, T, d], km: [bh, T, 8] key mask or None, seed: [3] i32
+    (seed, q_off, k_off — :func:`seed3`) or None (rate > 0) →
+    (o [bh, T, d], lse [bh, T, 8])."""
     bh, T, d = q.shape
     nq = T // BLOCK
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=nq,
@@ -518,7 +535,7 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     if rate > 0.0:
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 needs dropout_seed")
-        seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+        seed = seed3(dropout_seed)
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, T, d)

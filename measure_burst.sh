@@ -1,36 +1,51 @@
 #!/bin/bash
-# One TPU up-window → every round-4 measurement, in priority order.
-# Each stage is independently useful; a re-wedge mid-burst keeps earlier
-# results (bench.py persists per-config partials itself).
+# Round-4 measurement burst, flap-tolerant: the axon tunnel wedges and
+# recovers unpredictably, so instead of one linear pass this LOOPS over the
+# stages for up to BURST_WINDOW seconds (default 8h), skipping stages that
+# already succeeded (marker files in .burst_state/). Each bench config
+# persists its own partial result (bench.py), so any up-window makes
+# permanent progress. Heartbeat watchdog (bench.py BENCH_HB) kills wedged
+# children in ~20 min instead of 40.
 cd "$(dirname "$0")"
-echo "=== burst start $(date -u +%H:%M:%S) ==="
+STATE=.burst_state
+# fresh state per invocation (bench.py's own per-config partials persist in
+# BASELINE.json regardless); BURST_RESUME=1 keeps completed-stage markers
+# from a previous run
+[ -z "$BURST_RESUME" ] && rm -rf "$STATE"
+mkdir -p "$STATE"
+DEADLINE=$(( $(date +%s) + ${BURST_WINDOW:-28800} ))
+echo "=== burst start $(date -u +%H:%M:%S) (deadline +$(( (DEADLINE-$(date +%s))/60 )) min) ==="
 
-echo "--- stage 1: headline ResNet50 ---"
-BENCH_PROBE_WINDOW_S=${BURST_WINDOW:-14400} python bench.py
-rc=$?
-echo "headline rc=$rc"
-if [ $rc -ne 0 ]; then
-  echo "backend never came up; burst aborted"
-  exit $rc
-fi
+run_stage() {  # run_stage <name> <cmd...>
+  local name=$1; shift
+  [ -f "$STATE/$name.ok" ] && return 0
+  echo "--- stage $name ($(date -u +%H:%M:%S)) ---"
+  "$@"
+  local rc=$?
+  echo "$name rc=$rc"
+  [ $rc -eq 0 ] && touch "$STATE/$name.ok"
+  return $rc
+}
 
-echo "--- stage 2: bench --all ($(date -u +%H:%M:%S)) ---"
-BENCH_PROBE_WINDOW_S=600 python bench.py --all
-echo "all rc=$?"
-
-echo "--- stage 3: flash hardware check ($(date -u +%H:%M:%S)) ---"
-python perf_flash_check.py
-echo "flash rc=$?"
-
-echo "--- stage 4: LSTM roofline ($(date -u +%H:%M:%S)) ---"
-python perf_lstm.py roofline
-echo "roofline rc=$?"
-
-echo "--- stage 4b: LSTM persistent-kernel A/B ($(date -u +%H:%M:%S)) ---"
-python perf_lstm.py ab
-echo "ab rc=$?"
-
-echo "--- stage 5: LSTM sweep ($(date -u +%H:%M:%S)) ---"
-python perf_lstm.py sweep
-echo "sweep rc=$?"
-echo "=== burst done $(date -u +%H:%M:%S) ==="
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  # short probe window per cycle; the outer loop provides the long horizon
+  run_stage headline env BENCH_PROBE_WINDOW_S=900 python bench.py
+  if [ -f "$STATE/headline.ok" ]; then
+    run_stage all      env BENCH_PROBE_WINDOW_S=600 python bench.py --all
+    run_stage flash    python perf_flash_check.py
+    run_stage roofline python perf_lstm.py roofline
+    run_stage ab       python perf_lstm.py ab
+    run_stage sweep    python perf_lstm.py sweep
+  fi
+  if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
+     [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
+     [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ]; then
+    echo "=== all stages complete $(date -u +%H:%M:%S) ==="
+    exit 0
+  fi
+  echo "--- cycle incomplete; sleeping 600s ($(date -u +%H:%M:%S)) ---"
+  sleep 600
+done
+echo "=== burst window exhausted $(date -u +%H:%M:%S) ==="
+ls "$STATE"
+exit 1

@@ -490,23 +490,82 @@ def test_sequence_parallel_flag_does_not_leak_to_dense_paths():
     assert np.isfinite(float(net.score(DataSet(f[:, :64], l[:, :64]))))
 
 
-def test_sequence_parallel_step_rejects_dropout():
+def test_sequence_parallel_step_rejects_activation_dropout():
+    """Per-layer ACTIVATION dropout stays rejected (replicated rng would
+    draw the same mask on every shard); attention-probability dropout on
+    SelfAttentionLayer is allowed — it rides the ring-flash kernels."""
     from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
     from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
-                                                   RnnOutputLayer)
+                                                   RnnOutputLayer, DenseLayer)
     from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
                                              SEQUENCE_AXIS)
 
     mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
     conf = (NeuralNetConfiguration.builder().seed(1)
             .updater(Sgd(learning_rate=0.1)).activation("identity").list()
-            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2,
-                                      dropout_rate=0.1))
+            .layer(SelfAttentionLayer(n_in=8, n_out=8, num_heads=2))
+            .layer(DenseLayer(n_in=8, n_out=8, dropout=0.5))
             .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
                                   loss="mcxent"))
             .build())
-    with pytest.raises(ValueError, match="dropout"):
+    with pytest.raises(ValueError, match="activation dropout"):
         sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)
+
+
+def test_sequence_parallel_step_attention_dropout_matches_unsharded():
+    """Attention-probability dropout through the ring: the sp step derives
+    the same per-step seed as the unsharded flash path (replicated rng) and
+    the ring kernels hash GLOBAL coordinates — so the sp masked step equals
+    the unsharded dropout step exactly, and dropout is genuinely active."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    def make(rate):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-3)).activation("identity")
+                .list()
+                .layer(SelfAttentionLayer(n_in=16, n_out=16, num_heads=2,
+                                          causal=True, dropout_rate=rate))
+                .layer(RnnOutputLayer(n_in=16, n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(3)
+    T = 4 * 128
+    f = rng.normal(size=(2, T, 16)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, T))].astype(
+        np.float32)
+
+    net_a = make(0.3)
+    step, place = sequence_parallel_step(net_a, mesh)
+    place(net_a)
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                            jnp.asarray(f), jnp.asarray(l))
+    net_b = make(0.3)
+    raw = jax.jit(net_b._raw_step(False))
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           jnp.asarray(f), jnp.asarray(l), None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-4)
+
+    # dropout is ACTIVE on the sp path: rate 0 gives a different loss
+    net_c = make(0.0)
+    step_c, place_c = sequence_parallel_step(net_c, mesh)
+    place_c(net_c)
+    _, _, _, loss_c = step_c(net_c.params, net_c.states, net_c.updater_state,
+                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                             jnp.asarray(f), jnp.asarray(l))
+    assert abs(float(loss_c) - float(loss_a)) > 1e-6
 
 
 def test_sequence_parallel_step_dp_sp_composition():

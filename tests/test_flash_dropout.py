@@ -156,3 +156,50 @@ def test_mha_routes_training_dropout_to_flash(monkeypatch):
     mha(q, k, v, causal=True, compute_dtype=jnp.float32,
         dropout_rate=0.4, rng=jax.random.PRNGKey(0), train=False)
     assert calls["rate"] == 0.0
+
+
+def test_ring_flash_dropout_matches_single_kernel():
+    """Ring-flash dropout == the single-kernel flash dropout bit-for-bit:
+    the ring passes each block's GLOBAL (q, k) shard offsets into the
+    kernels' counter-hash PRNG, and the lse-combine (undropped block mass)
+    recombines the dropped numerators exactly."""
+    from deeplearning4j_tpu.parallel.sequence import (ring_flash_attention,
+                                                      SEQUENCE_AXIS)
+    from deeplearning4j_tpu.parallel.sharding import make_mesh
+
+    q, k, v = _qkv(b=1, T=512, h=2, d=16, seed=8)
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    for causal in (True, False):
+        out_ring = ring_flash_attention(q, k, v, mesh, causal=causal,
+                                        dropout_rate=0.3, dropout_seed=21)
+        want = fa.flash_attention(q, k, v, causal=causal, dropout_rate=0.3,
+                                  dropout_seed=21)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_ring_flash_dropout_grads_match_single_kernel():
+    from deeplearning4j_tpu.parallel.sequence import (ring_flash_attention,
+                                                      SEQUENCE_AXIS)
+    from deeplearning4j_tpu.parallel.sharding import make_mesh
+
+    q, k, v = _qkv(b=1, T=512, h=1, d=16, seed=9)
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rate, seed = 0.25, 31
+
+    def loss_ring(q, k, v):
+        o = ring_flash_attention(q, k, v, mesh, causal=True,
+                                 dropout_rate=rate, dropout_seed=seed)
+        return jnp.sum(o ** 2)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, dropout_rate=rate,
+                               dropout_seed=seed)
+        return jnp.sum(o ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, want in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(want),
+                                   rtol=5e-4, atol=5e-4)
