@@ -24,6 +24,7 @@ from .distributed import (ProcessLocalIterator, is_chief,
                           DistributedEarlyStoppingTrainer)
 from .sequence import (ring_attention, ulysses_attention, full_attention,
                        ring_flash_attention, ring_flash_supported,
+                       ulysses_flash_attention, ulysses_flash_supported,
                        sequence_parallel_step)
 from .tensor import megatron_rules, tensor_parallel_step, param_shardings
 from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
@@ -45,6 +46,7 @@ __all__ = [
     "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",
     "ProcessLocalIterator", "is_chief",
     "ring_attention", "ulysses_attention", "full_attention",
+    "ulysses_flash_attention", "ulysses_flash_supported",
     "ring_flash_attention", "ring_flash_supported",
     "sequence_parallel_step",
     "megatron_rules", "tensor_parallel_step", "param_shardings",

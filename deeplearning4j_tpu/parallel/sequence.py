@@ -144,6 +144,52 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     return fn(q, k, v)
 
 
+def _ulysses_flash_inner(q, k, v, axis: str, causal: bool):
+    """Ulysses layout with the FLASH kernel as the local compute: after the
+    sequence→heads all_to_all each device holds the FULL sequence for h/n
+    heads, so ONE Pallas kernel (O(T) memory, in-kernel causal grid skip)
+    replaces both the dense [T, T] logits of ``_ulysses_inner`` and the
+    ring's n sequential per-block launches — 2 all_to_alls on ICI + one
+    big MXU-friendly kernel. Exact; differentiable through the kernel's
+    custom VJP (all_to_all is linear, no custom ring backward needed)."""
+    from ..ops import flash_attention as _fa
+
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _fa.flash_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                            causal: bool = False):
+    """Sequence-parallel attention: Ulysses all_to_all layout + the flash
+    kernel over the gathered sequence (see :func:`_ulysses_flash_inner`).
+    q, k, v: [b, T, h, d]; h divisible by the axis size, T divisible by
+    the flash block × axis size, head_dim ≤ 256
+    (:func:`ulysses_flash_supported`)."""
+    spec = P(None, axis, None, None)
+    fn = shard_map(partial(_ulysses_flash_inner, axis=axis,
+                           causal=bool(causal)),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_flash_supported(T: int, n_shards: int, h: int, d: int) -> bool:
+    from ..ops import flash_attention as _fa
+    n = max(1, n_shards)
+    return (h % n == 0 and T % n == 0 and T % _fa.BLOCK == 0 and d <= 256
+            and (_fa._FORCE_INTERPRET
+                 or _fa.supported(max(T, _fa.MIN_SEQ), d, 0.0, None)))
+
+
 # --------------------------------------------------------------- ring-flash
 def _bh(x):
     b, T, h, d = x.shape
@@ -379,18 +425,30 @@ def sp_attend(q, k, v, axis: str, causal: bool, dropout_rate: float = 0.0,
 
     d = q.shape[-1]
     scale = 1.0 / float(d) ** 0.5
-    Tl = q.shape[1]
+    b, Tl, h, _ = q.shape
+    n = lax.psum(1, axis)            # static under shard_map
     rate = float(dropout_rate)
+    if rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs dropout_seed")
     flash_ok = (Tl % _fa.BLOCK == 0 and d <= 256
                 and (_fa._FORCE_INTERPRET or not _fa._interpret()))
+    # dropout-free + head-divisible: Ulysses layout — 2 all_to_alls on ICI
+    # and ONE full-sequence kernel beats the ring's n sequential launches
+    # (dropout stays on the ring, whose global-coordinate PRNG is bit-equal
+    # to the single-kernel mask; Ulysses splits heads across devices, which
+    # would re-index the PRNG's batch-head coordinate)
+    if rate == 0.0 and ulysses_flash_supported(Tl * n, n, h, d):
+        return _ulysses_flash_inner(q, k, v, axis, causal)
     if flash_ok:
         seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
                            jnp.int32).reshape(())
         return _ring_flash_inner(q, k, v, seed, axis, causal, scale, rate)
     if rate > 0.0:
         raise ValueError(
-            "attention dropout on the sp path needs the ring-flash kernel "
-            f"(local shard {Tl} % {_fa.BLOCK} == 0 and head_dim {d} <= 256)")
+            "attention dropout on the sp path needs the ring-flash kernel: "
+            "a TPU backend (or the tests' forced interpret mode), local "
+            f"shard length {Tl} divisible by {_fa.BLOCK}, and head_dim "
+            f"{d} <= 256")
     return _ring_inner(q, k, v, axis=axis, causal=causal, scale=scale)
 
 
@@ -467,7 +525,10 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                     f"is threaded through the ring in the sp step")
             if (name == "SelfAttentionLayer"
                     and getattr(cand, "dropout_rate", 0.0)):
-                hd = cand.n_out // max(1, cand.num_heads)
+                # same head_dim resolution as the impl (attention._dims):
+                # explicit head_dim wins over n_out // num_heads
+                hd = (getattr(cand, "head_dim", None)
+                      or cand.n_out // max(1, cand.num_heads))
                 if hd > 256:
                     raise ValueError(
                         f"layer {i}: attention dropout on the sp path runs "

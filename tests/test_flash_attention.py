@@ -663,3 +663,101 @@ def test_sequence_parallel_step_computation_graph():
                     jax.tree_util.tree_leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-3, atol=3e-4)
+
+
+def test_ulysses_flash_matches_full_attention():
+    """Ulysses layout + ONE local flash kernel over the gathered sequence
+    == dense full attention (fwd AND grads): the sp path's preferred
+    dropout-free impl (2 all_to_alls instead of n ring launches)."""
+    from deeplearning4j_tpu.parallel import (ulysses_flash_attention,
+                                             make_mesh, SEQUENCE_AXIS)
+    from deeplearning4j_tpu.parallel.sequence import full_attention
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(5)
+    b, T, h, d = 2, 4 * 128, 4, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, T, h, d)), jnp.float32)
+               for _ in range(3))
+    for causal in (True, False):
+        out = ulysses_flash_attention(q, k, v, mesh, causal=causal)
+        ref = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"causal={causal}")
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_flash_attention(q, k, v, mesh,
+                                               causal=True) ** 2)
+
+    def loss_f(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b2 in zip(gu, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_sp_attend_routes_ulysses_when_heads_divide(monkeypatch):
+    """sp step routing: heads divisible by the axis → Ulysses-flash (spied);
+    dropout or indivisible heads → ring; and the Ulysses-routed sp step
+    still equals the unsharded step."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Adam
+    from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                   RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+    import deeplearning4j_tpu.parallel.sequence as seq
+
+    calls = []
+    real = seq._ulysses_flash_inner
+    monkeypatch.setattr(seq, "_ulysses_flash_inner",
+                        lambda *a, **k: (calls.append(1) or real(*a, **k)))
+
+    def make(heads):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-3)).activation("identity")
+                .list()
+                .layer(SelfAttentionLayer(n_in=16, n_out=16,
+                                          num_heads=heads, causal=True))
+                .layer(RnnOutputLayer(n_in=16, n_out=4,
+                                      activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    rng = np.random.default_rng(3)
+    T = 4 * 128
+    f = rng.normal(size=(2, T, 16)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, T))].astype(
+        np.float32)
+
+    net_a = make(heads=4)            # 4 % 4 == 0 → ulysses
+    step, place = sequence_parallel_step(net_a, mesh)
+    place(net_a)
+    pa, _, _, loss_a = step(net_a.params, net_a.states, net_a.updater_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                            jnp.asarray(f), jnp.asarray(l))
+    assert calls, "heads%axis==0 did not route through ulysses-flash"
+
+    net_b = make(heads=4)
+    raw = jax.jit(net_b._raw_step(False))
+    pb, _, _, loss_b = raw(net_b.params, net_b.states, net_b.updater_state,
+                           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                           jnp.asarray(f), jnp.asarray(l), None, None)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-4)
+    for a, b2 in zip(jax.tree_util.tree_leaves(pa),
+                     jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=3e-3, atol=3e-4)
+
+    # heads NOT divisible → ring path, no new ulysses calls
+    calls.clear()
+    net_c = make(heads=2)            # 2 % 4 != 0
+    step_c, place_c = sequence_parallel_step(net_c, mesh)
+    place_c(net_c)
+    step_c(net_c.params, net_c.states, net_c.updater_state,
+           jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+           jnp.asarray(f), jnp.asarray(l))
+    assert not calls, "indivisible heads should stay on the ring"
