@@ -283,6 +283,45 @@ def bench_keras_import_parallel(batch_per_step=128, iters=10):
     return batch_per_step * iters / dt
 
 
+def bench_transformer_lm(batch=4, seq_len=8192, vocab=4096, embed=512,
+                         heads=8, blocks=8, iters=10):
+    """Net-new flagship: decoder-only TransformerLM (pre-LN residual CG;
+    T=8192 rides the Pallas flash-attention kernel — the dense path would
+    materialize 8 × [b, h, T, T] logits) tokens/sec. Not a BASELINE.md
+    config (the reference predates transformers) — measured as the
+    framework's own long-context headline."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import TransformerLM
+
+    m = TransformerLM(vocab_size=vocab, embed_dim=embed, num_heads=heads,
+                      num_blocks=blocks, seed=1)
+    conf = m.conf()
+    conf.global_conf.compute_dtype = "bfloat16"
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
+                      jnp.float32)
+    l = jax.nn.one_hot(jnp.asarray(
+        rng.integers(0, vocab, size=(batch, seq_len))), vocab,
+        dtype=jnp.float32)
+    step = net._ensure_step()
+    state = {"p": net.params, "s": net.states, "u": net.updater_state}
+    key = jax.random.PRNGKey(0)
+
+    def one(i):
+        it = jnp.asarray(i, jnp.int32)
+        state["p"], state["s"], state["u"], loss = step(
+            state["p"], state["s"], state["u"], it, key, (ids,), (l,),
+            None, None)
+        return loss
+
+    dt = _time_steps(one, n_timed=iters)
+    return batch * seq_len * iters / dt
+
+
 ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
     ("resnet50_imagenet_images_per_sec", "images/sec", bench_resnet50),
@@ -291,6 +330,7 @@ ALL_BENCHES = [
     ("word2vec_skipgram_words_per_sec", "words/sec", bench_word2vec),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
+    ("transformer_lm_tokens_per_sec", "tokens/sec", bench_transformer_lm),
 ]
 
 

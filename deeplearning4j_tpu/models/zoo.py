@@ -622,10 +622,102 @@ class TextGenerationLSTM(ZooModel):
         return b.build()
 
 
+# --------------------------------------------------------------- TransformerLM
+class TransformerLM(ZooModel):
+    """Decoder-only transformer language model — NET-NEW vs the 0.9.x
+    reference (whose only sequence model is ``TextGenerationLSTM.java``;
+    it predates transformers entirely). This is the TPU build's flagship
+    long-context model: every block is flash-attention + MoE/FFN material
+    the framework accelerates, and the stacked identical blocks are
+    exactly what the dp/tp/pp/sp axes were built for.
+
+    Architecture (pre-LN residual blocks, built as a ComputationGraph so
+    the residual adds are real ``ElementWiseVertex`` edges):
+
+        ids [b, T] → embed → n_blocks × [ x + Attn(LN(x));
+                                          x + FFN(LN(x)) ] → LN → softmax
+
+    Positions are implicit (no position embedding): causal attention with
+    per-token LayerNorm is order-aware through the causal mask (the
+    "NoPE" decoder-only setup), which keeps every layer shape-agnostic in
+    T — the same property that lets the sp step shard the time dim.
+    Defaults are char-LM sized to mirror ``TextGenerationLSTM``'s role;
+    scale up embed_dim/num_blocks for real workloads (head_dim stays
+    ≤ 256 for the flash kernel: embed_dim / num_heads)."""
+
+    name = "transformerlm"
+
+    def __init__(self, vocab_size: Optional[int] = None,
+                 num_classes: Optional[int] = None, seed: int = 123,
+                 embed_dim: int = 256, num_heads: int = 4,
+                 num_blocks: int = 4, ffn_mult: int = 4,
+                 dropout_rate: float = 0.0, **kw):
+        n = vocab_size if vocab_size is not None \
+            else (num_classes if num_classes is not None else 256)
+        super().__init__(n, seed, **kw)
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.num_blocks = int(num_blocks)
+        self.ffn_mult = int(ffn_mult)
+        self.dropout_rate = float(dropout_rate)
+        if self.embed_dim % self.num_heads:
+            raise ValueError(f"num_heads {num_heads} must divide embed_dim "
+                             f"{embed_dim}")
+
+    def conf(self):
+        from ..nn.conf.layers import (LayerNormalization, SelfAttentionLayer,
+                                      EmbeddingSequenceLayer)
+
+        E, V = self.embed_dim, self.num_classes
+        F = E * self.ffn_mult
+        # explicit n_in everywhere, NO set_input_types: every layer here is
+        # sequence-shaped [b, T, ·] end to end — input-type propagation
+        # would wrap the FFN Dense layers in Rnn→FF flatteners, which is
+        # exactly wrong inside residual blocks
+        g = (self._builder(activation="identity",
+                           weight_init=WeightInit.XAVIER)
+             .graph_builder()
+             .add_inputs("ids")
+             .add_layer("embed", EmbeddingSequenceLayer(n_in=V, n_out=E),
+                        "ids"))
+        prev = "embed"
+        for i in range(self.num_blocks):
+            g = (g.add_layer(f"b{i}-ln-a",
+                             LayerNormalization(n_in=E, n_out=E), prev)
+                 .add_layer(f"b{i}-attn",
+                            SelfAttentionLayer(n_in=E, n_out=E,
+                                               num_heads=self.num_heads,
+                                               causal=True,
+                                               dropout_rate=self.dropout_rate),
+                            f"b{i}-ln-a")
+                 .add_vertex(f"b{i}-res-a", ElementWiseVertex(op="add"),
+                             prev, f"b{i}-attn")
+                 .add_layer(f"b{i}-ln-f",
+                            LayerNormalization(n_in=E, n_out=E),
+                            f"b{i}-res-a")
+                 .add_layer(f"b{i}-ffn",
+                            DenseLayer(n_in=E, n_out=F, activation="gelu"),
+                            f"b{i}-ln-f")
+                 .add_layer(f"b{i}-proj",
+                            DenseLayer(n_in=F, n_out=E,
+                                       activation="identity"),
+                            f"b{i}-ffn")
+                 .add_vertex(f"b{i}-res-f", ElementWiseVertex(op="add"),
+                             f"b{i}-res-a", f"b{i}-proj"))
+            prev = f"b{i}-res-f"
+        g = (g.add_layer("ln-final", LayerNormalization(n_in=E, n_out=E),
+                         prev)
+             .add_layer("out", RnnOutputLayer(n_in=E, n_out=V,
+                                              activation="softmax",
+                                              loss="mcxent"), "ln-final")
+             .set_outputs("out"))
+        return g.build()
+
+
 # -------------------------------------------------------------- ModelSelector
 ZOO = {m.name: m for m in (LeNet, SimpleCNN, AlexNet, VGG16, VGG19, GoogLeNet,
                            ResNet50, InceptionResNetV1, FaceNetNN4Small2,
-                           TextGenerationLSTM)}
+                           TextGenerationLSTM, TransformerLM)}
 
 
 class ModelSelector:

@@ -404,6 +404,32 @@ class BatchNormalization(FeedForwardLayer):
 
 @register
 @dataclasses.dataclass
+class LayerNormalization(FeedForwardLayer):
+    """Per-token normalization over the FEATURE dim with learned gain/bias —
+    net-new vs the 0.9.x reference (which predates transformers; its only
+    norms are Batch/LRN, ``nn/conf/layers/BatchNormalization.java``).
+    Included because the transformer family (SelfAttentionLayer, MoEDense,
+    TransformerLM) is first-class in the TPU build: LN is stateless (no
+    running stats ⇒ no cross-replica/shard state to reconcile), normalizes
+    each position independently (works for [b, F] and [b, T, F], and the
+    time dim may be sharded — sp-safe by construction), and XLA fuses the
+    two-moment pass into neighbouring elementwise work."""
+    eps: float = 1e-5
+
+    def get_output_type(self, index, input_type):
+        return input_type
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in is None or override:
+            self.n_in = input_type.arity()
+        self.n_out = self.n_in
+
+    def preprocessor_for(self, input_type):
+        return None
+
+
+@register
+@dataclasses.dataclass
 class LocalResponseNormalization(Layer):
     """Reference ``nn/conf/layers/LocalResponseNormalization.java``."""
     k: float = 2.0
@@ -449,6 +475,11 @@ class EmbeddingSequenceLayer(FeedForwardLayer):
     def get_output_type(self, index, input_type):
         t = input_type.timeseries_length if isinstance(input_type, InputTypeRecurrent) else None
         return InputTypeRecurrent(self.n_out, t)
+
+    def preprocessor_for(self, input_type):
+        # consumes [b, T] token ids directly — a recurrent input type
+        # describes the SEQUENCE (vocab arity), never a tensor to flatten
+        return None
 
 
 @register

@@ -94,6 +94,36 @@ class BatchNormImpl(LayerImpl):
         return 0.0  # reference: no l1/l2 on BN params by default
 
 
+@implements("LayerNormalization")
+class LayerNormImpl(LayerImpl):
+    """Per-position LayerNorm over the last (feature) dim, learned
+    gain/bias (net-new: the reference predates transformers — see the
+    config class). Stateless; normalizes [b, F] or [b, T, F] tokens
+    independently, so a sharded time dim needs no collectives and the
+    whole op fuses into one elementwise XLA kernel around two f32-
+    accumulated moments."""
+
+    save_output = False  # elementwise given the two moments: recompute
+
+    def init(self, rng):
+        n = self.conf.n_out
+        return {"gain": host_full((n,), 1, self.dtype),
+                "bias": host_full((n,), 0, self.dtype)}, {}
+
+    def forward(self, params, state, x, train=False, rng=None, mask=None, ctx=None):
+        sd = acc_dtype(self.compute_dtype)
+        xs = x.astype(sd)
+        mean = jnp.mean(xs, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xs - mean), axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(var + self.conf.eps)
+        y = (xs - mean) * inv
+        y = (y * params["gain"].astype(sd) + params["bias"].astype(sd))
+        return y.astype(x.dtype), state
+
+    def regularization(self, params):
+        return 0.0  # norm params free of l1/l2, like BN
+
+
 @implements("LocalResponseNormalization")
 class LRNImpl(LayerImpl):
     """Across-channel LRN on NHWC (reference ``LocalResponseNormalization.java``):

@@ -107,16 +107,22 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     return fn(q, k, v)
 
 
+def _seq_to_heads(x, axis):
+    """Ulysses layout swap: split heads across devices, gather the full
+    sequence — [b, Tl, h, d] → [b, T, h/n, d]."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _heads_to_seq(x, axis):
+    """Inverse of :func:`_seq_to_heads`."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
 def _ulysses_inner(q, k, v, axis: str, causal: bool, scale: float):
     """All-to-all: [b, Tl, h, d] → [b, T, h/n, d] → local dense attention →
     back. Head count must be divisible by the axis size."""
-
-    def seq_to_heads(x):
-        # split heads across devices, gather full sequence
-        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
-
-    def heads_to_seq(x):
-        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+    seq_to_heads = lambda x: _seq_to_heads(x, axis)
+    heads_to_seq = lambda x: _heads_to_seq(x, axis)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     s = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
@@ -154,17 +160,11 @@ def _ulysses_flash_inner(q, k, v, axis: str, causal: bool):
     custom VJP (all_to_all is linear, no custom ring backward needed)."""
     from ..ops import flash_attention as _fa
 
-    def seq_to_heads(x):
-        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                              tiled=True)
-
-    def heads_to_seq(x):
-        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                              tiled=True)
-
-    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    qh = _seq_to_heads(q, axis)
+    kh = _seq_to_heads(k, axis)
+    vh = _seq_to_heads(v, axis)
     out = _fa.flash_attention(qh, kh, vh, causal=causal)
-    return heads_to_seq(out.astype(q.dtype))
+    return _heads_to_seq(out.astype(q.dtype), axis)
 
 
 def ulysses_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
@@ -586,16 +586,44 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     _sp_reduce_params = [None]                  # closed over by sp_reduce
     core = net._raw_update_core(grads_reduce=sp_reduce)
 
+    # [b, T] token-id streams (TransformerLM-style) ARE temporal on dim 1,
+    # so the P(data, time) prefix shards them correctly — detect them from
+    # the config: an input whose every consumer is an EmbeddingSequenceLayer
+    # carries ids. (Everything else rank-2 stays rejected: a [b, F] static
+    # stream would silently get its FEATURE dim sharded.)
+    if is_graph:
+        consumers = {}
+        for name, ins in net.conf.vertex_inputs.items():
+            for i_name in ins:
+                consumers.setdefault(i_name, []).append(name)
+        id_inputs = set()
+        for i_idx, i_name in enumerate(net.conf.network_inputs):
+            cons = consumers.get(i_name, [])
+            if cons and all(type(net.conf.vertices[c]).__name__
+                            == "EmbeddingSequenceLayer" for c in cons):
+                id_inputs.add(i_idx)
+    else:
+        id_inputs = ({0} if type(net.conf.layers[0]).__name__
+                     == "EmbeddingSequenceLayer" else set())
+
     def device_step(params, states, upd, it, rng, f, l):
-        # every input/label stream must be [b, T, ...]: the time-dim spec is
-        # applied as a pytree prefix, so a rank-2 static/label stream would
-        # silently get its FEATURE dim sharded instead
-        for leaf in jax.tree_util.tree_leaves((f, l)):
-            if leaf.ndim < 3:
+        # every stream must be [b, T, ...] — except declared id streams,
+        # which are [b, T]: the time-dim spec is a pytree prefix, so any
+        # OTHER rank-2 stream would silently get its feature dim sharded
+        f_streams = tuple(f) if isinstance(f, (tuple, list)) else (f,)
+        for si, leaf in enumerate(f_streams):
+            if leaf.ndim < 3 and not (leaf.ndim == 2 and si in id_inputs):
                 raise ValueError(
                     f"sp step streams must be rank-3 [b, T, ...] (got shape "
-                    f"{leaf.shape}); static side-inputs / non-temporal "
-                    f"labels are unsupported in v1")
+                    f"{leaf.shape}); static side-inputs are unsupported in "
+                    f"v1 ([b, T] is accepted only for token-id inputs "
+                    f"feeding EmbeddingSequenceLayer)")
+        for leaf in jax.tree_util.tree_leaves(l):
+            if leaf.ndim < 3:
+                raise ValueError(
+                    f"sp step labels must be rank-3 [b, T, ...] (got shape "
+                    f"{leaf.shape}); non-temporal labels are unsupported "
+                    f"in v1")
         # trace-scoped routing flag for SelfAttentionLayer (see
         # current_sp_axis): set only while THIS body traces, so later
         # output()/fit() traces keep the dense path

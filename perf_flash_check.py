@@ -69,6 +69,21 @@ def main():
         print(f"max |d{name} diff| = {e}")
         assert e < 5e-2, (name, e)
 
+    # in-kernel dropout on hardware: the counter-hash PRNG must lower via
+    # Mosaic to the same decisions the CPU-interpret tests pinned (oracle
+    # = dropout_keep_mask, bit-identical arithmetic by construction)
+    rate, seed = 0.3, 1234
+    got_dr = fa.flash_attention(q, k, v, causal=False, dropout_rate=rate,
+                                dropout_seed=seed)
+    keep = fa.dropout_keep_mask(b * h, T, T, seed, rate)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    p = jax.nn.softmax(s, axis=-1) * keep.reshape(b, h, T, T) / (1.0 - rate)
+    want_dr = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    e_dr = float(jnp.max(jnp.abs(got_dr.astype(jnp.float32) - want_dr)))
+    print("max |flash-dropout - masked-dense| =", e_dr)
+    assert e_dr < 2e-2, e_dr
+
     # masked flash vs dense timing at T=8192 (the round-3 7.5x checkpoint,
     # now with a mask in-kernel)
     T2 = 8192

@@ -417,3 +417,35 @@ def test_cg_multi_output_gradients():
         mds = MultiDataSet([rng.normal(size=(5, 4))],
                            [_onehot(rng, 5, 2), rng.normal(size=(5, 3))])
         _check(net, mds)
+
+
+def test_layer_norm_gradients():
+    """LayerNormalization (net-new: transformer family) — f64 numeric vs
+    analytic gradients through LN on both [b, F] and sequence [b, T, F]
+    activations."""
+    from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+
+    with double_precision():
+        conf = (_f64_builder().activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6))
+                .layer(LayerNormalization(n_in=6, n_out=6))
+                .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(3)
+        f = rng.normal(size=(5, 4)) * 3.0 + 1.0
+        _check(net, DataSet(f, _onehot(rng, 5, 3)))
+
+        seq = (_f64_builder().activation("tanh")
+               .list()
+               .layer(SimpleRnn(n_in=3, n_out=6, activation="tanh"))
+               .layer(LayerNormalization(n_in=6, n_out=6))
+               .layer(RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                     loss="mcxent"))
+               .build())
+        net2 = MultiLayerNetwork(seq).init()
+        f2 = rng.normal(size=(4, 5, 3))
+        l2 = np.eye(2, dtype=np.float64)[rng.integers(0, 2, (4, 5))]
+        _check(net2, DataSet(f2, l2))
