@@ -651,7 +651,8 @@ class TransformerLM(ZooModel):
                  num_classes: Optional[int] = None, seed: int = 123,
                  embed_dim: int = 256, num_heads: int = 4,
                  num_blocks: int = 4, ffn_mult: int = 4,
-                 dropout_rate: float = 0.0, **kw):
+                 dropout_rate: float = 0.0, num_experts: int = 0,
+                 top_k: int = 2, capacity_factor: float = 1.25, **kw):
         n = vocab_size if vocab_size is not None \
             else (num_classes if num_classes is not None else 256)
         super().__init__(n, seed, **kw)
@@ -660,9 +661,31 @@ class TransformerLM(ZooModel):
         self.num_blocks = int(num_blocks)
         self.ffn_mult = int(ffn_mult)
         self.dropout_rate = float(dropout_rate)
+        #: num_experts > 0 → every block's FFN becomes a sparse MoE
+        #: (capacity-factor token dispatch, experts shardable over the
+        #: `expert` mesh axis via expert_parallel_step) — the Mixtral-style
+        #: sparse decoder; 0 keeps the dense gelu FFN
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
         if self.embed_dim % self.num_heads:
             raise ValueError(f"num_heads {num_heads} must divide embed_dim "
                              f"{embed_dim}")
+
+    def _ffn(self, E, F):
+        """The block FFN up-projection: dense gelu, or a sparse MoE when
+        ``num_experts`` > 0 (experts specialize the up-projection; the
+        down-projection stays shared — one routed matmul per block keeps
+        the routing decision single like Switch, and the expert dim shards
+        over the ``expert`` mesh axis via ``expert_parallel_step``)."""
+        from ..nn.conf.layers import MoEDenseLayer
+
+        if self.num_experts > 0:
+            return MoEDenseLayer(n_in=E, n_out=F, activation="gelu",
+                                 num_experts=self.num_experts,
+                                 top_k=self.top_k,
+                                 capacity_factor=self.capacity_factor)
+        return DenseLayer(n_in=E, n_out=F, activation="gelu")
 
     def conf(self):
         from ..nn.conf.layers import (LayerNormalization, SelfAttentionLayer,
@@ -695,9 +718,7 @@ class TransformerLM(ZooModel):
                  .add_layer(f"b{i}-ln-f",
                             LayerNormalization(n_in=E, n_out=E),
                             f"b{i}-res-a")
-                 .add_layer(f"b{i}-ffn",
-                            DenseLayer(n_in=E, n_out=F, activation="gelu"),
-                            f"b{i}-ln-f")
+                 .add_layer(f"b{i}-ffn", self._ffn(E, F), f"b{i}-ln-f")
                  .add_layer(f"b{i}-proj",
                             DenseLayer(n_in=F, n_out=E,
                                        activation="identity"),

@@ -161,13 +161,16 @@ class MoEDenseLayer(FeedForwardLayer):
     top_k: int = 2
     aux_loss_weight: float = 1e-2
     has_bias: bool = True
-    #: > 0 enables SPARSE capacity-factor dispatch: each expert processes at
-    #: most ``ceil(top_k * tokens * capacity_factor / num_experts)`` tokens
-    #: (lane-aligned), so per-step FLOPs scale with ``top_k/num_experts``
-    #: instead of paying every expert for every token; over-capacity
-    #: (token, expert) assignments are dropped, Switch-Transformer style —
-    #: raise the factor if exact parity with dense routing matters more than
-    #: FLOPs. 0 keeps the dense einsum path (the correctness oracle).
+    #: > 0 enables SPARSE capacity-factor dispatch IN THE TRAIN STEP: each
+    #: expert processes at most ``ceil(top_k * tokens * capacity_factor /
+    #: num_experts)`` tokens (lane-aligned), so per-step FLOPs scale with
+    #: ``top_k/num_experts`` instead of paying every expert for every
+    #: token; over-capacity (token, expert) assignments are dropped,
+    #: Switch-Transformer style — raise the factor if exact parity with
+    #: dense routing matters more than FLOPs. Inference (train=False)
+    #: always routes exactly via the dense combine, so output/score/
+    #: streaming agree regardless of batch shape. 0 keeps the dense einsum
+    #: path everywhere (the correctness oracle).
     capacity_factor: float = 0.0
 
 

@@ -26,6 +26,12 @@ class MoEDenseImpl(LayerImpl):
     def init(self, rng):
         c = self.conf
         E = c.num_experts
+        if E < 1 or not (1 <= c.top_k <= E):
+            raise ValueError(f"MoEDenseLayer needs 1 <= top_k <= num_experts "
+                             f"(got top_k={c.top_k}, num_experts={E})")
+        if c.capacity_factor < 0:
+            raise ValueError(f"capacity_factor must be >= 0 "
+                             f"(got {c.capacity_factor})")
         kg, kw = jax.random.split(rng)
         params = {
             # router: small, always f32-precision-critical
@@ -122,7 +128,12 @@ class MoEDenseImpl(LayerImpl):
         gates, probs = self._route(flat.astype(rdt), params["Wg"])
 
         cd = self.compute_dtype
-        if c.capacity_factor and c.capacity_factor > 0:
+        # capacity dispatch only under TRAINING: dropping over-capacity
+        # assignments is a throughput/utilization device for the train step
+        # (Switch semantics); inference routes exactly, so output()/score()/
+        # rnn_time_step agree with each other regardless of batch shape —
+        # capacity is a function of n, and streaming steps see tiny n
+        if c.capacity_factor and c.capacity_factor > 0 and train:
             y = self._sparse_combine(params, flat, gates, cd)
         else:
             y = self._dense_combine(params, flat, gates, cd)

@@ -296,7 +296,7 @@ def test_moe_sparse_dispatch_matches_dense_oracle():
     impl_d, _ = _moe_impl(capacity_factor=0.0)
     rng = np.random.default_rng(7)
     x = jnp.asarray(rng.normal(size=(33, 6)), jnp.float32)  # odd n on purpose
-    ys, _ = impl_s.forward(p, {}, x)
+    ys, _ = impl_s.forward(p, {}, x, train=True)
     yd, _ = impl_d.forward(p, {}, x)
     np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
                                rtol=1e-4, atol=1e-5)
@@ -309,7 +309,7 @@ def test_moe_sparse_dispatch_grads_match_dense_oracle():
     x = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
 
     def loss(params, impl):
-        y, _ = impl.forward(params, {}, x)
+        y, _ = impl.forward(params, {}, x, train=True)
         return jnp.sum(y ** 2)
 
     gs = jax.grad(loss)(p, impl_s)
@@ -327,7 +327,7 @@ def test_moe_sparse_overflow_drops_lowest_gate_assignments():
     impl_d, _ = _moe_impl(capacity_factor=0.0, top_k=2)
     rng = np.random.default_rng(11)
     x = jnp.asarray(rng.normal(size=(64, 6)), jnp.float32)
-    ys, _ = impl_s.forward(p, {}, x)
+    ys, _ = impl_s.forward(p, {}, x, train=True)
     yd, _ = impl_d.forward(p, {}, x)
     assert np.isfinite(np.asarray(ys)).all()
     assert float(np.max(np.abs(np.asarray(ys)))) <= \
@@ -350,7 +350,7 @@ def test_moe_sparse_dispatch_flops_drop():
     x = jnp.asarray(rng.normal(size=(n, F)), jnp.float32)
 
     def flops(impl):
-        fn = lambda params: impl.forward(params, {}, x)[0]
+        fn = lambda params: impl.forward(params, {}, x, train=True)[0]
         ca = jax.jit(fn).lower(p).compile().cost_analysis() or {}
         return float(ca.get("flops", 0.0))
 
@@ -395,3 +395,47 @@ def test_moe_sparse_expert_parallel_matches_replicated():
                     jax.tree_util.tree_leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_moe_inference_routes_exactly_despite_capacity():
+    """Capacity dispatch is a TRAIN-step device: at train=False the layer
+    routes exactly (dense combine), so output()/score()/rnn_time_step agree
+    with each other regardless of batch shape — even at a capacity factor
+    tiny enough to drop almost every training assignment."""
+    impl_s, p = _moe_impl(capacity_factor=1e-6, top_k=2)
+    impl_d, _ = _moe_impl(capacity_factor=0.0, top_k=2)
+    rng = np.random.default_rng(15)
+    x = jnp.asarray(rng.normal(size=(32, 6)), jnp.float32)
+    y_inf, _ = impl_s.forward(p, {}, x)                  # train=False
+    y_dense, _ = impl_d.forward(p, {}, x)
+    np.testing.assert_allclose(np.asarray(y_inf), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-6)
+    y_train, _ = impl_s.forward(p, {}, x, train=True)    # drops ≫ 0
+    assert float(np.max(np.abs(np.asarray(y_train)
+                               - np.asarray(y_dense)))) > 1e-3
+
+
+def test_moe_rejects_bad_routing_config():
+    """top_k outside [1, num_experts] or negative capacity must raise at
+    init, not produce NaN gates (review finding)."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import MoEDenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu import Sgd
+
+    def build(**kw):
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(learning_rate=0.1)).activation("identity")
+                .list()
+                .layer(MoEDenseLayer(n_in=4, n_out=4, **kw))
+                .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    with pytest.raises(ValueError, match="top_k"):
+        build(num_experts=4, top_k=0)
+    with pytest.raises(ValueError, match="top_k"):
+        build(num_experts=4, top_k=5)
+    with pytest.raises(ValueError, match="capacity_factor"):
+        build(num_experts=4, top_k=2, capacity_factor=-1.0)
