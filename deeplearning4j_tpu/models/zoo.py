@@ -652,7 +652,8 @@ class TransformerLM(ZooModel):
                  embed_dim: int = 256, num_heads: int = 4,
                  num_blocks: int = 4, ffn_mult: int = 4,
                  dropout_rate: float = 0.0, num_experts: int = 0,
-                 top_k: int = 2, capacity_factor: float = 1.25, **kw):
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 aux_loss_weight: float = 1e-2, **kw):
         n = vocab_size if vocab_size is not None \
             else (num_classes if num_classes is not None else 256)
         super().__init__(n, seed, **kw)
@@ -668,6 +669,10 @@ class TransformerLM(ZooModel):
         self.num_experts = int(num_experts)
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
+        #: Switch load-balancing loss weight; set 0 to make the MoE variant
+        #: pipeline-parallelizable (the pipelined step does not collect
+        #: activation-dependent aux losses and rejects them loudly)
+        self.aux_loss_weight = float(aux_loss_weight)
         if self.embed_dim % self.num_heads:
             raise ValueError(f"num_heads {num_heads} must divide embed_dim "
                              f"{embed_dim}")
@@ -684,7 +689,8 @@ class TransformerLM(ZooModel):
             return MoEDenseLayer(n_in=E, n_out=F, activation="gelu",
                                  num_experts=self.num_experts,
                                  top_k=self.top_k,
-                                 capacity_factor=self.capacity_factor)
+                                 capacity_factor=self.capacity_factor,
+                                 aux_loss_weight=self.aux_loss_weight)
         return DenseLayer(n_in=E, n_out=F, activation="gelu")
 
     def conf(self):

@@ -385,6 +385,115 @@ def partition_graph(cg, n_stages: int, max_period: int = 8):
     return best
 
 
+def _vertex_eq(a, b):
+    """Structural equality of two vertex configs: every vertex/layer conf
+    is a dataclass, whose generated ``__eq__`` compares class + fields."""
+    return type(a) is type(b) and a == b
+
+
+def partition_graph_blocks(cg, n_stages: int, max_block: int = 16):
+    """Find repeated single-input/single-output SUBGRAPH windows along the
+    topo order — the residual-transformer case :func:`partition_graph`'s
+    linear-chain rule cannot express (skip connections live INSIDE each
+    block: ``x + Attn(LN(x)); x + FFN(LN(x))``).
+
+    A valid body is windows ``W_r = topo[s + r·p : s + (r+1)·p]`` where,
+    for every repeat r: (1) vertex configs match offset-wise across
+    repeats; (2) each vertex's inputs resolve to the SAME relative
+    positions — an in-window offset or the window's single external input
+    (window r's external input = window r-1's LAST vertex; window 0's =
+    whatever name the pattern references); (3) interior vertices have no
+    consumers outside their window, so the last offset is the only spine.
+    Returns (body_names, period, template) with ``template`` a list of
+    per-offset ``(is_layer, rel_inputs)`` where ``rel_inputs`` entries are
+    ``("ext",)`` or ``("in", offset)`` — enough for a stage to execute the
+    block without the global DAG. Raises like :func:`partition_graph` when
+    nothing qualifies."""
+    conf = cg.conf
+    from ..nn.conf.layers import Layer
+
+    topo = list(cg.topo)
+    consumers = _graph_consumers(conf)
+    n = len(topo)
+
+    def window_tmpl(s, p, r, ext):
+        """Template of window r = topo[s+r·p : s+(r+1)·p] given its single
+        allowed external input name ``ext``; None when invalid."""
+        base = s + r * p
+        if base + p > n:
+            return None
+        names = topo[base:base + p]
+        index = {nm: j for j, nm in enumerate(names)}
+        tmpl = []
+        for j, nm in enumerate(names):
+            v = conf.vertices.get(nm)
+            if v is None or nm in conf.network_outputs \
+                    or conf.input_preprocessors.get(nm) is not None:
+                return None
+            rel = []
+            for i_name in conf.vertex_inputs.get(nm, ()):
+                if i_name in index:
+                    if index[i_name] >= j:
+                        return None
+                    rel.append(("in", index[i_name]))
+                elif i_name == ext:
+                    rel.append(("ext",))
+                else:
+                    return None
+            # interior vertices must not leak outside the window (the last
+            # offset is the sole spine; its consumers are checked by the
+            # caller against the NEXT window)
+            if j < p - 1:
+                if any(c not in index for c in consumers.get(nm, ())):
+                    return None
+            tmpl.append((isinstance(v, Layer), tuple(rel)))
+        return tmpl
+
+    def spine_pure(s, p, r):
+        """Window r's last vertex may only feed window r+1."""
+        last = topo[s + r * p + p - 1]
+        nxt = set(topo[s + (r + 1) * p:s + (r + 2) * p])
+        return all(c in nxt for c in consumers.get(last, ()))
+
+    best = None                               # (start, period, R, template)
+    for p in range(1, max_block + 1):
+        for s in range(n - p * n_stages + 1):
+            # window 0's external input: the single out-of-window name its
+            # vertices reference (there must be exactly one)
+            names0 = set(topo[s:s + p])
+            refs = {i for nm in topo[s:s + p]
+                    for i in conf.vertex_inputs.get(nm, ())
+                    if i not in names0}
+            if len(refs) != 1:
+                continue
+            ext0 = next(iter(refs))
+            tmpl = window_tmpl(s, p, 0, ext0)
+            if not tmpl or not any(("ext",) in rel for _, rel in tmpl):
+                continue
+            R = 1
+            while spine_pure(s, p, R - 1):
+                base = s + R * p
+                t2 = window_tmpl(s, p, R, topo[base - 1])
+                if (t2 != tmpl
+                        or not all(_vertex_eq(conf.vertices[topo[s + j]],
+                                              conf.vertices[topo[base + j]])
+                                   for j in range(p))):
+                    break
+                R += 1
+            R = (R // n_stages) * n_stages    # stage homogeneity
+            if R >= n_stages and R * p > (0 if best is None
+                                          else best[2] * best[1]):
+                best = (s, p, R, tmpl)
+    if best is None:
+        raise ValueError(
+            f"No repeated single-input/single-output block pattern of ≥ "
+            f"{n_stages} repeats found to map onto {n_stages} pipeline "
+            f"stages; stack identical blocks (e.g. TransformerLM(num_blocks"
+            f"=...)) or use fewer stages.")
+    s, p, R, tmpl = best
+    return topo[s:s + R * p], p, tmpl
+
+
 class _PipelinedBase:
     """Shared machinery for the container-level pipeline trainers
     (:class:`PipelinedNetwork` for MultiLayerNetwork, :class:`PipelinedGraph`
@@ -816,11 +925,32 @@ class PipelinedGraph(_PipelinedBase):
             if isinstance(v, Layer):
                 self._check_layer_conf(f"vertex '{name}'", v)
         self._init_common(net, mesh, n_microbatches, axis, data_axis)
-        self.body, self.period = partition_graph(net, self.n_stages)
+        try:
+            self.body, self.period = partition_graph(net, self.n_stages)
+            self.body_tmpl = None            # linear chain of layer vertices
+        except ValueError as chain_err:
+            # residual-transformer case: repeated single-input/single-output
+            # SUBGRAPH blocks (skip connections inside each block)
+            try:
+                self.body, self.period, self.body_tmpl = \
+                    partition_graph_blocks(net, self.n_stages)
+            except ValueError as block_err:
+                raise ValueError(
+                    f"Neither pipelining rule fits this graph.\n"
+                    f"- linear chain: {chain_err}\n"
+                    f"- block pattern: {block_err}") from block_err
         self.body_len = len(self.body)
         self.layers_per_stage = self.body_len // self.n_stages
         self.repeats_per_stage = self.layers_per_stage // self.period
-        self.body_impls = [net.impls[n] for n in self.body[:self.period]]
+        self.body_impls = [net.impls.get(n) for n in self.body[:self.period]]
+        # masks through a block body need every vertex to propagate "first
+        # (non-None) input mask" — true for the default rule and Merge;
+        # Stack/Unstack/Reshape transform masks and are rejected at fit time
+        from ..nn.conf.graph import GraphVertexConf, MergeVertex
+        self._block_masks_ok = self.body_tmpl is None or all(
+            is_layer or type(conf.vertices[self.body[off]]).propagate_mask
+            in (GraphVertexConf.propagate_mask, MergeVertex.propagate_mask)
+            for off, (is_layer, _) in enumerate(self.body_tmpl))
         body_set = set(self.body)
         # head = everything downstream of the chain end; entry = the rest
         consumers = _graph_consumers(conf)
@@ -862,6 +992,15 @@ class PipelinedGraph(_PipelinedBase):
         self.upd_state = self._place(self.updater.init_state(self.params))
 
     # -- param/state layout ------------------------------------------------
+    def _layer_offsets(self):
+        """Body offsets that are LAYER vertices (all of them for a chain
+        body; the template's layer entries for a block body) — the offsets
+        that own params/state."""
+        if self.body_tmpl is None:
+            return list(range(self.period))
+        return [off for off, (is_layer, _) in enumerate(self.body_tmpl)
+                if is_layer]
+
     def _partition_tree(self, net_tree):
         p = self.period
         entry = {n: net_tree[n] for n in self.entry_names
@@ -870,18 +1009,56 @@ class PipelinedGraph(_PipelinedBase):
         blocks = {str(l): stack_stage_params(
             [net_tree[self.body[r * p + l]]
              for r in range(self.body_len // p)])
-            for l in range(p)}
+            for l in self._layer_offsets()
+            if self.body[l] in net_tree}
         return {"entry": entry, "blocks": blocks, "head": head}
 
     def _to_layer_keyed(self, tree):
         p = self.period
         out = dict(tree["entry"])
         for r in range(self.body_len // p):
-            for l in range(p):
-                out[self.body[r * p + l]] = _tm(lambda q: q[r],
-                                                tree["blocks"][str(l)])
+            for l in self._layer_offsets():
+                if str(l) in tree["blocks"]:
+                    out[self.body[r * p + l]] = _tm(lambda q: q[r],
+                                                    tree["blocks"][str(l)])
         out.update(tree["head"])
         return out
+
+    # -- the block-body stage ---------------------------------------------
+    def _stage_fn(self, params_slice, state_slice, x, *rest):
+        """Chain bodies use the shared linear stage; a BLOCK body executes
+        its template sub-DAG per repeat — in-window vertices resolve their
+        inputs by relative offset, the window's single external input is
+        the carried activation, and only layer offsets carry stacked
+        params/state."""
+        if self.body_tmpl is None:
+            return super()._stage_fn(params_slice, state_slice, x, *rest)
+        mask, key = rest
+        conf = self.net.conf
+        new_state = {k: state_slice[k] for k in state_slice}
+        for j in range(self.repeats_per_stage):
+            vals = {}
+            for off, (is_layer, rel) in enumerate(self.body_tmpl):
+                xs = [x if r[0] == "ext" else vals[r[1]] for r in rel]
+                name0 = self.body[off]          # template (window-0) name
+                if is_layer:
+                    impl = self.net.impls[name0]
+                    k = jax.random.fold_in(key, j * self.period + off)
+                    p_j = _tm(lambda q: q[j], params_slice[str(off)])
+                    s_j = (_tm(lambda q: q[j], new_state[str(off)])
+                           if str(off) in new_state else {})
+                    p_n = impl.noised_params(p_j, True, k)
+                    y, ns = impl.forward(p_n, s_j, xs[0], train=True,
+                                         rng=k, mask=mask, ctx={})
+                    if str(off) in new_state:
+                        new_state[str(off)] = _tm(
+                            lambda buf, v: buf.at[j].set(v),
+                            new_state[str(off)], ns)
+                    vals[off] = y
+                else:
+                    vals[off] = conf.vertices[name0].forward(xs, {})
+            x = vals[self.period - 1]
+        return x, new_state
 
     # -- forward pieces ----------------------------------------------------
     def _apply_vertices(self, names, params, states, acts, masks, ctx, key):
@@ -1035,9 +1212,10 @@ class PipelinedGraph(_PipelinedBase):
                 if impl is not None:
                     reg = reg + impl.regularization(tree[part][n])
         for r in range(self.body_len // p):
-            for l in range(p):
-                reg = reg + self.body_impls[l].regularization(
-                    _tm(lambda q: q[r], tree["blocks"][str(l)]))
+            for l in self._layer_offsets():
+                if str(l) in tree["blocks"]:
+                    reg = reg + self.body_impls[l].regularization(
+                        _tm(lambda q: q[r], tree["blocks"][str(l)]))
         return loss + reg, {"entry": entry_st, "blocks": blocks_st,
                             "head": head_st}
 
@@ -1056,9 +1234,9 @@ class PipelinedGraph(_PipelinedBase):
                 cons = cons_of(n)
                 if cons:
                     out[part][n] = apply_constraints(cons, out[part][n])
-        for l in range(self.period):
+        for l in self._layer_offsets():
             cons = cons_of(self.body[l])
-            if cons:
+            if cons and str(l) in tree["blocks"]:
                 per_rep = [apply_constraints(cons,
                                              _tm(lambda q: q[r],
                                                  tree["blocks"][str(l)]))
@@ -1085,6 +1263,12 @@ class PipelinedGraph(_PipelinedBase):
         labels = as_tuple(labels)
         fm = as_tuple(features_mask)
         lm = as_tuple(labels_mask)
+        if (fm is not None or lm is not None) and not self._block_masks_ok:
+            raise ValueError(
+                "this pipelined body contains a vertex whose mask "
+                "propagation is not the identity (Stack/Unstack/Reshape "
+                "class); masked training through the block pipeline would "
+                "silently diverge — train unpipelined")
         if fm is not None and len(fm) != len(self.net.conf.network_inputs):
             raise ValueError(f"features_mask needs one entry per network "
                              f"input ({len(self.net.conf.network_inputs)})")

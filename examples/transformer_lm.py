@@ -57,3 +57,17 @@ if len(devices) >= 2 and T % len(devices) == 0:
             sp_net.params, sp_net.states, sp_net.updater_state,
             jnp.asarray(it, jnp.int32), jax.random.PRNGKey(it), (f,), (l,))
     print(f"sp-trained loss over {len(devices)} time shards:", float(loss))
+
+# ---- pipeline parallelism: residual blocks as GPipe stages ----------------
+if len(devices) >= 2:
+    from deeplearning4j_tpu.parallel import pipeline_parallel_step
+
+    pp_net = TransformerLM(vocab_size=VOCAB, embed_dim=64, num_heads=4,
+                           num_blocks=4, seed=7).init()
+    pp = pipeline_parallel_step(pp_net, make_mesh(devices[:2],
+                                                  axes=("pipe",)),
+                                n_microbatches=2)
+    for _ in range(20):
+        pp_loss = pp.fit_batch(ids.astype(np.float32), labels)
+    print("pp-trained loss (residual blocks over 2 stages):",
+          float(pp_loss))
