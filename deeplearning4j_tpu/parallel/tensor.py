@@ -26,16 +26,57 @@ from .sharding import DATA_AXIS, MODEL_AXIS, batch_sharded, replicated
 def megatron_rules(net, axis: str = MODEL_AXIS) -> Dict[str, P]:
     """Alternating column/row parallel specs for the network's dense-family
     params: {param_path_regex: PartitionSpec}. Layer index parity decides the
-    split dim; biases follow their weight's output sharding."""
+    split dim; biases follow their weight's output sharding.
+
+    Works for BOTH containers. On a ComputationGraph the vertices walk in
+    builder order; `SelfAttentionLayer` gets the Megatron attention block
+    pattern (Wq/Wk/Wv column-parallel — the head dim splits — and Wo
+    row-parallel, output bias replicated), Dense-family vertices alternate
+    column/row so FFN up/down projections pair up, and everything else
+    (LayerNorm gain/bias, embeddings, routers) stays replicated by the
+    default rule."""
     rules: Dict[str, P] = {}
-    for i, _ in enumerate(net.conf.layers):
-        col = (i % 2 == 0)
-        if col:
-            rules[rf"^{i}/W$"] = P(None, axis)
-            rules[rf"^{i}/b$"] = P(axis)
-        else:
-            rules[rf"^{i}/W$"] = P(axis, None)
-            rules[rf"^{i}/b$"] = P()
+    layers = getattr(net.conf, "layers", None)
+    if layers is not None:                     # MultiLayerNetwork
+        for i, _ in enumerate(layers):
+            col = (i % 2 == 0)
+            if col:
+                rules[rf"^{i}/W$"] = P(None, axis)
+                rules[rf"^{i}/b$"] = P(axis)
+            else:
+                rules[rf"^{i}/W$"] = P(axis, None)
+                rules[rf"^{i}/b$"] = P()
+        return rules
+    parity = 0                                 # ComputationGraph
+    for name, v in net.conf.vertices.items():
+        k = re.escape(name)
+        tname = type(v).__name__
+        if tname == "SelfAttentionLayer":
+            rules[rf"^{k}/W[qkv]$"] = P(None, axis)
+            rules[rf"^{k}/Wo$"] = P(axis, None)
+            rules[rf"^{k}/b$"] = P()
+            parity = 0        # attention output is row-reduced → next col
+        elif tname in ("DenseLayer", "OutputLayer", "RnnOutputLayer"):
+            if parity % 2 == 0:
+                rules[rf"^{k}/W$"] = P(None, axis)
+                rules[rf"^{k}/b$"] = P(axis)
+            else:
+                rules[rf"^{k}/W$"] = P(axis, None)
+                rules[rf"^{k}/b$"] = P()
+            parity += 1
+        elif tname == "MoEDenseLayer":
+            # participates in the column/row pairing like a Dense layer so
+            # its down-projection partner still gets the row rule (expert W
+            # is [E, in, out]; the router Wg stays replicated). Under an
+            # ep+tp mesh, expert_rules' expert-dim sharding takes priority
+            # via extra_rules ordering.
+            if parity % 2 == 0:
+                rules[rf"^{k}/W$"] = P(None, None, axis)
+                rules[rf"^{k}/b$"] = P(None, axis)
+            else:
+                rules[rf"^{k}/W$"] = P(None, axis, None)
+                rules[rf"^{k}/b$"] = P()
+            parity += 1
     return rules
 
 
