@@ -1,8 +1,9 @@
 """Model zoo (reference ``deeplearning4j-zoo`` — SURVEY.md §2.7)."""
 from .zoo import (ZooModel, ModelSelector, ZOO, LeNet, SimpleCNN, AlexNet,
                   VGG16, VGG19, GoogLeNet, ResNet50, InceptionResNetV1,
-                  FaceNetNN4Small2, TextGenerationLSTM, TransformerLM)
+                  FaceNetNN4Small2, TextGenerationLSTM, TransformerLM,
+                  generate_tokens)
 
 __all__ = ["ZooModel", "ModelSelector", "ZOO", "LeNet", "SimpleCNN", "AlexNet",
            "VGG16", "VGG19", "GoogLeNet", "ResNet50", "InceptionResNetV1",
-           "FaceNetNN4Small2", "TextGenerationLSTM", "TransformerLM"]
+           "FaceNetNN4Small2", "TextGenerationLSTM", "TransformerLM", "generate_tokens"]

@@ -741,6 +741,71 @@ class TransformerLM(ZooModel):
         return g.build()
 
 
+def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
+    """Autoregressive sampling through the streaming KV/recurrent cache —
+    the reference's TextGenerationLSTM char-sampling workflow
+    (``zoo/model/TextGenerationLSTM.java`` exists for exactly this) as a
+    first-class helper. Works with any container whose ``rnn_time_step``
+    yields per-step class probabilities: ``TextGenerationLSTM`` (MLN
+    recurrent state) and ``TransformerLM`` (sliding-window KV cache).
+
+    ``prompt_ids``: [b, T] or [T] int token ids. Returns [b, n_tokens]
+    sampled ids. ``temperature`` → 0 approaches greedy decoding; sampling
+    is deterministic given ``seed``."""
+    import numpy as np
+
+    prompt = np.asarray(prompt_ids)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    prompt = prompt.astype(np.int64)
+    b = prompt.shape[0]
+    if prompt.shape[1] == 0:
+        raise ValueError("generate_tokens needs a non-empty prompt (the "
+                         "first sampling distribution comes from the "
+                         "prompt's last step)")
+    if int(n_tokens) <= 0:
+        return np.zeros((b, 0), np.int64)
+    is_graph = hasattr(net.conf, "vertices")
+    first = (next(iter(net.conf.vertices.values())) if is_graph
+             else net.conf.layers[0])
+    takes_ids = type(first).__name__ == "EmbeddingSequenceLayer"
+    vocab = first.n_in
+
+    def encode(toks):                          # [b, T] ids → model input
+        if takes_ids:
+            # rank-3 so the containers take the SEQUENCE path (rank-2
+            # means one [b, F] step); the embedding squeezes the 1
+            return toks[:, :, None].astype(np.float32)
+        return np.eye(vocab, dtype=np.float32)[toks]     # [b, T, V]
+
+    def step(tok):                            # tok: [b] int ids
+        if takes_ids:
+            x = tok[:, None].astype(np.float32)          # [b, 1] ids
+        else:
+            x = np.eye(vocab, dtype=np.float32)[tok]     # [b, V] one-hot
+        y = np.asarray(net.rnn_time_step(x))
+        return y[:, -1, :] if y.ndim == 3 else y         # [b, V] probs
+
+    net.rnn_clear_previous_state()
+    rng = np.random.default_rng(seed)
+    # prime the cache with ONE sequence call (the streaming state absorbs
+    # the whole prompt; per-token dispatch would sync the host T times)
+    y = np.asarray(net.rnn_time_step(encode(prompt)))
+    probs = y[:, -1, :]
+    out = []
+    for _ in range(int(n_tokens)):
+        p = np.maximum(probs.astype(np.float64), 1e-12)
+        if temperature != 1.0:
+            logp = np.log(p) / max(float(temperature), 1e-6)
+            p = np.exp(logp - logp.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        nxt = np.array([rng.choice(p.shape[-1], p=p[i]) for i in range(b)],
+                       dtype=np.int64)
+        out.append(nxt)
+        probs = step(nxt)
+    return np.stack(out, axis=1)
+
+
 # -------------------------------------------------------------- ModelSelector
 ZOO = {m.name: m for m in (LeNet, SimpleCNN, AlexNet, VGG16, VGG19, GoogLeNet,
                            ResNet50, InceptionResNetV1, FaceNetNN4Small2,

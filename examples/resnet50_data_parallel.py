@@ -21,15 +21,20 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
 
 
 def main():
-    model = ResNet50(num_classes=1000)
+    # DL4J_TPU_EXAMPLE_SMALL=1 shrinks to a CPU-smoke footprint; the
+    # default is the TPU-sized ImageNet config
+    small = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMALL"))
+    classes, hw, b = (10, 64, 8) if small else (1000, 224, 32)
+    model = ResNet50(num_classes=classes,
+                     input_shape=(3, hw, hw) if small else None)
     conf = model.conf()
     conf.global_conf.compute_dtype = "bfloat16"  # MXU path
     net = ComputationGraph(conf).init()
 
     rng = np.random.default_rng(0)
-    batches = [DataSet(rng.normal(size=(32, 3, 224, 224)).astype(np.float32),
-                       np.eye(1000, dtype=np.float32)[
-                           rng.integers(0, 1000, 32)])
+    batches = [DataSet(rng.normal(size=(b, 3, hw, hw)).astype(np.float32),
+                       np.eye(classes, dtype=np.float32)[
+                           rng.integers(0, classes, b)])
                for _ in range(4)]
 
     pw = (ParallelWrapper.Builder(net)

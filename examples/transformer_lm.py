@@ -42,6 +42,13 @@ for epoch in range(20):
     net.fit(mds)
 print("trained score:", float(net.score(mds)))
 
+# autoregressive sampling through the KV cache
+from deeplearning4j_tpu.models import generate_tokens
+
+sample = generate_tokens(net, ids[:2, :8], n_tokens=12, temperature=0.8,
+                         seed=1)
+print("sampled continuation:", sample[0].tolist())
+
 # ---- the same model, time dim sharded over all devices (sp) ---------------
 devices = jax.devices()
 if len(devices) >= 2 and T % len(devices) == 0:

@@ -62,3 +62,29 @@ def test_remat_auto_excludes_recurrent():
     assert not remat_enabled(conf.global_conf,
                              [impl_for(conf.layers[0], conf.global_conf),
                               impl_for(conf.layers[1], conf.global_conf)])
+
+
+def test_remat_transformer_lm_bitwise():
+    """Remat on the flagship: a rematerialized TransformerLM step (the
+    long-context memory strategy — activations recomputed in the backward,
+    block-junction spine saved via the named policy) is bit-identical to
+    the default schedule on a ComputationGraph."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 10, size=(4, 12)).astype(np.float32)
+    l = np.eye(10, dtype=np.float32)[np.roll(ids.astype(int), -1, axis=1)]
+    results = {}
+    for mode in ("off", "on"):
+        m = TransformerLM(vocab_size=10, embed_dim=16, num_heads=2,
+                          num_blocks=2, seed=6)
+        conf = m.conf()
+        conf.global_conf.remat = mode
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet((ids,), (l,))
+        for _ in range(3):
+            net.fit(mds)
+        results[mode] = float(net.score(mds))
+    assert results["off"] == results["on"], results
