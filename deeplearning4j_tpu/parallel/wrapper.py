@@ -21,6 +21,7 @@ pinning all disappear: batches go to devices by sharding annotation.
 from __future__ import annotations
 
 import logging
+import os
 from typing import List, Optional
 
 import numpy as np
@@ -34,7 +35,7 @@ from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
                        data_parallel_tbptt_step,
                        data_parallel_tbptt_update_step, pvary)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
-from ..nn.conf import BackpropType
+from ..nn.conf import BackpropType, CacheMode
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
@@ -142,6 +143,17 @@ class ParallelWrapper:
         else:
             self.local_workers_ = self.workers_
         self._mp_batch_size = None  # enforced-uniform size (multi-process)
+        # CacheMode.DEVICE for the sharded dispatch path: merged+sharded
+        # global batches keyed by the group's array identities (see
+        # DataSet._device_key). Values retain the KEYED HOST ARRAYS (the
+        # same rule as _cached_device_put) so an id/data-pointer can't be
+        # recycled into a stale-key collision, and the dict is LRU-evicted
+        # under a byte budget so non-repeating data (augmentation,
+        # streaming) can't pin unbounded HBM.
+        self._sharded_batch_cache = {}   # key -> (out, retained, nbytes)
+        self._sharded_cache_bytes = 0
+        self.sharded_cache_budget = int(
+            os.environ.get("DL4J_TPU_PW_CACHE_BYTES", 4 << 30))
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.training_mode = training_mode
@@ -571,7 +583,62 @@ class ParallelWrapper:
 
         Source dtypes are preserved (integer embedding indices, f64 nets);
         the layers' own ``cast_in`` decides the compute dtype. For a
-        ComputationGraph the step takes tuples of input/label streams."""
+        ComputationGraph the step takes tuples of input/label streams.
+
+        Under ``CacheMode.DEVICE`` the merged+sharded result is cached on
+        the group's array identities, so repeated epochs over the same
+        iterator batches skip the host→device transfer entirely — the
+        reference's ``CacheMode.DEVICE`` semantics (`nn/conf/CacheMode.java`)
+        applied to the ParallelWrapper dispatch path."""
+        return self._cached_sharded((), batches, self._global_batch_uncached)
+
+    def _cached_sharded(self, prefix, batches, build):
+        """LRU device-batch cache shared by the sync and local-SGD paths.
+        Keyed on the batches' ``_device_key`` tuples; each entry retains the
+        keyed host arrays (so ids/data pointers stay pinned for the entry's
+        lifetime — the `_cached_device_put` rule) and records the device
+        bytes it pins; total pinned bytes are bounded by
+        ``sharded_cache_budget`` (env ``DL4J_TPU_PW_CACHE_BYTES``, default
+        4 GiB) with least-recently-used eviction."""
+        if getattr(self.net.gc, "cache_mode", None) != CacheMode.DEVICE:
+            return build(batches)
+        ckey = prefix + tuple(b._device_key() for b in batches)
+        cache = self._sharded_batch_cache
+        hit = cache.pop(ckey, None)
+        if hit is not None:
+            cache[ckey] = hit                     # re-insert: LRU freshness
+            return hit[0]
+        out = build(batches)
+
+        def _retained(b):
+            if isinstance(b, MultiDataSet):
+                seqs = (b.features, b.labels, b.features_masks, b.labels_masks)
+                return tuple(tuple(s) for s in seqs if s is not None)
+            return (b.features, b.labels, b.features_mask, b.labels_mask)
+
+        nbytes = sum(getattr(a, "nbytes", 0)
+                     for a in jax.tree_util.tree_leaves(out))
+        cache[ckey] = (out, tuple(_retained(b) for b in batches), nbytes)
+        self._sharded_cache_bytes += nbytes
+        # plain-dict insertion order + re-insert-on-hit above ⇒ first key
+        # is the least recently used
+        while (self._sharded_cache_bytes > self.sharded_cache_budget
+               and len(cache) > 1):
+            oldest = next(iter(cache))
+            _, _, old_bytes = cache.pop(oldest)
+            self._sharded_cache_bytes -= old_bytes
+        return out
+
+    def clear_device_cache(self):
+        """Drop every cached sharded batch (and the host arrays it retains).
+        Use when training under ``CacheMode.DEVICE`` with data that does NOT
+        repeat across epochs (augmentation, streaming): non-repeating batches
+        insert entries that can never hit, and although the LRU byte budget
+        bounds the HBM pinned, that budget is better spent on activations."""
+        self._sharded_batch_cache.clear()
+        self._sharded_cache_bytes = 0
+
+    def _global_batch_uncached(self, batches):
         if self._is_graph:
             mds_list = [self.net._as_multi(b) for b in batches]
             mds = mds_list[0] if len(mds_list) == 1 else MultiDataSet.merge(mds_list)
@@ -608,7 +675,13 @@ class ParallelWrapper:
 
     def _stacked_batches(self, batches):
         """[N, global_b, ...] with the global batch dim sharded. Masks ride
-        along (all-ones filled when presence is mixed across micro-batches)."""
+        along (all-ones filled when presence is mixed across micro-batches).
+        ``CacheMode.DEVICE`` reuses the stacked+sharded device copy across
+        epochs (same cache as :meth:`_global_batch`)."""
+        return self._cached_sharded(("stack",), batches,
+                                    self._stacked_batches_uncached)
+
+    def _stacked_batches_uncached(self, batches):
         def stack_masks(masks, data):
             if all(m is None for m in masks):
                 return None
