@@ -793,7 +793,7 @@ def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
     y = np.asarray(net.rnn_time_step(encode(prompt)))
     probs = y[:, -1, :]
     out = []
-    for _ in range(int(n_tokens)):
+    for t in range(int(n_tokens)):
         p = np.maximum(probs.astype(np.float64), 1e-12)
         if temperature != 1.0:
             logp = np.log(p) / max(float(temperature), 1e-6)
@@ -802,7 +802,8 @@ def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
         nxt = np.array([rng.choice(p.shape[-1], p=p[i]) for i in range(b)],
                        dtype=np.int64)
         out.append(nxt)
-        probs = step(nxt)
+        if t + 1 < int(n_tokens):   # the last token needs no further step
+            probs = step(nxt)
     return np.stack(out, axis=1)
 
 

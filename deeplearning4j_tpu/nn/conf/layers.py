@@ -172,6 +172,14 @@ class MoEDenseLayer(FeedForwardLayer):
     #: streaming agree regardless of batch shape. 0 keeps the dense einsum
     #: path everywhere (the correctness oracle).
     capacity_factor: float = 0.0
+    #: token-group size for the sparse dispatch (GShard "group" dim):
+    #: capacity is enforced PER GROUP of this many tokens, so the one-hot
+    #: dispatch tensor is [groups, G, E, C_g] with C_g ∝ G — memory linear
+    #: in token count instead of quadratic ([n, E, C] with C ∝ n). Smaller
+    #: groups = less dispatch memory but more capacity fragmentation
+    #: (drops decided within each group). Token counts that don't divide
+    #: evenly are zero-gate padded to a group multiple.
+    group_size: int = 1024
 
 
 @register

@@ -417,55 +417,8 @@ class ComputationGraph:
         fused ``lax.scan`` program (one device dispatch per minibatch — see
         ``multilayer._build_tbptt_scan``); a ragged tail falls back to
         per-segment dispatch."""
-        T = int(inputs[0].shape[1])
-        L = self.conf.tbptt_fwd_length
-        n_applied = 1 if single_iteration else _n_iterations(self.gc)
-        if T % L == 0:
-            S, b = T // L, int(inputs[0].shape[0])
-
-            def stack_t(x):
-                return jnp.swapaxes(x.reshape(b, S, L, *x.shape[2:]), 0, 1)
-
-            f_s = tuple(stack_t(x) for x in inputs)
-            l_s = tuple(stack_t(x) if x.ndim == 3
-                        else jnp.broadcast_to(x, (S,) + x.shape)
-                        for x in labels)
-            fm_s = (None if fms is None
-                    else tuple(None if m is None else stack_t(m)
-                               for m in fms))
-            lm_s = (None if lms is None
-                    else tuple(None if m is None else stack_t(m)
-                               for m in lms))
-            scan_step = self._ensure_tbptt_scan_step(single_iteration)
-            it0 = jnp.asarray(self.iteration_count, jnp.int32)
-            (self.params, self.states, self.updater_state, loss) = scan_step(
-                self.params, self.states, self.updater_state, it0,
-                self._next_rng(), f_s, l_s, fm_s, lm_s,
-                self._init_rnn_state(b))
-            self.iteration_count += S * n_applied
-        else:
-            step = self._ensure_tbptt_step(single_iteration=single_iteration)
-            rnn_state = self._init_rnn_state(int(inputs[0].shape[0]))
-            loss = jnp.asarray(float("nan"))
-            for start in range(0, T, L):
-                sl = slice(start, min(start + L, T))
-                f_c = tuple(x[:, sl] for x in inputs)
-                l_c = tuple(l[:, sl] if l.ndim == 3 else l for l in labels)
-                fm_c = (None if fms is None
-                        else tuple(None if m is None else m[:, sl]
-                                   for m in fms))
-                lm_c = (None if lms is None
-                        else tuple(None if m is None else m[:, sl]
-                                   for m in lms))
-                it = jnp.asarray(self.iteration_count, jnp.int32)
-                (self.params, self.states, self.updater_state, loss,
-                 rnn_state) = step(self.params, self.states,
-                                   self.updater_state, it, self._next_rng(),
-                                   f_c, l_c, fm_c, lm_c, rnn_state)
-                self.iteration_count += n_applied
-        self.score_ = loss
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+        from .multilayer import _run_tbptt
+        _run_tbptt(self, inputs, labels, fms, lms, single_iteration)
 
     # ------------------------------------------------------------- streaming
     def rnn_time_step(self, *inputs):

@@ -89,35 +89,63 @@ class MoEDenseImpl(LayerImpl):
         each expert computes a fixed [C, F] buffer of its routed tokens, so
         expert FLOPs are E·C·F·O ≈ (top_k/E)·dense instead of n·E·F·O.
 
-        Buffer positions are assigned slot-major (all rank-0 assignments
-        before rank-1), so when an expert overflows its capacity the LOWER-
-        gate assignments are the ones dropped. Dropped (token, expert) pairs
-        simply contribute zero — Switch-Transformer semantics. The dispatch
-        tensor stays one-hot/shardable: with ``W`` sharded over the mesh
-        'expert' axis the per-expert einsums partition and the combine
-        reduction lowers to a psum, same as the dense path."""
+        Tokens are processed in GROUPS of ``conf.group_size`` (the GShard
+        group dim): capacity is enforced per group, so the one-hot dispatch
+        tensor is [g, G, E, C_g] with C_g ∝ G — memory LINEAR in token
+        count (n·k·G·cf elements) instead of the groupless [n, E, C]
+        (C ∝ n ⇒ quadratic: the T=8k flagship would need multi-GB dispatch
+        intermediates). A short token run (n ≤ G) is a single group, so
+        small-batch behavior is unchanged.
+
+        Buffer positions are assigned slot-major within each group (all
+        rank-0 assignments before rank-1), so when an expert overflows its
+        per-group capacity the LOWER-gate assignments are the ones dropped.
+        Dropped (token, expert) pairs simply contribute zero —
+        Switch-Transformer semantics. The dispatch tensor stays
+        one-hot/shardable: with ``W`` sharded over the mesh 'expert' axis
+        the per-expert einsums partition and the combine reduction lowers
+        to a psum, same as the dense path."""
         c = self.conf
         n, E = flat.shape[0], c.num_experts
         k = min(c.top_k, E)
-        C = self._capacity(n)
-        _, idxs = jax.lax.top_k(gates, k)                    # [n, k]
-        mask = jax.nn.one_hot(idxs, E, dtype=jnp.int32)      # [n, k, E]
-        mk = mask.transpose(1, 0, 2).reshape(k * n, E)       # slot-major
-        pos = jnp.cumsum(mk, axis=0) - 1                     # per-expert fill
-        pos_t = jnp.sum(pos * mk, axis=-1)                   # [k*n] buffer pos
+        G = max(8, min(n, int(getattr(c, "group_size", 1024) or 1024)))
+        g = -(-n // G)
+        pad = g * G - n
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad, flat.shape[1]), flat.dtype)], axis=0)
+            gates = jnp.concatenate(
+                [gates, jnp.zeros((pad, E), gates.dtype)], axis=0)
+        C = self._capacity(G)
+        xg = flat.reshape(g, G, -1)
+        gg = gates.reshape(g, G, E)
+        _, idxs = jax.lax.top_k(gg, k)                       # [g, G, k]
+        mask = jax.nn.one_hot(idxs, E, dtype=jnp.int32)      # [g, G, k, E]
+        if pad:
+            # top_k on a padding row's all-zero gates still one-hots experts
+            # 0..k-1; zero those mask rows so pads claim no buffer slots
+            # (they'd otherwise displace real low-gate assignments in the
+            # tail group)
+            valid = (jnp.arange(g * G) < n).astype(jnp.int32).reshape(g, G)
+            mask = mask * valid[:, :, None, None]
+        mk = mask.transpose(0, 2, 1, 3).reshape(g, k * G, E)  # slot-major
+        pos = jnp.cumsum(mk, axis=1) - 1                     # per-expert fill
+        pos_t = jnp.sum(pos * mk, axis=-1)                   # [g, k*G]
         keep = (pos_t < C) & (jnp.sum(mk, axis=-1) > 0)
-        slot = jax.nn.one_hot(pos_t, C, dtype=cd) * keep[:, None].astype(cd)
-        disp = (mk.astype(cd)[:, :, None] * slot[:, None, :])  # [k*n, E, C]
-        disp = disp.reshape(k, n, E, C).sum(axis=0)            # [n, E, C]
-        combine = disp * gates.astype(cd)[:, :, None]
-        expert_in = jnp.einsum("nec,nf->ecf", disp, flat.astype(cd),
+        slot = (jax.nn.one_hot(pos_t, C, dtype=cd)
+                * keep[..., None].astype(cd))                # [g, k*G, C]
+        disp = (mk.astype(cd)[..., None] * slot[..., None, :])
+        disp = disp.reshape(g, k, G, E, C).sum(axis=1)       # [g, G, E, C]
+        combine = disp * gg.astype(cd)[..., None]
+        expert_in = jnp.einsum("gnec,gnf->egcf", disp, xg.astype(cd),
                                preferred_element_type=pet_dtype(cd))
-        h = jnp.einsum("ecf,efo->eco", expert_in, params["W"].astype(cd),
+        h = jnp.einsum("egcf,efo->egco", expert_in, params["W"].astype(cd),
                        preferred_element_type=pet_dtype(cd))
         if "b" in params:
-            h = h + params["b"].astype(h.dtype)[:, None, :]
-        return jnp.einsum("nec,eco->no", combine, h,
-                          preferred_element_type=pet_dtype(cd))
+            h = h + params["b"].astype(h.dtype)[:, None, None, :]
+        y = jnp.einsum("gnec,egco->gno", combine, h,
+                       preferred_element_type=pet_dtype(cd))
+        return y.reshape(g * G, -1)[:n]
 
     def forward(self, params, state, x, train=False, rng=None, mask=None,
                 ctx=None):

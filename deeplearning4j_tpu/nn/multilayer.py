@@ -110,6 +110,70 @@ def _build_tbptt_scan(step, n_iter):
     return jax.jit(scanned, donate_argnums=(0, 2))
 
 
+def _map_streams(fn, x):
+    """Apply ``fn`` to every stream array: bare arrays (MultiLayerNetwork),
+    tuples of optional streams (ComputationGraph), or None pass through."""
+    if x is None:
+        return None
+    if isinstance(x, tuple):
+        return tuple(None if a is None else fn(a) for a in x)
+    return fn(x)
+
+
+def _run_tbptt(net, f, l, fm, lm, single_iteration):
+    """The TBPTT dispatch loop shared by BOTH containers (reference
+    ``doTruncatedBPTT`` in `MultiLayerNetwork.java:1219` and
+    `ComputationGraph.java`): equal segments fuse into ONE scanned program
+    (segment stacking [b, T, ...] → [S, b, L, ...], rank-2 labels/static
+    streams broadcast over S); a ragged tail falls back to per-segment
+    dispatch with the (h, c) carries threaded on the host. Stream-shape
+    differences between the containers are confined to ``_map_streams``."""
+    conf, gc = net.conf, net.gc
+    first = f[0] if isinstance(f, tuple) else f
+    T = int(first.shape[1])
+    L = conf.tbptt_fwd_length
+    n_applied = 1 if single_iteration else _n_iterations(gc)
+    if T % L == 0:
+        S, b = T // L, int(first.shape[0])
+
+        def stack(x):
+            return jnp.swapaxes(x.reshape(b, S, L, *x.shape[2:]), 0, 1)
+
+        def stack_lbl(x):
+            return (stack(x) if x.ndim == 3
+                    else jnp.broadcast_to(x, (S,) + x.shape))
+
+        scan_step = net._ensure_tbptt_scan_step(single_iteration)
+        it0 = jnp.asarray(net.iteration_count, jnp.int32)
+        (net.params, net.states, net.updater_state, loss) = scan_step(
+            net.params, net.states, net.updater_state, it0, net._next_rng(),
+            _map_streams(stack, f), _map_streams(stack_lbl, l),
+            _map_streams(stack, fm), _map_streams(stack, lm),
+            net._init_rnn_state(b))
+        # one iteration per TBPTT segment × iterations(n) applied per
+        # segment (reference increments iterationCount per applied update,
+        # so Adam bias correction and lr schedules see each one)
+        net.iteration_count += S * n_applied
+    else:
+        step = net._ensure_tbptt_step(single_iteration=single_iteration)
+        rnn_state = net._init_rnn_state(int(first.shape[0]))
+        for start in range(0, T, L):
+            sl = slice(start, min(start + L, T))
+            it = jnp.asarray(net.iteration_count, jnp.int32)
+            (net.params, net.states, net.updater_state, loss,
+             rnn_state) = step(
+                net.params, net.states, net.updater_state, it,
+                net._next_rng(),
+                _map_streams(lambda x: x[:, sl], f),
+                _map_streams(lambda x: x[:, sl] if x.ndim == 3 else x, l),
+                _map_streams(lambda x: x[:, sl], fm),
+                _map_streams(lambda x: x[:, sl], lm), rnn_state)
+            net.iteration_count += n_applied
+    net.score_ = loss
+    for lst in net.listeners:
+        lst.iteration_done(net, net.iteration_count - 1, float(loss))
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -478,48 +542,7 @@ class MultiLayerNetwork:
                         "backprop truncation uses the forward chunk length",
                         self.conf.tbptt_back_length, self.conf.tbptt_fwd_length)
             self._warned_tbptt = True
-        T = f.shape[1]
-        L = self.conf.tbptt_fwd_length
-        n_applied = 1 if single_iteration else _n_iterations(self.gc)
-        if T % L == 0:
-            # fused path: scan over stacked equal segments, ONE dispatch
-            S, b = T // L, f.shape[0]
-            f_s = jnp.swapaxes(f.reshape(b, S, L, *f.shape[2:]), 0, 1)
-            l_s = (jnp.swapaxes(l.reshape(b, S, L, *l.shape[2:]), 0, 1)
-                   if l.ndim == 3 else jnp.broadcast_to(l, (S,) + l.shape))
-            fm_s = (None if fm is None
-                    else jnp.swapaxes(fm.reshape(b, S, L), 0, 1))
-            lm_s = (None if lm is None
-                    else jnp.swapaxes(lm.reshape(b, S, L), 0, 1))
-            scan_step = self._ensure_tbptt_scan_step(single_iteration)
-            it0 = jnp.asarray(self.iteration_count, jnp.int32)
-            (self.params, self.states, self.updater_state, loss) = scan_step(
-                self.params, self.states, self.updater_state, it0,
-                self._next_rng(), f_s, l_s, fm_s, lm_s,
-                self._init_rnn_state(int(b)))
-            # one iteration per TBPTT segment × iterations(n) applied per
-            # segment (reference increments iterationCount per applied
-            # update, so Adam bias correction and lr schedules see each one)
-            self.iteration_count += S * n_applied
-        else:
-            # ragged tail: per-segment dispatch (shapes differ per segment)
-            step = self._ensure_tbptt_step(single_iteration=single_iteration)
-            rnn_state = self._init_rnn_state(int(f.shape[0]))
-            for start in range(0, T, L):
-                sl = slice(start, min(start + L, T))
-                f_c = f[:, sl]
-                l_c = l[:, sl] if l.ndim == 3 else l
-                fm_c = None if fm is None else fm[:, sl]
-                lm_c = None if lm is None else lm[:, sl]
-                it = jnp.asarray(self.iteration_count, jnp.int32)
-                (self.params, self.states, self.updater_state, loss,
-                 rnn_state) = step(self.params, self.states,
-                                   self.updater_state, it, self._next_rng(),
-                                   f_c, l_c, fm_c, lm_c, rnn_state)
-                self.iteration_count += n_applied
-        self.score_ = loss
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+        _run_tbptt(self, f, l, fm, lm, single_iteration)
 
     def _init_rnn_state(self, batch):
         state = {}

@@ -504,6 +504,14 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                     f"layer/vertex {i} ({name}) collapses or reshapes the "
                     f"sharded time dim — per-shard results would silently "
                     f"diverge; unsupported in the sp step (v1)")
+            if name == "BatchNormalization":
+                raise ValueError(
+                    f"layer {i} ({name}) computes train-time statistics "
+                    f"over the batch AND time dims; each time shard would "
+                    f"normalize with shard-local mean/var and diverge from "
+                    f"the unsharded step — unsupported in the sp step (v1). "
+                    f"Use LayerNormalization (per-token statistics, "
+                    f"shard-invariant) instead")
             if getattr(cand, "aux_loss_weight", 0.0):
                 raise ValueError(
                     f"layer {i} ({name}) has an activation-dependent aux "

@@ -800,3 +800,25 @@ def test_sequence_parallel_transformer_lm_matches_unsharded():
                      jax.tree_util.tree_leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
                                    rtol=3e-3, atol=3e-4)
+
+
+def test_sequence_parallel_step_rejects_batchnorm():
+    """BatchNormalization's train-time statistics reduce over batch AND time;
+    a time shard would normalize with shard-local mean/var and silently
+    diverge — the sp step must reject it loudly (review finding)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                   DenseLayer, RnnOutputLayer)
+    from deeplearning4j_tpu.parallel import (sequence_parallel_step, make_mesh,
+                                             SEQUENCE_AXIS)
+
+    mesh = make_mesh(jax.devices()[:4], axes=(SEQUENCE_AXIS,))
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(BatchNormalization(n_in=8, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    with pytest.raises(ValueError, match="statistics"):
+        sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)

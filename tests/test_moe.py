@@ -439,3 +439,60 @@ def test_moe_rejects_bad_routing_config():
         build(num_experts=4, top_k=5)
     with pytest.raises(ValueError, match="capacity_factor"):
         build(num_experts=4, top_k=2, capacity_factor=-1.0)
+
+
+def test_moe_sparse_grouped_dispatch_matches_dense():
+    """Multi-group dispatch (n > group_size, with a zero-padded tail group):
+    ample capacity ⇒ parity with the dense oracle for EVERY token, including
+    the tail group's real tokens."""
+    impl_s, p = _moe_impl(capacity_factor=4.0)
+    impl_s.conf.group_size = 16          # 3 full groups + 5-token tail
+    impl_d, _ = _moe_impl(capacity_factor=0.0)
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(53, 6)), jnp.float32)
+    ys, _ = impl_s.forward(p, {}, x, train=True)
+    yd, _ = impl_d.forward(p, {}, x)
+    assert ys.shape == yd.shape == (53, 8)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_sparse_tail_padding_claims_no_capacity():
+    """A nearly-empty tail group at TIGHT capacity must treat its real tokens
+    exactly like a dedicated group of the same tokens would: padding rows
+    claim no expert slots. (Regression: top_k on zero gates one-hots expert
+    0..k-1, which would displace real assignments.)"""
+    impl_s, p = _moe_impl(capacity_factor=1.0)
+    impl_s.conf.group_size = 32
+    rng = np.random.default_rng(17)
+    x_main = jnp.asarray(rng.normal(size=(32, 6)), jnp.float32)
+    x_tail = jnp.asarray(rng.normal(size=(3, 6)), jnp.float32)
+    y_joint, _ = impl_s.forward(p, {}, jnp.concatenate([x_main, x_tail]),
+                                train=True)
+    y_tail, _ = impl_s.forward(p, {}, x_tail, train=True)
+    # per-group capacity assignment ⇒ the tail group computed alone (its own
+    # single group, 3 real tokens, no pads) must match the joint run's tail
+    np.testing.assert_allclose(np.asarray(y_joint[32:]), np.asarray(y_tail),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_sparse_dispatch_memory_linear_in_tokens():
+    """The dispatch intermediates scale with n·G, not n²: jaxpr shapes for a
+    2×-token run contain no tensor whose element count grew 4× (quadratic)."""
+    import re
+
+    def max_elems(n):
+        impl_s, p = _moe_impl(capacity_factor=1.25)
+        impl_s.conf.group_size = 64
+        x = jnp.zeros((n, 6), jnp.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda pp, xx: impl_s.forward(pp, {}, xx, train=True))(p, x)
+        worst = 0
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                shape = getattr(v.aval, "shape", ())
+                worst = max(worst, int(np.prod(shape)) if shape else 0)
+        return worst
+
+    m1, m2 = max_elems(256), max_elems(512)
+    assert m2 <= m1 * 2.5, (m1, m2)   # linear (2×), not quadratic (4×)
