@@ -32,10 +32,24 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   run_stage headline env BENCH_PROBE_WINDOW_S=900 python bench.py
   if [ -f "$STATE/headline.ok" ]; then
     run_stage all      env BENCH_PROBE_WINDOW_S=600 python bench.py --all
-    run_stage flash    python perf_flash_check.py
-    run_stage roofline python perf_lstm.py roofline
-    run_stage ab       python perf_lstm.py ab
-    run_stage sweep    python perf_lstm.py sweep
+    # perf_* scripts have no tunnel watchdog of their own: a wedged backend
+    # init would block the loop forever, so (a) probe the tunnel cheaply
+    # before each stage — a wedged tunnel skips the stage this cycle
+    # instead of burning its whole timeout — and (b) bound each stage's
+    # wall clock anyway (the tunnel can wedge mid-run too)
+    probe() { timeout 150 python -c \
+      "import jax; jax.devices()" >/dev/null 2>&1; }
+    # marker check BEFORE the probe: completed stages must not pay the
+    # 150s probe on wedged cycles
+    need() { [ ! -f "$STATE/$1.ok" ]; }
+    need flash    && probe && run_stage flash \
+                     timeout 1800 python perf_flash_check.py
+    need roofline && probe && run_stage roofline \
+                     timeout 1200 python perf_lstm.py roofline
+    need ab       && probe && run_stage ab \
+                     timeout 1800 python perf_lstm.py ab
+    need sweep    && probe && run_stage sweep \
+                     timeout 2400 python perf_lstm.py sweep
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
