@@ -78,7 +78,32 @@ def put_replicated(x, mesh: Mesh):
     return jax.device_put(x, replicated(mesh))
 
 
-def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):
+def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
+    """Sharding pytree for OPTIMIZER STATE sharded over the data axis —
+    weight-update / optimizer-state sharding (Xu et al. 2020,
+    arXiv:2004.13336 "Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training"; the ZeRO-1 idea expressed as XLA sharding
+    annotations). Each leaf shards its first dim divisible by the axis
+    extent; everything else (small biases, scalar step counts) replicates.
+    With the updater state annotated this way and params replicated, the
+    SPMD partitioner keeps each replica's m/v (etc.) shard-resident —
+    optimizer memory drops ~N-fold — and reshards gradients into the
+    update instead of applying it N times redundantly."""
+    n = int(mesh.shape[axis])
+    repl = replicated(mesh)
+
+    def spec(x):
+        shape = getattr(x, "shape", ())
+        for d, s in enumerate(shape):
+            if s >= n and s % n == 0:
+                return NamedSharding(mesh, P(*([None] * d + [axis])))
+        return repl
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
+                       shard_update: bool = False):
     """Jit a network's train step for synchronous data parallelism.
 
     Equivalent role to the reference's ``ParallelWrapper`` AVERAGING mode with
@@ -89,12 +114,19 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True):
     Returns a jitted ``step(params, states, upd_state, iteration, rng, f, l,
     fm, lm)`` whose batch inputs must be sharded along ``axis`` (use
     :func:`shard_batch`) and whose params/updater-state are replicated.
+
+    ``shard_update=True`` enables weight-update/optimizer-state sharding
+    (:func:`update_sharded_specs`): updater state lives sharded over the
+    data axis instead of replicated — numerically identical, ~N× less
+    optimizer memory per device.
     """
     raw = net._raw_step(False)
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
-    in_sh = (repl, repl, repl, repl, repl, data, data, data, data)
-    out_sh = (repl, repl, repl, repl)
+    upd = (update_sharded_specs(net.updater_state, mesh, axis)
+           if shard_update else repl)
+    in_sh = (repl, repl, upd, repl, repl, data, data, data, data)
+    out_sh = (repl, repl, upd, repl)
     return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                    donate_argnums=(0, 2) if donate else ())
 
@@ -111,18 +143,21 @@ def _rnn_state_shardings(net, mesh: Mesh, axis: str):
 
 
 def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
-                             donate=True):
+                             donate=True, shard_update: bool = False):
     """Sharded train step that also threads the detached RNN/KV carry —
     the TBPTT segment step under data parallelism. Reference semantics:
     ``ParallelWrapper`` workers run the full ``MultiLayerNetwork.fit`` loop
     per replica (``trainer/DefaultTrainer.java:244``), truncated-BPTT
-    included, so the SPMD equivalent must segment time the same way."""
+    included, so the SPMD equivalent must segment time the same way.
+    ``shard_update`` as in :func:`data_parallel_step`."""
     raw = net._raw_step(True)
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
     state_sh = _rnn_state_shardings(net, mesh, axis)
-    in_sh = (repl, repl, repl, repl, repl, data, data, data, data, state_sh)
-    out_sh = (repl, repl, repl, repl, state_sh)
+    upd = (update_sharded_specs(net.updater_state, mesh, axis)
+           if shard_update else repl)
+    in_sh = (repl, repl, upd, repl, repl, data, data, data, data, state_sh)
+    out_sh = (repl, repl, upd, repl, state_sh)
     return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                    donate_argnums=(0, 2) if donate else ())
 

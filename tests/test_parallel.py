@@ -740,3 +740,99 @@ def test_parallel_wrapper_device_cache_lru_eviction():
     assert again[0] is cached[0]
     for _, retained, _ in pw._sharded_batch_cache.values():
         assert retained and all(r is not None for r in retained)
+
+
+def test_weight_update_sharding_matches_plain_dp():
+    """Optimizer-state sharding (arXiv:2004.13336 / ZeRO-1 as sharding
+    annotations) is numerically identical to plain replicated-state DP, and
+    the big updater leaves really live sharded over the data axis."""
+    from deeplearning4j_tpu.parallel import DATA_AXIS
+    ds_list = [_data(32, seed=i) for i in range(8)]
+
+    def adam_net():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Adam(learning_rate=1e-2)).activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=16))
+                .layer(DenseLayer(n_in=16, n_out=16))
+                .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    plain = adam_net()
+    (ParallelWrapper.Builder(plain).workers(8)
+     .training_mode(TrainingMode.AVERAGING).averaging_frequency(1).build()
+     .fit(ListDataSetIterator(ds_list), epochs=2))
+
+    ws = adam_net()
+    pw = (ParallelWrapper.Builder(ws).workers(8)
+          .training_mode(TrainingMode.AVERAGING).averaging_frequency(1)
+          .weight_update_sharding().build())
+    pw.fit(ListDataSetIterator(ds_list), epochs=2)
+
+    for k in plain.params:
+        for p in plain.params[k]:
+            np.testing.assert_allclose(np.asarray(plain.params[k][p]),
+                                       np.asarray(ws.params[k][p]),
+                                       rtol=1e-5, atol=1e-6)
+    # the updater state is genuinely sharded: at least one leaf's sharding
+    # spec names the data axis (16-wide dims shard over the 8-device mesh)
+    leaves = jax.tree_util.tree_leaves(ws.updater_state)
+    assert any(DATA_AXIS in str(getattr(l, "sharding", None).spec)
+               for l in leaves if hasattr(l, "sharding")), \
+        [getattr(l, "sharding", None) for l in leaves]
+
+
+def test_weight_update_sharding_rejects_unsupported_modes():
+    """Silent no-op would fake the memory saving — local SGD and
+    SHARED_GRADIENTS must reject the flag loudly."""
+    with pytest.raises(NotImplementedError, match="AVERAGING"):
+        (ParallelWrapper.Builder(_net()).workers(8)
+         .averaging_frequency(2).weight_update_sharding().build())
+    with pytest.raises(NotImplementedError, match="AVERAGING"):
+        (ParallelWrapper.Builder(_net()).workers(8)
+         .training_mode(TrainingMode.SHARED_GRADIENTS)
+         .weight_update_sharding().build())
+
+
+def test_weight_update_sharding_tbptt_matches_plain_dp():
+    """The sharded-optimizer flag rides the TBPTT sync step too."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf import BackpropType
+    from deeplearning4j_tpu.parallel import DATA_AXIS
+
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-2)).list()
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_forward_length(4).t_bptt_backward_length(4)
+                .layer(LSTM(n_in=3, n_out=16, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=16, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(31)
+    f = rng.normal(size=(16, 8, 3)).astype(np.float32)
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (16, 8))].astype(
+        np.float32)
+    ds = DataSet(f, l)
+
+    plain = make()
+    (ParallelWrapper.Builder(plain).workers(8).build()
+     .fit(ListDataSetIterator([ds]), epochs=2))
+
+    ws = make()
+    pw = (ParallelWrapper.Builder(ws).workers(8)
+          .weight_update_sharding().build())
+    pw.fit(ListDataSetIterator([ds]), epochs=2)
+
+    for k in plain.params:
+        for p in plain.params[k]:
+            np.testing.assert_allclose(np.asarray(plain.params[k][p]),
+                                       np.asarray(ws.params[k][p]),
+                                       rtol=1e-5, atol=1e-6)
+    assert any(DATA_AXIS in str(l2.sharding.spec)
+               for l2 in jax.tree_util.tree_leaves(ws.updater_state)
+               if hasattr(l2, "sharding"))
