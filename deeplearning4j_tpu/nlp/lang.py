@@ -112,6 +112,7 @@ class Lexicon:
     def __init__(self, words: Optional[Iterable[str]] = None):
         self._freq: Dict[str, int] = {}
         self._trie: Dict = {}
+        self._total = 0          # running Σfreq (O(1) total_freq)
         self.max_len = 1
         if words:
             for w in words:
@@ -121,7 +122,10 @@ class Lexicon:
         word = word.strip()
         if not word:
             return
-        self._freq[word] = max(self._freq.get(word, 0), int(freq))
+        old = self._freq.get(word, 0)
+        new = max(old, int(freq))
+        self._freq[word] = new
+        self._total += new - old
         self.max_len = max(self.max_len, len(word))
         node = self._trie
         for ch in word:
@@ -158,18 +162,8 @@ class Lexicon:
     def longest_prefix(self, text: str, start: int) -> int:
         """Length of the longest lexicon word starting at ``start`` (0 if
         none) — one trie walk, no per-length hashing."""
-        node = self._trie
-        best = 0
-        i = start
-        n = len(text)
-        while i < n:
-            node = node.get(text[i])
-            if node is None:
-                break
-            i += 1
-            if self._END in node:
-                best = i - start
-        return best
+        lengths = self.match_lengths(text, start)
+        return lengths[-1] if lengths else 0
 
     def longest_suffix(self, text: str, end: int) -> int:
         """Length of the longest lexicon word ENDING at ``end`` (exclusive).
@@ -179,6 +173,24 @@ class Lexicon:
             if text[start:end] in self._freq:
                 return end - start
         return 0
+
+    def match_lengths(self, text: str, start: int) -> List[int]:
+        """ALL lexicon-word lengths starting at ``start`` (one trie walk) —
+        the lattice edges for Viterbi segmentation."""
+        node = self._trie
+        out: List[int] = []
+        i, n = start, len(text)
+        while i < n:
+            node = node.get(text[i])
+            if node is None:
+                break
+            i += 1
+            if self._END in node:
+                out.append(i - start)
+        return out
+
+    def total_freq(self) -> int:
+        return self._total
 
 
 class _MaxMatchSegmenter:
@@ -246,21 +258,88 @@ class _MaxMatchSegmenter:
         return max(fwd, bwd, key=self._score)
 
 
+class _UnigramSegmenter:
+    """Unigram-LM lattice (word-DAG) segmentation with Viterbi DP — the
+    algorithm class behind the reference's bundled ansj/jieba-style
+    segmenters (`deeplearning4j-nlp-chinese/.../org/ansj/` builds a word
+    lattice over a double-array trie and picks the best-scoring path; same
+    capability here over the plain :class:`Lexicon` trie).
+
+    Every lexicon word starting at each position is a lattice edge scored
+    ``log((freq+1)/total)``; unknown single characters get the floor score.
+    ``route[i] = max_j logp(run[i:j]) + route[j]`` solved right-to-left in
+    O(n · max_word_len). Unlike max-match (greedy, longest-first), the DP
+    picks the globally most probable path, so frequency evidence can
+    override a longer dictionary match: 北京大学生前来应聘 segments
+    北京|大学生|前来|应聘 when 大学生 outweighs 北京大学, where FMM is
+    stuck with 北京大学|生前|来|应聘."""
+
+    def __init__(self, lexicon: Iterable[str]):
+        self.lexicon = (lexicon if isinstance(lexicon, Lexicon)
+                        else Lexicon(lexicon))
+
+    def add(self, *words: str):
+        for w in words:
+            self.lexicon.add(w)
+
+    def segment(self, run: str) -> List[str]:
+        import math
+        lex = self.lexicon
+        n = len(run)
+        if n == 0:
+            return []
+        logtot = math.log(lex.total_freq() + len(lex) + 1)
+        floor = -logtot  # unknown char: count ~1 in the corpus
+
+        def logp(w: str) -> float:
+            f = lex.freq(w)
+            return math.log(f + 1) - logtot if f > 0 else floor
+
+        route: List[Tuple[float, int]] = [(0.0, n)] * (n + 1)
+        for i in range(n - 1, -1, -1):
+            best = (logp(run[i]) + route[i + 1][0], i + 1)
+            for L in lex.match_lengths(run, i):
+                if L == 1:
+                    continue  # already covered by the char fallback
+                cand = logp(run[i:i + L]) + route[i + L][0]
+                if cand > best[0]:
+                    best = (cand, i + L)
+            route[i] = best
+        out: List[str] = []
+        i = 0
+        while i < n:
+            j = route[i][1]
+            out.append(run[i:j])
+            i = j
+        return out
+
+
 class ChineseTokenizerFactory(TokenizerFactory):
     """Dictionary forward-maximum-matching Chinese tokenizer (reference
     ``deeplearning4j-nlp-chinese/.../tokenization/tokenizerFactory/
     ChineseTokenizerFactory.java`` over the bundled ansj segmenter)."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None,
-                 dict_path: Optional[str] = None, bidirectional: bool = True):
+                 dict_path: Optional[str] = None, bidirectional: bool = True,
+                 algorithm: str = "bimm"):
         """``lexicon``: iterable of words or a :class:`Lexicon`;
         ``dict_path``: user dictionary file (``word [freq]`` per line,
-        jieba/ansj format) merged on top; ``bidirectional``: FMM+BMM with
-        ambiguity scoring (True) or plain forward max-match."""
+        jieba/ansj format) merged on top; ``algorithm``: ``"unigram"`` for
+        lattice-Viterbi unigram-LM segmentation (the ansj/jieba algorithm
+        class — best when the dictionary carries real frequencies),
+        ``"bimm"`` (default) for FMM+BMM with ambiguity scoring, ``"fmm"``
+        for plain forward max-match. ``bidirectional=False`` is a
+        back-compat alias for ``algorithm="fmm"``."""
         self._pre: Optional[TokenPreProcess] = None
-        self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
-                                       else CHINESE_LEXICON,
-                                       bidirectional=bidirectional)
+        lex = lexicon if lexicon is not None else CHINESE_LEXICON
+        if algorithm not in ("unigram", "bimm", "fmm"):
+            raise ValueError(f"unknown segmentation algorithm {algorithm!r}"
+                             " (expected 'unigram', 'bimm' or 'fmm')")
+        if algorithm == "unigram":
+            self._seg = _UnigramSegmenter(lex)
+        else:
+            self._seg = _MaxMatchSegmenter(
+                lex, bidirectional=bidirectional and algorithm == "bimm")
         if dict_path is not None:
             self._seg.lexicon.load(dict_path)
 

@@ -171,7 +171,7 @@ class ParallelWrapper:
                     "sharded optimizer state needs per-process local shard "
                     "assembly)")
             if (training_mode != TrainingMode.AVERAGING
-                    or int(averaging_frequency) != 1):
+                    or max(1, int(averaging_frequency)) != 1):
                 raise NotImplementedError(
                     "weight_update_sharding applies to "
                     "TrainingMode.AVERAGING with averaging_frequency=1 "

@@ -168,3 +168,52 @@ def test_bidirectional_keeps_seed_behavior():
     from deeplearning4j_tpu.nlp.lang import ChineseTokenizerFactory
     toks = ChineseTokenizerFactory().create("我们喜欢深度学习和神经网络").get_tokens()
     assert "深度学习" in toks and "神经网络" in toks and "我们" in toks
+
+
+def test_unigram_viterbi_beats_greedy_max_match(tmp_path):
+    """Lattice-Viterbi unigram segmentation: frequency evidence overrides a
+    longer greedy match — the case neither FMM nor BMM can fix, because both
+    are committed to maximal matches. 北京大学生前来应聘: the best unigram
+    path is 北京|大学生|前来|应聘, while FMM greedily eats 北京大学 and is
+    stuck with 生前|来."""
+    from deeplearning4j_tpu.nlp.lang import (Lexicon, _MaxMatchSegmenter,
+                                             _UnigramSegmenter)
+    d = tmp_path / "user.dict"
+    d.write_text("北京 50000\n北京大学 3000\n大学生 20000\n生前 500\n"
+                 "前来 8000\n应聘 6000\n大学 30000\n", encoding="utf-8")
+    lex = Lexicon.from_file(str(d))
+    uni = _UnigramSegmenter(lex)
+    assert uni.segment("北京大学生前来应聘") == ["北京", "大学生", "前来",
+                                                  "应聘"]
+    fmm = _MaxMatchSegmenter(lex, bidirectional=False)
+    assert fmm.segment("北京大学生前来应聘") != uni.segment(
+        "北京大学生前来应聘")
+    # when the longer word carries the frequency mass, the DP keeps it
+    # (with the counts above, 北京大学 splits to the more probable
+    # 北京|大学 — correct unigram behavior; make the compound dominant)
+    from deeplearning4j_tpu.nlp.lang import Lexicon as _Lx
+    lex2 = _Lx()
+    for w, f_ in (("中华人民共和国", 100000), ("中华", 100), ("人民", 100),
+                  ("共和国", 100)):
+        lex2.add(w, f_)
+    assert _UnigramSegmenter(lex2).segment("中华人民共和国") == [
+        "中华人民共和国"]
+    # unknown characters fall through as singles, known words still win
+    assert uni.segment("X北京Y") == ["X", "北京", "Y"]
+
+
+def test_unigram_factory_algorithm_option():
+    f = ChineseTokenizerFactory(algorithm="unigram")
+    toks = f.create("我们喜欢深度学习").get_tokens()
+    assert "深度学习" in toks and "我们" in toks
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="algorithm"):
+        ChineseTokenizerFactory(algorithm="nope")
+
+
+def test_lexicon_match_lengths_all_edges():
+    from deeplearning4j_tpu.nlp.lang import Lexicon
+    lex = Lexicon(["ab", "abc", "abcd", "b"])
+    assert lex.match_lengths("abcdef", 0) == [2, 3, 4]
+    assert lex.match_lengths("abcdef", 1) == [1]
+    assert lex.match_lengths("xyz", 0) == []
