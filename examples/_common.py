@@ -1,0 +1,29 @@
+"""Shared example bootstrap.
+
+``maybe_force_cpu()`` honors two knobs BEFORE the first framework import
+(environment variables alone are too late — the interpreter's
+sitecustomize may pin a TPU platform at startup, so the override has to
+go through ``jax.config``):
+
+- ``DL4J_TPU_EXAMPLE_CPU=1``  — run the example on the CPU backend.
+- ``DL4J_TPU_EXAMPLE_CPU=N``  (N > 1) — virtual N-device CPU mesh, so the
+  parallel examples exercise their sharding without TPU hardware.
+
+Combine with ``DL4J_TPU_EXAMPLE_SMALL=1`` for a quick smoke footprint.
+"""
+import os
+
+
+def maybe_force_cpu():
+    v = os.environ.get("DL4J_TPU_EXAMPLE_CPU", "").strip().lower()
+    if v in ("", "0", "false", "no", "off"):
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        n = int(v)
+    except ValueError:
+        n = 1
+    if n > 1:
+        jax.config.update("jax_num_cpu_devices", n)

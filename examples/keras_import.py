@@ -9,6 +9,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
 import numpy as np
 
 

@@ -9,6 +9,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
 from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
                                 InputType, Adam)
 from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,

@@ -5,13 +5,19 @@ mesh — ring(-flash) attention mixes context across shards, so per-device
 activation memory is O(T/n) while the math stays exactly the full-attention
 step. Runs anywhere; to try it on the virtual CPU mesh:
 
-    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/long_context_sequence_parallel.py
+    DL4J_TPU_EXAMPLE_CPU=8 python examples/long_context_sequence_parallel.py
+
+(env-var platform overrides alone are too late when a sitecustomize pins
+the TPU backend; the knob routes through jax.config before import)
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
 
 import numpy as np
 import jax

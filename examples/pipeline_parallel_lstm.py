@@ -4,13 +4,16 @@ TextGenerationLSTM's stacked identical cells map onto pipeline stages;
 entry/head stay replicated; with a 2-D mesh the microbatch dim is also
 data-parallel. Runs on any mesh — including the virtual CPU mesh:
 
-    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/pipeline_parallel_lstm.py
+    DL4J_TPU_EXAMPLE_CPU=8 python examples/pipeline_parallel_lstm.py
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
 
 import numpy as np
 import jax

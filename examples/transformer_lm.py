@@ -9,13 +9,16 @@ training with its TIME dim sharded across devices via
 ``sequence_parallel_step`` — rank-2 ``[b, T]`` token-id inputs are
 recognized as temporal and shard on dim 1.
 
-Run on CPU:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-             python examples/transformer_lm.py
+Run on CPU:  DL4J_TPU_EXAMPLE_CPU=8 python examples/transformer_lm.py
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
 
 import numpy as np
 import jax
