@@ -491,13 +491,19 @@ def _headline(value, base_val, error=None):
 def main():
     _apply_platform_override()
     if "--one" in sys.argv:
-        # child mode: run exactly one config in-process, print a result line
+        # child mode: run exactly one config in-process, print a result line.
+        # --write additionally persists it into BASELINE.json.published
+        # (the burst harness re-measures individual configs this way)
         name = sys.argv[sys.argv.index("--one") + 1]
         fn = next(f for n, _, f in ALL_BENCHES if n == name)
         import jax
         jax.devices()    # device contact proven before the first beat
         _hb()
-        print(json.dumps({"one": name, "value": round(fn(), 1)}))
+        value = round(fn(), 1)
+        if "--write" in sys.argv:
+            base_doc, _ = _read_baseline()
+            _write_partial(base_doc, {name: value})
+        print(json.dumps({"one": name, "value": value}))
         return
 
     run_all = "--all" in sys.argv

@@ -42,6 +42,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # marker check BEFORE the probe: completed stages must not pay the
     # 150s probe on wedged cycles
     need() { [ ! -f "$STATE/$1.ok" ]; }
+    # configs the `all` stage missed (wedge mid-sweep) or whose bench code
+    # changed after it ran: measured individually, persisted via --write
+    need transformer && probe && run_stage transformer \
+        timeout 2400 python bench.py --one transformer_lm_tokens_per_sec --write
+    need inception2  && probe && run_stage inception2 \
+        timeout 2400 python bench.py --one \
+        keras_inception_parallelwrapper_images_per_sec --write
     need flash    && probe && run_stage flash \
                      timeout 1800 python perf_flash_check.py
     need roofline && probe && run_stage roofline \
