@@ -501,7 +501,21 @@ def main():
         _hb()
         value = round(fn(), 1)
         if "--write" in sys.argv:
+            # published numbers are TPU numbers: refuse to overwrite them
+            # from an off-TPU run (BENCH_PLATFORM smoke tests, CPU
+            # fallback), and fail LOUDLY if the baseline file is unreadable
+            # — a silent no-op would mark the burst stage done with the
+            # measurement lost
+            backend = jax.default_backend()
+            if backend not in ("tpu", "axon"):
+                print(f"# --write refused: backend is {backend!r}, not TPU",
+                      file=sys.stderr)
+                sys.exit(3)
             base_doc, _ = _read_baseline()
+            if base_doc is None:
+                print("# --write failed: BASELINE.json missing/unreadable",
+                      file=sys.stderr)
+                sys.exit(3)
             _write_partial(base_doc, {name: value})
         print(json.dumps({"one": name, "value": value}))
         return

@@ -102,7 +102,10 @@ class _BaseLSTMImpl(LayerImpl):
         h0, c0 = _match_vma(h0, xp), _match_vma(c0, xp)
         peep = ((params["pi"], params["pf"], params["po"])
                 if self.peepholes else None)
-        rw = params["RW"].astype(ad)
+        # recurrent weights ride in COMPUTE dtype (bf16 policy): the
+        # per-step gemm is a native MXU bf16 pass accumulated in f32 (pet
+        # below); h/c and the gate math stay in the accumulation dtype
+        rw = params["RW"].astype(self.compute_dtype)
 
         # persistent-kernel fast path: the whole time loop as ONE Pallas
         # grid with RW resident in VMEM (ops/lstm_cell.py) — kills the
@@ -111,7 +114,8 @@ class _BaseLSTMImpl(LayerImpl):
         from ...ops import lstm_cell as _lk
 
         gate_name = getattr(c, "gate_activation", "sigmoid")
-        if _lk.supported(b, T, H, self.activation_name, str(gate_name)):
+        if _lk.supported(b, T, H, self.activation_name, str(gate_name),
+                         weight_bytes=jnp.dtype(rw.dtype).itemsize):
             y, (hT, cT) = _lk.lstm_scan(xp, rw, peep, h0, c0, mask)
             if reverse:
                 y = jnp.flip(y, axis=1)
@@ -120,7 +124,9 @@ class _BaseLSTMImpl(LayerImpl):
         def step(carry, inp):
             h, cc = carry
             xp_t, m_t = inp
-            z = xp_t + h @ rw
+            z = xp_t + lax.dot_general(
+                h.astype(rw.dtype), rw, (((1,), (0,)), ((), ())),
+                preferred_element_type=xp_t.dtype)
             zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
             if peep is not None:
                 zi = zi + cc * peep[0]
@@ -220,12 +226,14 @@ class SimpleRnnImpl(LayerImpl):
         xp = (x.reshape(b * T, -1).astype(self.compute_dtype)
               @ params["W"].astype(self.compute_dtype)).astype(ad)
         xp = xp.reshape(b, T, H) + params["b"].astype(ad)
-        rw = params["RW"].astype(ad)
+        rw = params["RW"].astype(self.compute_dtype)   # bf16-gemm policy
         act = self.activation
 
         def step(h, inp):
             xt, mt = inp
-            h_new = act(xt + h @ rw)
+            h_new = act(xt + lax.dot_general(
+                h.astype(rw.dtype), rw, (((1,), (0,)), ((), ())),
+                preferred_element_type=xt.dtype))
             if mt is not None:
                 mm = mt[:, None].astype(h_new.dtype)
                 h_new = mm * h_new + (1 - mm) * h

@@ -62,9 +62,13 @@ def _fwd_kernel(xp_ref, rw_ref, peep_ref, m_ref, h0_ref, c0_ref,
 
     h = h_s[:]
     c = c_s[:]
-    rw = rw_ref[...].astype(jnp.float32)                  # resident [H, 4H]
+    # resident [H, 4H] in its SOURCE dtype (bf16 under the mixed-precision
+    # policy): the MXU runs a native bf16×bf16→f32 pass instead of the
+    # multi-pass f32 algorithm, and the resident footprint halves. h/c stay
+    # f32 in scratch (accumulation dtype); only the gemm operand is cast.
+    rw = rw_ref[...]
     z = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
-        h, rw, (((1,), (0,)), ((), ())),
+        h.astype(rw.dtype), rw, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)               # [b, 4H]
     zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
                       z[:, 3 * H:])
@@ -228,8 +232,9 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
         dp_s[1] = dp_s[1] + jnp.sum(dzf * c_prev, axis=0)
         dp_s[2] = dp_s[2] + jnp.sum(dzo * c_cand, axis=0)
     dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)   # [b, 4H]
-    rwt = rwt_ref[...].astype(jnp.float32)                # resident [4H, H]
-    dh_prev = jax.lax.dot_general(dz, rwt, (((1,), (0,)), ((), ())),
+    rwt = rwt_ref[...]            # resident [4H, H], source (bf16) dtype
+    dh_prev = jax.lax.dot_general(dz.astype(rwt.dtype), rwt,
+                                  (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
     if m is not None:
         # dc/dz already carry the m factor (via dh_c/dc_c) — only the
@@ -336,7 +341,9 @@ def _lstm_bwd(res, grads):
     # batched-over-time pieces as single MXU gemms (outside the kernel):
     # z_t = xp_t + h_{t-1} @ RW  →  dxp = dz,  dRW = Σ_t h_{t-1}ᵀ dz_t
     h_prev = jnp.concatenate([h0.astype(ys.dtype)[None], ys[:-1]], axis=0)
-    drw = jnp.einsum("tbh,tbg->hg", h_prev.astype(jnp.float32), dz,
+    # batched gemm in the weight dtype (bf16 policy), f32 accumulation
+    drw = jnp.einsum("tbh,tbg->hg", h_prev.astype(rw.dtype),
+                     dz.astype(rw.dtype),
                      preferred_element_type=jnp.float32).astype(rw.dtype)
     dxp = dz                                              # z = xp + h @ RW
     dpeep_out = None if peep is None else dpeep.astype(peep.dtype)
@@ -349,7 +356,7 @@ _lstm.defvjp(_lstm_fwd, _lstm_bwd)
 
 #: kernel contract: tanh cell activation + sigmoid gates, TPU-tileable dims
 def supported(b: int, T: int, H: int, activation: str,
-              gate_activation: str) -> bool:
+              gate_activation: str, weight_bytes: int = 4) -> bool:
     """Whether the persistent kernel applies: TPU backend (or the tests'
     forced interpret mode), tanh/sigmoid activations (the kernel hard-codes
     them), lane-aligned width and sublane-aligned batch. Everything else
@@ -368,14 +375,17 @@ def supported(b: int, T: int, H: int, activation: str,
                 return False
         except Exception:  # pragma: no cover
             return False
-    # VMEM budget: resident f32 [H, 4H] weights (16H² bytes; the bwd kernel
-    # holds the transpose) PLUS the batch-dependent per-step blocks — xp/ys/
-    # gates/cseq/dz streams (double-buffered by the pipeline), h0/c0/dhT/dcT
-    # and the h/c scratch. Worst case (bwd) ≈ 16H² + ~120·b·H bytes; cap the
-    # SUM under a core's VMEM so oversized configs fall back to the scan
-    # instead of failing a Mosaic allocation (b=64,H=512 → 7.9 MB ✓;
-    # b=256,H=512 → 19.7 MB ✗ → scan; H=1024 needs a bf16-resident variant).
-    if 16 * H * H + 120 * b * H > 12 * 2 ** 20 or b > 1024:
+    # VMEM budget: resident [H, 4H] weights (4H² elements × weight_bytes;
+    # the bwd kernel holds the transpose) PLUS the batch-dependent per-step
+    # blocks — xp/ys/gates/cseq/dz streams (double-buffered by the
+    # pipeline), h0/c0/dhT/dcT and the h/c scratch. Worst case (bwd) ≈
+    # 4H²·wb + ~120·b·H bytes; cap the SUM under a core's VMEM so oversized
+    # configs fall back to the scan instead of failing a Mosaic allocation.
+    # bf16-resident weights (weight_bytes=2, the mixed-precision policy)
+    # halve the resident term: f32 b=64,H=512 → 7.9 MB ✓; b=256,H=512 →
+    # 19.7 MB ✗ → scan; bf16 b=64,H=1024 → 16.2 MB ✗ → scan still, but
+    # bf16 b=128,H=512 → 10 MB now fits.
+    if 4 * H * H * weight_bytes + 120 * b * H > 12 * 2 ** 20 or b > 1024:
         return False
     return (activation == "tanh" and gate_activation == "sigmoid"
             and H % 128 == 0 and b % 8 == 0 and T >= 1)
@@ -404,7 +414,10 @@ def lstm_scan(xp, rw, peep, h0, c0, mask=None):
         mk = jnp.broadcast_to(
             jnp.swapaxes(jnp.asarray(mask, jnp.float32), 0, 1)[..., None],
             (T, b, 8))
-    ys, hT, cT = _lstm(xp_tm.astype(jnp.float32), rw.astype(jnp.float32),
-                       pk, h0.astype(jnp.float32), c0.astype(jnp.float32),
-                       mk)
+    # xp (the accumulated input projection) stays f32 — gate math is
+    # accumulation-dtype; RW rides in its caller dtype (bf16 under the
+    # mixed-precision policy) so the recurrent gemm runs the MXU's native
+    # bf16 pass with f32 accumulation instead of multi-pass f32
+    ys, hT, cT = _lstm(xp_tm.astype(jnp.float32), rw, pk,
+                       h0.astype(jnp.float32), c0.astype(jnp.float32), mk)
     return jnp.swapaxes(ys, 0, 1), (hT, cT)

@@ -59,6 +59,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 2400 python perf_lstm.py sweep
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
+     [ -f "$STATE/transformer.ok" ] && [ -f "$STATE/inception2.ok" ] && \
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
      [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="

@@ -214,3 +214,73 @@ def test_supported_vmem_budget_counts_batch_blocks():
     assert not lk.supported(2048, 50, 128, "tanh", "sigmoid")
     assert lk.supported(8, 50, 768, "tanh", "sigmoid")
     assert not lk.supported(8, 50, 1024, "tanh", "sigmoid")
+
+
+def test_forward_matches_scan_bf16_weights():
+    """Mixed-precision policy path: RW in bf16 (native MXU pass in the
+    kernel), h/c/gate math f32 — kernel == scan oracle run with the SAME
+    bf16 weights, to bf16-class tolerance."""
+    xp, rw, pp, h0, c0, _ = _inputs(b=8, T=6, H=128, seed=3)
+    rwb = rw.astype(jnp.bfloat16)
+    ys, (hT, cT) = lk.lstm_scan(xp, rwb, pp, h0, c0, None)
+
+    def oracle(xp, rwb, h0, c0):
+        def step(carry, xt):
+            h, c = carry
+            z = xt + jax.lax.dot_general(
+                h.astype(jnp.bfloat16), rwb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(zi), jax.nn.sigmoid(zf),
+                       jax.nn.sigmoid(zo))
+            g = jnp.tanh(zg)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0),
+                                    jnp.swapaxes(xp, 0, 1))
+        return jnp.swapaxes(ys, 0, 1), hT, cT
+
+    wys, whT, wcT = oracle(xp, rwb, h0, c0)
+    np.testing.assert_allclose(np.asarray(ys, np.float32),
+                               np.asarray(wys, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(whT),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(wcT),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_grads_match_scan_bf16_weights():
+    """The hand-written BPTT with bf16-resident RWᵀ still matches AD of an
+    identically-cast scan."""
+    xp, rw, pp, h0, c0, _ = _inputs(b=8, T=4, H=128, seed=4)
+    rwb = rw.astype(jnp.bfloat16)
+
+    def loss_k(xp, rwb, h0, c0):
+        ys, (hT, cT) = lk.lstm_scan(xp, rwb, pp, h0, c0, None)
+        return jnp.sum(ys.astype(jnp.float32) ** 2) + jnp.sum(hT * 0.5)
+
+    def loss_s(xp, rwb, h0, c0):
+        def step(carry, xt):
+            h, c = carry
+            z = xt + jax.lax.dot_general(
+                h.astype(jnp.bfloat16), rwb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(zi), jax.nn.sigmoid(zf),
+                       jax.nn.sigmoid(zo))
+            g = jnp.tanh(zg)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), jnp.swapaxes(xp, 0, 1))
+        return jnp.sum(jnp.swapaxes(ys, 0, 1).astype(jnp.float32) ** 2) \
+            + jnp.sum(hT * 0.5)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(xp, rwb, h0, c0)
+    gs = jax.grad(loss_s, argnums=(0, 1, 2, 3))(xp, rwb, h0, c0)
+    for a, want in zip(gk, gs):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=5e-2, atol=5e-2)
