@@ -49,6 +49,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     need inception2  && probe && run_stage inception2 \
         timeout 2400 python bench.py --one \
         keras_inception_parallelwrapper_images_per_sec --write
+    # the bf16-recurrence change landed after the `all` sweep ran
+    need lstm2       && probe && run_stage lstm2 \
+        timeout 1800 python bench.py --one \
+        graves_lstm_charrnn_chars_per_sec --write
     need flash    && probe && run_stage flash \
                      timeout 1800 python perf_flash_check.py
     need roofline && probe && run_stage roofline \
@@ -60,6 +64,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
      [ -f "$STATE/transformer.ok" ] && [ -f "$STATE/inception2.ok" ] && \
+     [ -f "$STATE/lstm2.ok" ] && \
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
      [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="
