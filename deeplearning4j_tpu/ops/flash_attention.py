@@ -173,11 +173,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         acc_s[:] = jnp.zeros_like(acc_s)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [Bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [Bk, d]
-        v = v_ref[0].astype(jnp.float32)
+        # matmuls run in the SOURCE dtype (bf16 → native MXU pass) with f32
+        # accumulation via preferred_element_type; softmax stats stay f32.
+        # The scale moves after the dot so bf16 q is not pre-rounded by it.
+        q = q_ref[0]                                      # [Bq, d]
+        k = k_ref[0]                                      # [Bk, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, BLOCK)
         if km_ref is not None:
@@ -195,7 +198,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
             keep = _block_keep(seed_ref, bh, qi, kj, rate)
             p = p * keep * (1.0 / (1.0 - rate))
         acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     _when_visible(causal, kj <= qi, _compute)
@@ -267,14 +270,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         dq_s[:] = jnp.zeros_like(dq_s)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # source-dtype matmul operands (bf16 MXU pass), f32 accumulation —
+        # same policy as the forward kernel; softmax/ds math stays f32
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, BLOCK)
         if km_ref is not None:
@@ -289,7 +294,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
             dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta[:, None]) * scale
         dq_s[:] = dq_s[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     _when_visible(causal, kj <= qi, _compute)
@@ -313,14 +318,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
         dv_s[:] = jnp.zeros_like(dv_s)
 
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # source-dtype matmul operands (bf16 MXU pass), f32 accumulation
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qj, ki, BLOCK)
         if km_ref is not None:
@@ -334,7 +340,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
         else:
             pd = p
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
-            pd, do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -342,7 +348,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
             dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta[:, None]) * scale
         dk_s[:] = dk_s[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     _when_visible(causal, qj >= ki, _compute)
@@ -528,6 +534,11 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     ``dropout_seed`` (int32 scalar, may be traced — e.g. derived from the
     layer's PRNG key per step) is then required."""
     b, T, h, d = q.shape
+    # the kernels run SOURCE-dtype matmuls (dot_general is dtype-strict, and
+    # uniform operands are what lets bf16 take the native MXU pass) —
+    # normalize mixed-dtype inputs to q's dtype here
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
     rate = float(dropout_rate)

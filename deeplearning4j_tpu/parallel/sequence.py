@@ -372,6 +372,9 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     single-device flash kernel's dropout with the same ``dropout_seed``
     (int32 scalar, same on every shard), forward and backward."""
     d = q.shape[-1]
+    # block kernels run source-dtype matmuls (dtype-strict): normalize
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     scale = 1.0 / float(d) ** 0.5
     rate = float(dropout_rate)
     if rate > 0.0 and dropout_seed is None:

@@ -822,3 +822,35 @@ def test_sequence_parallel_step_rejects_batchnorm():
             .build())
     with pytest.raises(ValueError, match="statistics"):
         sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)
+
+
+def test_flash_bf16_matches_dense_bf16():
+    """Mixed-precision path: bf16 q/k/v run SOURCE-dtype matmuls in the
+    kernels (native MXU pass) with f32 softmax/accumulation — parity with a
+    dense oracle computed from the same bf16 inputs, fwd and grads, to
+    bf16-class tolerance."""
+    q, k, v = _qkv(b=2, T=256, h=2, d=32, seed=9)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    out = fa.flash_attention(qb, kb, vb, causal=True)
+    want = _dense_ref(qb.astype(jnp.float32), kb.astype(jnp.float32),
+                      vb.astype(jnp.float32), True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_ref(q.astype(jnp.float32),
+                                  k.astype(jnp.float32),
+                                  v.astype(jnp.float32), True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(qb, kb, vb)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=6e-2, atol=6e-2)
