@@ -103,7 +103,8 @@ def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
 
 
 def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
-                       shard_update: bool = False):
+                       shard_update: bool = False,
+                       shard_params: bool = False):
     """Jit a network's train step for synchronous data parallelism.
 
     Equivalent role to the reference's ``ParallelWrapper`` AVERAGING mode with
@@ -119,14 +120,24 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
     (:func:`update_sharded_specs`): updater state lives sharded over the
     data axis instead of replicated — numerically identical, ~N× less
     optimizer memory per device.
+
+    ``shard_params=True`` additionally SHARDS THE PARAMETERS over the data
+    axis (ZeRO-3/FSDP-style sharded storage): each leaf whose first
+    divisible dim splits over the axis is stored 1/N per device, and the
+    SPMD partitioner inserts the all-gathers at the points of use and
+    reduce-scatters the gradients into the sharded update. Leaves with no
+    divisible dim (small biases, odd conv kernels) stay replicated.
+    Numerically identical to replicated DP.
     """
     raw = net._raw_step(False)
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
     upd = (update_sharded_specs(net.updater_state, mesh, axis)
            if shard_update else repl)
-    in_sh = (repl, repl, upd, repl, repl, data, data, data, data)
-    out_sh = (repl, repl, upd, repl)
+    par = (update_sharded_specs(net.params, mesh, axis)
+           if shard_params else repl)
+    in_sh = (par, repl, upd, repl, repl, data, data, data, data)
+    out_sh = (par, repl, upd, repl)
     return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                    donate_argnums=(0, 2) if donate else ())
 
@@ -143,7 +154,8 @@ def _rnn_state_shardings(net, mesh: Mesh, axis: str):
 
 
 def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
-                             donate=True, shard_update: bool = False):
+                             donate=True, shard_update: bool = False,
+                             shard_params: bool = False):
     """Sharded train step that also threads the detached RNN/KV carry —
     the TBPTT segment step under data parallelism. Reference semantics:
     ``ParallelWrapper`` workers run the full ``MultiLayerNetwork.fit`` loop
@@ -156,8 +168,10 @@ def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
     state_sh = _rnn_state_shardings(net, mesh, axis)
     upd = (update_sharded_specs(net.updater_state, mesh, axis)
            if shard_update else repl)
-    in_sh = (repl, repl, upd, repl, repl, data, data, data, data, state_sh)
-    out_sh = (repl, repl, upd, repl, state_sh)
+    par = (update_sharded_specs(net.params, mesh, axis)
+           if shard_params else repl)
+    in_sh = (par, repl, upd, repl, repl, data, data, data, data, state_sh)
+    out_sh = (par, repl, upd, repl, state_sh)
     return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
                    donate_argnums=(0, 2) if donate else ())
 

@@ -66,6 +66,7 @@ class ParallelWrapper:
             self._accumulator = None
             self._mesh = None
             self._ws = False
+            self._fsdp = False
 
         def workers(self, n):
             self._workers = int(n)
@@ -117,6 +118,21 @@ class ParallelWrapper:
 
         weightUpdateSharding = weight_update_sharding
 
+        def fsdp(self, flag=True):
+            """ZeRO-3/FSDP-style sharded STORAGE: parameters AND optimizer
+            state shard over the data axis (leaves with a divisible dim;
+            the rest replicate). The SPMD partitioner inserts the
+            all-gathers at the points of use and reduce-scatters gradients
+            into the sharded update — numerically identical to replicated
+            DP with ~N× less param+optimizer memory per device. Implies
+            :meth:`weight_update_sharding`; same AVERAGING freq=1
+            constraint. Non-step uses of the net (``output()``/``score()``/
+            serialization) gather transparently."""
+            self._fsdp = bool(flag)
+            # the ws implication lives in __init__ ("ws or fsdp"), so
+            # toggling fsdp back off leaves an explicit ws setting intact
+            return self
+
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._net, workers=self._workers,
                                    prefetch_buffer=self._prefetch,
@@ -125,7 +141,8 @@ class ParallelWrapper:
                                    report_score_after_averaging=self._report_after_avg,
                                    accumulator=self._accumulator,
                                    mesh=self._mesh,
-                                   weight_update_sharding=self._ws)
+                                   weight_update_sharding=self._ws,
+                                   fsdp=self._fsdp)
 
     def __init__(self, net, workers: Optional[int] = None,
                  prefetch_buffer: int = 2, averaging_frequency: int = 1,
@@ -133,9 +150,11 @@ class ParallelWrapper:
                  report_score_after_averaging: bool = True,
                  accumulator: Optional[GradientsAccumulator] = None,
                  mesh: Optional[Mesh] = None,
-                 weight_update_sharding: bool = False):
+                 weight_update_sharding: bool = False,
+                 fsdp: bool = False):
         self.net = net
-        self.weight_update_sharding = bool(weight_update_sharding)
+        self.fsdp = bool(fsdp)
+        self.weight_update_sharding = bool(weight_update_sharding) or self.fsdp
         if (int(getattr(net.gc, "iterations", 1) or 1) > 1
                 and not getattr(net, "_warned_pw_iterations", False)):
             net._warned_pw_iterations = True
@@ -209,14 +228,16 @@ class ParallelWrapper:
         if self._sync_step is None:
             self._sync_step = data_parallel_step(
                 self.net, self.mesh,
-                shard_update=self.weight_update_sharding)
+                shard_update=self.weight_update_sharding,
+                shard_params=self.fsdp)
         return self._sync_step
 
     def _ensure_sync_tbptt_step(self):
         if getattr(self, "_sync_tbptt_step", None) is None:
             self._sync_tbptt_step = data_parallel_tbptt_step(
                 self.net, self.mesh,
-                shard_update=self.weight_update_sharding)
+                shard_update=self.weight_update_sharding,
+                shard_params=self.fsdp)
         return self._sync_tbptt_step
 
     # ------------------------------------------------------------ TBPTT
@@ -388,7 +409,11 @@ class ParallelWrapper:
     def _device_put_model(self):
         net = self.net
         put = lambda t: _tm(lambda x: put_replicated(x, self.mesh), t)
-        net.params = put(net.params)
+        if self.fsdp:
+            pspecs = update_sharded_specs(net.params, self.mesh)
+            net.params = _tm(jax.device_put, net.params, pspecs)
+        else:
+            net.params = put(net.params)
         net.states = put(net.states)
         if self.weight_update_sharding:
             specs = update_sharded_specs(net.updater_state, self.mesh)
