@@ -536,7 +536,13 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     b, T, h, d = q.shape
     # the kernels run SOURCE-dtype matmuls (dot_general is dtype-strict, and
     # uniform operands are what lets bf16 take the native MXU pass) —
-    # normalize mixed-dtype inputs to q's dtype here
+    # normalize mixed-dtype inputs to q's dtype here.
+    # DL4J_TPU_FLASH_F32=1 is the first-hardware rollback hatch: it restores
+    # the pre-bf16 behavior (every operand upcast to f32 before the kernels)
+    # should a Mosaic bf16 lowering gap surface on a new jaxlib.
+    import os
+    if os.environ.get("DL4J_TPU_FLASH_F32"):
+        q = q.astype(jnp.float32)
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
     if scale is None:
