@@ -372,7 +372,11 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     single-device flash kernel's dropout with the same ``dropout_seed``
     (int32 scalar, same on every shard), forward and backward."""
     d = q.shape[-1]
-    # block kernels run source-dtype matmuls (dtype-strict): normalize
+    # block kernels run source-dtype matmuls (dtype-strict): normalize.
+    # DL4J_TPU_FLASH_F32 — same rollback hatch as ops.flash_attention
+    import os
+    if os.environ.get("DL4J_TPU_FLASH_F32"):
+        q = q.astype(jnp.float32)
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
     scale = 1.0 / float(d) ** 0.5
