@@ -1,0 +1,79 @@
+"""ModelGuesser — load a model/config from a path without knowing its kind.
+
+Reference: ``deeplearning4j-core/.../util/ModelGuesser.java`` (loadModelGuess
+tries DL4J zip then Keras HDF5; loadConfigGuess tries MultiLayerConfiguration
+JSON, then Keras config, then ComputationGraphConfiguration JSON). Here the
+format is sniffed from magic bytes first — zip (``PK``) → ModelSerializer,
+HDF5 (``\\x89HDF``) → KerasModelImport — so no load is attempted blind; bare
+JSON files fall through to the config guess.
+"""
+from __future__ import annotations
+
+import json
+
+from .model_serializer import ModelSerializer
+
+_ZIP_MAGIC = b"PK"
+_HDF5_MAGIC = b"\x89HDF\r\n\x1a\n"
+
+
+def _magic(path: str, n: int = 8) -> bytes:
+    with open(path, "rb") as fh:
+        return fh.read(n)
+
+
+class ModelGuesser:
+    """Format-sniffing loaders (reference ``ModelGuesser.java``)."""
+
+    @staticmethod
+    def load_model_guess(path: str, load_updater: bool = True):
+        """A trained model from ``path``: DL4J zip (either container, with
+        coefficients/updater), Keras HDF5 (Sequential→MLN, functional→CG),
+        or a bare config JSON (returns a freshly ``init()``-ed net)."""
+        head = _magic(path)
+        if head.startswith(_ZIP_MAGIC):
+            return ModelSerializer.restore_model(path, load_updater)
+        if head.startswith(_HDF5_MAGIC):
+            from ..keras.model_import import KerasModelImport
+            return KerasModelImport.import_keras_model_and_weights(path)
+        conf = ModelGuesser.load_config_guess(path)
+        from ..nn.conf import MultiLayerConfiguration
+        from ..nn.multilayer import MultiLayerNetwork
+        from ..nn.graph import ComputationGraph
+        if isinstance(conf, MultiLayerConfiguration):
+            return MultiLayerNetwork(conf).init()
+        return ComputationGraph(conf).init()
+
+    loadModelGuess = load_model_guess
+
+    @staticmethod
+    def load_config_guess(path: str):
+        """A network CONFIGURATION from a JSON file: tries
+        ``MultiLayerConfiguration`` then ``ComputationGraphConfiguration``
+        (reference tries "json before YAML" for the same reason: the first
+        parser that accepts wins)."""
+        from ..nn.conf import MultiLayerConfiguration
+        from ..nn.conf.graph import ComputationGraphConfiguration
+
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        json.loads(text)  # fail fast with a JSON error, not a serde error
+        errors = []
+        for cls in (MultiLayerConfiguration, ComputationGraphConfiguration):
+            try:
+                return cls.from_json(text)
+            except Exception as e:  # noqa: BLE001 — collect and report all
+                errors.append(f"{cls.__name__}: {e}")
+        raise ValueError(
+            "Could not interpret the JSON as either container configuration:\n"
+            + "\n".join(errors))
+
+    loadConfigGuess = load_config_guess
+
+    @staticmethod
+    def load_normalizer(path: str):
+        """Facade for ``ModelSerializer.restore_normalizer`` (reference
+        ``ModelGuesser.loadNormalizer``)."""
+        return ModelSerializer.restore_normalizer(path)
+
+    loadNormalizer = load_normalizer
