@@ -41,12 +41,22 @@ def main():
                            rng.integers(0, classes, b)])
                for _ in range(4)]
 
-    pw = (ParallelWrapper.Builder(net)
-          .training_mode(TrainingMode.AVERAGING)
-          .averaging_frequency(1)
-          .build())
+    # DL4J_TPU_EXAMPLE_FSDP=1: ZeRO-3-style sharded storage — params AND
+    # optimizer state live 1/N per device (exact same numerics); ws-only
+    # (optimizer state) via .weight_update_sharding()
+    builder = (ParallelWrapper.Builder(net)
+               .training_mode(TrainingMode.AVERAGING)
+               .averaging_frequency(1))
+    if os.environ.get("DL4J_TPU_EXAMPLE_FSDP"):
+        builder.fsdp()
+    pw = builder.build()
     pw.fit(ListDataSetIterator(batches))
     print("score:", pw.last_score)
+    if os.environ.get("DL4J_TPU_EXAMPLE_FSDP"):
+        import jax
+        sharded = sum(1 for l in jax.tree_util.tree_leaves(net.params)
+                      if hasattr(l, "sharding") and l.sharding.spec)
+        print(f"FSDP: {sharded} param leaves sharded over the data axis")
 
 
 if __name__ == "__main__":
