@@ -499,20 +499,26 @@ def main():
         import jax
         jax.devices()    # device contact proven before the first beat
         _hb()
-        value = round(fn(), 1)
         if "--write" in sys.argv:
             # published numbers are TPU numbers: refuse to overwrite them
             # from an off-TPU run (BENCH_PLATFORM smoke tests, CPU
             # fallback), and fail LOUDLY if the baseline file is unreadable
             # — a silent no-op would mark the burst stage done with the
-            # measurement lost
+            # measurement lost. Both checks run BEFORE the measurement so a
+            # doomed run refuses in milliseconds, not after a 30-min bench
             backend = jax.default_backend()
             if backend not in ("tpu", "axon"):
                 print(f"# --write refused: backend is {backend!r}, not TPU",
                       file=sys.stderr)
                 sys.exit(3)
+            if _read_baseline()[0] is None:
+                print("# --write failed: BASELINE.json missing/unreadable",
+                      file=sys.stderr)
+                sys.exit(3)
+        value = round(fn(), 1)
+        if "--write" in sys.argv:
             base_doc, _ = _read_baseline()
-            if base_doc is None:
+            if base_doc is None:   # deleted mid-run: still fail loudly
                 print("# --write failed: BASELINE.json missing/unreadable",
                       file=sys.stderr)
                 sys.exit(3)

@@ -741,7 +741,8 @@ class TransformerLM(ZooModel):
         return g.build()
 
 
-def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
+def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0,
+                    advance_state=True):
     """Autoregressive sampling through the streaming KV/recurrent cache —
     the reference's TextGenerationLSTM char-sampling workflow
     (``zoo/model/TextGenerationLSTM.java`` exists for exactly this) as a
@@ -751,7 +752,11 @@ def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
 
     ``prompt_ids``: [b, T] or [T] int token ids. Returns [b, n_tokens]
     sampled ids. ``temperature`` → 0 approaches greedy decoding; sampling
-    is deterministic given ``seed``."""
+    is deterministic given ``seed``. ``advance_state=True`` (default)
+    feeds the FINAL sampled token into the streaming state too, so a
+    caller continuing with ``rnn_time_step`` sees a history consistent
+    with the returned sequence; pass ``False`` to skip that last device
+    dispatch when the state will not be reused."""
     import numpy as np
 
     prompt = np.asarray(prompt_ids)
@@ -802,7 +807,9 @@ def generate_tokens(net, prompt_ids, n_tokens, temperature=1.0, seed=0):
         nxt = np.array([rng.choice(p.shape[-1], p=p[i]) for i in range(b)],
                        dtype=np.int64)
         out.append(nxt)
-        if t + 1 < int(n_tokens):   # the last token needs no further step
+        if t + 1 < int(n_tokens) or advance_state:
+            # the last step only matters for callers that keep streaming:
+            # it advances the cache past the final sampled token
             probs = step(nxt)
     return np.stack(out, axis=1)
 

@@ -111,13 +111,10 @@ def _build_tbptt_scan(step, n_iter):
 
 
 def _map_streams(fn, x):
-    """Apply ``fn`` to every stream array: bare arrays (MultiLayerNetwork),
-    tuples of optional streams (ComputationGraph), or None pass through."""
-    if x is None:
-        return None
-    if isinstance(x, tuple):
-        return tuple(None if a is None else fn(a) for a in x)
-    return fn(x)
+    """Apply ``fn`` to every stream array — bare arrays (MultiLayerNetwork),
+    tuples of optional streams (ComputationGraph), None passthrough. Exactly
+    ``tree_map`` semantics; the alias names the intent at the call sites."""
+    return jax.tree_util.tree_map(fn, x)
 
 
 def _run_tbptt(net, f, l, fm, lm, single_iteration):

@@ -538,9 +538,12 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     # uniform operands are what lets bf16 take the native MXU pass) —
     # normalize mixed-dtype inputs to q's dtype here.
     # DL4J_TPU_FLASH_F32=1 is the first-hardware rollback hatch: it restores
-    # the pre-bf16 behavior (every operand upcast to f32 before the kernels)
-    # should a Mosaic bf16 lowering gap surface on a new jaxlib.
+    # the pre-bf16 KERNEL behavior (every operand upcast to f32 before the
+    # kernels) should a Mosaic bf16 lowering gap surface on a new jaxlib —
+    # the OUTPUT is cast back to the caller's dtype so flipping the hatch
+    # does not change downstream activation dtypes/memory.
     import os
+    out_dtype = q.dtype
     if os.environ.get("DL4J_TPU_FLASH_F32"):
         q = q.astype(jnp.float32)
     k = k.astype(q.dtype)
@@ -564,4 +567,4 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
         km = jnp.broadcast_to(km[..., None], (b * h, T, 8))
     o = _flash(to_bh(q), to_bh(k), to_bh(v), km, seed, bool(causal),
                float(scale), rate)
-    return jnp.transpose(o.reshape(b, h, T, d), (0, 2, 1, 3))
+    return jnp.transpose(o.reshape(b, h, T, d), (0, 2, 1, 3)).astype(out_dtype)

@@ -373,8 +373,10 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     (int32 scalar, same on every shard), forward and backward."""
     d = q.shape[-1]
     # block kernels run source-dtype matmuls (dtype-strict): normalize.
-    # DL4J_TPU_FLASH_F32 — same rollback hatch as ops.flash_attention
+    # DL4J_TPU_FLASH_F32 — same rollback hatch as ops.flash_attention;
+    # output cast back so the hatch never changes downstream dtypes
     import os
+    _out_dtype = q.dtype
     if os.environ.get("DL4J_TPU_FLASH_F32"):
         q = q.astype(jnp.float32)
     k = k.astype(q.dtype)
@@ -390,7 +392,7 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                            scale=scale, rate=rate),
                    mesh=mesh, in_specs=(spec, spec, spec, P()),
                    out_specs=spec, check_vma=False)
-    return fn(q, k, v, seed)
+    return fn(q, k, v, seed).astype(_out_dtype)
 
 
 def ring_flash_supported(T: int, n_shards: int, d: int) -> bool:

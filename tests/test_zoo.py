@@ -301,3 +301,25 @@ def test_generate_tokens_degenerate_sizes():
         generate_tokens(net, np.zeros((2, 0)), 4)
     out = generate_tokens(net, np.array([[1, 2]]), 0)
     assert out.shape == (1, 0)
+
+
+def test_generate_tokens_advances_state_past_last_token():
+    """After generate_tokens (default advance_state=True), continuing with
+    rnn_time_step must condition on the FULL returned sequence — identical
+    to streaming prompt+generated through a fresh state (review finding:
+    skipping the final step left the cache one token behind)."""
+    from deeplearning4j_tpu.models import TransformerLM, generate_tokens
+
+    net = TransformerLM(vocab_size=9, embed_dim=16, num_heads=2,
+                        num_blocks=2, seed=3).init()
+    prompt = np.array([[1, 2, 3]])
+    gen = generate_tokens(net, prompt, 4, seed=11)
+
+    probe = np.array([[2.0]])                        # next streamed token
+    cont = np.asarray(net.rnn_time_step(probe))      # uses post-gen state
+
+    net.rnn_clear_previous_state()
+    full = np.concatenate([prompt, gen], axis=1).astype(np.float32)
+    net.rnn_time_step(full[:, :, None])              # replay whole history
+    want = np.asarray(net.rnn_time_step(probe))
+    np.testing.assert_allclose(cont, want, rtol=1e-4, atol=1e-5)
