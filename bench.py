@@ -27,11 +27,31 @@ import numpy as np
 def _apply_platform_override():
     """``BENCH_PLATFORM=cpu`` forces the JAX platform via config (the
     sitecustomize pins JAX_PLATFORMS at interpreter start, so the env var
-    alone is too late) — used to smoke-test the harness off-TPU."""
+    alone is too late) — used to smoke-test the harness off-TPU.
+
+    Also enables a PERSISTENT XLA compilation cache (``BENCH_COMPILE_CACHE``,
+    default ``.jax_cache/`` next to this file; ``0`` disables): every
+    ``--one`` config runs in its own subprocess, so without it each sweep
+    member re-pays its full compile — with it, repeated sweeps/retries hit
+    the disk cache, shrinking the window a wedging tunnel can bite."""
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+    cache = os.environ.get("BENCH_COMPILE_CACHE", "")
+    if cache != "0":
+        if not cache:
+            cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache")
+        try:
+            import jax
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            # cache every program, not just slow-to-compile ones
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as e:  # cache is an optimization, never fatal
+            print(f"# compile cache disabled: {e}", file=sys.stderr)
 
 
 _PROBE_SRC = ("import os, jax\n"
