@@ -78,6 +78,31 @@ def put_replicated(x, mesh: Mesh):
     return jax.device_put(x, replicated(mesh))
 
 
+def put_sharded_tree(tree, specs):
+    """Place a host pytree with per-leaf ``NamedSharding``s. Single-process:
+    plain sharded device_put. Multi-process: every process holds the same
+    full host value (same-seed init), and ``make_array_from_callback``
+    slices out each process's addressable shards — no host ever transfers
+    more than its devices' portion."""
+    multi = jax.process_count() > 1
+
+    def put(x, sh):
+        cur = getattr(x, "sharding", None)
+        if cur == sh:
+            return x                      # already placed (second fit call)
+        if multi:
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # already distributed under another sharding: device-side
+                # reshard, no host round-trip
+                return jax.device_put(x, sh)
+            a = np.asarray(x)
+            return jax.make_array_from_callback(
+                a.shape, sh, lambda idx, _a=a: _a[idx])
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, tree, specs)
+
+
 def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
     """Sharding pytree for OPTIMIZER STATE sharded over the data axis —
     weight-update / optimizer-state sharding (Xu et al. 2020,

@@ -34,7 +34,7 @@ from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
                        shard_batch, put_replicated, data_parallel_step,
                        data_parallel_tbptt_step,
                        data_parallel_tbptt_update_step, pvary,
-                       update_sharded_specs)
+                       update_sharded_specs, put_sharded_tree)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
 from ..nn.conf import BackpropType, CacheMode
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
@@ -183,12 +183,6 @@ class ParallelWrapper:
             # supported: AVERAGING freq=1 (fused psum step, incl. its TBPTT
             # variant). Loud rejection elsewhere — a silent no-op would let
             # a memory-tight job believe it has the N-fold saving
-            if self.process_count > 1:
-                raise NotImplementedError(
-                    "weight_update_sharding currently supports "
-                    "single-process meshes (multi-process placement of the "
-                    "sharded optimizer state needs per-process local shard "
-                    "assembly)")
             if (training_mode != TrainingMode.AVERAGING
                     or max(1, int(averaging_frequency)) != 1):
                 raise NotImplementedError(
@@ -411,13 +405,13 @@ class ParallelWrapper:
         put = lambda t: _tm(lambda x: put_replicated(x, self.mesh), t)
         if self.fsdp:
             pspecs = update_sharded_specs(net.params, self.mesh)
-            net.params = _tm(jax.device_put, net.params, pspecs)
+            net.params = put_sharded_tree(net.params, pspecs)
         else:
             net.params = put(net.params)
         net.states = put(net.states)
         if self.weight_update_sharding:
             specs = update_sharded_specs(net.updater_state, self.mesh)
-            net.updater_state = _tm(jax.device_put, net.updater_state, specs)
+            net.updater_state = put_sharded_tree(net.updater_state, specs)
         else:
             net.updater_state = put(net.updater_state)
 
@@ -695,6 +689,34 @@ class ParallelWrapper:
             _, _, old_bytes = cache.pop(oldest)
             self._sharded_cache_bytes -= old_bytes
         return out
+
+    def gather_model(self):
+        """Re-replicate a sharded-storage model (``fsdp``/
+        ``weight_update_sharding``) so its params/updater state are plain
+        host-accessible arrays again — REQUIRED before ``np.asarray``/
+        serialization/scoring on a MULTI-PROCESS mesh, where a sharded
+        leaf spans non-addressable devices (single-process shards gather
+        transparently). Uses ``process_allgather`` across hosts."""
+        net = self.net
+        if self.process_count > 1:
+            from jax.experimental import multihost_utils
+
+            def regather(t):
+                return _tm(
+                    lambda x: multihost_utils.process_allgather(
+                        x, tiled=True)
+                    if hasattr(x, "sharding") and x.sharding.spec else x, t)
+
+            net.params = regather(net.params)
+            net.updater_state = regather(net.updater_state)
+        else:
+            put = lambda t: _tm(
+                lambda x: jax.device_put(np.asarray(x)), t)
+            net.params = put(net.params)
+            net.updater_state = put(net.updater_state)
+        return net
+
+    gatherModel = gather_model
 
     def clear_device_cache(self):
         """Drop every cached sharded batch (and the host arrays it retains).

@@ -158,3 +158,21 @@ def test_two_process_sharded_tbptt(tmp_path):
     # each process groups its 8 local batches by 2 local devices → 4 groups
     # per epoch × 2 TBPTT segments × 3 epochs = 24 applied updates
     assert int(r0[2]) == 24
+
+
+def test_two_process_fsdp_sharded_storage(tmp_path):
+    """FSDP/weight-update sharding across a 2-process (2×2-device) cluster:
+    each process holds only its devices' param/optimizer shards
+    (put_sharded_tree), training matches replicated DP exactly, and both
+    processes end bit-identical."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "resources", "multiproc_ws_worker.py")
+    port = _free_port()
+    _run_workers(worker, tmp_path, port)
+
+    p0 = np.load(tmp_path / "ws_params_0.npy")
+    p1 = np.load(tmp_path / "ws_params_1.npy")
+    np.testing.assert_array_equal(p0, p1)
+    s0 = float((tmp_path / "ws_result_0.txt").read_text())
+    s1 = float((tmp_path / "ws_result_1.txt").read_text())
+    assert s0 == s1 and np.isfinite(s0)
