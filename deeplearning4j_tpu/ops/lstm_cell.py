@@ -49,10 +49,34 @@ def _sig(x):
     return jax.nn.sigmoid(x)
 
 
+def _unroll_factor(T: int, b: int, H: int, weight_bytes: int) -> int:
+    """Timesteps per grid step. The sequential chain is bound by per-grid-
+    step latency (PERF.md round-4 addendum 3), so U > 1 divides it — but
+    every streamed block ([U, b, 4H] xp/gates/dz, double-buffered) scales
+    with U, so U shrinks until the VMEM budget fits. T must divide evenly.
+    ``DL4J_TPU_LSTM_UNROLL`` overrides the default (2); 1 disables."""
+    import os
+    try:
+        u = int(os.environ.get("DL4J_TPU_LSTM_UNROLL", "2"))
+    except ValueError:
+        u = 2
+    u = max(1, min(u, T))
+    while u > 1 and (T % u
+                     or 4 * H * H * weight_bytes + 120 * u * b * H
+                     > 12 * 2 ** 20):
+        u -= 1
+    return u
+
+
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(xp_ref, rw_ref, peep_ref, m_ref, h0_ref, c0_ref,
                 ys_ref, gates_ref, cseq_ref, hc_ref,
-                h_s, c_s, *, T, H, peep):
+                h_s, c_s, *, nb, H, peep, U):
+    """One grid step processes U consecutive timesteps (statically
+    unrolled): the measured bound at the char-RNN config is per-grid-step
+    latency × the sequential chain length, not FLOPs or HBM bytes
+    (PERF.md round-4 addendum 3) — U steps per launch divides that chain
+    by U. All block operands carry a leading [U] time dim."""
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -67,41 +91,44 @@ def _fwd_kernel(xp_ref, rw_ref, peep_ref, m_ref, h0_ref, c0_ref,
     # multi-pass f32 algorithm, and the resident footprint halves. h/c stay
     # f32 in scratch (accumulation dtype); only the gemm operand is cast.
     rw = rw_ref[...]
-    z = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
-        h.astype(rw.dtype), rw, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [b, 4H]
-    zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
-                      z[:, 3 * H:])
     if peep:
         pi = peep_ref[0].astype(jnp.float32)              # [H]
         pf = peep_ref[1].astype(jnp.float32)
         po = peep_ref[2].astype(jnp.float32)
-        zi = zi + c * pi[None, :]
-        zf = zf + c * pf[None, :]
-    i = _sig(zi)
-    f = _sig(zf)
-    g = jnp.tanh(zg)
-    c_new = f * c + i * g
-    if peep:
-        zo = zo + c_new * po[None, :]
-    o = _sig(zo)
-    h_new = o * jnp.tanh(c_new)
-    if m_ref is not None:
-        m = m_ref[0, :, 0][:, None]                       # [b, 1]
-        h_new = m * h_new + (1.0 - m) * h
-        c_new = m * c_new + (1.0 - m) * c
-    h_s[:] = h_new
-    c_s[:] = c_new
-    ys_ref[0] = h_new.astype(ys_ref.dtype)
-    if gates_ref is not None:  # reserve space for BPTT (training fwd only)
-        gates_ref[0] = jnp.concatenate([i, f, o, g], axis=-1
-                                       ).astype(gates_ref.dtype)
-        cseq_ref[0] = c_new.astype(cseq_ref.dtype)
+    for u in range(U):
+        z = xp_ref[u].astype(jnp.float32) + jax.lax.dot_general(
+            h.astype(rw.dtype), rw, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [b, 4H]
+        zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
+                          z[:, 3 * H:])
+        if peep:
+            zi = zi + c * pi[None, :]
+            zf = zf + c * pf[None, :]
+        i = _sig(zi)
+        f = _sig(zf)
+        g = jnp.tanh(zg)
+        c_new = f * c + i * g
+        if peep:
+            zo = zo + c_new * po[None, :]
+        o = _sig(zo)
+        h_new = o * jnp.tanh(c_new)
+        if m_ref is not None:
+            m = m_ref[u, :, 0][:, None]                   # [b, 1]
+            h_new = m * h_new + (1.0 - m) * h
+            c_new = m * c_new + (1.0 - m) * c
+        ys_ref[u] = h_new.astype(ys_ref.dtype)
+        if gates_ref is not None:  # reserve for BPTT (training fwd only)
+            gates_ref[u] = jnp.concatenate([i, f, o, g], axis=-1
+                                           ).astype(gates_ref.dtype)
+            cseq_ref[u] = c_new.astype(cseq_ref.dtype)
+        h, c = h_new, c_new
+    h_s[:] = h
+    c_s[:] = c
 
-    @pl.when(t == T - 1)
+    @pl.when(t == nb - 1)
     def _():
-        hc_ref[0] = h_new.astype(hc_ref.dtype)
-        hc_ref[1] = c_new.astype(hc_ref.dtype)
+        hc_ref[0] = h.astype(hc_ref.dtype)
+        hc_ref[1] = c.astype(hc_ref.dtype)
 
 
 def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
@@ -113,11 +140,14 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
     returns (ys, None, None, hcT)."""
     T, b, H4 = xp.shape
     H = H4 // 4
-    kern = functools.partial(_fwd_kernel, T=T, H=H, peep=peep is not None)
+    U = _unroll_factor(T, b, H, jnp.dtype(rw.dtype).itemsize)
+    nb = T // U
+    kern = functools.partial(_fwd_kernel, nb=nb, H=H, peep=peep is not None,
+                             U=U)
     const3 = lambda t: (0, 0, 0)
     const2 = lambda t: (0, 0)
     specs = [
-        _vspec((1, b, H4), lambda t: (t, 0, 0)),          # xp (streamed)
+        _vspec((U, b, H4), lambda t: (t, 0, 0)),          # xp (streamed)
         _vspec((H, H4), const2),                          # rw (resident)
     ]
     ops = [xp, rw]
@@ -126,7 +156,7 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
         ops.append(peep)
     has_mask = mask is not None
     if has_mask:
-        specs.append(_vspec((1, b, 8), lambda t: (t, 0, 0)))
+        specs.append(_vspec((U, b, 8), lambda t: (t, 0, 0)))
         ops.append(mask)
     specs += [_vspec((b, H), const2), _vspec((b, H), const2)]   # h0, c0
     ops += [h0, c0]
@@ -148,12 +178,12 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
                     ys_ref, gates_ref, cseq_ref, hc_ref, h_s, c_s)
 
     ad = jnp.float32
-    out_specs = [_vspec((1, b, H), lambda t: (t, 0, 0))]  # ys
+    out_specs = [_vspec((U, b, H), lambda t: (t, 0, 0))]  # ys
     out_shape = [jax.ShapeDtypeStruct((T, b, H), xp.dtype)]
     if save_reserve:
         out_specs += [
-            _vspec((1, b, H4), lambda t: (t, 0, 0)),      # gates (reserve)
-            _vspec((1, b, H), lambda t: (t, 0, 0)),       # c sequence
+            _vspec((U, b, H4), lambda t: (t, 0, 0)),      # gates (reserve)
+            _vspec((U, b, H), lambda t: (t, 0, 0)),       # c sequence
         ]
         out_shape += [jax.ShapeDtypeStruct((T, b, H4), ad),
                       jax.ShapeDtypeStruct((T, b, H), ad)]
@@ -161,7 +191,7 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
     out_shape.append(jax.ShapeDtypeStruct((2, b, H), ad))
     res = pl.pallas_call(
         shim,
-        grid=(T,),
+        grid=(nb,),
         in_specs=specs,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
@@ -178,8 +208,13 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
 def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
                 m_ref, c0_ref, dhT_ref, dcT_ref,
                 dz_ref, dh0_ref, dc0_ref, dpeep_ref,
-                dh_s, dc_s, dp_s, *, T, H, peep):
-    t = pl.program_id(0)          # walks 0..T-1; operands indexed T-1-t
+                dh_s, dc_s, dp_s, *, nb, H, peep, U):
+    """Reverse BPTT, U timesteps per grid step (statically unrolled, walked
+    u = U-1 … 0 inside the block). ``cprev_ref`` streams the PREVIOUS
+    block of the c sequence — in-block u > 0 takes c_{t-1} from the local
+    block, u == 0 takes it from ``cprev_ref[U-1]`` (or c0 at the sequence
+    start)."""
+    t = pl.program_id(0)          # walks 0..nb-1; blocks indexed nb-1-t
 
     @pl.when(t == 0)
     def _():
@@ -188,68 +223,78 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
         if peep:
             dp_s[:] = jnp.zeros_like(dp_s)
 
-    rt_is_first = t == T - 1      # reverse step at sequence start
-    gts = gates_ref[0].astype(jnp.float32)
-    i, f, o, g = (gts[:, :H], gts[:, H:2 * H], gts[:, 2 * H:3 * H],
-                  gts[:, 3 * H:])
-    c_out = cseq_ref[0].astype(jnp.float32)
-    # c_prev: cseq[rt-1] for rt > 0 (streamed via clamped index), c0 at rt=0
-    c_prev = jnp.where(rt_is_first, c0_ref[...].astype(jnp.float32),
-                       cprev_ref[0].astype(jnp.float32))
-    dh_tot = dy_ref[0].astype(jnp.float32) + dh_s[:]
-    dc_tot = dc_s[:]
-    if m_ref is not None:
-        m = m_ref[0, :, 0][:, None]
-    else:
-        m = None
-    dh_c = dh_tot if m is None else m * dh_tot
-    dc_c = dc_tot if m is None else m * dc_tot
-    # cseq stores the POST-mask c_eff (it is the next step's c_prev); the
-    # tanh/peephole-o in the forward used the PRE-mask candidate — recompute
-    # it from the saved gates so masked-step gradients are exact for any
-    # mask value in [0, 1], not just binary
-    c_cand = c_out if m is None else f * c_prev + i * g
-    tc = jnp.tanh(c_cand)
-    do = dh_c * tc
-    dzo = do * o * (1.0 - o)
-    dc = dc_c + dh_c * o * (1.0 - tc * tc)
+    rt_is_first = t == nb - 1     # reverse block at sequence start
+    rwt = rwt_ref[...]            # resident [4H, H], source (bf16) dtype
     if peep:
         pi = peep_ref[0].astype(jnp.float32)
         pf = peep_ref[1].astype(jnp.float32)
         po = peep_ref[2].astype(jnp.float32)
-        dc = dc + dzo * po[None, :]
-    di = dc * g
-    df = dc * c_prev
-    dg = dc * i
-    dzi = di * i * (1.0 - i)
-    dzf = df * f * (1.0 - f)
-    dzg = dg * (1.0 - g * g)
-    dc_prev = dc * f
-    if peep:
-        dc_prev = dc_prev + dzi * pi[None, :] + dzf * pf[None, :]
-        # peephole grads accumulate across steps ([8, H] scratch rows 0-2)
-        dp_s[0] = dp_s[0] + jnp.sum(dzi * c_prev, axis=0)
-        dp_s[1] = dp_s[1] + jnp.sum(dzf * c_prev, axis=0)
-        dp_s[2] = dp_s[2] + jnp.sum(dzo * c_cand, axis=0)
-    dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)   # [b, 4H]
-    rwt = rwt_ref[...]            # resident [4H, H], source (bf16) dtype
-    dh_prev = jax.lax.dot_general(dz.astype(rwt.dtype), rwt,
-                                  (((1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-    if m is not None:
-        # dc/dz already carry the m factor (via dh_c/dc_c) — only the
-        # straight-through (1-m) residual is added here; an extra m factor
-        # would double-scale fractional masks (binary masks hide it: m² = m)
-        dh_prev = dh_prev + (1.0 - m) * dh_tot
-        dc_prev = dc_prev + (1.0 - m) * dc_tot
-    dh_s[:] = dh_prev
-    dc_s[:] = dc_prev
-    dz_ref[0] = dz.astype(dz_ref.dtype)
+    dh_carry = dh_s[:]
+    dc_carry = dc_s[:]
+    for u in reversed(range(U)):
+        gts = gates_ref[u].astype(jnp.float32)
+        i, f, o, g = (gts[:, :H], gts[:, H:2 * H], gts[:, 2 * H:3 * H],
+                      gts[:, 3 * H:])
+        c_out = cseq_ref[u].astype(jnp.float32)
+        if u > 0:
+            c_prev = cseq_ref[u - 1].astype(jnp.float32)
+        else:
+            # first step of the block: c_{t-1} lives in the previous block
+            # (clamped stream), or is c0 at the very start of the sequence
+            c_prev = jnp.where(rt_is_first,
+                               c0_ref[...].astype(jnp.float32),
+                               cprev_ref[U - 1].astype(jnp.float32))
+        dh_tot = dy_ref[u].astype(jnp.float32) + dh_carry
+        dc_tot = dc_carry
+        if m_ref is not None:
+            m = m_ref[u, :, 0][:, None]
+        else:
+            m = None
+        dh_c = dh_tot if m is None else m * dh_tot
+        dc_c = dc_tot if m is None else m * dc_tot
+        # cseq stores the POST-mask c_eff (it is the next step's c_prev);
+        # the tanh/peephole-o in the forward used the PRE-mask candidate —
+        # recompute it from the saved gates so masked-step gradients are
+        # exact for any mask value in [0, 1], not just binary
+        c_cand = c_out if m is None else f * c_prev + i * g
+        tc = jnp.tanh(c_cand)
+        do = dh_c * tc
+        dzo = do * o * (1.0 - o)
+        dc = dc_c + dh_c * o * (1.0 - tc * tc)
+        if peep:
+            dc = dc + dzo * po[None, :]
+        di = dc * g
+        df = dc * c_prev
+        dg = dc * i
+        dzi = di * i * (1.0 - i)
+        dzf = df * f * (1.0 - f)
+        dzg = dg * (1.0 - g * g)
+        dc_prev = dc * f
+        if peep:
+            dc_prev = dc_prev + dzi * pi[None, :] + dzf * pf[None, :]
+            # peephole grads accumulate across steps ([8, H] scratch 0-2)
+            dp_s[0] = dp_s[0] + jnp.sum(dzi * c_prev, axis=0)
+            dp_s[1] = dp_s[1] + jnp.sum(dzf * c_prev, axis=0)
+            dp_s[2] = dp_s[2] + jnp.sum(dzo * c_cand, axis=0)
+        dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)   # [b, 4H]
+        dh_prev = jax.lax.dot_general(dz.astype(rwt.dtype), rwt,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        if m is not None:
+            # dc/dz already carry the m factor (via dh_c/dc_c) — only the
+            # straight-through (1-m) residual is added here; an extra m
+            # factor would double-scale fractional masks (binary: m² = m)
+            dh_prev = dh_prev + (1.0 - m) * dh_tot
+            dc_prev = dc_prev + (1.0 - m) * dc_tot
+        dz_ref[u] = dz.astype(dz_ref.dtype)
+        dh_carry, dc_carry = dh_prev, dc_prev
+    dh_s[:] = dh_carry
+    dc_s[:] = dc_carry
 
-    @pl.when(t == T - 1)
+    @pl.when(t == nb - 1)
     def _():
-        dh0_ref[...] = dh_prev.astype(dh0_ref.dtype)
-        dc0_ref[...] = dc_prev.astype(dc0_ref.dtype)
+        dh0_ref[...] = dh_carry.astype(dh0_ref.dtype)
+        dc0_ref[...] = dc_carry.astype(dc0_ref.dtype)
         if peep:
             dpeep_ref[...] = dp_s[:].astype(dpeep_ref.dtype)
         else:
@@ -259,16 +304,19 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
 def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
     T, b, H = dy.shape
     H4 = 4 * H
-    kern = functools.partial(_bwd_kernel, T=T, H=H, peep=peep is not None)
-    rev = lambda t: (T - 1 - t, 0, 0)
+    U = _unroll_factor(T, b, H, jnp.dtype(rwt.dtype).itemsize)
+    nb = T // U
+    kern = functools.partial(_bwd_kernel, nb=nb, H=H, peep=peep is not None,
+                             U=U)
+    rev = lambda t: (nb - 1 - t, 0, 0)
     # c_prev stream: block rt-1, clamped at 0 (selected against c0 in-kernel)
-    rev_prev = lambda t: (jnp.maximum(T - 1 - t - 1, 0), 0, 0)
+    rev_prev = lambda t: (jnp.maximum(nb - 1 - t - 1, 0), 0, 0)
     const2 = lambda t: (0, 0)
     specs = [
-        _vspec((1, b, H), rev),                           # dy
-        _vspec((1, b, H4), rev),                          # gates
-        _vspec((1, b, H), rev),                           # c sequence
-        _vspec((1, b, H), rev_prev),                      # c_{t-1} stream
+        _vspec((U, b, H), rev),                           # dy
+        _vspec((U, b, H4), rev),                          # gates
+        _vspec((U, b, H), rev),                           # c sequence
+        _vspec((U, b, H), rev_prev),                      # c_{t-1} stream
         _vspec((H4, H), const2),                          # rw^T (resident)
     ]
     ops = [dy, gates, cseq, cseq, rwt]
@@ -277,7 +325,7 @@ def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
         ops.append(peep)
     has_mask = mask is not None
     if has_mask:
-        specs.append(_vspec((1, b, 8), rev))
+        specs.append(_vspec((U, b, 8), rev))
         ops.append(mask)
     specs += [_vspec((b, H), const2)] * 3                 # c0, dhT, dcT
     ops += [c0, dhT, dcT]
@@ -296,10 +344,10 @@ def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
     ad = jnp.float32
     return pl.pallas_call(
         shim,
-        grid=(T,),
+        grid=(nb,),
         in_specs=specs,
         out_specs=(
-            _vspec((1, b, H4), rev),                      # dz per step
+            _vspec((U, b, H4), rev),                      # dz per step
             _vspec((b, H), const2),                       # dh0
             _vspec((b, H), const2),                       # dc0
             _vspec((8, H), const2),                       # dpeep

@@ -284,3 +284,33 @@ def test_grads_match_scan_bf16_weights():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(want, np.float32),
                                    rtol=5e-2, atol=5e-2)
+
+
+def test_unroll_factor_selection(monkeypatch):
+    """U honors the env override, must divide T, and shrinks under the
+    VMEM budget."""
+    monkeypatch.delenv("DL4J_TPU_LSTM_UNROLL", raising=False)
+    assert lk._unroll_factor(50, 64, 512, 2) == 2        # default
+    assert lk._unroll_factor(5, 64, 512, 2) == 1         # 5 % 2 != 0
+    monkeypatch.setenv("DL4J_TPU_LSTM_UNROLL", "5")
+    assert lk._unroll_factor(50, 8, 128, 2) == 5
+    assert lk._unroll_factor(50, 64, 512, 2) <= 5        # budget may shrink
+    monkeypatch.setenv("DL4J_TPU_LSTM_UNROLL", "1")
+    assert lk._unroll_factor(50, 8, 128, 2) == 1
+
+
+@pytest.mark.parametrize("peep", [False, True])
+def test_unrolled_kernel_matches_scan_u5(monkeypatch, peep):
+    """U=5 (10 steps → 2 grid blocks): fwd AND grads equal the oracle, with
+    masks + peepholes — the block-boundary c_prev handoff (cprev stream
+    [U-1] vs in-block u-1) is exactly what this pins."""
+    monkeypatch.setenv("DL4J_TPU_LSTM_UNROLL", "5")
+    xp, rw, pp, h0, c0, mk = _inputs(b=8, T=10, H=128, peep=peep, mask=True,
+                                     seed=6)
+    ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
+    want_ys, (whT, wcT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(want_ys),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(whT),
+                               rtol=1e-5, atol=1e-5)
+    _assert_grads_match(xp, rw, pp, h0, c0, mk)
