@@ -49,6 +49,13 @@ def _sig(x):
     return jax.nn.sigmoid(x)
 
 
+def _vmem_fits(b: int, H: int, weight_bytes: int, u: int = 1) -> bool:
+    """One budget definition for supported() AND _unroll_factor: resident
+    [H, 4H] weights + the u-scaled double-buffered streamed blocks must fit
+    a core's VMEM (measured heuristic — see supported())."""
+    return 4 * H * H * weight_bytes + 120 * u * b * H <= 12 * 2 ** 20
+
+
 def _unroll_factor(T: int, b: int, H: int, weight_bytes: int) -> int:
     """Timesteps per grid step. The sequential chain is bound by per-grid-
     step latency (PERF.md round-4 addendum 3), so U > 1 divides it — but
@@ -61,9 +68,7 @@ def _unroll_factor(T: int, b: int, H: int, weight_bytes: int) -> int:
     except ValueError:
         u = 2
     u = max(1, min(u, T))
-    while u > 1 and (T % u
-                     or 4 * H * H * weight_bytes + 120 * u * b * H
-                     > 12 * 2 ** 20):
+    while u > 1 and (T % u or not _vmem_fits(b, H, weight_bytes, u)):
         u -= 1
     return u
 
@@ -243,7 +248,7 @@ def _bwd_kernel(dy_ref, gates_ref, cseq_ref, cprev_ref, rwt_ref, peep_ref,
             # (clamped stream), or is c0 at the very start of the sequence
             c_prev = jnp.where(rt_is_first,
                                c0_ref[...].astype(jnp.float32),
-                               cprev_ref[U - 1].astype(jnp.float32))
+                               cprev_ref[0].astype(jnp.float32))
         dh_tot = dy_ref[u].astype(jnp.float32) + dh_carry
         dc_tot = dc_carry
         if m_ref is not None:
@@ -309,14 +314,16 @@ def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
     kern = functools.partial(_bwd_kernel, nb=nb, H=H, peep=peep is not None,
                              U=U)
     rev = lambda t: (nb - 1 - t, 0, 0)
-    # c_prev stream: block rt-1, clamped at 0 (selected against c0 in-kernel)
-    rev_prev = lambda t: (jnp.maximum(nb - 1 - t - 1, 0), 0, 0)
+    # c_prev stream: ONE row — the last element of block rt-1 (block size 1
+    # on the time dim ⇒ the index map is an ELEMENT index), clamped at 0
+    # and selected against c0 in-kernel at the sequence start
+    rev_prev = lambda t: (jnp.maximum((nb - 1 - t) * U - 1, 0), 0, 0)
     const2 = lambda t: (0, 0)
     specs = [
         _vspec((U, b, H), rev),                           # dy
         _vspec((U, b, H4), rev),                          # gates
         _vspec((U, b, H), rev),                           # c sequence
-        _vspec((U, b, H), rev_prev),                      # c_{t-1} stream
+        _vspec((1, b, H), rev_prev),                      # c_{t-1} stream
         _vspec((H4, H), const2),                          # rw^T (resident)
     ]
     ops = [dy, gates, cseq, cseq, rwt]
@@ -433,7 +440,7 @@ def supported(b: int, T: int, H: int, activation: str,
     # halve the resident term: f32 b=64,H=512 → 7.9 MB ✓; b=256,H=512 →
     # 19.7 MB ✗ → scan; bf16 b=64,H=1024 → 16.2 MB ✗ → scan still, but
     # bf16 b=128,H=512 → 10 MB now fits.
-    if 4 * H * H * weight_bytes + 120 * b * H > 12 * 2 ** 20 or b > 1024:
+    if not _vmem_fits(b, H, weight_bytes) or b > 1024:
         return False
     return (activation == "tanh" and gate_activation == "sigmoid"
             and H % 128 == 0 and b % 8 == 0 and T >= 1)
