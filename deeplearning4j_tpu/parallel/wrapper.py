@@ -710,10 +710,11 @@ class ParallelWrapper:
             net.params = regather(net.params)
             net.updater_state = regather(net.updater_state)
         else:
-            put = lambda t: _tm(
-                lambda x: jax.device_put(np.asarray(x)), t)
-            net.params = put(net.params)
-            net.updater_state = put(net.updater_state)
+            # leave HOST arrays (like the multi-process branch): the whole
+            # point of fsdp is that a full copy may not fit one device
+            host = lambda t: _tm(np.asarray, t)
+            net.params = host(net.params)
+            net.updater_state = host(net.updater_state)
         return net
 
     gatherModel = gather_model

@@ -32,17 +32,21 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   run_stage headline env BENCH_PROBE_WINDOW_S=900 python bench.py
   if [ -f "$STATE/headline.ok" ]; then
     if [ ! -f "$STATE/all.ok" ]; then
+      # stderr to a plain file (no procsub race), echoed to the log after
       run_stage all env BENCH_PROBE_WINDOW_S=600 python bench.py --all \
-        2> >(tee "$STATE/all.err" >&2)
+        2> "$STATE/all.err"
+      cat "$STATE/all.err" >&2
       # a fresh `all` sweep measured these configs with CURRENT code —
       # skip the dedicated re-measure stages for whichever it covered
+      # (pattern anchored to a NUMERIC value: bench also prints
+      # '# <name>: no result line ...' on a lost measurement)
       if [ -f "$STATE/all.ok" ] && [ -f "$STATE/all.err" ]; then
-        grep -q "# transformer_lm_tokens_per_sec:" "$STATE/all.err" \
+        grep -Eq "# transformer_lm_tokens_per_sec: [0-9]" "$STATE/all.err" \
           && touch "$STATE/transformer.ok"
-        grep -q "# keras_inception_parallelwrapper_images_per_sec:" \
+        grep -Eq "# keras_inception_parallelwrapper_images_per_sec: [0-9]" \
           "$STATE/all.err" && touch "$STATE/inception2.ok"
-        grep -q "# graves_lstm_charrnn_chars_per_sec:" "$STATE/all.err" \
-          && touch "$STATE/lstm2.ok"
+        grep -Eq "# graves_lstm_charrnn_chars_per_sec: [0-9]" \
+          "$STATE/all.err" && touch "$STATE/lstm2.ok"
       fi
     fi
     # perf_* scripts have no tunnel watchdog of their own: a wedged backend

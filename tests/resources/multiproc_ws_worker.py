@@ -68,7 +68,6 @@ pw = (ParallelWrapper.Builder(fs)
 rng = np.random.default_rng(0)
 pw.fit(ListDataSetIterator(local_batches()), epochs=3)
 w = fs.params["1"]["W"]
-import jax as _jax
 assert DATA_AXIS in str(w.sharding.spec), w.sharding
 # each process only holds its devices' shards: 2 of 4 → half the leaf
 local = sum(s.data.nbytes for s in w.addressable_shards)
