@@ -38,20 +38,33 @@ def _apply_platform_override():
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache (``BENCH_COMPILE_CACHE``, default
+    ``.jax_cache/`` next to this file; ``0`` disables). Called ONLY from the
+    ``--one`` child AFTER ``jax.devices()`` proved device contact — (a) the
+    parent must never touch backend init (a wedged tunnel would hang it;
+    that is what the subprocess probe exists for), and (b) TPU/axon only:
+    XLA:CPU AOT entries are machine-flag sensitive (the loader warns about
+    SIGILL on mismatch) and the CPU path is just the harness smoke test."""
     cache = os.environ.get("BENCH_COMPILE_CACHE", "")
-    if cache != "0":
+    if cache == "0":
+        return
+    try:
+        import jax
+        if jax.default_backend() not in ("tpu", "axon"):
+            return
         if not cache:
             cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".jax_cache")
-        try:
-            import jax
-            os.makedirs(cache, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", cache)
-            # cache every program, not just slow-to-compile ones
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        except Exception as e:  # cache is an optimization, never fatal
-            print(f"# compile cache disabled: {e}", file=sys.stderr)
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        # cache every program, not just slow-to-compile ones
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # cache is an optimization, never fatal
+        print(f"# compile cache disabled: {e}", file=sys.stderr)
 
 
 _PROBE_SRC = ("import os, jax\n"
@@ -519,6 +532,7 @@ def main():
         import jax
         jax.devices()    # device contact proven before the first beat
         _hb()
+        _enable_compile_cache()
         if "--write" in sys.argv:
             # published numbers are TPU numbers: refuse to overwrite them
             # from an off-TPU run (BENCH_PLATFORM smoke tests, CPU
