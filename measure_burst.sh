@@ -54,8 +54,18 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # before each stage — a wedged tunnel skips the stage this cycle
     # instead of burning its whole timeout — and (b) bound each stage's
     # wall clock anyway (the tunnel can wedge mid-run too)
-    probe() { timeout 150 python -c \
-      "import jax; jax.devices()" >/dev/null 2>&1; }
+    # ONE probe per cycle (cached): a wedged tunnel fails every probe the
+    # same way, and 7 needed stages × 150s of probing per down-cycle slowed
+    # the loop to ~2 cycles/hour — per-cycle probing notices a recovery
+    # within ~12 min instead of ~27
+    PROBE_RESULT=""
+    probe() {
+      if [ -z "$PROBE_RESULT" ]; then
+        if timeout 150 python -c "import jax; jax.devices()" \
+            >/dev/null 2>&1; then PROBE_RESULT=ok; else PROBE_RESULT=down; fi
+      fi
+      [ "$PROBE_RESULT" = ok ]
+    }
     # marker check BEFORE the probe: completed stages must not pay the
     # 150s probe on wedged cycles
     need() { [ ! -f "$STATE/$1.ok" ]; }
