@@ -1,7 +1,12 @@
 """Benchmark harness.
 
-Default run prints ONE JSON line — the BASELINE.json north-star metric
-(ResNet50 ComputationGraph training, images/sec on one chip). ``--all`` also
+Default run prints the BASELINE.json north-star metric (ResNet50
+ComputationGraph training, images/sec on one chip) as JSON lines on stdout —
+possibly SEVERAL: a stale-marked replay of the last banked number at startup,
+then the fresh measurement (or a stale-marked/error final line) when the run
+resolves. THE CONTRACT IS LAST-LINE-WINS: the most recent parseable headline
+line is the run's result; earlier lines exist so that a kill at any moment
+still leaves something parseable. ``--all`` also
 benchmarks every config BASELINE.md commits to (LeNet MNIST, VGG16, GravesLSTM
 char-RNN with TBPTT, Word2Vec skip-gram, Keras-imported inception-style model
 under ParallelWrapper), writes the results into ``BASELINE.json.published``,
@@ -16,12 +21,20 @@ XLA computation; params in f32, matmul/conv compute in bfloat16 on the MXU
 """
 from __future__ import annotations
 
+import datetime
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
 
 
 def _apply_platform_override():
@@ -375,27 +388,38 @@ def _await_backend(max_wait_s=None, probe_timeout=120) -> bool:
     """Guard against a wedged axon tunnel: PJRT client creation can hang
     FOREVER when the relay holds a stale lease (observed in rounds 3/4).
     Probe ``jax.devices()`` in a subprocess under a timeout, with a
-    backoff-growing retry schedule for up to ~30 minutes by default — the
+    backoff-growing retry schedule for up to 15 minutes by default — the
     relay lease has been observed to reset on its own, and spending part of
-    the bench window waiting beats zeroing the round (round-3 lesson: the
-    old 4×120 s window was not enough). Returns False rather than hanging."""
+    the bench window waiting beats zeroing the round. Default capped WELL
+    below the driver's ~30-min kill window (round-4 lesson: a 30-min probe
+    window lost the race and the driver got nothing; the startup replay +
+    deadline guard now backstop this, but the probe budget must still leave
+    time for a real measurement). Override upward only deliberately via
+    BENCH_PROBE_WINDOW_S. Returns False rather than hanging."""
     import subprocess
 
     if max_wait_s is None:
-        max_wait_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 1800))
+        max_wait_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 900))
     t_start = time.monotonic()
     wait, attempt = 60.0, 0
     while True:
         attempt += 1
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        _CHILDREN.add(proc)    # the guards kill in-flight probes too
         try:
-            probe = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, timeout=probe_timeout)
-            if probe.returncode == 0:
-                return True
-            msg = probe.stderr.decode(errors="replace").strip()[-200:]
-        except subprocess.TimeoutExpired:
-            msg = f"probe timed out after {probe_timeout}s"
+            try:
+                _, perr = proc.communicate(timeout=probe_timeout)
+                if proc.returncode == 0:
+                    return True
+                msg = perr.decode(errors="replace").strip()[-200:]
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+                msg = f"probe timed out after {probe_timeout}s"
+        finally:
+            _CHILDREN.discard(proc)
         elapsed = time.monotonic() - t_start
         remaining = max_wait_s - elapsed
         if remaining <= 0:
@@ -438,10 +462,12 @@ def _run_one_subprocess(name, timeout_s=2400):
                                      delete=False)
     hb.close()
     env = dict(os.environ, BENCH_HB=hb.name)
+    proc = None
     try:
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--one", name],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        _CHILDREN.add(proc)    # the guards kill a live bench child too
         t0 = time.monotonic()
         start_wall = time.time()
         timed_out = stale = False
@@ -462,6 +488,8 @@ def _run_one_subprocess(name, timeout_s=2400):
                 break
         p = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
     finally:
+        if proc is not None:
+            _CHILDREN.discard(proc)
         try:
             os.unlink(hb.name)
         except OSError:
@@ -501,29 +529,176 @@ def _read_baseline():
 
 def _write_partial(base_doc, results):
     """Persist whatever has succeeded SO FAR — a later hang must not lose
-    earlier configs' numbers."""
+    earlier configs' numbers. ``published`` always holds the LAST measured
+    value; ``last_measured`` stamps when each metric was actually captured
+    on hardware, so "published" can never silently become best-ever
+    cherry-picking across rounds (VERDICT r4 weak 5)."""
     if base_doc is None:
         return
     base_doc.setdefault("published", {}).update(results)
+    stamps = base_doc.setdefault("last_measured", {})
+    now = _utcnow()
+    for name in results:
+        stamps[name] = now
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BASELINE.json")
-    with open(path, "w") as fh:
+    # atomic replace: a SIGTERM/deadline os._exit mid-write must never
+    # truncate the file the startup replay depends on
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(base_doc, fh, indent=2)
+    os.replace(tmp, path)
 
 
-def _headline(value, base_val, error=None):
+# ---------------------------------------------------------------------------
+# The always-parseable-headline contract (VERDICT r4, "do this" item 1).
+#
+# The driver runs ``python bench.py`` under a kill timeout and parses a JSON
+# line from stdout. Round 4 handed it ``parsed: null``: the 30-min probe
+# window met the driver window and the process died mid-probe having printed
+# nothing. Three defenses, layered:
+#   1. STARTUP REPLAY — before touching any backend, print the last-banked
+#      headline from BASELINE.json with ``"stale": true`` and its
+#      ``last_measured`` stamp. From second ~0 there is always a parseable
+#      line on stdout, whatever happens later.
+#   2. SIGTERM FLUSH — ``timeout`` sends SIGTERM before SIGKILL; the handler
+#      prints a final headline (fresh if one was measured, else stale-marked)
+#      and exits. Also covers Ctrl-C (SIGINT).
+#   3. HARD DEADLINE — a watchdog thread flushes the final headline and
+#      exits at ``BENCH_DEADLINE_S`` (default 1440 s, comfortably under the
+#      observed ~1800 s driver kill), so even a SIGKILL-only driver sees a
+#      completed process. Default mode only — ``--all`` sweeps are run by
+#      the burst harness under its own horizon (set BENCH_DEADLINE_S to
+#      override there too).
+# The reference's PerformanceListener never makes reporting conditional on
+# a healthy run (optimize/listeners/PerformanceListener.java:22-23); same
+# rule here.
+# ---------------------------------------------------------------------------
+
+# NO lock: _emit_final must be callable from a signal handler, where a
+# non-reentrant lock held by the interrupted main thread would deadlock.
+# The one-shot guard is a plain flag; rc is latched so a late signal after
+# a stale-only emit exits with the SAME code, not a fabricated 0. The only
+# races this leaves are microsecond windows that at worst duplicate or drop
+# the FINAL line — the startup replay line is already on stdout by then, so
+# the last-line-wins contract still yields a parseable headline.
+_FINAL = {
+    "emitted": False,          # one-shot guard for the FINAL line
+    "rc": 2,                   # latched exit code of the final emit
+    "fresh_value": None,       # measured this run, on hardware
+    "stale_value": None,       # replayed from BASELINE.json
+    "stale_utc": None,
+    "base_val": None,
+}
+
+# live child processes (bench --one subprocesses, backend probes): the
+# signal/deadline handlers kill these before os._exit so a dying parent
+# never orphans a TPU-holding child against the tunnel
+_CHILDREN = set()
+
+
+def _headline_doc(value, base_val, *, stale=False, measured_utc=None,
+                  error=None):
     vs = (value / base_val) if (base_val and value) else (1.0 if value else None)
     doc = {"metric": "resnet50_imagenet_images_per_sec", "value": value,
            "unit": "images/sec",
            "vs_baseline": round(vs, 3) if vs else None}
+    if stale:
+        doc["stale"] = True
+    if measured_utc:
+        doc["measured_utc"] = measured_utc
     if error:
         doc["error"] = error
-    print(json.dumps(doc))
+    return doc
+
+
+def _print_line(doc):
+    # os.write to fd 1: async-signal-safe (no buffered-writer reentrancy
+    # when called from the SIGTERM handler) and atomic for short lines
+    os.write(1, (json.dumps(doc) + "\n").encode())
+
+
+def _emit_startup_replay():
+    """Defense 1: a parseable line on stdout before any backend contact."""
+    base_doc, base_val = _read_baseline()
+    _FINAL["base_val"] = base_val
+    if base_doc is not None and base_val:
+        utc = base_doc.get("last_measured", {}).get(
+            "resnet50_imagenet_images_per_sec")
+        _FINAL["stale_value"] = base_val
+        _FINAL["stale_utc"] = utc
+        _print_line(_headline_doc(
+            base_val, base_val, stale=True, measured_utc=utc,
+            error="replayed last banked measurement; fresh run in progress"))
+    return base_doc, base_val
+
+
+def _emit_final(error=None):
+    """Print the final headline exactly once: fresh if this run measured
+    one, else the stale replay (marked), else an explicit error object.
+    Returns the exit code the caller should use. Signal-handler safe: no
+    locks, no buffered I/O (see the _FINAL comment for the race analysis)."""
+    if _FINAL["emitted"]:
+        return _FINAL["rc"]
+    if _FINAL["fresh_value"] is not None:
+        doc = _headline_doc(_FINAL["fresh_value"], _FINAL["base_val"],
+                            measured_utc=_utcnow())
+        rc = 0
+    elif _FINAL["stale_value"] is not None:
+        doc = _headline_doc(
+            _FINAL["stale_value"], _FINAL["base_val"], stale=True,
+            measured_utc=_FINAL["stale_utc"],
+            error=error or "no fresh measurement; replaying last banked")
+        rc = 2
+    else:
+        doc = _headline_doc(None, None, error=error or "no measurement")
+        rc = 2
+    _FINAL["rc"] = rc
+    _FINAL["emitted"] = True
+    _print_line(doc)
+    return rc
+
+
+def _kill_children():
+    """Best-effort kill of live probe/bench subprocesses so the dying
+    parent never leaves an orphan holding the TPU tunnel."""
+    for p in list(_CHILDREN):
+        try:
+            p.kill()
+        except Exception:
+            pass
+
+
+def _install_guards(deadline_s):
+    """Defenses 2+3: SIGTERM/SIGINT flush and the hard-deadline watchdog."""
+    def _on_signal(signum, frame):
+        rc = _emit_final(error=f"killed by signal {signum} before a fresh "
+                               f"measurement completed")
+        _kill_children()
+        os._exit(rc)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass
+    if deadline_s and deadline_s > 0:
+        def _on_deadline():
+            rc = _emit_final(error=f"self-imposed deadline {deadline_s:.0f}s "
+                                   f"reached (driver window protection)")
+            _kill_children()
+            os._exit(rc)
+        t = threading.Timer(deadline_s, _on_deadline)
+        t.daemon = True
+        t.start()
 
 
 def main():
-    _apply_platform_override()
+    # parent mode NEVER imports jax in-process (device contact and platform
+    # override belong to the probe/child subprocesses) — so the startup
+    # replay line hits stdout within numpy-import time, not jax-import time
     if "--one" in sys.argv:
+        _apply_platform_override()
         # child mode: run exactly one config in-process, print a result line.
         # --write additionally persists it into BASELINE.json.published
         # (the burst harness re-measures individual configs this way)
@@ -561,14 +736,19 @@ def main():
         return
 
     run_all = "--all" in sys.argv
-    base_doc, base_val = _read_baseline()
+    # startup replay FIRST (defense 1), then the signal/deadline guards
+    # (defenses 2+3). --all runs under the burst harness's own horizon, so
+    # the hard deadline is off there unless explicitly set.
+    base_doc, base_val = _emit_startup_replay()
+    default_deadline = 0 if run_all else 1440
+    _install_guards(float(os.environ.get("BENCH_DEADLINE_S",
+                                         default_deadline)))
     if not _await_backend():
         # fail honestly rather than hang the driver: no number is fabricated;
-        # the error is machine-readable and the exit code is non-zero.
-        # BASELINE.json keeps the last real measurements.
-        _headline(None, None, error="TPU backend init hang (wedged tunnel); "
-                                    "no measurement taken")
-        sys.exit(2)
+        # the stale replay (if any) is marked as such and the exit code is
+        # non-zero. BASELINE.json keeps the last real measurements.
+        sys.exit(_emit_final(error="TPU backend init hang (wedged tunnel); "
+                                   "no fresh measurement taken"))
 
     if run_all:
         results = {}
@@ -585,18 +765,30 @@ def main():
                 continue
             results[name] = value
             print(f"# {name}: {value} {unit}", file=sys.stderr)
-            _write_partial(base_doc, results)
+            # write ONLY the new entry: passing the cumulative dict would
+            # re-stamp earlier configs' last_measured with the wrong time
+            _write_partial(base_doc, {name: value})
+            if name == "resnet50_imagenet_images_per_sec":
+                # latch immediately: a SIGTERM later in the sweep must emit
+                # THIS fresh number, not the previous round's stale replay
+                _FINAL["fresh_value"] = value
         value = results.get("resnet50_imagenet_images_per_sec")
     else:
         value = _run_one_subprocess("resnet50_imagenet_images_per_sec")
-        if value is None and _await_backend(max_wait_s=900):
+        if value is None and _await_backend(max_wait_s=600):
             value = _run_one_subprocess("resnet50_imagenet_images_per_sec")
+        if value is not None:
+            _FINAL["fresh_value"] = value      # latch before any disk I/O
+            # bank the fresh headline + its timestamp (default mode is the
+            # driver's path — its numbers must persist like --all's do)
+            _write_partial(base_doc,
+                           {"resnet50_imagenet_images_per_sec": value})
 
     if value is None:
-        _headline(None, base_val, error="benchmark did not complete "
-                                        "(wedged tunnel?); no measurement")
-        sys.exit(2)
-    _headline(value, base_val)
+        sys.exit(_emit_final(error="benchmark did not complete (wedged "
+                                   "tunnel?); no fresh measurement"))
+    _FINAL["fresh_value"] = value
+    sys.exit(_emit_final())
 
 
 if __name__ == "__main__":

@@ -28,8 +28,14 @@ run_stage() {  # run_stage <name> <cmd...>
 }
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  # short probe window per cycle; the outer loop provides the long horizon
-  run_stage headline env BENCH_PROBE_WINDOW_S=900 python bench.py
+  # short probe window per cycle; the outer loop provides the long horizon.
+  # BENCH_DEADLINE_S=0: bench.py's self-imposed deadline exists to beat the
+  # DRIVER's kill window; here the outer timeout owns the budget, and the
+  # internal deadline would kill a healthy cold-compile measurement mid-run
+  # outer budget covers bench.py's own worst case: 900s probe + 2400s run
+  # + 600s re-probe + 2400s retry (+ slack) — never kill a healthy run
+  run_stage headline env BENCH_PROBE_WINDOW_S=900 BENCH_DEADLINE_S=0 \
+    timeout 6600 python bench.py
   if [ -f "$STATE/headline.ok" ]; then
     if [ ! -f "$STATE/all.ok" ]; then
       # stderr to a plain file (no procsub race), echoed to the log after

@@ -40,16 +40,26 @@ def test_await_backend_backoff_schedule(bench, monkeypatch):
 
     calls = {"n": 0}
 
-    def fake_run(cmd, capture_output, timeout):
-        calls["n"] += 1
-        if calls["n"] >= 4:
-            return types.SimpleNamespace(returncode=0, stderr=b"")
-        raise subprocess.TimeoutExpired(cmd, timeout)
+    class FakeProbe:
+        def __init__(self):
+            calls["n"] += 1
+            self._ok = calls["n"] >= 4
+            self.returncode = 0 if self._ok else None
+
+        def communicate(self, timeout=None):
+            import subprocess as sp
+            if not self._ok and self.returncode is None:
+                raise sp.TimeoutExpired(["probe"], timeout)
+            return b"", b""
+
+        def kill(self):
+            self.returncode = -9
 
     monkeypatch.setattr(bench.time, "sleep", fake_sleep)
     monkeypatch.setattr(bench.time, "monotonic", fake_monotonic)
     import subprocess as sp
-    monkeypatch.setattr(sp, "run", fake_run)  # bench imports it lazily
+    monkeypatch.setattr(sp, "Popen",
+                        lambda *a, **k: FakeProbe())  # imported lazily
 
     assert bench._await_backend(max_wait_s=10_000) is True
     assert calls["n"] == 4
@@ -145,7 +155,8 @@ def test_run_one_subprocess_heartbeat_stale_kill(bench, monkeypatch):
 
 def test_partial_results_persisted_per_config(bench, tmp_path, monkeypatch):
     """_write_partial merges into BASELINE.json.published incrementally so
-    a later hang cannot lose earlier configs' numbers."""
+    a later hang cannot lose earlier configs' numbers, and stamps
+    last_measured so published numbers carry their vintage."""
     doc = {"published": {"old_metric": 1.0}}
     path = tmp_path / "BASELINE.json"
     path.write_text(json.dumps(doc))
@@ -158,19 +169,134 @@ def test_partial_results_persisted_per_config(bench, tmp_path, monkeypatch):
     on_disk = json.loads(path.read_text())
     assert on_disk["published"]["old_metric"] == 1.0
     assert on_disk["published"]["resnet50_imagenet_images_per_sec"] == 42.0
+    assert "resnet50_imagenet_images_per_sec" in on_disk["last_measured"]
+    assert "old_metric" not in on_disk["last_measured"]
 
     bench._write_partial(base_doc, {"second_metric": 7.0})
     on_disk = json.loads(path.read_text())
     assert on_disk["published"]["second_metric"] == 7.0
+    assert "second_metric" in on_disk["last_measured"]
 
 
-def test_headline_json_shape(bench, capsys):
-    bench._headline(2641.9, 2600.0)
-    doc = json.loads(capsys.readouterr().out.strip())
+def test_headline_json_shape(bench, capfd):
+    bench._print_line(bench._headline_doc(2641.9, 2600.0))
+    doc = json.loads(capfd.readouterr().out.strip())
     assert doc["metric"] == "resnet50_imagenet_images_per_sec"
     assert doc["value"] == 2641.9
     assert abs(doc["vs_baseline"] - 2641.9 / 2600.0) < 1e-3
+    assert "stale" not in doc
 
-    bench._headline(None, None, error="wedged")
-    doc = json.loads(capsys.readouterr().out.strip())
+    bench._print_line(bench._headline_doc(None, None, error="wedged"))
+    doc = json.loads(capfd.readouterr().out.strip())
     assert doc["value"] is None and doc["error"] == "wedged"
+
+
+def test_startup_replay_emits_stale_headline(bench, tmp_path, monkeypatch,
+                                             capfd):
+    """Defense 1: before any backend contact there is already a parseable
+    stale-marked headline on stdout, carrying the last_measured stamp."""
+    doc = {"published": {"resnet50_imagenet_images_per_sec": 2621.8},
+           "last_measured": {"resnet50_imagenet_images_per_sec":
+                             "2026-07-20T07:28:00Z"}}
+    (tmp_path / "BASELINE.json").write_text(json.dumps(doc))
+    monkeypatch.setattr(bench.os.path, "dirname", lambda p: str(tmp_path))
+    base_doc, base_val = bench._emit_startup_replay()
+    out = json.loads(capfd.readouterr().out.strip())
+    assert out["value"] == 2621.8 and out["stale"] is True
+    assert out["measured_utc"] == "2026-07-20T07:28:00Z"
+    assert base_val == 2621.8
+
+    # no baseline at all: nothing printed (the final-line path still covers
+    # the contract with an explicit error object)
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path / "nowhere"))
+    bench._FINAL["stale_value"] = None
+    bench._emit_startup_replay()
+    assert capfd.readouterr().out == ""
+
+
+def test_emit_final_one_shot_and_priority(bench, capfd):
+    """_emit_final prints exactly once; a fresh value beats the stale
+    replay; with neither, an explicit error object is still parseable."""
+    bench._FINAL.update(fresh_value=None, stale_value=500.0,
+                        stale_utc="2026-07-20T00:00:00Z", base_val=500.0)
+    rc = bench._emit_final(error="sigterm")
+    doc = json.loads(capfd.readouterr().out.strip())
+    assert rc == 2 and doc["value"] == 500.0 and doc["stale"] is True
+    # one-shot: silent no-op returning the LATCHED code (a signal landing
+    # after a stale-only emit must not rewrite history to rc=0)
+    assert bench._emit_final() == 2
+    assert capfd.readouterr().out == ""
+
+    bench._FINAL.update(emitted=False, fresh_value=123.0)
+    rc = bench._emit_final()
+    doc = json.loads(capfd.readouterr().out.strip())
+    assert rc == 0 and doc["value"] == 123.0 and "stale" not in doc
+
+    bench._FINAL.update(emitted=False, fresh_value=None, stale_value=None)
+    rc = bench._emit_final()
+    doc = json.loads(capfd.readouterr().out.strip())
+    assert rc == 2 and doc["value"] is None and doc["error"]
+
+
+def _bench_sandbox(tmp_path):
+    """Copy bench.py + a fake BASELINE.json into tmp_path so a real
+    subprocess run exercises the module exactly as the driver does, without
+    touching the repo's real baseline."""
+    import shutil
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shutil.copy(os.path.join(repo, "bench.py"), tmp_path / "bench.py")
+    (tmp_path / "BASELINE.json").write_text(json.dumps(
+        {"published": {"resnet50_imagenet_images_per_sec": 999.9},
+         "last_measured": {"resnet50_imagenet_images_per_sec":
+                           "2026-07-20T07:28:00Z"}}))
+    env = dict(os.environ, BENCH_PLATFORM="__nonexistent__",
+               JAX_PLATFORMS="cpu")
+    return tmp_path / "bench.py", env
+
+
+def _parseable_headlines(stdout: str):
+    docs = []
+    for line in stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if d.get("metric") == "resnet50_imagenet_images_per_sec":
+            docs.append(d)
+    return docs
+
+
+def test_driver_contract_sigterm_mid_probe(tmp_path):
+    """THE round-4 failure mode: tunnel down, driver kills bench.py mid
+    probe. Contract: stdout already/still holds a parseable stale-marked
+    headline and the process dies promptly on SIGTERM."""
+    script, env = _bench_sandbox(tmp_path)
+    env["BENCH_PROBE_WINDOW_S"] = "3600"     # probing "forever"
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            env=env, cwd=tmp_path)
+    import time
+    time.sleep(3.0)                          # past startup replay
+    proc.send_signal(15)
+    out, _ = proc.communicate(timeout=30)
+    docs = _parseable_headlines(out.decode())
+    assert docs, f"no parseable headline in: {out!r}"
+    assert docs[0]["value"] == 999.9 and docs[0]["stale"] is True
+    assert docs[-1]["stale"] is True         # final flush also stale-marked
+
+
+def test_driver_contract_deadline_self_exit(tmp_path):
+    """Defense 3: with the tunnel down and no SIGTERM, the self-imposed
+    deadline flushes a final stale headline and exits non-zero on its own —
+    a SIGKILL-only driver still sees a completed process."""
+    script, env = _bench_sandbox(tmp_path)
+    env["BENCH_PROBE_WINDOW_S"] = "3600"
+    env["BENCH_DEADLINE_S"] = "4"
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, env=env, cwd=tmp_path,
+                          timeout=60)
+    docs = _parseable_headlines(proc.stdout.decode())
+    assert proc.returncode == 2
+    assert docs and docs[-1]["value"] == 999.9
+    assert docs[-1]["stale"] is True and "deadline" in docs[-1]["error"]
