@@ -471,6 +471,27 @@ def _bwd(causal, scale, rate, res, g):
 
 
 # ------------------------------------------------------------- public entry
+def normalize_operand_dtypes(q, k, v):
+    """Uniform source-dtype operands for the dtype-strict kernels
+    (``dot_general`` rejects mixed dtypes; uniform bf16 is what takes the
+    native MXU pass): promote to the WIDEST operand dtype, so an f32 k/v
+    alongside a bf16 q keeps its precision instead of being silently
+    downcast. ``DL4J_TPU_FLASH_F32=1`` forces f32 — the first-hardware
+    rollback hatch restoring the pre-bf16 kernel behavior should a Mosaic
+    bf16 lowering gap surface on a new jaxlib. Returns
+    ``(q, k, v, out_dtype)`` with ``out_dtype`` = q's ORIGINAL dtype;
+    callers cast the kernel output back to it so neither the promotion nor
+    the hatch ever changes downstream activation dtypes. Shared by
+    :func:`flash_attention` and ``parallel.sequence.ring_flash_attention``
+    — one policy, one place."""
+    import os
+    out_dtype = q.dtype
+    common = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype), v.dtype)
+    if os.environ.get("DL4J_TPU_FLASH_F32"):
+        common = jnp.float32
+    return (q.astype(common), k.astype(common), v.astype(common), out_dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash(q, k, v, km, seed, causal, scale, rate):
     o, _ = _fwd(q, k, v, km, seed, causal, scale, rate)
@@ -534,20 +555,7 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     ``dropout_seed`` (int32 scalar, may be traced — e.g. derived from the
     layer's PRNG key per step) is then required."""
     b, T, h, d = q.shape
-    # the kernels run SOURCE-dtype matmuls (dot_general is dtype-strict, and
-    # uniform operands are what lets bf16 take the native MXU pass) —
-    # normalize mixed-dtype inputs to q's dtype here.
-    # DL4J_TPU_FLASH_F32=1 is the first-hardware rollback hatch: it restores
-    # the pre-bf16 KERNEL behavior (every operand upcast to f32 before the
-    # kernels) should a Mosaic bf16 lowering gap surface on a new jaxlib —
-    # the OUTPUT is cast back to the caller's dtype so flipping the hatch
-    # does not change downstream activation dtypes/memory.
-    import os
-    out_dtype = q.dtype
-    if os.environ.get("DL4J_TPU_FLASH_F32"):
-        q = q.astype(jnp.float32)
-    k = k.astype(q.dtype)
-    v = v.astype(q.dtype)
+    q, k, v, out_dtype = normalize_operand_dtypes(q, k, v)
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
     rate = float(dropout_rate)

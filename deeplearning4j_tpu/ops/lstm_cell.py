@@ -61,7 +61,12 @@ def _unroll_factor(T: int, b: int, H: int, weight_bytes: int) -> int:
     step latency (PERF.md round-4 addendum 3), so U > 1 divides it — but
     every streamed block ([U, b, 4H] xp/gates/dz, double-buffered) scales
     with U, so U shrinks until the VMEM budget fits. T must divide evenly.
-    ``DL4J_TPU_LSTM_UNROLL`` overrides the default (2); 1 disables."""
+    ``DL4J_TPU_LSTM_UNROLL`` overrides the default (2); 1 disables.
+
+    TRACE-TIME knob: the env var is read when the enclosing step is traced
+    (first call per shape). Once jit has cached a compiled step, changing
+    it has NO effect on subsequent steps of the same config — set it before
+    the first fit/step, or clear jax caches to re-trace."""
     import os
     try:
         u = int(os.environ.get("DL4J_TPU_LSTM_UNROLL", "2"))

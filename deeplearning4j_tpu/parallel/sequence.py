@@ -372,15 +372,10 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
     single-device flash kernel's dropout with the same ``dropout_seed``
     (int32 scalar, same on every shard), forward and backward."""
     d = q.shape[-1]
-    # block kernels run source-dtype matmuls (dtype-strict): normalize.
-    # DL4J_TPU_FLASH_F32 — same rollback hatch as ops.flash_attention;
-    # output cast back so the hatch never changes downstream dtypes
-    import os
-    _out_dtype = q.dtype
-    if os.environ.get("DL4J_TPU_FLASH_F32"):
-        q = q.astype(jnp.float32)
-    k = k.astype(q.dtype)
-    v = v.astype(q.dtype)
+    # one dtype policy for all flash paths (widest-operand promotion +
+    # DL4J_TPU_FLASH_F32 hatch): shared helper in ops.flash_attention
+    from ..ops.flash_attention import normalize_operand_dtypes
+    q, k, v, _out_dtype = normalize_operand_dtypes(q, k, v)
     scale = 1.0 / float(d) ** 0.5
     rate = float(dropout_rate)
     if rate > 0.0 and dropout_seed is None:

@@ -108,8 +108,11 @@ def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
     weight-update / optimizer-state sharding (Xu et al. 2020,
     arXiv:2004.13336 "Automatic Cross-Replica Sharding of Weight Update in
     Data-Parallel Training"; the ZeRO-1 idea expressed as XLA sharding
-    annotations). Each leaf shards its first dim divisible by the axis
-    extent; everything else (small biases, scalar step counts) replicates.
+    annotations). Each leaf shards its LARGEST dim divisible by the axis
+    extent (ties broken toward the later dim, so an NHWC/HWIO conv kernel
+    shards over channels rather than a small spatial dim that happens to
+    divide); leaves with no divisible dim — scalar step counts, biases
+    narrower than the axis extent — replicate.
     With the updater state annotated this way and params replicated, the
     SPMD partitioner keeps each replica's m/v (etc.) shard-resident —
     optimizer memory drops ~N-fold — and reshards gradients into the
@@ -119,9 +122,12 @@ def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
 
     def spec(x):
         shape = getattr(x, "shape", ())
+        best = None
         for d, s in enumerate(shape):
-            if s >= n and s % n == 0:
-                return NamedSharding(mesh, P(*([None] * d + [axis])))
+            if s >= n and s % n == 0 and (best is None or s >= shape[best]):
+                best = d
+        if best is not None:
+            return NamedSharding(mesh, P(*([None] * best + [axis])))
         return repl
 
     return jax.tree_util.tree_map(spec, tree)
@@ -147,11 +153,11 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
     optimizer memory per device.
 
     ``shard_params=True`` additionally SHARDS THE PARAMETERS over the data
-    axis (ZeRO-3/FSDP-style sharded storage): each leaf whose first
-    divisible dim splits over the axis is stored 1/N per device, and the
-    SPMD partitioner inserts the all-gathers at the points of use and
-    reduce-scatters the gradients into the sharded update. Leaves with no
-    divisible dim (small biases, odd conv kernels) stay replicated.
+    axis (ZeRO-3/FSDP-style sharded storage): each leaf's largest
+    axis-divisible dim (see :func:`update_sharded_specs`) is stored 1/N
+    per device, and the SPMD partitioner inserts the all-gathers at the
+    points of use and reduce-scatters the gradients into the sharded
+    update. Leaves with no divisible dim stay replicated.
     Numerically identical to replicated DP.
     """
     raw = net._raw_step(False)

@@ -660,7 +660,14 @@ class ParallelWrapper:
         lifetime — the `_cached_device_put` rule) and records the device
         bytes it pins; total pinned bytes are bounded by
         ``sharded_cache_budget`` (env ``DL4J_TPU_PW_CACHE_BYTES``, default
-        4 GiB) with least-recently-used eviction."""
+        4 GiB) with least-recently-used eviction.
+
+        CONTRACT — cached arrays must not be mutated in place: the key is
+        (id, data pointer, shape, dtype), so a pipeline that WRITES into a
+        reused batch buffer (e.g. augmentation into the same ndarray) keeps
+        the same key and the step silently trains on the STALE device copy.
+        Feed ``CacheMode.DEVICE`` fresh arrays per distinct batch, or call
+        ``clear_device_cache()`` after mutating."""
         if getattr(self.net.gc, "cache_mode", None) != CacheMode.DEVICE:
             return build(batches)
         ckey = prefix + tuple(b._device_key() for b in batches)
@@ -724,7 +731,10 @@ class ParallelWrapper:
         Use when training under ``CacheMode.DEVICE`` with data that does NOT
         repeat across epochs (augmentation, streaming): non-repeating batches
         insert entries that can never hit, and although the LRU byte budget
-        bounds the HBM pinned, that budget is better spent on activations."""
+        bounds the HBM pinned, that budget is better spent on activations.
+        ALSO required for correctness if batch arrays were mutated IN PLACE:
+        the cache keys on array identity, so an in-place write leaves a
+        stale device copy behind the same key (see ``_cached_sharded``)."""
         self._sharded_batch_cache.clear()
         self._sharded_cache_bytes = 0
 
