@@ -97,6 +97,23 @@ CHINESE_LEXICON = {
 }
 
 
+def _iter_dict_lines(path: str, encoding: str = "utf-8"):
+    """Shared dictionary-file line parser (jieba/ansj user-dict format):
+    yields ``(word, freq, extra_columns)`` per non-blank non-``#`` line;
+    commas normalize to spaces; freq defaults to 1 when the second column
+    is missing/non-numeric. One parser for every load() so format fixes
+    apply to all languages at once."""
+    with open(path, encoding=encoding) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.replace(",", " ").split()
+            freq = (int(parts[1]) if len(parts) > 1
+                    and parts[1].isdigit() else 1)
+            yield parts[0], freq, parts[2:]
+
+
 class Lexicon:
     """Frequency dictionary + character trie for segmentation.
 
@@ -135,15 +152,8 @@ class Lexicon:
     def load(self, path: str, encoding: str = "utf-8") -> "Lexicon":
         """Merge a dictionary file: ``word``, ``word freq`` or ``word,freq``
         per line; blank lines and ``#`` comments skipped."""
-        with open(path, encoding=encoding) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line or line.startswith("#"):
-                    continue
-                parts = line.replace(",", " ").split()
-                freq = (int(parts[1]) if len(parts) > 1
-                        and parts[1].isdigit() else 1)
-                self.add(parts[0], freq)
+        for word, freq, _extra in _iter_dict_lines(path, encoding):
+            self.add(word, freq)
         return self
 
     @classmethod
@@ -378,35 +388,310 @@ JAPANESE_PARTICLES = (
     "か", "な",
 )
 
-#: Seed lexicon for common multi-kanji words.
+#: Auxiliary verbs / copulas (connection category "a": attach after content).
+JAPANESE_AUX = (
+    "です", "ます", "でした", "ました", "だ", "である", "ない", "たい",
+    "れる", "られる", "せる", "させる",
+)
+
+#: Seed lexicon for common multi-kanji words (legacy max-match seed).
 JAPANESE_LEXICON = {
     "日本", "東京", "大学", "学生", "先生", "機械", "学習", "機械学習",
     "言語", "自然", "自然言語", "処理", "深層", "深層学習", "好き",
 }
 
+#: Seed dictionary for the LATTICE segmenter: (word, freq, category).
+#: category: "c" content, "p" particle, "a" auxiliary/copula. Frequencies
+#: are order-of-magnitude corpus ranks (particles ≫ common nouns ≫ rest) —
+#: they set edge costs the way IPADIC word costs do for Kuromoji. Extend
+#: per-corpus via ``dict_path`` / ``add_words``.
+JAPANESE_SEED_ENTRIES: Tuple[Tuple[str, int, str], ...] = (
+    # particles (the highest-frequency tokens in any Japanese corpus)
+    ("の", 8000, "p"), ("は", 6000, "p"), ("が", 5500, "p"),
+    ("を", 5000, "p"), ("に", 5000, "p"), ("と", 4000, "p"),
+    ("で", 3800, "p"), ("も", 3500, "p"), ("へ", 1200, "p"),
+    ("や", 1000, "p"), ("から", 1500, "p"), ("まで", 900, "p"),
+    ("には", 800, "p"), ("とは", 500, "p"), ("ね", 600, "p"),
+    ("よ", 600, "p"), ("か", 1200, "p"), ("な", 900, "p"),
+    # auxiliaries / copulas
+    ("です", 3000, "a"), ("ます", 2500, "a"), ("でした", 900, "a"),
+    ("ました", 900, "a"), ("だ", 1500, "a"), ("である", 500, "a"),
+    ("ない", 1500, "a"), ("たい", 500, "a"),
+    # pronouns & everyday nouns
+    ("私", 2000, "c"), ("あなた", 500, "c"), ("これ", 900, "c"),
+    ("それ", 900, "c"), ("うち", 700, "c"), ("こと", 1500, "c"),
+    ("もの", 1200, "c"), ("とき", 700, "c"), ("ところ", 600, "c"),
+    ("今日", 800, "c"), ("明日", 500, "c"), ("昨日", 500, "c"),
+    # common fruit/food (the classic lattice demo words — real IPADIC
+    # entries, not test rigging: すもも = plum, もも = peach)
+    ("すもも", 50, "c"), ("もも", 120, "c"), ("りんご", 150, "c"),
+    # greetings / frequent hiragana content words (must beat particle
+    # shredding: ありがとう vs あり|が|とう)
+    ("ありがとう", 400, "c"), ("こんにちは", 300, "c"),
+    ("さようなら", 150, "c"), ("おはよう", 200, "c"),
+    # verbs/adjectives with okurigana (kanji+hira edges that cross script
+    # boundaries — the case the script-run fallback cannot handle)
+    ("好き", 600, "c"), ("食べる", 400, "c"), ("行く", 500, "c"),
+    ("見る", 500, "c"), ("する", 1800, "c"), ("いる", 1500, "c"),
+    ("ある", 1500, "c"), ("なる", 1000, "c"), ("言う", 600, "c"),
+    ("思う", 600, "c"), ("大きい", 300, "c"), ("小さい", 250, "c"),
+    ("新しい", 300, "c"),
+    # domain nouns (mirror the Chinese seed)
+    ("日本", 1000, "c"), ("東京", 700, "c"), ("大学", 600, "c"),
+    ("学生", 500, "c"), ("先生", 500, "c"), ("機械", 300, "c"),
+    ("学習", 350, "c"), ("機械学習", 200, "c"), ("言語", 300, "c"),
+    ("自然", 300, "c"), ("自然言語", 150, "c"), ("処理", 300, "c"),
+    ("深層", 100, "c"), ("深層学習", 120, "c"), ("計算", 300, "c"),
+    ("研究", 400, "c"), ("時間", 500, "c"), ("問題", 500, "c"),
+    ("世界", 500, "c"), ("仕事", 450, "c"),
+)
+
+
+class JapaneseLexicon(Lexicon):
+    """:class:`Lexicon` + a connection category per word (``"c"`` content,
+    ``"p"`` particle, ``"a"`` auxiliary). Dictionary files may carry the
+    category as a third column (``word freq pos``); without one it is
+    inferred from the particle/aux tables."""
+
+    def __init__(self, entries: Optional[Iterable] = None):
+        self._cat: Dict[str, str] = {}
+        super().__init__()
+        if entries:
+            for e in entries:
+                if isinstance(e, str):
+                    self.add(e)
+                else:
+                    self.add(*e)
+
+    def add(self, word: str, freq: int = 1, cat: Optional[str] = None):
+        word = word.strip()
+        if not word:
+            return
+        if cat is None:
+            cat = self._cat.get(word) or (
+                "p" if word in JAPANESE_PARTICLES
+                else "a" if word in JAPANESE_AUX else "c")
+        self._cat[word] = cat
+        super().add(word, freq)
+
+    def load(self, path: str, encoding: str = "utf-8") -> "JapaneseLexicon":
+        """``word``, ``word freq`` or ``word freq pos`` per line (pos ∈
+        c/p/a); ``#`` comments and blanks skipped."""
+        for word, freq, extra in _iter_dict_lines(path, encoding):
+            cat = extra[0] if extra and extra[0] in ("c", "p", "a") else None
+            self.add(word, freq, cat)
+        return self
+
+    def category(self, word: str) -> str:
+        return self._cat.get(word, "c")
+
+
+class _JapaneseLatticeSegmenter:
+    """Dictionary-lattice Viterbi segmentation — the Kuromoji algorithm
+    class (reference ``deeplearning4j-nlp-japanese/src/main/java/com/
+    atilika/kuromoji/viterbi/ViterbiBuilder.java`` + ``ViterbiSearcher``:
+    build a word lattice over the dictionary, add unknown-word edges by
+    character class, pick the min-cost path under word + connection costs)
+    without the 9k-LoC third-party bundle.
+
+    Mechanics, mirrored structurally (not translated):
+
+    - EDGES: every dictionary word starting at each position (one trie walk
+      via :meth:`Lexicon.match_lengths` — the Chinese lattice machinery),
+      with cost ``log(total) - log(freq+1)`` (unigram LM; the role of
+      IPADIC word costs).
+    - UNKNOWN EDGES: where the dictionary has no cover, candidates are
+      generated by CHARACTER CLASS like Kuromoji's ``UnknownDictionary``:
+      katakana and latin runs stay whole (loanwords, identifiers); kanji
+      and hiragana get edges of every length up to the same-script run end
+      (capped), costed ``UNK_BASE + UNK_PER_CHAR·len`` so any dictionary
+      cover beats them.
+    - CONNECTION COSTS: a small category matrix (content/particle/aux ×
+      same, plus BOS/EOS) stands in for IPADIC's 1316² context-id matrix.
+      It encodes what Japanese word order makes cheap — particle after
+      content, content after particle — and penalizes particle-after-
+      particle / content-after-content, which is exactly what
+      disambiguates すもももももももものうち into
+      すもも|も|もも|も|もも|の|うち (the alternating C-P-C-P… path) over
+      equal-word-count rivals.
+    - SEARCH: single left-to-right DP over (position, category) — Viterbi
+      on the lattice, O(n · edges-per-position · categories²).
+    """
+
+    #: connection cost [prev][next] over categories c/p/a (+ B start/E end)
+    _CONN = {
+        "B": {"c": 0.0, "p": 3.0, "a": 3.0},
+        "c": {"c": 1.0, "p": 0.0, "a": 0.0, "E": 0.0},
+        "p": {"c": 0.0, "p": 2.0, "a": 1.5, "E": 0.5},
+        "a": {"c": 0.5, "p": 0.5, "a": 1.0, "E": 0.0},
+    }
+    _UNK_BASE = 12.0
+    _UNK_PER_CHAR = 2.0
+    _UNK_MAX_LEN = 8          # cap unknown-edge fan-out per position
+
+    def __init__(self, lexicon: Optional[Iterable] = None):
+        # a JapaneseLexicon REPLACES the dictionary (caller takes full
+        # control); any other iterable MERGES into the seed entries — the
+        # lattice is useless without particle/aux/frequency structure
+        if isinstance(lexicon, JapaneseLexicon):
+            self.lexicon = lexicon
+        else:
+            self.lexicon = JapaneseLexicon(JAPANESE_SEED_ENTRIES)
+            if lexicon is not None:
+                for w in lexicon:
+                    self.lexicon.add(w) if isinstance(w, str) \
+                        else self.lexicon.add(*w)
+
+    def add(self, *words):
+        for w in words:
+            self.lexicon.add(w) if isinstance(w, str) \
+                else self.lexicon.add(*w)
+
+    def _edges(self, text: str, i: int,
+               logtot: float) -> List[Tuple[int, float, str]]:
+        """Outgoing lattice edges at position ``i`` → [(length, cost, cat)].
+        Dictionary edges + character-class unknown edges (always generated:
+        an out-of-vocabulary reading must be representable even where a
+        dictionary word also starts). ``logtot`` is hoisted to segment()
+        — the lexicon cannot change mid-segmentation."""
+        import math
+        lex = self.lexicon
+        out: List[Tuple[int, float, str]] = []
+        for L in lex.match_lengths(text, i):
+            w = text[i:i + L]
+            out.append((L, logtot - math.log(lex.freq(w) + 1),
+                        lex.category(w)))
+        cls = _script_class(text[i])
+        run_end = i
+        n = len(text)
+        while run_end < n and _script_class(text[run_end]) == cls:
+            run_end += 1
+        R = run_end - i
+        if cls in ("kata", "latin"):
+            # loanwords / identifiers: the whole run, one edge
+            out.append((R, self._UNK_BASE * 0.5 + self._UNK_PER_CHAR, "c"))
+        else:
+            seen = {L for L, _, _ in out}
+            for L in range(1, min(R, self._UNK_MAX_LEN) + 1):
+                if L not in seen:
+                    out.append((L, self._UNK_BASE + self._UNK_PER_CHAR * L,
+                                "c"))
+        return out
+
+    def segment(self, text: str) -> List[str]:
+        import math
+        n = len(text)
+        if n == 0:
+            return []
+        INF = float("inf")
+        lex = self.lexicon
+        logtot = math.log(lex.total_freq() + len(lex) + 1)
+        # best[i][cat] = (cost, back-pointer (prev_i, prev_cat, word))
+        best: List[Dict[str, Tuple[float, Optional[Tuple]]]] = \
+            [dict() for _ in range(n + 1)]
+        best[0]["B"] = (0.0, None)
+        for i in range(n):
+            if not best[i]:
+                continue
+            for L, wcost, cat in self._edges(text, i, logtot):
+                j = i + L
+                word = text[i:j]
+                for pcat, (pcost, _) in best[i].items():
+                    conn = self._CONN.get(pcat, self._CONN["c"]).get(cat, 1.0)
+                    cand = pcost + conn + wcost
+                    cur = best[j].get(cat, (INF, None))
+                    if cand < cur[0]:
+                        best[j][cat] = (cand, (i, pcat, word))
+        # EOS connection picks the final category
+        end_cat, end_cost = None, INF
+        for cat, (cost, _) in best[n].items():
+            total = cost + self._CONN.get(cat, self._CONN["c"]).get("E", 0.0)
+            if total < end_cost:
+                end_cat, end_cost = cat, total
+        out: List[str] = []
+        i, cat = n, end_cat
+        while i > 0:
+            _, back = best[i][cat]
+            pi, pcat, word = back
+            out.append(word)
+            i, cat = pi, pcat
+        out.reverse()
+        return out
+
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Script-run + particle-split Japanese tokenizer (contract of reference
-    ``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory.java`` over
-    bundled Kuromoji). Kanji runs are lexicon max-matched; hiragana runs are
-    greedily split into known particles (longest first) where possible."""
+    """Japanese tokenizer behind the reference's ``TokenizerFactory`` seam
+    (``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory.java`` over
+    bundled Kuromoji).
 
-    def __init__(self, lexicon: Optional[Iterable[str]] = None,
-                 dict_path: Optional[str] = None, bidirectional: bool = True):
+    ``algorithm="lattice"`` (default): dictionary-lattice Viterbi with
+    connection costs and character-class unknown words — the Kuromoji
+    algorithm class (see :class:`_JapaneseLatticeSegmenter`). Handles
+    okurigana words crossing script boundaries (好き, 食べる) and classic
+    ambiguities (すもももももももものうち).
+
+    ``algorithm="script"``: the legacy script-run heuristic (kanji runs
+    lexicon max-matched, ONE trailing particle peeled off hiragana runs) —
+    kept as the dependency-free fallback and for callers pinned to the old
+    behavior.
+
+    ``lexicon`` semantics differ by mode: in ``lattice`` mode a plain
+    iterable MERGES into the seed dictionary (the lattice needs particles,
+    auxiliaries and frequencies to function — an unweighted word list alone
+    would cripple it); pass a :class:`JapaneseLexicon` to take full control
+    of the dictionary instead. In ``script`` mode it REPLACES the seed,
+    as before."""
+
+    def __init__(self, lexicon: Optional[Iterable] = None,
+                 dict_path: Optional[str] = None, bidirectional: bool = True,
+                 algorithm: str = "lattice"):
         self._pre: Optional[TokenPreProcess] = None
-        self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
-                                       else JAPANESE_LEXICON,
-                                       bidirectional=bidirectional)
-        if dict_path is not None:
-            self._seg.lexicon.load(dict_path)
+        if algorithm not in ("lattice", "script"):
+            raise ValueError(f"unknown segmentation algorithm {algorithm!r}"
+                             " (expected 'lattice' or 'script')")
+        self._algorithm = algorithm
+        if algorithm == "lattice":
+            self._lat = _JapaneseLatticeSegmenter(lexicon)
+            if dict_path is not None:
+                self._lat.lexicon.load(dict_path)
+        else:
+            self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
+                                           else JAPANESE_LEXICON,
+                                           bidirectional=bidirectional)
+            if dict_path is not None:
+                self._seg.lexicon.load(dict_path)
         self._particles = sorted(JAPANESE_PARTICLES, key=len, reverse=True)
 
+    def add_words(self, *words):
+        """Extend the dictionary (Kuromoji user-dictionary seam). Entries
+        are words or ``(word, freq[, cat])`` tuples; in ``script`` mode the
+        category column is meaningless and ignored."""
+        if self._algorithm == "lattice":
+            self._lat.add(*words)
+        else:
+            for w in words:
+                if isinstance(w, str):
+                    self._seg.lexicon.add(w)
+                else:
+                    self._seg.lexicon.add(*w[:2])
+        return self
+
+    addWords = add_words
+
+    def load_dictionary(self, path: str):
+        """Merge a user dictionary file at runtime."""
+        lex = (self._lat.lexicon if self._algorithm == "lattice"
+               else self._seg.lexicon)
+        lex.load(path)
+        return self
+
+    loadDictionary = load_dictionary
+
     def _split_hiragana(self, run: str) -> List[str]:
-        """Peel ONE longest known particle off the END of the run (a hiragana
-        run after a kanji run is typically okurigana/content + a trailing
-        particle; compound tails like でした are single lexicon entries).
-        Splitting mid-word, or peeling repeatedly, would shred content words
-        like ありがとう / もも whose characters double as particles."""
+        """(script fallback) Peel ONE longest known particle off the END of
+        the run. Splitting mid-word, or peeling repeatedly, would shred
+        content words like ありがとう / もも whose characters double as
+        particles."""
         for p in self._particles:
             if run.endswith(p) and run != p:
                 return [run[:-len(p)], p]
@@ -414,6 +699,21 @@ class JapaneseTokenizerFactory(TokenizerFactory):
 
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
+        if self._algorithm == "lattice":
+            # lattice over maximal Japanese-script spans (han/hira/kata mixed
+            # — okurigana edges cross script boundaries); latin runs whole;
+            # space/punct separate
+            for is_ja, run in itertools.groupby(
+                    text, key=lambda ch: _script_class(ch)
+                    in ("han", "hira", "kata")):
+                chunk = "".join(run)
+                if is_ja:
+                    tokens.extend(self._lat.segment(chunk))
+                else:
+                    for sub, scls in _script_runs(chunk):
+                        if scls in ("latin", "hangul"):
+                            tokens.append(sub)
+            return self._finish(tokens)
         for run, cls in _script_runs(text):
             if cls == "han":
                 tokens.extend(self._seg.segment(run))

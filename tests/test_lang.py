@@ -217,3 +217,59 @@ def test_lexicon_match_lengths_all_edges():
     assert lex.match_lengths("abcdef", 0) == [2, 3, 4]
     assert lex.match_lengths("abcdef", 1) == [1]
     assert lex.match_lengths("xyz", 0) == []
+
+
+# ------------------------------------------------------- Japanese lattice
+def test_japanese_lattice_classic_ambiguity():
+    """The Kuromoji demo sentence: equal-word-count rival paths exist, and
+    only the connection costs (particle-after-content alternation) pick the
+    right one — exactly what the lattice adds over script-run splitting."""
+    f = JapaneseTokenizerFactory()          # lattice is the default
+    assert f.create("すもももももももものうち").get_tokens() == \
+        ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+
+
+def test_japanese_lattice_okurigana_crosses_scripts():
+    """Okurigana words (kanji+hiragana: 食べる, 好き) are single lattice
+    edges spanning the script boundary — the script-run fallback can never
+    produce these."""
+    f = JapaneseTokenizerFactory()
+    assert f.create("私は食べる").get_tokens() == ["私", "は", "食べる"]
+    toks = f.create("私は機械学習が好きです").get_tokens()
+    assert toks == ["私", "は", "機械学習", "が", "好き", "です"]
+
+
+def test_japanese_lattice_unknown_words():
+    """Character-class unknown handling: katakana loanwords stay whole
+    without dictionary entries; unknown kanji compounds survive as one
+    token; known content words still beat particle shredding."""
+    f = JapaneseTokenizerFactory()
+    assert f.create("テンソルの計算").get_tokens() == ["テンソル", "の", "計算"]
+    assert f.create("ありがとう").get_tokens() == ["ありがとう"]
+    assert f.create("ももが").get_tokens() == ["もも", "が"]
+
+
+def test_japanese_lattice_user_dictionary(tmp_path):
+    """Kuromoji user-dictionary seam: a 3-column (word freq pos) file
+    changes segmentation; runtime add_words with category tuples too."""
+    f = JapaneseTokenizerFactory()
+    # precondition: without the user dict, 朝焼け is not in the seed
+    # dictionary, so the user-dict assertions below prove something
+    assert "朝焼け" not in f.create("朝焼けの空").get_tokens()
+    d = tmp_path / "user.dict"
+    d.write_text("朝焼け 500 c\n空 400 c\n", encoding="utf-8")
+    f2 = JapaneseTokenizerFactory(dict_path=str(d))
+    assert f2.create("朝焼けの空").get_tokens() == ["朝焼け", "の", "空"]
+    f3 = JapaneseTokenizerFactory().add_words(("朝焼け", 500, "c"),
+                                              ("空", 400, "c"))
+    assert f3.create("朝焼けの空").get_tokens() == ["朝焼け", "の", "空"]
+
+
+def test_japanese_script_fallback_still_available():
+    """algorithm='script' pins the legacy dependency-free behavior."""
+    f = JapaneseTokenizerFactory(algorithm="script")
+    toks = f.create("私は機械学習が好きです").get_tokens()
+    assert "機械学習" in toks
+    import pytest
+    with pytest.raises(ValueError):
+        JapaneseTokenizerFactory(algorithm="nope")
