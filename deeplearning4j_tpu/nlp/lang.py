@@ -547,13 +547,15 @@ class _JapaneseLatticeSegmenter:
             self.lexicon.add(w) if isinstance(w, str) \
                 else self.lexicon.add(*w)
 
-    def _edges(self, text: str, i: int,
-               logtot: float) -> List[Tuple[int, float, str]]:
+    def _edges(self, text: str, i: int, logtot: float,
+               run_end: int) -> List[Tuple[int, float, str]]:
         """Outgoing lattice edges at position ``i`` → [(length, cost, cat)].
         Dictionary edges + character-class unknown edges (always generated:
         an out-of-vocabulary reading must be representable even where a
-        dictionary word also starts). ``logtot`` is hoisted to segment()
-        — the lexicon cannot change mid-segmentation."""
+        dictionary word also starts). ``logtot`` and ``run_end`` (end of
+        the same-script run containing ``i``) are hoisted to segment() —
+        the lexicon cannot change mid-segmentation, and rescanning the run
+        per position would make segmentation O(m²)."""
         import math
         lex = self.lexicon
         out: List[Tuple[int, float, str]] = []
@@ -562,10 +564,6 @@ class _JapaneseLatticeSegmenter:
             out.append((L, logtot - math.log(lex.freq(w) + 1),
                         lex.category(w)))
         cls = _script_class(text[i])
-        run_end = i
-        n = len(text)
-        while run_end < n and _script_class(text[run_end]) == cls:
-            run_end += 1
         R = run_end - i
         if cls in ("kata", "latin"):
             # loanwords / identifiers: the whole run, one edge
@@ -586,6 +584,14 @@ class _JapaneseLatticeSegmenter:
         INF = float("inf")
         lex = self.lexicon
         logtot = math.log(lex.total_freq() + len(lex) + 1)
+        # same-script run end per position, computed once (O(n))
+        run_end = [0] * n
+        pos = 0
+        for run, _cls in _script_runs(text):
+            end = pos + len(run)
+            for j in range(pos, end):
+                run_end[j] = end
+            pos = end
         # best[i][cat] = (cost, back-pointer (prev_i, prev_cat, word))
         best: List[Dict[str, Tuple[float, Optional[Tuple]]]] = \
             [dict() for _ in range(n + 1)]
@@ -593,7 +599,7 @@ class _JapaneseLatticeSegmenter:
         for i in range(n):
             if not best[i]:
                 continue
-            for L, wcost, cat in self._edges(text, i, logtot):
+            for L, wcost, cat in self._edges(text, i, logtot, run_end[i]):
                 j = i + L
                 word = text[i:j]
                 for pcat, (pcost, _) in best[i].items():
@@ -643,12 +649,21 @@ class JapaneseTokenizerFactory(TokenizerFactory):
     as before."""
 
     def __init__(self, lexicon: Optional[Iterable] = None,
-                 dict_path: Optional[str] = None, bidirectional: bool = True,
+                 dict_path: Optional[str] = None,
+                 bidirectional: Optional[bool] = None,
                  algorithm: str = "lattice"):
         self._pre: Optional[TokenPreProcess] = None
         if algorithm not in ("lattice", "script"):
             raise ValueError(f"unknown segmentation algorithm {algorithm!r}"
                              " (expected 'lattice' or 'script')")
+        if bidirectional is not None and algorithm == "lattice":
+            # a max-match knob makes no sense on the lattice; a caller
+            # passing it is pinned to the old behavior — fail loudly
+            # instead of silently segmenting differently
+            raise ValueError(
+                "bidirectional= only applies to algorithm='script' "
+                "(max-match); the lattice default ignores it — pass "
+                "algorithm='script' to keep the legacy behavior")
         self._algorithm = algorithm
         if algorithm == "lattice":
             self._lat = _JapaneseLatticeSegmenter(lexicon)
@@ -657,7 +672,9 @@ class JapaneseTokenizerFactory(TokenizerFactory):
         else:
             self._seg = _MaxMatchSegmenter(lexicon if lexicon is not None
                                            else JAPANESE_LEXICON,
-                                           bidirectional=bidirectional)
+                                           bidirectional=bidirectional
+                                           if bidirectional is not None
+                                           else True)
             if dict_path is not None:
                 self._seg.lexicon.load(dict_path)
         self._particles = sorted(JAPANESE_PARTICLES, key=len, reverse=True)

@@ -40,22 +40,43 @@ log = logging.getLogger(__name__)
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None):
+                           process_id: Optional[int] = None,
+                           heartbeat_timeout_s: Optional[int] = None,
+                           initialization_timeout_s: Optional[int] = None):
     """Form the multi-host cluster (replaces the reference's
     ``VoidParameterServer.init`` Aeron mesh handshake,
     ``SharedTrainingMaster.java:469``). No-op when single-process.
 
     On the CPU backend (tests / virtual clusters) cross-process collectives
-    need the gloo transport — configured automatically when available."""
+    need the gloo transport — configured automatically when available.
+
+    FAILURE SEMANTICS: the cluster is fate-shared, like the reference's
+    Spark stage — there is no in-framework elastic recovery (SURVEY.md §5:
+    the reference's only failure handling is RDD-lineage retry OUTSIDE the
+    training step). What the framework guarantees is DETECTION, not
+    resurrection: when a peer dies, the coordination service notices within
+    ``heartbeat_timeout_s`` (the barrier/collective path raises a
+    distributed-runtime error naming the dead/timed-out peer) and survivors
+    FAIL CLEANLY instead of hanging — catch the error, checkpoint if
+    appropriate, and let the job scheduler relaunch the whole cluster
+    (resume via ``ModelSerializer`` exact-restore). Lower
+    ``heartbeat_timeout_s`` (default 100 s upstream) to shrink
+    detection latency; see ``tests/test_multiprocess.py``
+    ``test_killed_worker_fails_cleanly`` for the pinned behavior."""
     if coordinator_address is None:
         return False
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass  # TPU backends use ICI/DCN natively
+    kw = {}
+    if heartbeat_timeout_s is not None:
+        kw["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)
+    if initialization_timeout_s is not None:
+        kw["initialization_timeout"] = int(initialization_timeout_s)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kw)
     return True
 
 

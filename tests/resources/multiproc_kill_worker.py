@@ -1,0 +1,91 @@
+"""Worker for the killed-worker failure-semantics test: N processes form a
+cluster and train; the victim process exits abruptly mid-fit (SIGKILL-style
+``os._exit``), and every SURVIVOR must fail CLEANLY and ATTRIBUTABLY — a
+raised distributed-runtime error within the heartbeat window, never a hang.
+
+The framework's failure contract (``initialize_distributed`` docstring): the
+cluster is fate-shared like the reference's Spark stage; the guarantee is
+fast DETECTION + clean failure, with relaunch/resume delegated to the job
+scheduler + ``ModelSerializer`` exact-restore.
+
+Usage: python multiproc_kill_worker.py <pid> <nproc> <port> <outdir>
+"""
+import sys
+import os
+import time
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), sys.argv[4])
+VICTIM = nproc - 1
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from deeplearning4j_tpu.parallel import (initialize_distributed,
+                                         ParallelWrapper, TrainingMode)
+
+initialize_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                       process_id=pid, heartbeat_timeout_s=10)
+
+import numpy as np
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+conf = (NeuralNetConfiguration.builder().seed(3)
+        .updater(Sgd(learning_rate=5e-2)).activation("tanh")
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=16))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+rng = np.random.default_rng(0)
+
+
+def batch():
+    f = rng.normal(size=(nproc * 4, 6)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, nproc * 4)]
+    lo, hi = pid * 4, (pid + 1) * 4
+    return DataSet(f[lo:hi], l[lo:hi])
+
+
+pw = (ParallelWrapper.Builder(net)
+      .training_mode(TrainingMode.AVERAGING).averaging_frequency(1).build())
+
+# one healthy step so the cluster is proven working before the kill
+pw.fit(ListDataSetIterator([batch()]))
+with open(os.path.join(outdir, f"kill_alive_{pid}.txt"), "w") as fh:
+    fh.write(f"{pw.last_score}")
+
+if pid == VICTIM:
+    os._exit(13)          # abrupt death: no shutdown, no goodbye
+
+# survivors: keep training. The framework's detection contract gives each
+# survivor ONE of two prompt, attributable ends (see initialize_distributed):
+#   (a) the in-flight collective raises a catchable JaxRuntimeError naming
+#       the broken transport — handled here: write evidence, exit 0;
+#   (b) the distributed runtime's error-polling thread fatal-terminates the
+#       process with a log naming the dead task's heartbeat timeout — the
+#       test reads that evidence from captured stderr instead.
+# Neither path may hang.
+t0 = time.monotonic()
+try:
+    for i in range(2000):
+        pw.fit(ListDataSetIterator([batch()]))
+    status, detail = "no_failure", ""
+except BaseException as e:      # noqa: BLE001 - any raise is a clean fail
+    status = "raised"
+    detail = f"{type(e).__name__}: {e}"
+dt = time.monotonic() - t0
+with open(os.path.join(outdir, f"kill_result_{pid}.txt"), "w") as fh:
+    fh.write(f"{status}\t{dt:.1f}\t{detail[:500]}")
+print("survivor", pid, status, f"{dt:.1f}s", detail[:200], flush=True)
+# skip the doomed atexit shutdown barrier (the cluster is already broken;
+# a real job would checkpoint and exit here) — path (a) ends CLEANLY
+os._exit(0 if status == "raised" else 4)
