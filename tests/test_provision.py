@@ -59,3 +59,198 @@ def test_runner_injection_executes_commands():
     assert p.create(run=True) == "ok"
     assert p.delete(run=True) == "ok"
     assert calls[0][4] == "create" and calls[1][4] == "delete"
+
+
+# ----------------------------------------------------- lifecycle rehearsal
+class _FakeCloud:
+    """Scripted executor standing in for gcloud/gsutil: tracks pod
+    existence, returns READY after a configurable number of describes, and
+    can be told to fail specific commands — the rehearsal surface for the
+    full ClusterSetup.java-style lifecycle."""
+
+    def __init__(self, ready_after=2, fail_on=None):
+        self.calls = []
+        self.exists = False
+        self.describes = 0
+        self.ready_after = ready_after
+        self.fail_on = fail_on or (lambda cmd: False)
+
+    def __call__(self, cmd):
+        import types
+        self.calls.append(cmd)
+        if self.fail_on(cmd):
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="injected failure")
+        verb = cmd[4] if cmd[:4] == ["gcloud", "compute", "tpus",
+                                     "tpu-vm"] else cmd[0]
+        if verb == "create":
+            self.exists = True
+            return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+        if verb == "delete":
+            self.exists = False
+            return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+        if verb == "describe":
+            if not self.exists:
+                return types.SimpleNamespace(returncode=1, stdout="",
+                                             stderr="NOT_FOUND")
+            self.describes += 1
+            state = ("state: READY" if self.describes >= self.ready_after
+                     else "state: CREATING")
+            return types.SimpleNamespace(returncode=0, stdout=state,
+                                         stderr="")
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+    def verbs(self):
+        return [c[4] if c[:4] == ["gcloud", "compute", "tpus", "tpu-vm"]
+                else c[0] for c in self.calls]
+
+
+def _lifecycle(tmp_path, cloud, **kw):
+    from deeplearning4j_tpu.provision import PodLifecycle
+    setup = ClusterSetup(TpuPodProvisioner(_cfg()), train_script="train.py",
+                         env={"JAX_PLATFORMS": "tpu"})
+    return PodLifecycle(
+        setup, stager=GcsStager("gs://bkt/data"), datasets=["imagenet"],
+        setup_commands=["pip install deeplearning4j_tpu"],
+        journal_path=str(tmp_path / "journal.json"), executor=cloud,
+        poll_interval_s=0.0, ready_timeout_s=30.0, **kw)
+
+
+def test_lifecycle_full_bringup_ordering_and_teardown(tmp_path):
+    """create → wait-ready (polls until READY) → provision all hosts →
+    stage data → launch, strictly in order; teardown deletes and is
+    idempotent on a gone pod."""
+    cloud = _FakeCloud(ready_after=3)
+    lc = _lifecycle(tmp_path, cloud)
+    ran = lc.bringup()
+    assert ran == ["create", "wait_ready", "provision", "stage_data",
+                   "launch"]
+    v = cloud.verbs()
+    # describe (exists?) precedes create; polling describes follow; then
+    # scp upload, ssh setup, ssh gsutil staging, ssh launch
+    assert v[0] == "describe" and v[1] == "create"
+    assert v.count("describe") >= 4            # exists-probe + 3 polls
+    first_ssh = v.index("scp")
+    assert all(x == "describe" for x in v[2:first_ssh])
+    assert v[first_ssh:] == ["scp", "ssh", "ssh", "ssh"]
+    # the staged dataset ends up in the fetchers' data dir on every host
+    stage_cmd = cloud.calls[-2]
+    assert "gsutil" in stage_cmd[stage_cmd.index("--command") + 1]
+    launch = cloud.calls[-1]
+    assert launch[launch.index("--command") + 1] == \
+        "JAX_PLATFORMS=tpu python3 train.py"
+
+    lc.teardown()
+    assert cloud.verbs()[-1] == "delete" and not cloud.exists
+    lc.teardown()                              # idempotent: no second delete
+    assert cloud.verbs().count("delete") == 1
+
+
+def test_lifecycle_reentry_skips_completed_steps(tmp_path):
+    """Idempotent re-entry: a second bringup() with an intact journal runs
+    NOTHING; after a mid-flight failure, re-entry resumes at the failed
+    step without re-creating the pod."""
+    cloud = _FakeCloud(ready_after=1)
+    lc = _lifecycle(tmp_path, cloud)
+    assert lc.bringup() == list(lc.STEPS)
+    n_calls = len(cloud.calls)
+    assert lc.bringup() == []                  # fully journaled: no-op
+    # only the journal-trust existence probe hits the cloud, nothing else
+    assert len(cloud.calls) == n_calls + 1
+    assert cloud.verbs()[-1] == "describe"
+
+    # fresh journal + failure during provision (scp): create/wait succeed,
+    # bringup raises, journal holds the completed prefix
+    cloud2 = _FakeCloud(ready_after=1,
+                        fail_on=lambda cmd: "scp" in cmd)
+    lc2 = _lifecycle(tmp_path / "b", cloud2)
+    (tmp_path / "b").mkdir()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="provision"):
+        lc2.bringup()
+    # heal the cloud; re-entry must NOT re-create (exists + journaled),
+    # must resume at provision
+    cloud2.fail_on = lambda cmd: False
+    ran = lc2.bringup()
+    assert ran == ["provision", "stage_data", "launch"]
+    assert cloud2.verbs().count("create") == 1
+
+
+def test_lifecycle_edited_step_reruns(tmp_path):
+    """Changing a step's commands invalidates its journal hash: only that
+    step (and nothing before it) re-runs."""
+    cloud = _FakeCloud(ready_after=1)
+    lc = _lifecycle(tmp_path, cloud)
+    lc.bringup()
+    lc.setup_commands.append("pip install extra-dep")   # edit provision
+    ran = lc.bringup()
+    assert ran == ["provision"]
+
+
+def test_lifecycle_double_create_guard(tmp_path):
+    """A pod that already exists (another operator / crashed run with a
+    lost journal) is never double-created."""
+    cloud = _FakeCloud(ready_after=1)
+    cloud.exists = True                        # pre-existing pod
+    lc = _lifecycle(tmp_path, cloud)
+    ran = lc.bringup()
+    assert ran == list(lc.STEPS)               # steps run (fresh journal)...
+    assert "create" not in cloud.verbs()       # ...but no create command
+
+
+def test_lifecycle_ready_timeout(tmp_path):
+    """A pod that never reaches READY fails loudly within the budget."""
+    cloud = _FakeCloud(ready_after=10**9)
+    lc = _lifecycle(tmp_path, cloud)
+    lc.ready_timeout_s = 0.2
+    import pytest as _pytest
+    with _pytest.raises(TimeoutError, match="READY"):
+        lc.bringup()
+
+
+def test_lifecycle_preempted_pod_invalidates_journal(tmp_path):
+    """A completed journal is only trusted while the pod exists: after an
+    external delete/preemption, bringup() starts over instead of reporting
+    a dead pod as up."""
+    cloud = _FakeCloud(ready_after=1)
+    lc = _lifecycle(tmp_path, cloud)
+    assert lc.bringup() == list(lc.STEPS)
+    cloud.exists = False                       # preempted behind our back
+    cloud.describes = 0
+    ran = lc.bringup()
+    assert ran == list(lc.STEPS)               # full re-bring-up
+    assert cloud.verbs().count("create") == 2
+
+
+def test_lifecycle_honors_provisioner_runner(tmp_path):
+    """A runner injected on TpuPodProvisioner (the pre-existing seam) is
+    used by PodLifecycle too — auth wrappers are not silently bypassed."""
+    import types
+    from deeplearning4j_tpu.provision import PodLifecycle
+    calls = []
+
+    def auth_runner(cmd):
+        calls.append(cmd)
+        if cmd[4] == "describe":
+            return types.SimpleNamespace(returncode=0, stdout="state: READY",
+                                         stderr="")
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+    prov = TpuPodProvisioner(_cfg(), runner=auth_runner)
+    lc = PodLifecycle(ClusterSetup(prov, train_script="t.py"),
+                      journal_path=str(tmp_path / "j.json"),
+                      poll_interval_s=0.0)
+    lc.bringup()
+    assert calls, "provisioner runner must receive the lifecycle commands"
+
+
+def test_lifecycle_stage_data_home_expansion(tmp_path):
+    """The staged destination keeps $HOME expandable on the remote shell
+    (a single-quoted literal '~' would stage into the wrong directory)."""
+    cloud = _FakeCloud(ready_after=1)
+    lc = _lifecycle(tmp_path, cloud)
+    [cmd] = lc._step_commands("stage_data")
+    remote = cmd[cmd.index("--command") + 1]
+    assert '"$HOME"' in remote and "'~" not in remote
+    assert remote.startswith("mkdir -p ")
+    assert "gs://bkt/data/imagenet" in remote
