@@ -415,6 +415,32 @@ class ParallelWrapper:
         else:
             net.updater_state = put(net.updater_state)
 
+    def _resolve_score(self, pending):
+        """Resolve a deferred ``(loss, iteration_idx)`` score fetch. The
+        value fetch is THE device-sync point (axon ``block_until_ready`` is
+        unreliable — see StepTimerListener), so it is deferred by exactly
+        one step: when it blocks here, the NEXT step's host→device transfer
+        and dispatch are already enqueued, overlapping H2D with compute —
+        the device-side half of the AsyncDataSetIterator promise
+        (reference ``ParallelWrapper.java:468-516`` keeps workers busy via
+        queues; XLA's async dispatch plays that role, and an eager per-step
+        ``float(loss)`` would serialize it away).
+
+        Deferral only happens with NO listeners attached (the bench/
+        throughput shape): a deferred callback would hand listeners a model
+        whose params/iteration_count had already advanced one step
+        (CheckpointListener would save the wrong params under the label,
+        ParamAndGradient would attribute the wrong delta), so with
+        listeners the fetch stays eager and exact."""
+        if pending is None:
+            return
+        loss, idx = pending
+        v = float(loss)
+        self.last_score = v
+        net = self.net
+        for lst in net.listeners:
+            lst.iteration_done(net, idx, v)
+
     def _fit_sync(self, it):
         """AVERAGING freq=1 / SHARED_GRADIENTS: fused psum step per global
         batch (the reference's per-iteration averaging ≡ gradient all-reduce).
@@ -424,28 +450,47 @@ class ParallelWrapper:
         batch per parallel iteration, so ``workers_`` iterator batches are
         merged into the global batch of a step. A tail group smaller than
         ``workers_`` is still trained (sharded across all devices) so no data
-        is dropped."""
+        is dropped.
+
+        The per-step score fetch is double-buffered (``_resolve_score``)
+        when no listeners are attached: step k's H2D + dispatch are
+        enqueued before step k-1's loss is fetched, so the host link
+        streams the next global batch while the chip computes the current
+        one. With listeners the fetch is eager (exact model state per
+        callback — see ``_resolve_score``)."""
         net = self.net
         step = self._ensure_sync_step()
         self._device_put_model()
-        for group in self._batch_groups(it):
-            if group is None:
-                continue  # tail handled unsharded by _batch_groups
-            f, l, fm, lm = self._global_batch(group)
-            if self._tbptt_applicable(f):
-                self._fit_sync_tbptt(f, l, fm, lm)
-                continue
-            itc = jnp.asarray(net.iteration_count, jnp.int32)
-            key = put_replicated(net._next_rng(), self.mesh)
-            net.params, net.states, net.updater_state, loss = step(
-                net.params, net.states, net.updater_state, itc, key, f, l,
-                fm, lm)
-            self.last_score = float(loss)
-            net.score_ = loss
-            net.iteration_count += 1
-            self.iteration_count += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration_count - 1, float(loss))
+        pending = None
+        try:
+            for group in self._batch_groups(it):
+                if group is None:
+                    continue  # tail handled unsharded by _batch_groups
+                f, l, fm, lm = self._global_batch(group)
+                if self._tbptt_applicable(f):
+                    prev, pending = pending, None
+                    self._resolve_score(prev)
+                    self._fit_sync_tbptt(f, l, fm, lm)
+                    continue
+                itc = jnp.asarray(net.iteration_count, jnp.int32)
+                key = put_replicated(net._next_rng(), self.mesh)
+                net.params, net.states, net.updater_state, loss = step(
+                    net.params, net.states, net.updater_state, itc, key, f, l,
+                    fm, lm)
+                net.score_ = loss
+                net.iteration_count += 1
+                self.iteration_count += 1
+                cur = (loss, net.iteration_count - 1)
+                if net.listeners:
+                    self._resolve_score(cur)       # eager: exact state
+                else:
+                    # clear BEFORE resolving: a raise mid-resolve must not
+                    # let the finally replay the same iteration
+                    prev, pending = pending, cur
+                    self._resolve_score(prev)
+        finally:
+            prev, pending = pending, None
+            self._resolve_score(prev)
 
     def _batch_groups(self, it):
         """Yield groups of iterator batches (reference round-robin dispatch):

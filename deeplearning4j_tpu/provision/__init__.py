@@ -268,20 +268,24 @@ class PodLifecycle:
         if step == "stage_data":
             if not (self.stager and self.datasets):
                 return []
-            out = []
-            for name in self.datasets:
-                dst = f"{self.data_dir}/{name}"
+            def expr(path: str) -> str:
                 # '~' must reach the REMOTE shell expandable: single-quoting
                 # it would stage into a literal './~' dir while the fetchers
                 # expanduser() to the real home — use "$HOME" + quoted rest
-                if dst.startswith("~/"):
-                    dst_expr = '"$HOME"' + shlex.quote(dst[1:])
-                else:
-                    dst_expr = shlex.quote(dst)
+                if path.startswith("~/"):
+                    return '"$HOME"' + shlex.quote(path[1:])
+                return shlex.quote(path)
+
+            out = []
+            for name in self.datasets:
+                dst = f"{self.data_dir}/{name}"
                 parts = self.stager.download_command(name, dst)
-                cmd = " ".join(map(shlex.quote, parts[:-1]) ) + " " + dst_expr
+                cmd = " ".join(map(shlex.quote, parts[:-1])) + " " + expr(dst)
+                # mkdir the PARENT (data dir) only: pre-creating dst itself
+                # would make `gsutil cp -r` nest the dataset one level too
+                # deep (<dst>/<name>/...), invisible to the fetchers
                 out.append(self.hosts.run_command(
-                    f"mkdir -p {dst_expr} && {cmd}"))
+                    f"mkdir -p {expr(self.data_dir)} && {cmd}"))
             return out
         if step == "launch":
             return [self.setup.launch_command()]
@@ -299,7 +303,21 @@ class PodLifecycle:
                                          stderr=e.stderr or "")
 
     def _pod_exists(self) -> bool:
-        return self._describe().returncode == 0
+        """True/False from describe — but a TRANSIENT failure (auth, rate
+        limit, network) is neither: treating it as 'gone' would wipe the
+        journal and re-launch the job on a live pod, so anything that
+        isn't an explicit not-found raises instead."""
+        r = self._describe()
+        if r.returncode == 0:
+            return True
+        err = (getattr(r, "stderr", "") or "").lower()
+        if "not_found" in err or "not found" in err or "404" in err:
+            return False
+        raise RuntimeError(
+            f"describe failed transiently (rc={r.returncode}): "
+            f"{err[-300:] or 'no stderr'} — cannot tell whether pod "
+            f"{self.provisioner.config.name!r} exists; retry when the "
+            f"control plane answers")
 
     def _run_step(self, step: str):
         if step == "create":

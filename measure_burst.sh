@@ -92,12 +92,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 1200 python perf_lstm.py roofline
     need ab       && probe && run_stage ab \
                      timeout 1800 python perf_lstm.py ab
+    # r5: U-cap sweep (fresh subprocess per U — trace-time knob);
+    # budget: 6 Us x <=900s child timeout + slack
+    need unroll   && probe && run_stage unroll \
+                     timeout 6000 python perf_lstm.py unroll
     need sweep    && probe && run_stage sweep \
                      timeout 2400 python perf_lstm.py sweep
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
      [ -f "$STATE/transformer.ok" ] && [ -f "$STATE/inception2.ok" ] && \
-     [ -f "$STATE/lstm2.ok" ] && \
+     [ -f "$STATE/lstm2.ok" ] && [ -f "$STATE/unroll.ok" ] && \
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
      [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="

@@ -96,6 +96,49 @@ def kernel_ab(batch=64, width=512, tbptt=50, seq_len=200):
             os.environ["DL4J_TPU_NO_PERSISTENT_LSTM"] = prior
 
 
+def unroll_sweep(batch=64, width=512, tbptt=50, seq_len=200):
+    """VERDICT r4 item 3: sweep DL4J_TPU_LSTM_UNROLL (U timesteps per
+    pallas grid step) to find where the sequential-latency division
+    saturates. Each U runs in a FRESH SUBPROCESS — the knob is trace-time
+    (ops/lstm_cell.py::_unroll_factor), so an in-process sweep would
+    silently reuse the first U's compiled step. U candidates divide
+    tbptt=50; the kernel itself shrinks U when VMEM doesn't fit, so what
+    we sweep is the CAP."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    print(f"{'U':>4} {'chars/s':>12} {'vs U=1':>8}")
+    base = None
+    for u in (1, 2, 5, 10, 25, 50):
+        env = dict(os.environ, DL4J_TPU_LSTM_UNROLL=str(u))
+        try:
+            p = subprocess.run(
+                [_sys.executable, os.path.abspath(__file__), "measure-one",
+                 str(batch), str(width), str(tbptt), str(seq_len)],
+                capture_output=True, text=True, env=env, timeout=900)
+        except subprocess.TimeoutExpired:
+            # per-U failures are non-fatal by design: a hung U must not
+            # abort the rest of the sweep (nor wedge the burst stage)
+            print(f"{u:>4} FAILED timeout 900s", flush=True)
+            continue
+        line = None
+        for ln in reversed((p.stdout or "").splitlines()):
+            try:
+                line = _json.loads(ln)
+                break
+            except ValueError:
+                continue
+        if p.returncode or not line:
+            print(f"{u:>4} FAILED rc={p.returncode} "
+                  f"{(p.stderr or '')[-200:]}", flush=True)
+            continue
+        r = line["chars_per_sec"]
+        if u == 1:
+            base = r            # the column is "vs U=1", never a rebase
+        ratio = f"{r / base:>7.2f}x" if base else "    n/a"
+        print(f"{u:>4} {r:>12,.0f} {ratio}", flush=True)
+
+
 def sweep():
     print(f"{'batch':>6} {'width':>6} {'tbptt':>6} {'chars/s':>12}")
     for batch in (64, 128, 256, 512):
@@ -157,6 +200,14 @@ if __name__ == "__main__":
     cmd = sys.argv[1] if len(sys.argv) > 1 else "sweep"
     if cmd == "sweep":
         sweep()
+    elif cmd == "unroll":
+        unroll_sweep()
+    elif cmd == "measure-one":
+        # unroll_sweep child: one measurement, one JSON line
+        import json as _json
+        b, w, t, s = (int(x) for x in sys.argv[2:6])
+        print(_json.dumps({"chars_per_sec": measure(batch=b, width=w,
+                                                    tbptt=t, seq_len=s)}))
     elif cmd == "ab":
         kernel_ab()
     elif cmd == "roofline":
