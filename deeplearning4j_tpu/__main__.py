@@ -1,0 +1,4 @@
+"""``python -m deeplearning4j_tpu`` → the operational CLI (main.py)."""
+from .main import main
+
+raise SystemExit(main())

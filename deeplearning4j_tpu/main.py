@@ -1,0 +1,213 @@
+"""Operational CLI — the reference's ``ParallelWrapperMain`` (
+``deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/src/main/
+java/org/deeplearning4j/parallelism/main/ParallelWrapperMain.java``: load a
+model file, build a data iterator from a factory, train it under
+ParallelWrapper with arg-controlled workers/averaging, save the result,
+optionally post stats to a UI) as a TPU-native entry point:
+
+    python -m deeplearning4j_tpu train \
+        --model-path model.zip --model-output-path trained.zip \
+        --data mnist --epochs 2 --averaging-frequency 1 --report-score
+
+Differences from the reference, by design:
+- ``--workers`` is advisory: the device mesh defines parallelism (every
+  addressable device trains; the reference's per-GPU worker threads are an
+  artifact of its dispatch model). A value != device count warns.
+- ``--data`` names a built-in dataset (mnist/emnist/iris/cifar) or
+  ``--data-factory module:callable`` imports a factory returning a
+  DataSetIterator — the Python spelling of ``dataSetIteratorFactoryClazz``.
+- Multi-host: ``--coordinator host:port --num-processes N --process-id i``
+  forms the jax.distributed cluster first (``initialize_distributed``).
+- ``serve-ui`` starts the training UI server over a stats file the run
+  wrote (``--stats-file``), standing in for the reference's play UI.
+
+Both reference camelCase flags (``--modelPath``) and kebab-case work.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _factory(spec: str):
+    """``module:callable`` → the callable's return value (the Python
+    spelling of the reference's dataSetIteratorFactoryClazz)."""
+    mod, _, fn = spec.partition(":")
+    if not fn:
+        raise SystemExit(f"--data-factory needs module:callable, got {spec!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), fn)()
+
+
+def _builtin_data(name: str, batch_size: int, num_examples=None,
+                  train: bool = True):
+    from .datasets.impl import (MnistDataSetIterator, EmnistDataSetIterator,
+                                IrisDataSetIterator, CifarDataSetIterator)
+    name = name.lower()
+    if name == "mnist":
+        return MnistDataSetIterator(batch_size, num_examples, train=train)
+    if name.startswith("emnist"):
+        # emnist or emnist-<split> (balanced/byclass/bymerge/digits/letters)
+        split = name.partition("-")[2] or "balanced"
+        return EmnistDataSetIterator(split, batch_size, num_examples,
+                                     train=train)
+    if name == "iris":
+        return IrisDataSetIterator(batch_size, num_examples or 150)
+    if name == "cifar":
+        return CifarDataSetIterator(batch_size, num_examples, train=train)
+    raise SystemExit(f"unknown --data {name!r} (mnist/emnist/iris/cifar, "
+                     f"or use --data-factory module:callable)")
+
+
+def _add_train_args(p: argparse.ArgumentParser):
+    # required pair, exactly like the reference
+    p.add_argument("--model-path", "--modelPath", required=True,
+                   help="model to train: DL4J zip, Keras .h5, or config "
+                        "JSON (ModelGuesser sniffs the format)")
+    p.add_argument("--model-output-path", "--modelOutputPath", required=True,
+                   help="where the trained model zip is written")
+    p.add_argument("--data", default=None,
+                   help="built-in dataset: mnist/emnist/iris/cifar")
+    p.add_argument("--data-factory", "--dataSetIteratorFactory", default=None,
+                   help="module:callable returning a DataSetIterator")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-examples", type=int, default=None,
+                   help="cap the built-in dataset size")
+    p.add_argument("--workers", type=int, default=None,
+                   help="advisory; the device mesh defines parallelism")
+    p.add_argument("--prefetch-size", "--prefetchSize", type=int, default=16)
+    p.add_argument("--averaging-frequency", "--averagingFrequency",
+                   type=int, default=1)
+    p.add_argument("--report-score", "--reportScore", action="store_true")
+    p.add_argument("--no-average-updaters", dest="average_updaters",
+                   action="store_false", default=True)
+    p.add_argument("--mode", choices=("averaging", "shared_gradients"),
+                   default="averaging")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3-style sharded param+optimizer storage")
+    p.add_argument("--weight-update-sharding", action="store_true",
+                   help="ZeRO-1-style sharded optimizer state")
+    p.add_argument("--ui-url", "--uiUrl", default=None,
+                   help="host:port of a UI server to post stats to")
+    p.add_argument("--stats-file", default=None,
+                   help="write training stats to this sqlite/json file "
+                        "(serve later with `serve-ui`)")
+    # multi-host cluster formation
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+
+
+def cmd_train(args) -> int:
+    import jax
+    from .parallel import (ParallelWrapper, TrainingMode,
+                           initialize_distributed, is_chief)
+    from .utils.model_guesser import ModelGuesser
+    from .utils.model_serializer import ModelSerializer
+    from .optimize.listeners import ScoreIterationListener
+
+    if args.coordinator:
+        initialize_distributed(args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+    n_dev = len(jax.devices())
+    if args.workers and args.workers != n_dev:
+        print(f"# --workers {args.workers} is advisory: the mesh has "
+              f"{n_dev} devices and all of them train", file=sys.stderr)
+
+    # data first: bad --data args fail fast, before the (possibly large)
+    # model load
+    data = (_factory(args.data_factory) if args.data_factory
+            else _builtin_data(args.data or "mnist", args.batch_size,
+                               args.num_examples))
+
+    net = ModelGuesser.load_model_guess(args.model_path)
+
+    listeners = []
+    if args.report_score:
+        listeners.append(ScoreIterationListener(1))
+    if args.ui_url or args.stats_file:
+        from .ui import (StatsListener, FileStatsStorage,
+                         RemoteUIStatsStorageRouter)
+        if args.ui_url:
+            url = args.ui_url
+            if "://" not in url:
+                url = f"http://{url}"
+            listeners.append(StatsListener(RemoteUIStatsStorageRouter(url)))
+        if args.stats_file:
+            listeners.append(StatsListener(FileStatsStorage(args.stats_file)))
+    if listeners:
+        net.set_listeners(*listeners)
+
+    if not args.average_updaters:
+        # reference knob with no seam here: updater-state averaging is
+        # fused into the jitted step (freq>1 pmean), not a separate pass
+        print("# --no-average-updaters has no effect: updater averaging "
+              "is fused into the step", file=sys.stderr)
+    mode = (TrainingMode.SHARED_GRADIENTS
+            if args.mode == "shared_gradients" else TrainingMode.AVERAGING)
+    b = (ParallelWrapper.Builder(net)
+         .training_mode(mode)
+         .averaging_frequency(args.averaging_frequency)
+         .prefetch_buffer(args.prefetch_size))
+    if args.report_score:
+        b = b.report_score_after_averaging()
+    if args.fsdp:
+        b = b.fsdp()
+    if args.weight_update_sharding:
+        b = b.weight_update_sharding()
+    pw = b.build()
+    pw.fit(data, epochs=args.epochs)
+
+    if args.fsdp or args.weight_update_sharding:
+        pw.gather_model()
+    if is_chief():
+        ModelSerializer.write_model(net, args.model_output_path,
+                                    save_updater=True)
+        print(f"model written to {args.model_output_path} "
+              f"(last score {pw.last_score})")
+    return 0
+
+
+def cmd_serve_ui(args) -> int:
+    import time
+    from .ui import UIServer, FileStatsStorage, InMemoryStatsStorage
+    storage = (FileStatsStorage(args.stats_file) if args.stats_file
+               else InMemoryStatsStorage())
+    server = UIServer.get_instance()
+    server.attach(storage)
+    port = server.start(args.port)         # /remote receiver included
+    print(f"training UI on http://127.0.0.1:{port}", flush=True)
+    try:
+        while True:                        # serve_forever runs in a thread
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu",
+        description="TPU-native DL4J operational entry points")
+    sub = p.add_subparsers(dest="command", required=True)
+    t = sub.add_parser("train",
+                       help="ParallelWrapperMain: train a model file over "
+                            "all devices")
+    _add_train_args(t)
+    t.set_defaults(fn=cmd_train)
+    s = sub.add_parser("serve-ui", help="serve the training UI")
+    s.add_argument("--stats-file", default=None)
+    s.add_argument("--port", type=int, default=9000)
+    s.set_defaults(fn=cmd_serve_ui)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

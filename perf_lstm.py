@@ -16,6 +16,7 @@ Structural lever already landed in round 4 (no hardware needed to justify):
 `lax.scan` program — a T=200/L=50 batch now costs ONE device dispatch
 instead of four (each ~5 ms over the tunnel).
 """
+import os
 import sys
 import time
 

@@ -1,0 +1,110 @@
+"""Operational CLI (reference ``ParallelWrapperMain.java``: model file in,
+arg-controlled ParallelWrapper training, model file out)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.main import main, build_parser
+
+
+def _write_model(path):
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=5e-2)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ModelSerializer.write_model(net, str(path))
+    return net
+
+
+def test_train_subcommand_end_to_end(tmp_path):
+    """model zip in → trained zip out, through the real arg surface
+    (ParallelWrapperMain contract), with stats written to a file the
+    serve-ui subcommand can serve."""
+    model = tmp_path / "model.zip"
+    out = tmp_path / "trained.zip"
+    stats = tmp_path / "stats.db"
+    _write_model(model)
+    rc = main(["train", "--model-path", str(model),
+               "--model-output-path", str(out),
+               "--data", "mnist", "--num-examples", "256",
+               "--batch-size", "32", "--epochs", "1",
+               "--report-score", "--stats-file", str(stats)])
+    assert rc == 0
+    assert out.exists() and stats.exists()
+
+    from deeplearning4j_tpu.utils.model_guesser import ModelGuesser
+    net = ModelGuesser.load_model_guess(str(out))
+    assert net.iteration_count > 0
+
+    from deeplearning4j_tpu.ui import FileStatsStorage
+    storage = FileStatsStorage(str(stats))
+    sids = storage.list_session_ids()
+    assert sids, "training must have recorded stats sessions"
+
+
+def test_train_camelcase_flags_and_factory(tmp_path, monkeypatch):
+    """Reference spellings (--modelPath etc.) parse; --data-factory imports
+    module:callable like dataSetIteratorFactoryClazz."""
+    model = tmp_path / "m.zip"
+    out = tmp_path / "t.zip"
+    _write_model(model)
+
+    factory_mod = tmp_path / "myfactory.py"
+    factory_mod.write_text(
+        "import numpy as np\n"
+        "from deeplearning4j_tpu.datasets.dataset import (DataSet,\n"
+        "    ListDataSetIterator)\n"
+        "def make():\n"
+        "    rng = np.random.default_rng(0)\n"
+        "    ds = [DataSet(rng.normal(size=(32, 784)).astype(np.float32),\n"
+        "                  np.eye(10, dtype=np.float32)[\n"
+        "                      rng.integers(0, 10, 32)])\n"
+        "          for _ in range(4)]\n"
+        "    return ListDataSetIterator(ds)\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    rc = main(["train", "--modelPath", str(model),
+               "--modelOutputPath", str(out),
+               "--data-factory", "myfactory:make", "--epochs", "1"])
+    assert rc == 0 and out.exists()
+
+
+def test_parser_errors():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["train"])          # missing required
+    with pytest.raises(SystemExit):
+        main(["train", "--model-path", "x", "--model-output-path", "y",
+              "--data", "nope"])                      # unknown dataset
+
+
+def test_workers_flag_is_advisory(tmp_path, capsys):
+    import jax
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+    model = tmp_path / "m.zip"
+    out = tmp_path / "t.zip"
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=5e-2)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    ModelSerializer.write_model(MultiLayerNetwork(conf).init(), str(model))
+    rc = main(["train", "--model-path", str(model),
+               "--model-output-path", str(out),
+               "--data", "iris", "--batch-size", "30",
+               "--workers", str(len(jax.devices()) + 7)])
+    assert rc == 0
+    assert "advisory" in capsys.readouterr().err
