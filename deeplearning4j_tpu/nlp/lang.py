@@ -17,13 +17,17 @@ natively:
   strategy of ansj's DAT segmenter, reference
   ``deeplearning4j-nlp-chinese/.../ChineseTokenizerFactory``), Latin/digit
   runs kept whole.
-- ``JapaneseTokenizerFactory`` — script-class segmentation (kanji / hiragana /
-  katakana / Latin runs) with lexicon longest-match and trailing-particle
-  splitting (the observable behavior of the Kuromoji wrapper in
-  ``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory``).
-- ``KoreanTokenizerFactory`` — whitespace eojeol split + josa/particle
-  suffix stripping (arirang's stemming contract, reference
-  ``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory``).
+- ``JapaneseTokenizerFactory`` — dictionary-lattice Viterbi segmentation
+  with connection costs and character-class unknown words (the Kuromoji
+  algorithm class, reference
+  ``deeplearning4j-nlp-japanese/.../JapaneseTokenizerFactory`` over
+  ``com/atilika/kuromoji/viterbi/ViterbiBuilder.java``); script-run
+  heuristic kept as ``algorithm="script"`` fallback.
+- ``KoreanTokenizerFactory`` — whitespace eojeol split + eojeol-internal
+  morpheme lattice (stem/josa/eomi decomposition with homograph edges —
+  the arirang ``MorphAnalyzer`` algorithm class, reference
+  ``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory``); longest-josa
+  strip kept as ``algorithm="simple"`` fallback.
 - ``UimaTokenizerFactory`` / ``AnnotationPipeline`` — sentence segmenter +
   tokenizer + rule-based POS tagger behind one pipeline object (reference
   ``deeplearning4j-nlp-uima/.../UimaTokenizerFactory``,
@@ -485,6 +489,11 @@ class JapaneseLexicon(Lexicon):
     def category(self, word: str) -> str:
         return self._cat.get(word, "c")
 
+    def categories(self, word: str) -> Tuple[str, ...]:
+        """All lattice categories for a surface form (homographs get one
+        edge per category; the base class tracks a single one)."""
+        return (self.category(word),)
+
 
 class _JapaneseLatticeSegmenter:
     """Dictionary-lattice Viterbi segmentation — the Kuromoji algorithm
@@ -528,15 +537,21 @@ class _JapaneseLatticeSegmenter:
     _UNK_BASE = 12.0
     _UNK_PER_CHAR = 2.0
     _UNK_MAX_LEN = 8          # cap unknown-edge fan-out per position
+    _UNK_CAT = "c"            # category assigned to unknown edges
+
+    #: subclasses (Korean) override these two to re-seed the machinery
+    _LEX_CLS = None           # set below (JapaneseLexicon)
+    _SEED: Tuple = ()
 
     def __init__(self, lexicon: Optional[Iterable] = None):
-        # a JapaneseLexicon REPLACES the dictionary (caller takes full
-        # control); any other iterable MERGES into the seed entries — the
-        # lattice is useless without particle/aux/frequency structure
-        if isinstance(lexicon, JapaneseLexicon):
+        # an instance of the language's lexicon class REPLACES the
+        # dictionary (caller takes full control); any other iterable MERGES
+        # into the seed entries — the lattice is useless without
+        # particle/aux/frequency structure
+        if isinstance(lexicon, self._LEX_CLS):
             self.lexicon = lexicon
         else:
-            self.lexicon = JapaneseLexicon(JAPANESE_SEED_ENTRIES)
+            self.lexicon = self._LEX_CLS(self._SEED)
             if lexicon is not None:
                 for w in lexicon:
                     self.lexicon.add(w) if isinstance(w, str) \
@@ -561,22 +576,27 @@ class _JapaneseLatticeSegmenter:
         out: List[Tuple[int, float, str]] = []
         for L in lex.match_lengths(text, i):
             w = text[i:i + L]
-            out.append((L, logtot - math.log(lex.freq(w) + 1),
-                        lex.category(w)))
+            cost = logtot - math.log(lex.freq(w) + 1)
+            for cat in lex.categories(w):
+                out.append((L, cost, cat))
         cls = _script_class(text[i])
         R = run_end - i
         if cls in ("kata", "latin"):
             # loanwords / identifiers: the whole run, one edge
-            out.append((R, self._UNK_BASE * 0.5 + self._UNK_PER_CHAR, "c"))
+            out.append((R, self._UNK_BASE * 0.5 + self._UNK_PER_CHAR,
+                        self._UNK_CAT))
         else:
             seen = {L for L, _, _ in out}
             for L in range(1, min(R, self._UNK_MAX_LEN) + 1):
                 if L not in seen:
                     out.append((L, self._UNK_BASE + self._UNK_PER_CHAR * L,
-                                "c"))
+                                self._UNK_CAT))
         return out
 
-    def segment(self, text: str) -> List[str]:
+    def segment_with_categories(self, text: str) -> List[Tuple[str, str]]:
+        """Best path as (morpheme, chosen-category) pairs — the category
+        the VITERBI PATH selected, not the lexicon's primary reading
+        (homographs like 가 = josa/verb differ per context)."""
         import math
         n = len(text)
         if n == 0:
@@ -603,7 +623,9 @@ class _JapaneseLatticeSegmenter:
                 j = i + L
                 word = text[i:j]
                 for pcat, (pcost, _) in best[i].items():
-                    conn = self._CONN.get(pcat, self._CONN["c"]).get(cat, 1.0)
+                    conn = self._CONN.get(pcat,
+                                          self._CONN[self._UNK_CAT]).get(
+                        cat, 1.0)
                     cand = pcost + conn + wcost
                     cur = best[j].get(cat, (INF, None))
                     if cand < cur[0]:
@@ -611,18 +633,26 @@ class _JapaneseLatticeSegmenter:
         # EOS connection picks the final category
         end_cat, end_cost = None, INF
         for cat, (cost, _) in best[n].items():
-            total = cost + self._CONN.get(cat, self._CONN["c"]).get("E", 0.0)
+            total = cost + self._CONN.get(
+                cat, self._CONN[self._UNK_CAT]).get("E", 0.0)
             if total < end_cost:
                 end_cat, end_cost = cat, total
-        out: List[str] = []
+        out: List[Tuple[str, str]] = []
         i, cat = n, end_cat
         while i > 0:
             _, back = best[i][cat]
             pi, pcat, word = back
-            out.append(word)
+            out.append((word, cat))
             i, cat = pi, pcat
         out.reverse()
         return out
+
+    def segment(self, text: str) -> List[str]:
+        return [w for w, _ in self.segment_with_categories(text)]
+
+
+_JapaneseLatticeSegmenter._LEX_CLS = JapaneseLexicon
+_JapaneseLatticeSegmenter._SEED = JAPANESE_SEED_ENTRIES
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
@@ -749,16 +779,188 @@ KOREAN_JOSA = (
     "를", "에", "와", "과", "도", "만", "의",
 )
 
+#: Seed dictionary for the Korean morpheme lattice: (morpheme, freq, cat).
+#: Categories: "n" noun/pronoun stem, "v" verb/adjective stem, "j" josa
+#: (case particle), "e" eomi (verbal ending, incl. tense infixes and the
+#: common CONTRACTED portmanteau forms like 했/갔 — arirang handles these
+#: through its own tables too), "x" affix. Frequencies are corpus-rank
+#: order-of-magnitude, like the Japanese seed.
+KOREAN_SEED_ENTRIES: Tuple[Tuple[str, int, str], ...] = (
+    # josa — the highest-frequency bound morphemes
+    ("이", 6000, "j"), ("가", 5500, "j"), ("은", 5500, "j"),
+    ("는", 5500, "j"), ("을", 5000, "j"), ("를", 5000, "j"),
+    ("에", 4500, "j"), ("에서", 2500, "j"), ("에서는", 600, "j"),
+    ("에게", 900, "j"), ("으로", 1500, "j"), ("로", 1500, "j"),
+    ("와", 1200, "j"), ("과", 1200, "j"), ("도", 1800, "j"),
+    ("만", 1000, "j"), ("의", 3000, "j"), ("보다", 500, "j"),
+    ("처럼", 400, "j"), ("까지", 600, "j"), ("부터", 600, "j"),
+    ("하고", 500, "j"),
+    # eomi — endings and tense morphemes (syllable-aligned forms +
+    # frequent contracted portmanteaus)
+    ("다", 4000, "e"), ("요", 2500, "e"), ("고", 2000, "e"),
+    ("지", 1200, "e"), ("면", 1000, "e"), ("서", 1000, "e"),
+    ("니다", 1500, "e"), ("습니다", 2000, "e"),
+    ("었", 2000, "e"), ("았", 1500, "e"), ("겠", 800, "e"),
+    ("는다", 800, "e"), ("기", 900, "e"),
+    ("게", 900, "e"), ("죠", 400, "e"), ("어요", 1500, "e"),
+    ("아요", 900, "e"), ("어", 1200, "e"), ("아", 900, "e"),
+    ("으면", 500, "e"), ("습니까", 400, "e"), ("세요", 700, "e"),
+    # contracted stem+tense portmanteaus (the syllable fuses stem vowel and
+    # 았/었 — listing them is how a syllable-level lattice covers them)
+    ("했", 1500, "e"), ("갔", 600, "e"), ("왔", 600, "e"),
+    ("됐", 400, "e"), ("합니다", 1800, "e"), ("갑니다", 400, "e"),
+    ("해요", 900, "e"),
+    ("한다", 700, "e"), ("하는", 900, "e"), ("하면", 500, "e"),
+    # verb / adjective stems
+    ("하", 3000, "v"), ("가", 1200, "v"), ("오", 800, "v"),
+    ("먹", 800, "v"), ("보", 900, "v"), ("살", 500, "v"),
+    ("알", 600, "v"), ("모르", 400, "v"), ("좋", 800, "v"),
+    ("크", 400, "v"), ("작", 300, "v"), ("있", 2000, "v"),
+    ("없", 1200, "v"), ("되", 1000, "v"), ("배우", 400, "v"), ("싶", 600, "v"),
+    ("만들", 400, "v"), ("읽", 300, "v"), ("쓰", 400, "v"),
+    # noun / pronoun stems
+    ("사람", 1500, "n"), ("것", 2000, "n"), ("때", 1200, "n"),
+    ("집", 700, "n"), ("학교", 700, "n"), ("학생", 600, "n"),
+    ("선생님", 500, "n"), ("시간", 700, "n"), ("나라", 400, "n"),
+    ("한국", 800, "n"), ("한국어", 300, "n"), ("서울", 500, "n"),
+    ("말", 700, "n"), ("물", 400, "n"), ("밥", 300, "n"),
+    ("나", 1500, "n"), ("너", 700, "n"), ("우리", 1200, "n"),
+    ("저", 800, "n"), ("그", 1500, "n"), ("공부", 500, "n"),
+    ("일", 900, "n"), ("오늘", 600, "n"), ("내일", 400, "n"),
+    ("어제", 300, "n"), ("책", 400, "n"), ("친구", 600, "n"),
+)
+
+
+class KoreanLexicon(JapaneseLexicon):
+    """:class:`Lexicon` + Korean morpheme categories (n/v/j/e/x). Reuses
+    the 3-column dictionary format; uncategorized words default to noun
+    (the open class), with the josa table as a fallback hint. Homographs
+    keep EVERY category they were added with (가 is a josa and a verb
+    stem; the lattice gets one edge per reading)."""
+
+    _CATS = ("n", "v", "j", "e", "x")
+
+    def add(self, word: str, freq: int = 1, cat: Optional[str] = None):
+        word = word.strip()
+        if not word:
+            return
+        if cat is None:
+            cat = self._cat.get(word) or (
+                "j" if word in KOREAN_JOSA else "n")
+        self._cat.setdefault(word, cat)     # primary = first reading
+        cats = self._all_cats.setdefault(word, [])
+        if cat not in cats:
+            cats.append(cat)
+        Lexicon.add(self, word, freq)
+
+    def __init__(self, entries: Optional[Iterable] = None):
+        self._all_cats: Dict[str, List[str]] = {}
+        super().__init__(entries)
+
+    def categories(self, word: str) -> Tuple[str, ...]:
+        return tuple(self._all_cats.get(word) or (self.category(word),))
+
+    def load(self, path: str, encoding: str = "utf-8") -> "KoreanLexicon":
+        for word, freq, extra in _iter_dict_lines(path, encoding):
+            cat = extra[0] if extra and extra[0] in self._CATS else None
+            self.add(word, freq, cat)
+        return self
+
+    def category(self, word: str) -> str:
+        return self._cat.get(word, "n")
+
+
+class _KoreanLatticeSegmenter(_JapaneseLatticeSegmenter):
+    """Eojeol-internal morpheme lattice — the arirang algorithm class
+    (reference ``deeplearning4j-nlp-korean`` bundles arirang's
+    ``MorphAnalyzer``: decompose each eojeol into stem + particle/ending
+    chains via dictionary tables and pick the best analysis). Same Viterbi
+    machinery as the Japanese lattice, Korean category set + connection
+    matrix:
+
+    - ``B → n/v/x`` (an eojeol opens with a stem; bound morphemes first
+      are penalized),
+    - ``n → j`` (noun+josa, the dominant pattern), ``n → n`` mildly
+      penalized (compounds exist: 한국+어),
+    - ``v → e`` (verb stems must take an ending; ``v → E`` is heavily
+      penalized — an unfinished verb is not a Korean word),
+    - ``e → e`` cheap (ending chains: 먹+었+습니다), ``e → E`` free.
+
+    Syllable-level honesty: Korean tense/politeness morphemes fuse INTO
+    the preceding syllable when the stem ends in a vowel (가+았→갔,
+    하+았→했, 하+ㅂ니다→합니다). A syllable lattice cannot split those, so
+    the seed lists frequent portmanteau forms as single "e"/"v" entries —
+    the same table-driven answer arirang uses — and everything
+    syllable-aligned (먹/었/습니다, 학생/이) decomposes properly."""
+
+    _CONN = {
+        "B": {"n": 0.0, "v": 0.3, "x": 1.0, "j": 4.0, "e": 4.0},
+        # n->j carries a small BONUS: noun+josa is the dominant eojeol
+        # shape, and it must beat an unknown run absorbing its josa
+        "n": {"n": 1.2, "v": 1.5, "j": -0.5, "e": 1.0, "x": 0.8, "E": 0.2},
+        "v": {"e": 0.0, "n": 2.5, "v": 2.5, "j": 3.0, "x": 2.0, "E": 3.0},
+        "j": {"n": 1.5, "v": 1.8, "j": 1.5, "e": 2.5, "x": 2.0, "E": 0.0},
+        "e": {"e": 0.3, "n": 2.0, "v": 2.0, "j": 1.5, "x": 2.0, "E": 0.0},
+        "x": {"n": 0.5, "v": 0.8, "j": 1.0, "e": 1.5, "x": 1.5, "E": 0.8},
+    }
+    _UNK_CAT = "n"            # unknown runs read as noun stems (open class)
+    _UNK_PER_CHAR = 3.0       # steeper than Japanese: an unknown eojeol
+                              # must not swallow its trailing josa/eomi
+    _LEX_CLS = KoreanLexicon
+    _SEED = KOREAN_SEED_ENTRIES
+
 
 class KoreanTokenizerFactory(TokenizerFactory):
-    """Whitespace eojeol split + josa suffix strip (contract of reference
-    ``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory.java`` over the
-    arirang analyzer)."""
+    """Korean tokenizer behind the reference's ``TokenizerFactory`` seam
+    (``deeplearning4j-nlp-korean/.../KoreanTokenizerFactory.java`` over the
+    arirang analyzer).
 
-    def __init__(self, strip_josa: bool = True):
+    ``algorithm="lattice"`` (default): whitespace eojeol split, then an
+    eojeol-internal morpheme lattice (:class:`_KoreanLatticeSegmenter`) —
+    stems, josa and endings come out as separate tokens, so 학생이 and
+    학생을 both contribute 학생 to an embedding vocabulary.
+    ``strip_particles=True`` (default) drops josa/eomi from the output,
+    the arirang stemming contract for embedding pipelines; set False to
+    keep the full morpheme stream.
+
+    ``algorithm="simple"``: the legacy longest-josa suffix strip."""
+
+    def __init__(self, strip_josa: bool = True, algorithm: str = "lattice",
+                 lexicon: Optional[Iterable] = None,
+                 dict_path: Optional[str] = None,
+                 strip_particles: Optional[bool] = None):
         self._pre: Optional[TokenPreProcess] = None
+        if algorithm not in ("lattice", "simple"):
+            raise ValueError(f"unknown segmentation algorithm {algorithm!r}"
+                             " (expected 'lattice' or 'simple')")
+        self._algorithm = algorithm
         self._strip = strip_josa
+        self._strip_particles = (strip_particles if strip_particles
+                                 is not None else strip_josa)
         self._josa = sorted(KOREAN_JOSA, key=len, reverse=True)
+        if algorithm == "lattice":
+            self._lat = _KoreanLatticeSegmenter(lexicon)
+            if dict_path is not None:
+                self._lat.lexicon.load(dict_path)
+
+    def add_words(self, *words):
+        """Extend the dictionary (arirang user-dictionary seam); entries
+        are words or ``(word, freq[, cat])`` tuples (lattice mode)."""
+        if self._algorithm == "lattice":
+            self._lat.add(*words)
+        return self
+
+    addWords = add_words
+
+    def load_dictionary(self, path: str):
+        if self._algorithm != "lattice":
+            raise ValueError("algorithm='simple' has no dictionary — the "
+                             "josa strip is table-driven; use the lattice "
+                             "for user dictionaries")
+        self._lat.lexicon.load(path)
+        return self
+
+    loadDictionary = load_dictionary
 
     def _stem(self, word: str) -> str:
         if not self._strip or not all(_is_hangul(c) for c in word):
@@ -768,12 +970,27 @@ class KoreanTokenizerFactory(TokenizerFactory):
                 return word[:-len(j)]
         return word
 
+    def _analyze(self, eojeol: str) -> List[str]:
+        pairs = self._lat.segment_with_categories(eojeol)
+        if not self._strip_particles:
+            return [m for m, _ in pairs]
+        # filter on the category the Viterbi PATH chose — a homograph verb
+        # stem whose surface doubles as a josa (가고 → 가+고) must survive
+        kept = [m for m, cat in pairs if cat not in ("j", "e")]
+        # an eojeol that is ALL particles/endings (e.g. 합니다 alone)
+        # keeps its surface form: dropping every token would lose it
+        return kept or [eojeol]
+
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
         for raw in text.split():
             # punctuation splits the eojeol (안녕,세상 → 안녕 / 세상)
             for word, cls in _script_runs(raw):
-                if cls != "punct":
+                if cls == "punct":
+                    continue
+                if self._algorithm == "lattice" and cls == "hangul":
+                    tokens.extend(self._analyze(word))
+                else:
                     tokens.append(self._stem(word))
         return self._finish(tokens)
 

@@ -72,7 +72,6 @@ def kernel_ab(batch=64, width=512, tbptt=50, seq_len=200):
     toggling the kernel's DL4J_TPU_NO_PERSISTENT_LSTM escape hatch around
     the two legs (the operator's own setting is restored afterwards; if
     they exported the hatch as a rollback, the kernel leg is skipped)."""
-    import os
     prior = os.environ.get("DL4J_TPU_NO_PERSISTENT_LSTM")
     try:
         if prior:

@@ -67,9 +67,42 @@ def test_korean_josa_stripping():
     assert "학교" in toks      # 에서 stripped
     assert "친구" in toks      # 를 stripped
     assert "만났다" in toks
+    # lattice + strip off: the FULL morpheme stream (stems AND particles)
     raw = KoreanTokenizerFactory(strip_josa=False).create(
         "학교에서 친구를").get_tokens()
-    assert raw == ["학교에서", "친구를"]
+    assert raw == ["학교", "에서", "친구", "를"]
+    # legacy surface-eojeol behavior lives under algorithm="simple"
+    legacy = KoreanTokenizerFactory(strip_josa=False,
+                                    algorithm="simple").create(
+        "학교에서 친구를").get_tokens()
+    assert legacy == ["학교에서", "친구를"]
+
+
+def test_korean_morpheme_lattice():
+    """arirang-class eojeol decomposition: stem/josa/eomi separate, ending
+    chains split (먹+었+습니다), homographs resolved by connection costs
+    (가 = josa after a noun, not the verb stem), unknown noun stems keep
+    their trailing josa separate (김철수+가)."""
+    f = KoreanTokenizerFactory(strip_particles=False)
+    assert f.create("학생이 학교에서 공부합니다").get_tokens() == \
+        ["학생", "이", "학교", "에서", "공부", "합니다"]
+    assert f.create("먹었습니다").get_tokens() == ["먹", "었", "습니다"]
+    assert f.create("김철수가 책을 읽었다").get_tokens() == \
+        ["김철수", "가", "책", "을", "읽", "었", "다"]
+    # stripping keeps stems only; embedding vocab sees 학생 for 학생이/학생을
+    fs = KoreanTokenizerFactory()
+    assert fs.create("학생이 학교에서 공부합니다").get_tokens() == \
+        ["학생", "학교", "공부"]
+    # user dictionary extends the analysis (3-column format, homographs ok)
+    f2 = KoreanTokenizerFactory(strip_particles=False).add_words(
+        ("데이터", 500, "n"))
+    assert f2.create("데이터를").get_tokens() == ["데이터", "를"]
+    # stripping filters on the CHOSEN path category: the verb stem 가
+    # (whose surface doubles as the josa 가) survives in 가고
+    assert fs.create("가고 싶다").get_tokens() == ["가", "싶"]
+    import pytest
+    with pytest.raises(ValueError):
+        KoreanTokenizerFactory(algorithm="nope")
 
 
 def test_sentence_annotator_guards():
