@@ -945,9 +945,13 @@ class KoreanTokenizerFactory(TokenizerFactory):
 
     def add_words(self, *words):
         """Extend the dictionary (arirang user-dictionary seam); entries
-        are words or ``(word, freq[, cat])`` tuples (lattice mode)."""
-        if self._algorithm == "lattice":
-            self._lat.add(*words)
+        are words or ``(word, freq[, cat])`` tuples. Lattice mode only —
+        the simple josa strip has no dictionary, so silently accepting
+        words would lose them."""
+        if self._algorithm != "lattice":
+            raise ValueError("algorithm='simple' has no dictionary — use "
+                             "the lattice for user words")
+        self._lat.add(*words)
         return self
 
     addWords = add_words

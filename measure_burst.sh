@@ -98,12 +98,23 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 6000 python perf_lstm.py unroll
     need sweep    && probe && run_stage sweep \
                      timeout 2400 python perf_lstm.py sweep
+    # r5: ResNet50 HBM-wall experiments, split so a timeout loses one
+    # sub-stage, not all eight configs
+    need rescost  && probe && run_stage rescost \
+                     timeout 1800 bash -c \
+                     "python perf_exp.py cost 256 && python perf_exp.py cost 512"
+    need resbench && probe && run_stage resbench \
+                     timeout 1800 python perf_exp.py bench2
+    need resremat && probe && run_stage resremat \
+                     timeout 2400 python perf_exp.py remat
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
      [ -f "$STATE/transformer.ok" ] && [ -f "$STATE/inception2.ok" ] && \
      [ -f "$STATE/lstm2.ok" ] && [ -f "$STATE/unroll.ok" ] && \
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
-     [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ]; then
+     [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ] && \
+     [ -f "$STATE/rescost.ok" ] && [ -f "$STATE/resbench.ok" ] && \
+     [ -f "$STATE/resremat.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="
     exit 0
   fi

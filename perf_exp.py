@@ -1,7 +1,13 @@
 """Perf experiment harness (not part of the framework; PERF.md records results).
 
-Batch-size sweep over the ResNet50 train step — the measurement loop behind
-the PERF.md table. `python perf_exp.py 64 128 256`.
+Modes (run on real TPU; the burst harness drives `full`):
+
+  python perf_exp.py 64 128 256      # batch-size sweep (legacy spelling)
+  python perf_exp.py sweep 64 256    # same, explicit
+  python perf_exp.py remat           # VERDICT r4 item 8: batch 384/512,
+                                     # remat off vs auto (HBM-wall push)
+  python perf_exp.py cost [BATCH]    # XLA cost model + v5e roofline bound
+  python perf_exp.py full            # cost + sweep + remat (burst stage)
 """
 import sys
 import time
@@ -11,19 +17,26 @@ import jax
 import jax.numpy as jnp
 
 
-def bench_resnet(batch=256, iters=10, warmup=3, compute_dtype="bfloat16"):
+def _setup(batch, compute_dtype="bfloat16", remat="off"):
+    """One model+data builder for bench AND cost — the cost model must
+    lower exactly the program the benchmark runs."""
     from deeplearning4j_tpu.models import ResNet50
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    model = ResNet50(num_classes=1000)
-    conf = model.conf()
+    conf = ResNet50(num_classes=1000).conf()
     conf.global_conf.compute_dtype = compute_dtype
+    conf.global_conf.remat = remat
     net = ComputationGraph(conf).init()
-
     rng = np.random.default_rng(0)
     f = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)), jnp.float32)
-    l = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+    l = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+    return net, f, l
 
+
+def bench_resnet(batch=256, iters=10, warmup=3, compute_dtype="bfloat16",
+                 remat="off"):
+    net, f, l = _setup(batch, compute_dtype, remat)
     step = net._ensure_step()
     params, states, upd = net.params, net.states, net.updater_state
     key = jax.random.PRNGKey(0)
@@ -38,11 +51,74 @@ def bench_resnet(batch=256, iters=10, warmup=3, compute_dtype="bfloat16"):
     float(loss)  # value fetch: axon block_until_ready can return early
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
-    print(f"batch={batch} dtype={compute_dtype}: {ips:.1f} img/s "
-          f"({dt / iters * 1e3:.1f} ms/step)")
+    print(f"batch={batch} dtype={compute_dtype} remat={remat}: "
+          f"{ips:.1f} img/s ({dt / iters * 1e3:.1f} ms/step)")
     return ips
 
 
+def remat_ab():
+    """VERDICT r4 item 8: push past the HBM wall — larger batches amortize
+    fixed traffic but blow activation memory; remat='auto' (saveable
+    conv/gemm outputs, recompute the cheap elementwise chains) trades
+    recompute FLOPs for HBM. Keep or revert BY MEASUREMENT; failures
+    (OOM) are recorded, not fatal."""
+    for batch in (384, 512):
+        for remat in ("off", "auto"):
+            try:
+                bench_resnet(batch=batch, remat=remat)
+            except Exception as e:
+                print(f"batch={batch} remat={remat} FAILED: "
+                      f"{str(e)[:200]}", flush=True)
+
+
+def cost(batch=256, remat="off"):
+    """XLA cost model of the ResNet50 train step + v5e roofline bound
+    (197 TFLOPS bf16, 819 GB/s HBM) — the before/after instrument for any
+    layout/fusion change."""
+    net, f, l = _setup(batch, remat=remat)
+    step = net._ensure_step()
+    lowered = step.lower(net.params, net.states, net.updater_state,
+                         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                         (f,), (l,), None, None)
+    ca = lowered.compile().cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    by = float(ca.get("bytes accessed", 0.0))
+    t_f, t_h = flops / 197e12, by / 819e9
+    if max(t_f, t_h) == 0.0:
+        # cost_analysis unavailable on this backend/jaxlib: report, don't
+        # crash the burst stage
+        print(f"batch={batch} remat={remat}: cost_analysis unavailable")
+        return
+    bound = "HBM" if t_h > t_f else "compute"
+    print(f"batch={batch} remat={remat}: {flops/1e12:.2f} TFLOP, "
+          f"{by/1e9:.1f} GB/step -> ideal {batch/max(t_f, t_h):,.0f} img/s "
+          f"({bound}-bound)")
+
+
+def main(argv):
+    if not argv or argv[0].isdigit():
+        for b in (int(x) for x in argv or ["256"]):
+            bench_resnet(batch=b)
+    elif argv[0] == "sweep":
+        for b in (int(x) for x in argv[1:] or ["64", "128", "256"]):
+            bench_resnet(batch=b)
+    elif argv[0] == "remat":
+        remat_ab()
+    elif argv[0] == "cost":
+        cost(int(argv[1]) if len(argv) > 1 else 256)
+        cost(int(argv[1]) if len(argv) > 1 else 256, remat="auto")
+    elif argv[0] == "full":
+        cost(256)
+        cost(512, remat="auto")
+        for b in (128, 256):
+            bench_resnet(batch=b)
+        remat_ab()
+    elif argv[0] == "bench2":
+        for b in (128, 256):
+            bench_resnet(batch=b)
+    else:
+        raise SystemExit(f"unknown mode {argv[0]}")
+
+
 if __name__ == "__main__":
-    for b in (int(x) for x in sys.argv[1:] or ["256"]):
-        bench_resnet(batch=b)
+    main(sys.argv[1:])
