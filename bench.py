@@ -320,7 +320,14 @@ def bench_keras_import_parallel(batch_per_step=128, iters=10):
                          rng.integers(0, 1000, batch_per_step // n_dev)])
              for _ in range(n_dev)]
     pw = (ParallelWrapper.Builder(net).training_mode(TrainingMode.AVERAGING)
-          .averaging_frequency(1).build())
+          .averaging_frequency(1)
+          # images + bf16 compute: host-side cast halves the H2D bytes of
+          # the warm-up/first-epoch transfer, bit-identical results
+          # (parity-tested). The TIMED loop reuses the device cache
+          # (cache_mode='device'), so this shortens the un-timed first
+          # pass — the first-epoch path the overlap work targets — without
+          # touching the steady-state number
+          .host_transfer_dtype("bfloat16").build())
     pw.fit(ListDataSetIterator(dsets))  # compile + one pass
     _sync(net.params)
     t0 = time.perf_counter()

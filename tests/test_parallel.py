@@ -939,3 +939,94 @@ def test_sync_score_fetch_deferred_one_step():
     # 8 batches grouped one-per-device per step, × 2 epochs — the deferred
     # path must not drop iterations
     assert net2.iteration_count == 2 * (len(batches) // len(jax.devices()))
+
+
+def test_host_transfer_dtype_bit_identical():
+    """host_transfer_dtype('bfloat16'): float features cast on the HOST
+    before the wire must give BIT-IDENTICAL training to the device-side
+    cast (the layers cast inputs to the compute dtype either way) — while
+    halving transfer bytes. Labels/ints are never touched."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+    import ml_dtypes
+
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Sgd(learning_rate=1e-2)).activation("relu")
+                .compute_dtype("bfloat16")
+                .list()
+                .layer(DenseLayer(n_in=12, n_out=16))
+                .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(16, 12)).astype(np.float32),
+                       np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)])
+               for _ in range(4)]
+
+    a = make()
+    pw_a = (ParallelWrapper.Builder(a)
+            .training_mode(TrainingMode.AVERAGING).averaging_frequency(1)
+            .build())
+    pw_a.fit(ListDataSetIterator(batches), epochs=2)
+
+    b = make()
+    pw_b = (ParallelWrapper.Builder(b)
+            .training_mode(TrainingMode.AVERAGING).averaging_frequency(1)
+            .host_transfer_dtype("bfloat16").build())
+    # the cast actually happens (and leaves ints/labels alone)
+    cast = pw_b._host_cast(batches[0].features)
+    assert cast.dtype == ml_dtypes.bfloat16
+    assert pw_b._host_cast(np.arange(4)).dtype == np.int64
+    pw_b.fit(ListDataSetIterator(batches), epochs=2)
+
+    assert pw_a.last_score == pw_b.last_score     # bit-identical loss
+    for k in a.params:
+        for p in a.params[k]:
+            np.testing.assert_array_equal(np.asarray(a.params[k][p]),
+                                          np.asarray(b.params[k][p]))
+
+
+def test_host_transfer_dtype_local_sgd_and_mismatch_warning(caplog):
+    """The cast applies on the local-SGD (stacked) path too, and a
+    compute/transfer dtype mismatch warns instead of silently degrading."""
+    import logging
+    import ml_dtypes
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater(Sgd(learning_rate=1e-2)).activation("tanh")
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(8, 6)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+               for _ in range(4)]
+    pw = (ParallelWrapper.Builder(net).averaging_frequency(2)
+          .host_transfer_dtype("bfloat16").build())
+    f, *_ = pw._stacked_batches_uncached(batches[:2])
+    assert f.dtype == ml_dtypes.bfloat16       # stacked path casts too
+    pw.fit(ListDataSetIterator(batches))
+    assert np.isfinite(pw.last_score)
+
+    # mismatched compute dtype: loud warning, once
+    conf2 = (NeuralNetConfiguration.builder().seed(4)
+             .updater(Sgd(learning_rate=1e-2)).list()
+             .layer(DenseLayer(n_in=6, n_out=8))
+             .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss="mcxent"))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    pw2 = (ParallelWrapper.Builder(net2)
+           .host_transfer_dtype("bfloat16").build())
+    with caplog.at_level(logging.WARNING):
+        pw2._host_cast(batches[0].features)
+        pw2._host_cast(batches[0].features)
+    warns = [r for r in caplog.records if "host_transfer_dtype" in r.message]
+    assert len(warns) == 1
