@@ -170,7 +170,7 @@ def cmd_train(args) -> int:
     return 0
 
 
-def cmd_serve_ui(args) -> int:
+def cmd_serve_ui(args, block: bool = True) -> int:
     import time
     from .ui import UIServer, FileStatsStorage, InMemoryStatsStorage
     storage = (FileStatsStorage(args.stats_file) if args.stats_file
@@ -179,6 +179,8 @@ def cmd_serve_ui(args) -> int:
     server.attach(storage)
     port = server.start(args.port)         # /remote receiver included
     print(f"training UI on http://127.0.0.1:{port}", flush=True)
+    if not block:                          # tests: caller owns the server
+        return port
     try:
         while True:                        # serve_forever runs in a thread
             time.sleep(3600)

@@ -108,3 +108,39 @@ def test_workers_flag_is_advisory(tmp_path, capsys):
                "--workers", str(len(jax.devices()) + 7)])
     assert rc == 0
     assert "advisory" in capsys.readouterr().err
+
+
+def test_serve_ui_serves_training_stats(tmp_path):
+    """train --stats-file then serve-ui over it: the UI endpoints answer
+    with the run's sessions (the reference's PlayUIServer workflow end to
+    end, minus the blocking loop)."""
+    import json
+    import urllib.request
+    from deeplearning4j_tpu.main import cmd_serve_ui
+
+    model = tmp_path / "m.zip"
+    out = tmp_path / "t.zip"
+    stats = tmp_path / "stats.db"
+    _write_model(model)
+    assert main(["train", "--model-path", str(model),
+                 "--model-output-path", str(out),
+                 "--data", "mnist", "--num-examples", "128",
+                 "--batch-size", "32", "--stats-file", str(stats)]) == 0
+
+    args = build_parser().parse_args(["serve-ui", "--stats-file", str(stats),
+                                      "--port", "0"])
+    port = cmd_serve_ui(args, block=False)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/sessions", timeout=10) as r:
+            sessions = json.loads(r.read())
+        assert sessions, "served UI must list the training session"
+        sid = sessions[0]          # list_session_ids returns plain strings
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/overview?sid={sid}",
+                timeout=10) as r:
+            overview = json.loads(r.read())
+        assert overview.get("scores"), overview
+    finally:
+        from deeplearning4j_tpu.ui import UIServer
+        UIServer.get_instance().stop()
