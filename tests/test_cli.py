@@ -9,17 +9,17 @@ import pytest
 from deeplearning4j_tpu.main import main, build_parser
 
 
-def _write_model(path):
+def _write_model(path, n_in=784, n_hidden=16, n_out=10, seed=1, lr=5e-2):
     from deeplearning4j_tpu import (NeuralNetConfiguration,
                                     MultiLayerNetwork, Sgd)
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
     from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
-    conf = (NeuralNetConfiguration.builder().seed(1)
-            .updater(Sgd(learning_rate=5e-2)).activation("tanh")
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=lr)).activation("tanh")
             .list()
-            .layer(DenseLayer(n_in=784, n_out=16))
-            .layer(OutputLayer(n_in=16, n_out=10, activation="softmax",
-                               loss="mcxent"))
+            .layer(DenseLayer(n_in=n_in, n_out=n_hidden))
+            .layer(OutputLayer(n_in=n_hidden, n_out=n_out,
+                               activation="softmax", loss="mcxent"))
             .build())
     net = MultiLayerNetwork(conf).init()
     ModelSerializer.write_model(net, str(path))
@@ -88,20 +88,9 @@ def test_parser_errors():
 
 def test_workers_flag_is_advisory(tmp_path, capsys):
     import jax
-    from deeplearning4j_tpu import (NeuralNetConfiguration,
-                                    MultiLayerNetwork, Sgd)
-    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
     model = tmp_path / "m.zip"
     out = tmp_path / "t.zip"
-    conf = (NeuralNetConfiguration.builder().seed(1)
-            .updater(Sgd(learning_rate=5e-2)).activation("tanh")
-            .list()
-            .layer(DenseLayer(n_in=4, n_out=8))
-            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
-                               loss="mcxent"))
-            .build())
-    ModelSerializer.write_model(MultiLayerNetwork(conf).init(), str(model))
+    _write_model(model, n_in=4, n_hidden=8, n_out=3)
     rc = main(["train", "--model-path", str(model),
                "--model-output-path", str(out),
                "--data", "iris", "--batch-size", "30",
@@ -144,3 +133,68 @@ def test_serve_ui_serves_training_stats(tmp_path):
     finally:
         from deeplearning4j_tpu.ui import UIServer
         UIServer.get_instance().stop()
+
+
+def test_train_multihost_coordinator_flags(tmp_path):
+    """--coordinator/--num-processes/--process-id: two CLI processes form a
+    real jax.distributed cluster and train; only the chief writes the
+    model (ParallelWrapperMain's cluster story, multi-controller style)."""
+    import subprocess
+    import sys
+    from test_multiprocess import _free_port
+
+    port = _free_port()
+
+    factory = tmp_path / "partfactory.py"
+    factory.write_text(
+        "import numpy as np, jax\n"
+        "from deeplearning4j_tpu.datasets.dataset import (DataSet,\n"
+        "    ListDataSetIterator)\n"
+        "def make():\n"
+        "    rng = np.random.default_rng(0)\n"
+        "    all_ds = [DataSet(rng.normal(size=(8, 6)).astype(np.float32),\n"
+        "                      np.eye(3, dtype=np.float32)[\n"
+        "                          rng.integers(0, 3, 8)])\n"
+        "              for _ in range(8)]\n"
+        "    pid = jax.process_index()\n"
+        "    return ListDataSetIterator(all_ds[pid::2])\n")
+
+    model = tmp_path / "m.zip"
+    _write_model(model, n_in=6, n_hidden=8, n_out=3, seed=2, lr=1e-2)
+    out = tmp_path / "trained.zip"
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(tmp_path), repo, env.get("PYTHONPATH", "")])
+    runner = tmp_path / "run_cli.py"
+    runner.write_text(
+        "import sys, jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_num_cpu_devices', 2)\n"
+        "from deeplearning4j_tpu.main import main\n"
+        "sys.exit(main(sys.argv[1:]))\n")
+    procs = [subprocess.Popen(
+        [sys.executable, str(runner), "train",
+         "--model-path", str(model), "--model-output-path", str(out),
+         "--data-factory", "partfactory:make", "--epochs", "2",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--num-processes", "2", "--process-id", str(p)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for p in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=420)
+            outs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"CLI worker failed:\n{o[-3000:]}"
+    assert out.exists()                       # chief wrote the model
+    assert "model written" in outs[0]         # pid 0 is chief
+    assert "model written" not in outs[1]     # non-chief stays quiet
