@@ -24,6 +24,27 @@ def _free_port():
     return port
 
 
+def _free_port_block(n):
+    """A base port with ``n`` CONSECUTIVE bindable ports (the wire workers
+    derive peer addresses as port_base+rank): probe the whole block, retry
+    on collision instead of flaking."""
+    for _ in range(50):
+        base = _free_port()
+        socks = []
+        try:
+            for q in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + q))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no block of {n} consecutive free ports found")
+
+
 def _run_workers(worker_path, tmp_path, port, n=2, timeout=540, check=True):
     """Spawn n workers, wait (killing survivors when one hangs so a timeout
     cannot leak processes into the run), and — unless ``check=False`` —
@@ -124,7 +145,7 @@ def test_shared_gradients_real_wire(tmp_path):
     the dense update."""
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_wire_worker.py")
-    port = _free_port()
+    port = _free_port_block(2)   # peers bind port+rank
     _run_workers(worker, tmp_path, port)
 
     p0 = np.load(tmp_path / "wire_params_0.npy")
@@ -188,7 +209,7 @@ def test_four_process_shared_gradients_wire(tmp_path):
     and compression still beats dense."""
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_wire_worker.py")
-    port = _free_port()
+    port = _free_port_block(4)   # peers bind port+rank
     _run_workers(worker, tmp_path, port, n=4, timeout=720)
 
     ps = [np.load(tmp_path / f"wire_params_{p}.npy") for p in range(4)]
