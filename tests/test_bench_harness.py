@@ -270,18 +270,26 @@ def _parseable_headlines(stdout: str):
 def test_driver_contract_sigterm_mid_probe(tmp_path):
     """THE round-4 failure mode: tunnel down, driver kills bench.py mid
     probe. Contract: stdout already/still holds a parseable stale-marked
-    headline and the process dies promptly on SIGTERM."""
+    headline and the process dies promptly on SIGTERM. The signal is sent
+    only AFTER the startup replay line appears (a fixed sleep races
+    interpreter startup under full-suite load)."""
+    import time
     script, env = _bench_sandbox(tmp_path)
     env["BENCH_PROBE_WINDOW_S"] = "3600"     # probing "forever"
     proc = subprocess.Popen([sys.executable, str(script)],
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             env=env, cwd=tmp_path)
-    import time
-    time.sleep(3.0)                          # past startup replay
+    first = b""
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:       # wait for the replay line
+        first = proc.stdout.readline()
+        if first.strip():
+            break
+    assert first.strip(), "startup replay never appeared"
     proc.send_signal(15)
     out, _ = proc.communicate(timeout=30)
-    docs = _parseable_headlines(out.decode())
-    assert docs, f"no parseable headline in: {out!r}"
+    docs = _parseable_headlines((first + out).decode())
+    assert docs, f"no parseable headline in: {(first + out)!r}"
     assert docs[0]["value"] == 999.9 and docs[0]["stale"] is True
     assert docs[-1]["stale"] is True         # final flush also stale-marked
 
