@@ -697,6 +697,11 @@ def _install_guards(deadline_s):
         except (ValueError, OSError):
             pass
     if deadline_s and deadline_s > 0:
+        # published so default mode can size the bench child's timeout to
+        # the REMAINING budget — a child allowed to outlive the deadline
+        # would always be killed mid-measurement by the watchdog instead
+        _FINAL["deadline_at"] = time.monotonic() + deadline_s
+
         def _on_deadline():
             rc = _emit_final(error=f"self-imposed deadline {deadline_s:.0f}s "
                                    f"reached (driver window protection)")
@@ -705,6 +710,16 @@ def _install_guards(deadline_s):
         t = threading.Timer(deadline_s, _on_deadline)
         t.daemon = True
         t.start()
+
+
+def _budget_timeout(default_s: float) -> float:
+    """Child timeout capped to the remaining self-deadline budget (minus a
+    flush margin) so the measurement subprocess, not the watchdog, is what
+    gives up first — preserving the parent's re-probe/retry path."""
+    at = _FINAL.get("deadline_at")
+    if at is None:
+        return default_s
+    return max(120.0, min(default_s, at - time.monotonic() - 60.0))
 
 
 def main():
@@ -788,9 +803,12 @@ def main():
                 _FINAL["fresh_value"] = value
         value = results.get("resnet50_imagenet_images_per_sec")
     else:
-        value = _run_one_subprocess("resnet50_imagenet_images_per_sec")
-        if value is None and _await_backend(max_wait_s=600):
-            value = _run_one_subprocess("resnet50_imagenet_images_per_sec")
+        value = _run_one_subprocess("resnet50_imagenet_images_per_sec",
+                                    timeout_s=_budget_timeout(2400))
+        if value is None and _await_backend(
+                max_wait_s=min(600, _budget_timeout(600))):
+            value = _run_one_subprocess("resnet50_imagenet_images_per_sec",
+                                        timeout_s=_budget_timeout(2400))
         if value is not None:
             _FINAL["fresh_value"] = value      # latch before any disk I/O
             # bank the fresh headline + its timestamp (default mode is the

@@ -118,6 +118,9 @@ def cmd_train(args) -> int:
 
     # data first: bad --data args fail fast, before the (possibly large)
     # model load
+    if args.data and args.data_factory:
+        raise SystemExit("--data and --data-factory are mutually exclusive "
+                         "(the factory would silently win)")
     data = (_factory(args.data_factory) if args.data_factory
             else _builtin_data(args.data or "mnist", args.batch_size,
                                args.num_examples))

@@ -281,11 +281,14 @@ class PodLifecycle:
                 dst = f"{self.data_dir}/{name}"
                 parts = self.stager.download_command(name, dst)
                 cmd = " ".join(map(shlex.quote, parts[:-1])) + " " + expr(dst)
-                # mkdir the PARENT (data dir) only: pre-creating dst itself
-                # would make `gsutil cp -r` nest the dataset one level too
-                # deep (<dst>/<name>/...), invisible to the fetchers
+                # mkdir the PARENT (data dir) only, and rm any partial dst
+                # first: `gsutil cp -r` into an EXISTING dir nests the
+                # dataset one level too deep (<dst>/<name>/...), invisible
+                # to the fetchers — both on pre-created dirs and on RETRY
+                # after a mid-copy failure (the journal re-runs this step)
                 out.append(self.hosts.run_command(
-                    f"mkdir -p {expr(self.data_dir)} && {cmd}"))
+                    f"mkdir -p {expr(self.data_dir)} && "
+                    f"rm -rf {expr(dst)} && {cmd}"))
             return out
         if step == "launch":
             return [self.setup.launch_command()]

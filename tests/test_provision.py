@@ -253,4 +253,8 @@ def test_lifecycle_stage_data_home_expansion(tmp_path):
     remote = cmd[cmd.index("--command") + 1]
     assert '"$HOME"' in remote and "'~" not in remote
     assert remote.startswith("mkdir -p ")
+    # retry-safe: a partial dst from a failed copy is removed before the
+    # re-run, or `gsutil cp -r` would nest the dataset one level deeper
+    assert "rm -rf" in remote and remote.index("rm -rf") < \
+        remote.index("gsutil")
     assert "gs://bkt/data/imagenet" in remote
