@@ -69,6 +69,13 @@ def _dense_attention(q, k, v, visible, compute_dtype, dropout_rate=0.0,
     if visible is not None:
         logits = jnp.where(visible, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    if visible is not None:
+        # a query row with NO visible key outputs 0 (softmax over all -1e30
+        # would silently average every value vector) — same convention as
+        # the flash kernels, so the oracle and kernel cannot diverge on
+        # fully-padded rows
+        probs = jnp.where(jnp.any(visible, axis=-1, keepdims=True),
+                          probs, 0.0)
     if train and dropout_rate > 0.0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)

@@ -205,10 +205,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
 
     @pl.when(kj == nk - 1)
     def _():
+        # Rows whose visible keys were ALL masked never raise m above _NEG;
+        # for them every p above was exp(_NEG - _NEG) = 1, so acc/l is a
+        # uniform average over the masked block — garbage. Define the
+        # semantics instead: no visible key -> output 0, lse = _NEG (the
+        # ring merge's no-contribution identity), and the backward's
+        # s-guard (see _dq_kernel) makes the row's gradients exactly 0.
+        m = m_s[:, 0]
         l = jnp.maximum(l_s[:, 0], 1e-30)
-        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to((m_s[:, 0] + jnp.log(l))[:, None],
-                                      lse_ref.shape[1:])
+        valid = (m > _NEG * 0.5).astype(jnp.float32)
+        o_ref[0] = (acc_s[:] * (valid / l)[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            jnp.where(valid > 0, m + jnp.log(l), _NEG)[:, None],
+            lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, km, seed, causal, scale, rate):
@@ -284,7 +293,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
             s = _causal_mask(s, qi, kj, BLOCK)
         if km_ref is not None:
             s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        # s-guard: masked cells get p = 0 even on fully-masked rows, where
+        # lse is the _NEG sentinel and exp(s - lse) would be exp(0) = 1
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if rate > 0.0:
@@ -331,7 +342,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
             s = _causal_mask(s, qj, ki, BLOCK)
         if km_ref is not None:
             s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
-        p = jnp.exp(s - lse[:, None])                     # [Bq, Bk]
+        # same s-guard as _dq_kernel (fully-masked rows: lse = _NEG)
+        p = jnp.where(s > _NEG * 0.5,
+                      jnp.exp(s - lse[:, None]), 0.0)    # [Bq, Bk]
         if rate > 0.0:
             # same (bh, q-block, k-block) seeding as the fwd kernel: the
             # grid here is (bh, k, q), so the id order swaps

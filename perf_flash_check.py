@@ -26,6 +26,9 @@ def dense_ref(q, k, v, causal, km=None):
     if km is not None:
         vis = vis & (km[:, None, None, :] > 0)
     p = jax.nn.softmax(jnp.where(vis, s, -1e30), axis=-1)
+    # fully-masked rows output 0 (the framework-wide convention; see
+    # ops/flash_attention.py _fwd_kernel)
+    p = jnp.where(jnp.any(vis, axis=-1, keepdims=True), p, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
 
 

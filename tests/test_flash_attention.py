@@ -320,6 +320,75 @@ def test_flash_key_mask_grads_match_dense():
                                    rtol=2e-3, atol=2e-4)
 
 
+def test_flash_fully_masked_rows_zero():
+    """A query row whose visible keys are ALL masked outputs 0 with zero
+    gradients — the framework-wide convention (found on first hardware run:
+    perf_flash_check r5 max-err 0.306 came entirely from causal row 0 when
+    key 0 was padding; the kernel averaged block 0, the dense ref averaged
+    all T — both garbage). Kernel and dense mha path must agree."""
+    q, k, v = _qkv(b=2, T=256, h=2, d=32, seed=31)
+    km = np.ones((2, 256), np.float32)
+    km[0, 0] = 0.0          # batch 0: causal row 0 sees only masked keys
+    km[1, :2] = 0.0         # batch 1: causal rows 0 AND 1 fully masked
+    km = jnp.asarray(km)
+
+    got = fa.flash_attention(q, k, v, causal=True, key_mask=km)
+    # the convention itself: fully-masked rows are exactly 0
+    np.testing.assert_array_equal(np.asarray(got[0, 0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(got[1, :2]), 0.0)
+
+    # oracle with the same convention: remaining rows still match dense
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(32.0)
+    vis = (km[:, None, None, :] > 0) & \
+        jnp.tril(jnp.ones((256, 256), bool))[None, None]
+    p = jax.nn.softmax(jnp.where(vis, s, -1e30), axis=-1)
+    p = jnp.where(jnp.any(vis, axis=-1, keepdims=True), p, 0.0)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    # dense mha path applies the same convention: T=100 is not
+    # block-divisible, so supported() is False and mha truly takes
+    # _dense_attention (T=256 here would route to flash under the
+    # interpret fixture's min_seq=2*BLOCK)
+    qd, kd, vd = (a[:, :100] for a in (q, k, v))
+    got_dense = mha(qd, kd, vd, True, jnp.float32, key_mask=km[:, :100])
+    np.testing.assert_array_equal(np.asarray(got_dense[0, 0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(got_dense[1, :2]), 0.0)
+    got_flash_trunc = fa.flash_attention(
+        jnp.pad(qd, ((0, 0), (0, 156), (0, 0), (0, 0))),
+        jnp.pad(kd, ((0, 0), (0, 156), (0, 0), (0, 0))),
+        jnp.pad(vd, ((0, 0), (0, 156), (0, 0), (0, 0))), causal=True,
+        key_mask=jnp.pad(km[:, :100], ((0, 0), (0, 156))))[:, :100]
+    np.testing.assert_allclose(np.asarray(got_dense),
+                               np.asarray(got_flash_trunc),
+                               rtol=2e-4, atol=2e-5)
+
+    # gradients: finite everywhere, exactly 0 into the dead rows' queries
+    gq, gk, gv = jax.grad(
+        lambda a, b_, c: jnp.sum(fa.flash_attention(
+            a, b_, c, causal=True, key_mask=km) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_array_equal(np.asarray(gq[0, 0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(gq[1, :2]), 0.0)
+
+    # and the masked key's k/v receive no gradient through dead rows only —
+    # cross-check full grads against the zero-convention dense oracle
+    gqd, gkd, gvd = jax.grad(
+        lambda a, b_, c: jnp.sum(jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            jnp.where(jnp.any(vis, axis=-1, keepdims=True), jax.nn.softmax(
+                jnp.where(vis, jnp.einsum("bqhd,bkhd->bhqk", a, b_)
+                          / jnp.sqrt(32.0), -1e30), axis=-1), 0.0),
+            c) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip((gq, gk, gv), (gqd, gkd, gvd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_mha_routes_masked_to_flash(monkeypatch):
     """supported() accepts a [b, T] array mask; mha with such a mask on a
     block-divisible sequence must ACTUALLY take the flash path (spied) and
