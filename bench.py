@@ -131,6 +131,19 @@ def _time_steps(step_fn, n_warmup=3, n_timed=10):
     return time.perf_counter() - t0
 
 
+def _warm_time(fn, *args, iters=5):
+    """Compile+warm ``fn(*args)`` once, then return mean seconds per call
+    over ``iters`` calls — the shared timing harness for the perf_* scripts
+    (same value-fetch gating rationale as :func:`_time_steps`)."""
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
 def _cnn_throughput(model_cls, batch, img, classes=1000, iters=10,
                     compute_dtype="bfloat16", **model_kw):
     """images/sec for a zoo CNN (ComputationGraph or MultiLayerNetwork) on

@@ -44,7 +44,22 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-BLOCK = 128  # q/k block edge: MXU-aligned (lane dim 128)
+# q/k block edge. 128 is the MXU lane-aligned minimum; LARGER blocks divide
+# the sequential grid-step count quadratically (grid = bh * (T/B)^2), which
+# is what bounds throughput at head_dim 64 (each 128x64x128 dot is ~2 MFLOP
+# of MXU work against fixed per-step DMA/launch latency). IMPORT-TIME knob:
+# DL4J_TPU_FLASH_BLOCK must be set before the first import (same trace-time
+# caveat as DL4J_TPU_LSTM_UNROLL, read once here so behavior is predictable;
+# supported()/T-divisibility and the tests' 2*BLOCK min_seq all follow it).
+import os as _os
+try:
+    BLOCK = max(128, int(_os.environ.get("DL4J_TPU_FLASH_BLOCK", "128")))
+except ValueError:  # pragma: no cover - malformed override
+    BLOCK = 128
+# snap to the 128-lane grid: a non-multiple would mis-tile every BlockSpec;
+# a multiple that doesn't divide a model's T makes supported() route that
+# model to the dense path (by design — same rule as any odd T)
+BLOCK -= BLOCK % 128
 _NEG = -1e30
 
 # ---------------------------------------------------------------- dropout RNG

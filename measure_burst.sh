@@ -88,6 +88,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         graves_lstm_charrnn_chars_per_sec --write
     need flash    && probe && run_stage flash \
                      timeout 1800 python perf_flash_check.py
+    # r5b: flash BLOCK A/B at the transformer bench shapes (fresh
+    # subprocess per value — import-time knob) + LSTM latency attribution
+    # budget: 4 blocks x <=900s child timeout + parent startup slack
+    need blocksweep && probe && run_stage blocksweep \
+                     timeout 4500 python perf_flash_check.py blocksweep
+    need micro    && probe && run_stage micro \
+                     timeout 1200 python perf_lstm.py micro
     need roofline && probe && run_stage roofline \
                      timeout 1200 python perf_lstm.py roofline
     need ab       && probe && run_stage ab \
@@ -114,7 +121,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
      [ -f "$STATE/flash.ok" ] && [ -f "$STATE/roofline.ok" ] && \
      [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ] && \
      [ -f "$STATE/rescost.ok" ] && [ -f "$STATE/resbench.ok" ] && \
-     [ -f "$STATE/resremat.ok" ]; then
+     [ -f "$STATE/resremat.ok" ] && [ -f "$STATE/blocksweep.ok" ] && \
+     [ -f "$STATE/micro.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="
     exit 0
   fi

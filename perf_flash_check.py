@@ -6,6 +6,7 @@ dense oracle. Run when the axon tunnel is healthy:
 
     python perf_flash_check.py
 """
+import os
 import time
 
 import numpy as np
@@ -112,5 +113,71 @@ def main():
     print("FLASH HARDWARE CHECK OK")
 
 
+def block_one():
+    """Child for blocksweep: time flash fwd and fwd+bwd at the transformer
+    bench's attention shapes (bench.py bench_transformer_lm: b=4, h=8,
+    T=8192, d=64 -> bh=32). The block size comes from DL4J_TPU_FLASH_BLOCK
+    (import-time knob — that is why each value needs a fresh process)."""
+    import json
+
+    from bench import _warm_time
+    import deeplearning4j_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    b, T, h, d = 4, 8192, 8, 64
+    # a BLOCK that doesn't divide T would leave tail blocks unwritten
+    # (supported() normally guards this; we call the kernel directly)
+    assert T % fa.BLOCK == 0, (T, fa.BLOCK)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, T, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    f = jax.jit(lambda a, b_, c: fa.flash_attention(a, b_, c, causal=True))
+    g = jax.jit(jax.grad(lambda a, b_, c: jnp.sum(
+        fa.flash_attention(a, b_, c, causal=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))
+    tf = _warm_time(f, q, k, v)
+    tg = _warm_time(g, q, k, v)
+    print(json.dumps({"block": fa.BLOCK, "fwd_ms": tf * 1e3,
+                      "fwdbwd_ms": tg * 1e3}))
+
+
+def blocksweep():
+    """A/B DL4J_TPU_FLASH_BLOCK (import-time knob -> fresh subprocess per
+    value) at the transformer bench attention shapes."""
+    import json
+    import subprocess
+    import sys
+
+    print(f"{'block':>6} {'fwd_ms':>9} {'fwdbwd_ms':>10}")
+    for blk in (128, 256, 512, 1024):
+        env = dict(os.environ, DL4J_TPU_FLASH_BLOCK=str(blk))
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "block-one"],
+                capture_output=True, text=True, env=env, timeout=900)
+        except subprocess.TimeoutExpired:
+            print(f"{blk:>6} FAILED timeout", flush=True)
+            continue
+        line = None
+        for ln in reversed((p.stdout or "").splitlines()):
+            try:
+                line = json.loads(ln)
+                break
+            except ValueError:
+                continue
+        if p.returncode or not line:
+            print(f"{blk:>6} FAILED rc={p.returncode} "
+                  f"{(p.stderr or '')[-300:]}", flush=True)
+            continue
+        print(f"{blk:>6} {line['fwd_ms']:>9.1f} {line['fwdbwd_ms']:>10.1f}",
+              flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "check"
+    if cmd == "blocksweep":
+        blocksweep()
+    elif cmd == "block-one":
+        block_one()
+    else:
+        main()

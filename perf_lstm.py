@@ -96,6 +96,79 @@ def kernel_ab(batch=64, width=512, tbptt=50, seq_len=200):
             os.environ["DL4J_TPU_NO_PERSISTENT_LSTM"] = prior
 
 
+def micro(batch=64, width=512, tbptt=50):
+    """Decompose the per-grid-step latency (r5: bench 431-580k chars/s vs
+    740k raw step vs 7.6M roofline). Times, in isolation on-chip:
+      a. empty pallas kernel, same grid as one TBPTT segment (pure
+         grid-step overhead)
+      b. the recurrent matmul chain alone ([b,H]@[H,4H] x tbptt, lax.scan)
+      c. the full persistent-LSTM fwd kernel, one segment
+      d. lstm_scan fwd+bwd (kernel + BPTT kernel + outside gemms)
+    Each leg prints ms per call and µs per timestep, so the residual
+    between (a)-(d) attributes the 33 µs/step directly."""
+    from jax.experimental import pallas as pl
+    from bench import _warm_time
+    import deeplearning4j_tpu.ops.lstm_cell as lc
+
+    b, H, T = batch, width, tbptt
+    U = lc._unroll_factor(T, b, H, 2)
+    nb = T // U
+    rng = np.random.default_rng(0)
+    xp = jnp.asarray(rng.normal(size=(T, b, 4 * H)), jnp.float32)
+    rw = jnp.asarray(rng.normal(size=(H, 4 * H)) / np.sqrt(H), jnp.bfloat16)
+    h0 = jnp.zeros((b, H), jnp.float32)
+    c0 = jnp.zeros((b, H), jnp.float32)
+
+    def timeit(fn, *args):
+        return _warm_time(fn, *args, iters=20)
+
+    # a. empty kernel on the same grid (streams the same xp blocks so the
+    # DMA pattern matches; compute body is a single copy)
+    def _empty_kernel(xp_ref, o_ref):
+        o_ref[...] = xp_ref[...]
+
+    empty = jax.jit(lambda x: pl.pallas_call(
+        _empty_kernel,
+        grid=(nb,),
+        in_specs=[lc._vspec((U, b, 4 * H), lambda t: (t, 0, 0))],
+        out_specs=lc._vspec((U, b, 4 * H), lambda t: (t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, b, 4 * H), x.dtype),
+        interpret=lc._interpret(),
+    )(x))
+    ta = timeit(empty, xp)
+    print(f"a. empty {nb}-step grid:        {ta*1e3:8.3f} ms "
+          f"({ta/T*1e6:6.1f} us/timestep)")
+
+    # b. recurrent matmul chain alone (scan, no pallas)
+    def chain(h, _):
+        z = jax.lax.dot_general(h.astype(jnp.bfloat16), rw,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return jnp.tanh(z[:, :H]), None
+
+    chain_j = jax.jit(lambda h: jax.lax.scan(chain, h, None, length=T)[0])
+    tb = timeit(chain_j, h0)
+    print(f"b. bare matmul chain (scan):   {tb*1e3:8.3f} ms "
+          f"({tb/T*1e6:6.1f} us/timestep)")
+
+    # c. persistent fwd kernel, one segment (training fwd w/ reserve)
+    fwd_j = jax.jit(lambda x, r, h, c: lc._fwd(x, r, None, h, c, None)[0])
+    tc = timeit(fwd_j, xp, rw, h0, c0)
+    print(f"c. persistent fwd kernel:      {tc*1e3:8.3f} ms "
+          f"({tc/T*1e6:6.1f} us/timestep)")
+
+    # d. lstm_scan fwd+bwd
+    xp_bm = jnp.swapaxes(xp, 0, 1)
+    grad_j = jax.jit(jax.grad(lambda x, r: jnp.sum(
+        lc.lstm_scan(x, r, None, h0, c0)[0]), argnums=(0, 1)))
+    td = timeit(grad_j, xp_bm, rw)
+    print(f"d. lstm_scan fwd+bwd:          {td*1e3:8.3f} ms "
+          f"({td/T*1e6:6.1f} us/timestep)")
+    print(f"attribution: grid overhead {ta/T*1e6:.1f} us, +matmul "
+          f"{(tb-ta)/T*1e6:+.1f} us, +gates/reserve {(tc-tb)/T*1e6:+.1f} us,"
+          f" +bwd {(td-tc)/T*1e6:+.1f} us  (per timestep)")
+
+
 def unroll_sweep(batch=64, width=512, tbptt=50, seq_len=200):
     """VERDICT r4 item 3: sweep DL4J_TPU_LSTM_UNROLL (U timesteps per
     pallas grid step) to find where the sequential-latency division
@@ -210,6 +283,8 @@ if __name__ == "__main__":
                                                     tbptt=t, seq_len=s)}))
     elif cmd == "ab":
         kernel_ab()
+    elif cmd == "micro":
+        micro()
     elif cmd == "roofline":
         roofline()
     elif cmd == "profile":
