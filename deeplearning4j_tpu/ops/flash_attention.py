@@ -31,6 +31,7 @@ always-available fallback for short/odd-length sequences.
 from __future__ import annotations
 
 import functools
+import math
 
 import numpy as np
 import jax
@@ -47,20 +48,42 @@ except Exception:  # pragma: no cover
 # q/k block edge. 128 is the MXU lane-aligned minimum; LARGER blocks divide
 # the sequential grid-step count quadratically (grid = bh * (T/B)^2), which
 # is what bounds throughput at head_dim 64 (each 128x64x128 dot is ~2 MFLOP
-# of MXU work against fixed per-step DMA/launch latency). IMPORT-TIME knob:
+# of MXU work against fixed per-step DMA/launch latency). BLOCK is the CAP:
+# each kernel call picks the largest 128-multiple <= BLOCK that divides its
+# T (:func:`pick_block`), so odd-length-but-lane-aligned sequences degrade
+# to a smaller block instead of losing the flash path. IMPORT-TIME knob:
 # DL4J_TPU_FLASH_BLOCK must be set before the first import (same trace-time
-# caveat as DL4J_TPU_LSTM_UNROLL, read once here so behavior is predictable;
-# supported()/T-divisibility and the tests' 2*BLOCK min_seq all follow it).
+# caveat as DL4J_TPU_LSTM_UNROLL, read once here so behavior is
+# predictable); snapped to the 128 grid — a non-multiple would mis-tile
+# every BlockSpec.
 import os as _os
+MIN_BLOCK = 128
 try:
-    BLOCK = max(128, int(_os.environ.get("DL4J_TPU_FLASH_BLOCK", "128")))
+    BLOCK = max(MIN_BLOCK,
+                int(_os.environ.get("DL4J_TPU_FLASH_BLOCK", "128")))
 except ValueError:  # pragma: no cover - malformed override
-    BLOCK = 128
-# snap to the 128-lane grid: a non-multiple would mis-tile every BlockSpec;
-# a multiple that doesn't divide a model's T makes supported() route that
-# model to the dense path (by design — same rule as any odd T)
-BLOCK -= BLOCK % 128
+    BLOCK = MIN_BLOCK
+BLOCK -= BLOCK % MIN_BLOCK
 _NEG = -1e30
+
+
+def pick_block(T: int, d: int) -> int:
+    """Largest 128-multiple <= the BLOCK cap that divides ``T``, bounded by
+    a VMEM budget covering BOTH the [blk, d] operand tiles (blk*d <= 64k
+    elements) and the dominant in-kernel [blk, blk] f32 intermediates
+    (s/p/keep: 12*blk^2 bytes <= 8 MB, which caps picks at 768; at d=128
+    the operand term caps at 512 first, at d=256 at 256). Dropout
+    coordinates hash GLOBAL positions, so forward/backward kernels may
+    legally pick different blocks without changing any semantics."""
+    cap = min(BLOCK, T)
+    cap -= cap % MIN_BLOCK
+    while cap > MIN_BLOCK and (cap * d > 65536
+                               or 12 * cap * cap > 8 * 2 ** 20):
+        cap -= MIN_BLOCK
+    for b in range(cap, MIN_BLOCK, -MIN_BLOCK):
+        if T % b == 0:
+            return b
+    return MIN_BLOCK
 
 # ---------------------------------------------------------------- dropout RNG
 # Counter-based hash PRNG for attention-probability dropout INSIDE the
@@ -104,17 +127,19 @@ def _keep_from_coords(seed, bh, qpos, kpos, rate):
     return (u >= rate).astype(jnp.float32)
 
 
-def _block_keep(seed_ref, bh, qi, kj, rate):
-    """[BLOCK, BLOCK] keep mask for attention block (bh, qi, kj). The SMEM
+def _block_keep(seed_ref, bh, qi, kj, rate, blk):
+    """[blk, blk] keep mask for attention block (bh, qi, kj). The SMEM
     seed operand is [3] i32: (seed, q_offset, k_offset) — the offsets make
     the hashed coordinates GLOBAL, so a kernel running on a ring shard
     draws bit-identical decisions to a single kernel over the full
     sequence (``parallel.sequence.ring_flash_attention`` passes each ring
-    step's shard offsets; single-device callers pass 0, 0)."""
-    qpos = (seed_ref[1] + qi * BLOCK
-            + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 0))
-    kpos = (seed_ref[2] + kj * BLOCK
-            + lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 1))
+    step's shard offsets; single-device callers pass 0, 0). Hashing global
+    positions also makes the decisions independent of the block size the
+    calling kernel happened to pick."""
+    qpos = (seed_ref[1] + qi * blk
+            + lax.broadcasted_iota(jnp.int32, (blk, blk), 0))
+    kpos = (seed_ref[2] + kj * blk
+            + lax.broadcasted_iota(jnp.int32, (blk, blk), 1))
     return _keep_from_coords(seed_ref[0], bh, qpos, kpos, rate)
 
 
@@ -174,7 +199,8 @@ def _causal_mask(s, qi, kj, block):
 
 
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km,
+                blk):
     has_seed = rate > 0.0
     km_ref = rest[0] if has_km else None
     seed_ref = rest[int(has_km)] if has_seed else None
@@ -197,7 +223,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, BLOCK)
+            s = _causal_mask(s, qi, kj, blk)
         if km_ref is not None:
             s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         m = m_s[:, 0]
@@ -210,7 +236,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         l_s[:, 0] = l_s[:, 0] * alpha + jnp.sum(p, axis=-1)
         m_s[:, 0] = m_new
         if rate > 0.0:
-            keep = _block_keep(seed_ref, bh, qi, kj, rate)
+            keep = _block_keep(seed_ref, bh, qi, kj, rate, blk)
             p = p * keep * (1.0 / (1.0 - rate))
         acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -240,9 +266,10 @@ def _fwd(q, k, v, km, seed, causal, scale, rate):
     (seed, q_off, k_off — :func:`seed3`) or None (rate > 0) →
     (o [bh, T, d], lse [bh, T, 8])."""
     bh, T, d = q.shape
-    nq = T // BLOCK
+    blk = pick_block(T, d)
+    nq = T // blk
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=nq,
-                             rate=rate, has_km=km is not None)
+                             rate=rate, has_km=km is not None, blk=blk)
     if causal:
         # invisible (kj > qj) steps clamp to the diagonal block: same index
         # as the previous visible step → Pallas skips the DMA entirely
@@ -250,16 +277,16 @@ def _fwd(q, k, v, km, seed, causal, scale, rate):
     else:
         kv_idx = lambda i, qj, kj: (i, kj, 0)
     # lse is lane-padded to [bh, T, 8]: TPU block shapes need their last two
-    # dims (8·k, 128·m) or full-dim; a (1, BLOCK) slice of [bh, T] is
+    # dims (8·k, 128·m) or full-dim; a (1, blk) slice of [bh, T] is
     # unlowerable. 8 f32 lanes per position is noise next to q/k/v
     in_specs = [
-        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
-        _vspec((1, BLOCK, d), kv_idx),
-        _vspec((1, BLOCK, d), kv_idx),
+        _vspec((1, blk, d), lambda i, qj, kj: (i, qj, 0)),
+        _vspec((1, blk, d), kv_idx),
+        _vspec((1, blk, d), kv_idx),
     ]
     operands = [q, k, v]
     if km is not None:
-        in_specs.append(_vspec((1, BLOCK, 8), kv_idx))
+        in_specs.append(_vspec((1, blk, 8), kv_idx))
         operands.append(km)
     if rate > 0.0:
         in_specs.append(_smem_spec())
@@ -269,19 +296,20 @@ def _fwd(q, k, v, km, seed, causal, scale, rate):
         grid=(bh, nq, nq),
         in_specs=in_specs,
         out_specs=(
-            _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
-            _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),
+            _vspec((1, blk, d), lambda i, qj, kj: (i, qj, 0)),
+            _vspec((1, blk, 8), lambda i, qj, kj: (i, qj, 0)),
         ),
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((bh, T, 8), jnp.float32)),
-        scratch_shapes=[_scratch((BLOCK, 8)), _scratch((BLOCK, 8)),
-                        _scratch((BLOCK, d))],
+        scratch_shapes=[_scratch((blk, 8)), _scratch((blk, 8)),
+                        _scratch((blk, d))],
         interpret=_interpret(),
     )(*operands)
 
 
 # ----------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
+def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate,
+               has_km, blk):
     has_seed = rate > 0.0
     km_ref = rest[0] if has_km else None
     seed_ref = rest[int(has_km)] if has_seed else None
@@ -305,7 +333,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, BLOCK)
+            s = _causal_mask(s, qi, kj, blk)
         if km_ref is not None:
             s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         # s-guard: masked cells get p = 0 even on fully-masked rows, where
@@ -316,7 +344,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         if rate > 0.0:
             # dP flows only through kept cells: dP = (do·vᵀ)·keep/(1-r);
             # delta already equals rowsum(P∘dP) = rowsum(do∘o) unchanged
-            keep = _block_keep(seed_ref, bh, qi, kj, rate)
+            keep = _block_keep(seed_ref, bh, qi, kj, rate, blk)
             dp = dp * keep * (1.0 / (1.0 - rate))
         ds = p * (dp - delta[:, None]) * scale
         dq_s[:] = dq_s[:] + jax.lax.dot_general(
@@ -330,7 +358,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nk, rate, has_km):
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
+def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate,
+                has_km, blk):
     has_seed = rate > 0.0
     km_ref = rest[0] if has_km else None
     seed_ref = rest[int(has_km)] if has_seed else None
@@ -354,7 +383,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qj, ki, BLOCK)
+            s = _causal_mask(s, qj, ki, blk)
         if km_ref is not None:
             s = jnp.where(km_ref[0, :, 0][None, :] > 0, s, _NEG)
         # same s-guard as _dq_kernel (fully-masked rows: lse = _NEG)
@@ -363,7 +392,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, nq, rate, has_km):
         if rate > 0.0:
             # same (bh, q-block, k-block) seeding as the fwd kernel: the
             # grid here is (bh, k, q), so the id order swaps
-            keep = _block_keep(seed_ref, bh, qj, ki, rate)
+            keep = _block_keep(seed_ref, bh, qj, ki, rate, blk)
             pd = p * keep * (1.0 / (1.0 - rate))          # = drop(P)
         else:
             pd = p
@@ -396,38 +425,41 @@ def dq_block(q, k, v, km, do, delta, lse, causal, scale, seed=None,
     in-kernel backward below AND per ring step by
     ``parallel.sequence.ring_flash_attention``."""
     bh, Tq, d = q.shape
-    nq, nk = Tq // BLOCK, k.shape[1] // BLOCK
+    # one block size must tile BOTH the q shard and the k/v block (the ring
+    # passes different lengths): pick on the gcd
+    blk = pick_block(math.gcd(Tq, k.shape[1]), d)
+    nq, nk = Tq // blk, k.shape[1] // blk
     kern = functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nk,
-                             rate=rate, has_km=km is not None)
+                             rate=rate, has_km=km is not None, blk=blk)
     if causal:
         kv_idx = lambda i, qj, kj: (i, jnp.minimum(kj, qj), 0)
     else:
         kv_idx = lambda i, qj, kj: (i, kj, 0)
     specs = [
-        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # q
-        _vspec((1, BLOCK, d), kv_idx),                         # k
-        _vspec((1, BLOCK, d), kv_idx),                         # v
+        _vspec((1, blk, d), lambda i, qj, kj: (i, qj, 0)),     # q
+        _vspec((1, blk, d), kv_idx),                           # k
+        _vspec((1, blk, d), kv_idx),                           # v
     ]
     ops = [q, k, v]
     if km is not None:
-        specs.append(_vspec((1, BLOCK, 8), kv_idx))            # key mask
+        specs.append(_vspec((1, blk, 8), kv_idx))              # key mask
         ops.append(km)
     if rate > 0.0:
         specs.append(_smem_spec())
         ops.append(seed)
     specs += [
-        _vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),   # do
-        _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # delta
-        _vspec((1, BLOCK, 8), lambda i, qj, kj: (i, qj, 0)),   # lse
+        _vspec((1, blk, d), lambda i, qj, kj: (i, qj, 0)),     # do
+        _vspec((1, blk, 8), lambda i, qj, kj: (i, qj, 0)),     # delta
+        _vspec((1, blk, 8), lambda i, qj, kj: (i, qj, 0)),     # lse
     ]
     ops += [do, delta, lse]
     return pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
         in_specs=specs,
-        out_specs=_vspec((1, BLOCK, d), lambda i, qj, kj: (i, qj, 0)),
+        out_specs=_vspec((1, blk, d), lambda i, qj, kj: (i, qj, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[_scratch((BLOCK, d))],
+        scratch_shapes=[_scratch((blk, d))],
         interpret=_interpret(),
     )(*ops)
 
@@ -437,30 +469,31 @@ def dkv_block(q, k, v, km, do, delta, lse, causal, scale, seed=None,
     """(dk, dv) for one k/v block against one q-shard; see :func:`dq_block`
     for the global-``lse``/``delta`` contract."""
     bh, Tk, d = k.shape
-    nq, nk = q.shape[1] // BLOCK, Tk // BLOCK
+    blk = pick_block(math.gcd(q.shape[1], Tk), d)
+    nq, nk = q.shape[1] // blk, Tk // blk
     kern = functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq,
-                             rate=rate, has_km=km is not None)
+                             rate=rate, has_km=km is not None, blk=blk)
     if causal:
         q_idx = lambda i, kj, qj: (i, jnp.maximum(qj, kj), 0)
     else:
         q_idx = lambda i, kj, qj: (i, qj, 0)
     specs = [
-        _vspec((1, BLOCK, d), q_idx),                          # q
-        _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # k
-        _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),   # v
+        _vspec((1, blk, d), q_idx),                            # q
+        _vspec((1, blk, d), lambda i, kj, qj: (i, kj, 0)),     # k
+        _vspec((1, blk, d), lambda i, kj, qj: (i, kj, 0)),     # v
     ]
     ops = [q, k, v]
     if km is not None:
-        specs.append(_vspec((1, BLOCK, 8),
+        specs.append(_vspec((1, blk, 8),
                             lambda i, kj, qj: (i, kj, 0)))     # key mask
         ops.append(km)
     if rate > 0.0:
         specs.append(_smem_spec())
         ops.append(seed)
     specs += [
-        _vspec((1, BLOCK, d), q_idx),                          # do
-        _vspec((1, BLOCK, 8), q_idx),                          # delta
-        _vspec((1, BLOCK, 8), q_idx),                          # lse
+        _vspec((1, blk, d), q_idx),                            # do
+        _vspec((1, blk, 8), q_idx),                            # delta
+        _vspec((1, blk, 8), q_idx),                            # lse
     ]
     ops += [do, delta, lse]
     return pl.pallas_call(
@@ -468,12 +501,12 @@ def dkv_block(q, k, v, km, do, delta, lse, causal, scale, seed=None,
         grid=(bh, nk, nq),
         in_specs=specs,
         out_specs=(
-            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
-            _vspec((1, BLOCK, d), lambda i, kj, qj: (i, kj, 0)),
+            _vspec((1, blk, d), lambda i, kj, qj: (i, kj, 0)),
+            _vspec((1, blk, d), lambda i, kj, qj: (i, kj, 0)),
         ),
         out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
-        scratch_shapes=[_scratch((BLOCK, d)), _scratch((BLOCK, d))],
+        scratch_shapes=[_scratch((blk, d)), _scratch((blk, d))],
         interpret=_interpret(),
     )(*ops)
 
@@ -559,7 +592,7 @@ def supported(T: int, d: int, dropout_rate: float, key_mask) -> bool:
     (round-3 VERDICT item 5) AND attention-probability dropout (round-3
     "ideally dropout"; in-kernel counter-hash PRNG) stream through the
     kernels — neither falls back to dense anymore."""
-    min_seq = 2 * BLOCK if _FORCE_INTERPRET else MIN_SEQ
+    min_seq = 2 * MIN_BLOCK if _FORCE_INTERPRET else MIN_SEQ
     if not _FORCE_INTERPRET:
         try:
             if jax.default_backend() not in ("tpu", "axon"):
@@ -568,7 +601,7 @@ def supported(T: int, d: int, dropout_rate: float, key_mask) -> bool:
             return False
     if key_mask is not None and getattr(key_mask, "ndim", None) != 2:
         return False
-    return (T % BLOCK == 0 and T >= min_seq and d <= 256
+    return (T % MIN_BLOCK == 0 and T >= min_seq and d <= 256
             and 0.0 <= dropout_rate < 1.0)
 
 

@@ -185,7 +185,7 @@ def ulysses_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
 def ulysses_flash_supported(T: int, n_shards: int, h: int, d: int) -> bool:
     from ..ops import flash_attention as _fa
     n = max(1, n_shards)
-    return (h % n == 0 and T % n == 0 and T % _fa.BLOCK == 0 and d <= 256
+    return (h % n == 0 and T % n == 0 and T % _fa.MIN_BLOCK == 0 and d <= 256
             and (_fa._FORCE_INTERPRET
                  or _fa.supported(max(T, _fa.MIN_SEQ), d, 0.0, None)))
 
@@ -393,7 +393,7 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = SEQUENCE_AXIS,
 def ring_flash_supported(T: int, n_shards: int, d: int) -> bool:
     from ..ops import flash_attention as _fa
     Tl = T // max(1, n_shards)
-    return (T % max(1, n_shards) == 0 and Tl % _fa.BLOCK == 0 and d <= 256
+    return (T % max(1, n_shards) == 0 and Tl % _fa.MIN_BLOCK == 0 and d <= 256
             and (_fa._FORCE_INTERPRET
                  or _fa.supported(max(Tl, _fa.MIN_SEQ), d, 0.0, None)))
 
@@ -434,7 +434,7 @@ def sp_attend(q, k, v, axis: str, causal: bool, dropout_rate: float = 0.0,
     rate = float(dropout_rate)
     if rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 needs dropout_seed")
-    flash_ok = (Tl % _fa.BLOCK == 0 and d <= 256
+    flash_ok = (Tl % _fa.MIN_BLOCK == 0 and d <= 256
                 and (_fa._FORCE_INTERPRET or not _fa._interpret()))
     # dropout-free + head-divisible: Ulysses layout — 2 all_to_alls on ICI
     # and ONE full-sequence kernel beats the ring's n sequential launches
@@ -451,7 +451,7 @@ def sp_attend(q, k, v, axis: str, causal: bool, dropout_rate: float = 0.0,
         raise ValueError(
             "attention dropout on the sp path needs the ring-flash kernel: "
             "a TPU backend (or the tests' forced interpret mode), local "
-            f"shard length {Tl} divisible by {_fa.BLOCK}, and head_dim "
+            f"shard length {Tl} divisible by {_fa.MIN_BLOCK}, and head_dim "
             f"{d} <= 256")
     return _ring_inner(q, k, v, axis=axis, causal=causal, scale=scale)
 

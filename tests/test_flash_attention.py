@@ -320,6 +320,32 @@ def test_flash_key_mask_grads_match_dense():
                                    rtol=2e-3, atol=2e-4)
 
 
+def test_pick_block_contract():
+    """pick_block: largest 128-multiple <= cap dividing T, bounded by the
+    blk*d <= 64k VMEM tile budget, floor 128. (The cap itself is the
+    import-time DL4J_TPU_FLASH_BLOCK knob, default 128.)"""
+    old = fa.BLOCK
+    try:
+        fa.BLOCK = 512
+        assert fa.pick_block(8192, 64) == 512
+        assert fa.pick_block(4224, 64) == 384    # 33*128: 512∤, 384|
+        assert fa.pick_block(4352, 64) == 256    # 34*128: 512∤, 384∤, 256|
+        assert fa.pick_block(8192, 256) == 256   # VMEM: 512*256 > 64k
+        assert fa.pick_block(256, 64) == 256     # cap clamps to T
+        assert fa.pick_block(128, 64) == 128
+        fa.BLOCK = 1024
+        # 1024*64 fits the operand budget but 12*1024^2 blows the [blk,blk]
+        # intermediate budget -> capped at 768, which doesn't divide 8192,
+        # so the largest dividing 128-multiple <= 768 wins
+        assert fa.pick_block(8192, 64) == 512
+        assert fa.pick_block(8192, 128) == 512
+        assert fa.pick_block(768 * 4, 64) == 768
+        fa.BLOCK = 128
+        assert fa.pick_block(8192, 64) == 128    # default: unchanged path
+    finally:
+        fa.BLOCK = old
+
+
 def test_flash_fully_masked_rows_zero():
     """A query row whose visible keys are ALL masked outputs 0 with zero
     gradients — the framework-wide convention (found on first hardware run:
@@ -350,7 +376,7 @@ def test_flash_fully_masked_rows_zero():
     # dense mha path applies the same convention: T=100 is not
     # block-divisible, so supported() is False and mha truly takes
     # _dense_attention (T=256 here would route to flash under the
-    # interpret fixture's min_seq=2*BLOCK)
+    # interpret fixture's min_seq = 2*MIN_BLOCK = 256)
     qd, kd, vd = (a[:, :100] for a in (q, k, v))
     got_dense = mha(qd, kd, vd, True, jnp.float32, key_mask=km[:, :100])
     np.testing.assert_array_equal(np.asarray(got_dense[0, 0]), 0.0)
