@@ -49,11 +49,28 @@ def _sig(x):
     return jax.nn.sigmoid(x)
 
 
+def _stream_dtype():
+    """Dtype of the HBM-streamed per-step tensors (xp in, ys/gates/cseq
+    reserve out, dz out): ``DL4J_TPU_LSTM_STREAM_DTYPE`` = ``float32``
+    (default) or ``bfloat16``. bf16 halves the dominant HBM traffic of the
+    sequential chain (the cuDNN reserve-space convention stores the
+    compute dtype) at a small recompute-precision cost in the backward;
+    h/c state and all gate math stay f32 regardless. TRACE-TIME knob, same
+    caveat as ``DL4J_TPU_LSTM_UNROLL``: set it before the first step of a
+    config."""
+    import os
+    v = os.environ.get("DL4J_TPU_LSTM_STREAM_DTYPE", "float32")
+    return jnp.bfloat16 if v in ("bfloat16", "bf16") else jnp.float32
+
+
 def _vmem_fits(b: int, H: int, weight_bytes: int, u: int = 1) -> bool:
     """One budget definition for supported() AND _unroll_factor: resident
     [H, 4H] weights + the u-scaled double-buffered streamed blocks must fit
-    a core's VMEM (measured heuristic — see supported())."""
-    return 4 * H * H * weight_bytes + 120 * u * b * H <= 12 * 2 ** 20
+    a core's VMEM (measured heuristic — see supported()). The stream term
+    scales with the stream dtype (30·stream_bytes·u·b·H: 120 coeff at f32,
+    60 at bf16 — bf16 streams double the U the budget admits)."""
+    sb = jnp.dtype(_stream_dtype()).itemsize
+    return 4 * H * H * weight_bytes + 30 * sb * u * b * H <= 12 * 2 ** 20
 
 
 def _unroll_factor(T: int, b: int, H: int, weight_bytes: int) -> int:
@@ -187,7 +204,7 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
         return kern(ins[0], ins[1], peep_ref, m_ref, ins[pos], ins[pos + 1],
                     ys_ref, gates_ref, cseq_ref, hc_ref, h_s, c_s)
 
-    ad = jnp.float32
+    sd = _stream_dtype()          # reserve stream dtype (policy knob)
     out_specs = [_vspec((U, b, H), lambda t: (t, 0, 0))]  # ys
     out_shape = [jax.ShapeDtypeStruct((T, b, H), xp.dtype)]
     if save_reserve:
@@ -195,10 +212,10 @@ def _fwd(xp, rw, peep, h0, c0, mask, save_reserve=True):
             _vspec((U, b, H4), lambda t: (t, 0, 0)),      # gates (reserve)
             _vspec((U, b, H), lambda t: (t, 0, 0)),       # c sequence
         ]
-        out_shape += [jax.ShapeDtypeStruct((T, b, H4), ad),
-                      jax.ShapeDtypeStruct((T, b, H), ad)]
-    out_specs.append(_vspec((2, b, H), const3))           # final (h, c)
-    out_shape.append(jax.ShapeDtypeStruct((2, b, H), ad))
+        out_shape += [jax.ShapeDtypeStruct((T, b, H4), sd),
+                      jax.ShapeDtypeStruct((T, b, H), sd)]
+    out_specs.append(_vspec((2, b, H), const3))           # final (h, c):
+    out_shape.append(jax.ShapeDtypeStruct((2, b, H), jnp.float32))
     res = pl.pallas_call(
         shim,
         grid=(nb,),
@@ -353,7 +370,8 @@ def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
         return kern(ins[0], ins[1], ins[2], ins[3], ins[4], peep_ref, m_ref,
                     ins[pos], ins[pos + 1], ins[pos + 2], *rest)
 
-    ad = jnp.float32
+    sd = _stream_dtype()          # dz rides the stream-dtype policy too
+    f32 = jnp.float32
     return pl.pallas_call(
         shim,
         grid=(nb,),
@@ -364,10 +382,10 @@ def _bwd_call(dy, gates, cseq, rwt, peep, mask, c0, dhT, dcT):
             _vspec((b, H), const2),                       # dc0
             _vspec((8, H), const2),                       # dpeep
         ),
-        out_shape=(jax.ShapeDtypeStruct((T, b, H4), ad),
-                   jax.ShapeDtypeStruct((b, H), ad),
-                   jax.ShapeDtypeStruct((b, H), ad),
-                   jax.ShapeDtypeStruct((8, H), ad)),
+        out_shape=(jax.ShapeDtypeStruct((T, b, H4), sd),
+                   jax.ShapeDtypeStruct((b, H), f32),
+                   jax.ShapeDtypeStruct((b, H), f32),
+                   jax.ShapeDtypeStruct((8, H), f32)),
         scratch_shapes=[_scratch((b, H)), _scratch((b, H)),
                         _scratch((8, H))],
         interpret=_interpret(),
@@ -439,12 +457,15 @@ def supported(b: int, T: int, H: int, activation: str,
     # the bwd kernel holds the transpose) PLUS the batch-dependent per-step
     # blocks — xp/ys/gates/cseq/dz streams (double-buffered by the
     # pipeline), h0/c0/dhT/dcT and the h/c scratch. Worst case (bwd) ≈
-    # 4H²·wb + ~120·b·H bytes; cap the SUM under a core's VMEM so oversized
-    # configs fall back to the scan instead of failing a Mosaic allocation.
-    # bf16-resident weights (weight_bytes=2, the mixed-precision policy)
-    # halve the resident term: f32 b=64,H=512 → 7.9 MB ✓; b=256,H=512 →
-    # 19.7 MB ✗ → scan; bf16 b=64,H=1024 → 16.2 MB ✗ → scan still, but
-    # bf16 b=128,H=512 → 10 MB now fits.
+    # 4H²·wb + 30·sb·u·b·H bytes where sb is the STREAM dtype's width
+    # (DL4J_TPU_LSTM_STREAM_DTYPE: 120·u·b·H at the f32 default, 60·u·b·H
+    # at bf16 — see _vmem_fits); cap the SUM under a core's VMEM so
+    # oversized configs fall back to the scan instead of failing a Mosaic
+    # allocation. bf16-resident weights (weight_bytes=2, the
+    # mixed-precision policy) halve the resident term. At f32 streams:
+    # f32-weights b=64,H=512 → 7.9 MB ✓; b=256,H=512 → 19.7 MB ✗ → scan;
+    # bf16-weights b=64,H=1024 → 16.2 MB ✗ → scan, b=128,H=512 → 10 MB ✓.
+    # bf16 streams halve the b-dependent term, roughly doubling each bound.
     if not _vmem_fits(b, H, weight_bytes) or b > 1024:
         return False
     return (activation == "tanh" and gate_activation == "sigmoid"
@@ -458,8 +479,10 @@ def lstm_scan(xp, rw, peep, h0, c0, mask=None):
     [0, 1]) or None. The mask is NON-differentiable (the custom_vjp returns
     a zero cotangent for it); callers differentiating through a soft mask
     must stop_gradient it on their fallback path too (recurrent.py does).
-    Returns (ys [b, T, H], (hT, cT)) in f32 accumulation dtype — a drop-in
-    for the ``lax.scan`` recurrent loop with the weight stream eliminated."""
+    Returns (ys [b, T, H] in the stream dtype — f32 unless
+    ``DL4J_TPU_LSTM_STREAM_DTYPE=bfloat16`` — and (hT, cT) in f32) — a
+    drop-in for the ``lax.scan`` recurrent loop with the weight stream
+    eliminated."""
     b, T, H4 = xp.shape
     H = H4 // 4
     xp_tm = jnp.swapaxes(xp, 0, 1)                        # time-major
@@ -474,10 +497,11 @@ def lstm_scan(xp, rw, peep, h0, c0, mask=None):
         mk = jnp.broadcast_to(
             jnp.swapaxes(jnp.asarray(mask, jnp.float32), 0, 1)[..., None],
             (T, b, 8))
-    # xp (the accumulated input projection) stays f32 — gate math is
-    # accumulation-dtype; RW rides in its caller dtype (bf16 under the
-    # mixed-precision policy) so the recurrent gemm runs the MXU's native
-    # bf16 pass with f32 accumulation instead of multi-pass f32
-    ys, hT, cT = _lstm(xp_tm.astype(jnp.float32), rw, pk,
+    # xp (the accumulated input projection) rides the STREAM dtype policy
+    # (f32 default; DL4J_TPU_LSTM_STREAM_DTYPE=bfloat16 halves the per-step
+    # HBM stream — gate math stays f32 in-kernel either way); RW rides in
+    # its caller dtype (bf16 under the mixed-precision policy) so the
+    # recurrent gemm runs the MXU's native bf16 pass with f32 accumulation
+    ys, hT, cT = _lstm(xp_tm.astype(_stream_dtype()), rw, pk,
                        h0.astype(jnp.float32), c0.astype(jnp.float32), mk)
     return jnp.swapaxes(ys, 0, 1), (hT, cT)

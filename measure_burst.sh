@@ -90,11 +90,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 1800 python perf_flash_check.py
     # r5b: flash BLOCK A/B at the transformer bench shapes (fresh
     # subprocess per value — import-time knob) + LSTM latency attribution
-    # budget: 4 blocks x <=900s child timeout + parent startup slack
+    # budget: 3 blocks x <=900s child timeout + parent startup slack
     need blocksweep && probe && run_stage blocksweep \
-                     timeout 4500 python perf_flash_check.py blocksweep
+                     timeout 3000 python perf_flash_check.py blocksweep
     need micro    && probe && run_stage micro \
                      timeout 1200 python perf_lstm.py micro
+    # r5c: f32-vs-bf16 stream dtype x unroll (4 cells x <=900s + slack)
+    need stream   && probe && run_stage stream \
+                     timeout 4500 python perf_lstm.py stream
     need roofline && probe && run_stage roofline \
                      timeout 1200 python perf_lstm.py roofline
     need ab       && probe && run_stage ab \
@@ -122,7 +125,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
      [ -f "$STATE/ab.ok" ] && [ -f "$STATE/sweep.ok" ] && \
      [ -f "$STATE/rescost.ok" ] && [ -f "$STATE/resbench.ok" ] && \
      [ -f "$STATE/resremat.ok" ] && [ -f "$STATE/blocksweep.ok" ] && \
-     [ -f "$STATE/micro.ok" ]; then
+     [ -f "$STATE/micro.ok" ] && [ -f "$STATE/stream.ok" ]; then
     echo "=== all stages complete $(date -u +%H:%M:%S) ==="
     exit 0
   fi

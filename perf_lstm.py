@@ -164,9 +164,24 @@ def micro(batch=64, width=512, tbptt=50):
     td = timeit(grad_j, xp_bm, rw)
     print(f"d. lstm_scan fwd+bwd:          {td*1e3:8.3f} ms "
           f"({td/T*1e6:6.1f} us/timestep)")
+
+    # e. fwd kernel with bf16 xp stream (halves streamed bytes): a big win
+    # here means the step is HBM-stream-bound, not latency-bound
+    te = timeit(fwd_j, xp.astype(jnp.bfloat16), rw, h0, c0)
+    print(f"e. fwd kernel, bf16 xp:        {te*1e3:8.3f} ms "
+          f"({te/T*1e6:6.1f} us/timestep)")
+
+    # f. inference fwd (save_reserve=False: no gates/cseq HBM writes)
+    inf_j = jax.jit(lambda x, r, h, c: lc._fwd(
+        x, r, None, h, c, None, save_reserve=False)[0])
+    tf2 = timeit(inf_j, xp, rw, h0, c0)
+    print(f"f. fwd kernel, no reserve:     {tf2*1e3:8.3f} ms "
+          f"({tf2/T*1e6:6.1f} us/timestep)")
     print(f"attribution: grid overhead {ta/T*1e6:.1f} us, +matmul "
           f"{(tb-ta)/T*1e6:+.1f} us, +gates/reserve {(tc-tb)/T*1e6:+.1f} us,"
-          f" +bwd {(td-tc)/T*1e6:+.1f} us  (per timestep)")
+          f" +bwd {(td-tc)/T*1e6:+.1f} us  (per timestep); "
+          f"bf16-xp saves {(tc-te)/T*1e6:.1f} us, "
+          f"reserve writes cost {(tc-tf2)/T*1e6:.1f} us")
 
 
 def unroll_sweep(batch=64, width=512, tbptt=50, seq_len=200):
@@ -176,40 +191,65 @@ def unroll_sweep(batch=64, width=512, tbptt=50, seq_len=200):
     (ops/lstm_cell.py::_unroll_factor), so an in-process sweep would
     silently reuse the first U's compiled step. U candidates divide
     tbptt=50; the kernel itself shrinks U when VMEM doesn't fit, so what
-    we sweep is the CAP."""
-    import json as _json
-    import subprocess
-    import sys as _sys
+    we sweep is the CAP. Per-U failures are non-fatal by design: a hung U
+    must not abort the rest of the sweep (nor wedge the burst stage)."""
     print(f"{'U':>4} {'chars/s':>12} {'vs U=1':>8}")
     base = None
     for u in (1, 2, 5, 10, 25, 50):
         env = dict(os.environ, DL4J_TPU_LSTM_UNROLL=str(u))
-        try:
-            p = subprocess.run(
-                [_sys.executable, os.path.abspath(__file__), "measure-one",
-                 str(batch), str(width), str(tbptt), str(seq_len)],
-                capture_output=True, text=True, env=env, timeout=900)
-        except subprocess.TimeoutExpired:
-            # per-U failures are non-fatal by design: a hung U must not
-            # abort the rest of the sweep (nor wedge the burst stage)
-            print(f"{u:>4} FAILED timeout 900s", flush=True)
+        r = _measure_one(env, batch, width, tbptt, seq_len)
+        if isinstance(r, str):
+            print(f"{u:>4} {r}", flush=True)
             continue
-        line = None
-        for ln in reversed((p.stdout or "").splitlines()):
-            try:
-                line = _json.loads(ln)
-                break
-            except ValueError:
-                continue
-        if p.returncode or not line:
-            print(f"{u:>4} FAILED rc={p.returncode} "
-                  f"{(p.stderr or '')[-200:]}", flush=True)
-            continue
-        r = line["chars_per_sec"]
         if u == 1:
             base = r            # the column is "vs U=1", never a rebase
         ratio = f"{r / base:>7.2f}x" if base else "    n/a"
         print(f"{u:>4} {r:>12,.0f} {ratio}", flush=True)
+
+
+def _measure_one(env, batch, width, tbptt, seq_len, timeout=900):
+    """Run one measure() in a fresh subprocess (trace-time env knobs) and
+    return chars/s, or a 'FAILED ...' string. Shared by unroll_sweep and
+    stream_ab."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    try:
+        p = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__), "measure-one",
+             str(batch), str(width), str(tbptt), str(seq_len)],
+            capture_output=True, text=True, env=env, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return f"FAILED timeout {timeout}s"
+    line = None
+    for ln in reversed((p.stdout or "").splitlines()):
+        try:
+            line = _json.loads(ln)
+            break
+        except ValueError:
+            continue
+    if p.returncode or not line:
+        return f"FAILED rc={p.returncode} {(p.stderr or '')[-200:]}"
+    return line["chars_per_sec"]
+
+
+def stream_ab(batch=64, width=512, tbptt=50, seq_len=200):
+    """A/B DL4J_TPU_LSTM_STREAM_DTYPE (f32 vs bf16 streams) x unroll caps.
+    bf16 halves the per-step HBM stream AND doubles the unroll the VMEM
+    budget admits — if the chain is stream-bound this is the 2x lever.
+    Trace-time knobs -> fresh subprocess per cell; U candidates divide
+    tbptt=50 (the kernel decrements non-divisors, which would silently
+    re-measure a duplicate point)."""
+    print(f"{'stream':>9} {'U':>4} {'chars/s':>12}")
+    for sd, us in (("float32", (2,)), ("bfloat16", (2, 5, 10))):
+        for u in us:
+            env = dict(os.environ, DL4J_TPU_LSTM_STREAM_DTYPE=sd,
+                       DL4J_TPU_LSTM_UNROLL=str(u))
+            r = _measure_one(env, batch, width, tbptt, seq_len)
+            if isinstance(r, str):
+                print(f"{sd:>9} {u:>4} {r}", flush=True)
+            else:
+                print(f"{sd:>9} {u:>4} {r:>12,.0f}", flush=True)
 
 
 def sweep():
@@ -285,6 +325,8 @@ if __name__ == "__main__":
         kernel_ab()
     elif cmd == "micro":
         micro()
+    elif cmd == "stream":
+        stream_ab()
     elif cmd == "roofline":
         roofline()
     elif cmd == "profile":

@@ -314,3 +314,52 @@ def test_unrolled_kernel_matches_scan_u5(monkeypatch, peep):
     np.testing.assert_allclose(np.asarray(hT), np.asarray(whT),
                                rtol=1e-5, atol=1e-5)
     _assert_grads_match(xp, rw, pp, h0, c0, mk)
+
+
+@pytest.mark.parametrize("peep", [False, True])
+def test_bf16_stream_dtype_matches_scan(monkeypatch, peep):
+    """DL4J_TPU_LSTM_STREAM_DTYPE=bfloat16 halves the per-step HBM streams
+    (xp in, ys/gates/cseq reserve out, dz out — the cuDNN reserve-space
+    convention); h/c state and gate math stay f32. Forward and gradients
+    must match the f32 scan oracle within bf16 rounding of the streamed
+    tensors (the RECURRENT state chain itself never rounds, so the error
+    does not compound across steps)."""
+    monkeypatch.setenv("DL4J_TPU_LSTM_STREAM_DTYPE", "bfloat16")
+    xp, rw, pp, h0, c0, mk = _inputs(b=8, T=6, H=128, peep=peep, mask=True,
+                                     seed=7)
+    ys, (hT, cT) = lk.lstm_scan(xp, rw, pp, h0, c0, mk)
+    assert ys.dtype == jnp.bfloat16          # stream dtype rides through
+    assert hT.dtype == jnp.float32           # state precision kept
+    want_ys, (whT, wcT) = _scan_oracle(xp, rw, pp, h0, c0, mk)
+    np.testing.assert_allclose(np.asarray(ys, np.float32),
+                               np.asarray(want_ys), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(whT),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(wcT),
+                               rtol=2e-2, atol=2e-2)
+
+    def loss(run):
+        def f(xp, rw, pp, h0, c0):
+            ys, (hT, cT) = run(xp, rw, pp, h0, c0, mk)
+            return (jnp.sum(ys.astype(jnp.float32) ** 2)
+                    + jnp.sum(hT * 0.7) + jnp.sum(cT * 0.3))
+        return f
+
+    argnums = (0, 1, 3, 4) if pp is None else (0, 1, 2, 3, 4)
+    gk = jax.grad(loss(lk.lstm_scan), argnums=argnums)(xp, rw, pp, h0, c0)
+    gs = jax.grad(loss(_scan_oracle), argnums=argnums)(xp, rw, pp, h0, c0)
+    for a, want in zip(jax.tree_util.tree_leaves(gk),
+                       jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(want), rtol=5e-2, atol=5e-2)
+
+
+def test_stream_dtype_budget_doubles_unroll(monkeypatch):
+    """bf16 streams halve the VMEM stream term, so the unroll the budget
+    admits doubles at the char-RNN shape (b=64, H=512, bf16 weights)."""
+    monkeypatch.setenv("DL4J_TPU_LSTM_UNROLL", "8")
+    monkeypatch.delenv("DL4J_TPU_LSTM_STREAM_DTYPE", raising=False)
+    u_f32 = lk._unroll_factor(40, 64, 512, 2)
+    monkeypatch.setenv("DL4J_TPU_LSTM_STREAM_DTYPE", "bfloat16")
+    u_bf16 = lk._unroll_factor(40, 64, 512, 2)
+    assert u_bf16 >= 2 * u_f32
