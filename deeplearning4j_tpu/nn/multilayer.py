@@ -259,11 +259,24 @@ class MultiLayerNetwork:
         if rnn_state_in is not None:
             ctx["rnn_state_in"] = rnn_state_in
         new_states = dict(states)
-        for i in range(end):
+        i = 0
+        while i < end:
             pre = self.conf.preprocessor(i)
             if pre is not None:
                 x = pre(x, ctx)
             impl = self.impls[i]
+            # fused two-layer persistent LSTM (ops/lstm_fused.py): two
+            # consecutive eligible LSTM layers run as ONE kernel chain —
+            # half the sequential grid steps, no inter-layer HBM round
+            # trip. Eligibility is static per (shape, config); ineligible
+            # pairs (masks, bidirectional, dropout between, VMEM budget)
+            # take the per-layer path below unchanged.
+            if (i + 1 < end and self.conf.preprocessor(i + 1) is None
+                    and self._lstm_pair_fusable(i, x, fmask, train)):
+                x = self._fused_lstm_forward(params, x, train, keys[i],
+                                             ctx, i)
+                i += 2
+                continue
             p_i = impl.noised_params(params[str(i)], train, keys[i])
             x, ns = impl.forward(p_i, states[str(i)], x, train=train,
                                  rng=keys[i], mask=fmask, ctx=ctx)
@@ -271,7 +284,85 @@ class MultiLayerNetwork:
                 # tag for the remat policy (identity outside jax.checkpoint)
                 x = checkpoint_name(x, "dl4j_act")
             new_states[str(i)] = ns
+            i += 1
         return x, new_states, ctx
+
+    def _lstm_pair_fusable(self, i, x, fmask, train):
+        """Static eligibility for fusing layers (i, i+1) into
+        ``ops/lstm_fused.lstm_scan2``: both plain (non-bidirectional) LSTM
+        impls with matching peephole-ness and H, no step mask, no
+        inter-layer dropout or weight noise in effect, each layer
+        kernel-eligible, and the fused VMEM budget admits the shape."""
+        from .layers.recurrent import (_BaseLSTMImpl,
+                                       GravesBidirectionalLSTMImpl)
+        from ..ops import lstm_cell as _lk
+        from ..ops import lstm_fused as _lf
+
+        if fmask is not None or getattr(x, "ndim", 0) != 3:
+            return False
+        a, b_ = self.impls[i], self.impls[i + 1]
+        for im in (a, b_):
+            if (not isinstance(im, _BaseLSTMImpl)
+                    or isinstance(im, GravesBidirectionalLSTMImpl)):
+                return False
+            if train and im.weight_noise is not None:
+                return False
+        if a.peepholes != b_.peepholes:
+            return False
+        if train and b_.dropout_obj is not None:
+            return False
+        ca, cb = a.conf, b_.conf
+        if not (ca.n_out == cb.n_in == cb.n_out):
+            return False
+        bsz, T = x.shape[0], x.shape[1]
+        H = ca.n_out
+        wb = jnp.dtype(a.compute_dtype).itemsize
+        for im, c in ((a, ca), (b_, cb)):
+            gate = str(getattr(c, "gate_activation", "sigmoid"))
+            if not _lk.supported(bsz, T, H, im.activation_name, gate,
+                                 weight_bytes=wb):
+                return False
+        return _lf.supported2(bsz, T, H, weight_bytes=wb)
+
+    def _fused_lstm_forward(self, params, x, train, rng, ctx, i):
+        """Run layers (i, i+1) through the fused kernel. Mirrors
+        ``recurrent._BaseLSTMImpl._run``'s hoisted input projection and
+        ctx-carried (h, c) state handling for BOTH layer indices."""
+        from ..ops import lstm_fused as _lf
+        from .layers.base import acc_dtype
+        from .layers.recurrent import _match_vma
+
+        a, b_ = self.impls[i], self.impls[i + 1]
+        x = a.maybe_dropout(x, train, rng)
+        pa, pb = params[str(i)], params[str(i + 1)]
+        cd = a.compute_dtype
+        ad = acc_dtype(cd)
+        bsz, T, _ = x.shape
+        H = a.conf.n_out
+        xp1 = (x.reshape(bsz * T, -1).astype(cd)
+               @ pa["W"].astype(cd)).astype(ad)
+        xp1 = xp1.reshape(bsz, T, 4 * H) + pa["b"].astype(ad)
+        zeros = lambda: jnp.zeros((bsz, H), ad)
+        sin = (ctx or {}).get("rnn_state_in", {})
+        h01, c01 = sin.get(i) or (zeros(), zeros())
+        h02, c02 = sin.get(i + 1) or (zeros(), zeros())
+        # same shard_map carry-typing fix as recurrent._run (fresh zero
+        # states are not device-varying; xp1 is)
+        h01, c01 = _match_vma(h01, xp1), _match_vma(c01, xp1)
+        h02, c02 = _match_vma(h02, xp1), _match_vma(c02, xp1)
+        peep1 = ((pa["pi"], pa["pf"], pa["po"]) if a.peepholes else None)
+        peep2 = ((pb["pi"], pb["pf"], pb["po"]) if b_.peepholes else None)
+        ys2, hc1, hc2 = _lf.lstm_scan2(
+            xp1, pa["RW"].astype(cd), peep1, pb["W"].astype(cd),
+            pb["b"], pb["RW"].astype(cd), peep2, h01, c01, h02, c02)
+        if ctx is not None:
+            out = ctx.setdefault("rnn_state_out", {})
+            out[i] = hc1
+            out[i + 1] = hc2
+        y = ys2.astype(b_.out_dtype)
+        if b_.save_output:
+            y = checkpoint_name(y, "dl4j_act")
+        return y
 
     def _adapt_input(self, f):
         """User-facing convolutional input is NCHW (reference convention);
