@@ -95,9 +95,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 3000 python perf_flash_check.py blocksweep
     need micro    && probe && run_stage micro \
                      timeout 1200 python perf_lstm.py micro
-    # r5c: f32-vs-bf16 stream dtype x unroll (4 cells x <=900s + slack)
+    # r5c: stream dtype x unroll x fused (6 cells x <=900s + slack)
     need stream   && probe && run_stage stream \
-                     timeout 4500 python perf_lstm.py stream
+                     timeout 6000 python perf_lstm.py stream
     need roofline && probe && run_stage roofline \
                      timeout 1200 python perf_lstm.py roofline
     need ab       && probe && run_stage ab \

@@ -240,16 +240,25 @@ def stream_ab(batch=64, width=512, tbptt=50, seq_len=200):
     Trace-time knobs -> fresh subprocess per cell; U candidates divide
     tbptt=50 (the kernel decrements non-divisors, which would silently
     re-measure a duplicate point)."""
-    print(f"{'stream':>9} {'U':>4} {'chars/s':>12}")
-    for sd, us in (("float32", (2,)), ("bfloat16", (2, 5, 10))):
-        for u in us:
-            env = dict(os.environ, DL4J_TPU_LSTM_STREAM_DTYPE=sd,
-                       DL4J_TPU_LSTM_UNROLL=str(u))
-            r = _measure_one(env, batch, width, tbptt, seq_len)
-            if isinstance(r, str):
-                print(f"{sd:>9} {u:>4} {r}", flush=True)
-            else:
-                print(f"{sd:>9} {u:>4} {r:>12,.0f}", flush=True)
+    print(f"{'config':>16} {'U':>4} {'chars/s':>12}")
+    # under bf16 streams the fused two-layer kernel engages at the
+    # char-RNN shape (lstm_fused.supported2 VMEM budget) — the +nofuse
+    # rows isolate its contribution from the stream-dtype win
+    cells = [("float32", 2, {}),
+             ("bfloat16", 2, {}),
+             ("bfloat16", 5, {}),
+             ("bfloat16", 10, {}),
+             ("bfloat16+nofuse", 2, {"DL4J_TPU_NO_FUSED_LSTM": "1"}),
+             ("bfloat16+nofuse", 5, {"DL4J_TPU_NO_FUSED_LSTM": "1"})]
+    for label, u, extra in cells:
+        sd = label.split("+")[0]
+        env = dict(os.environ, DL4J_TPU_LSTM_STREAM_DTYPE=sd,
+                   DL4J_TPU_LSTM_UNROLL=str(u), **extra)
+        r = _measure_one(env, batch, width, tbptt, seq_len)
+        if isinstance(r, str):
+            print(f"{label:>16} {u:>4} {r}", flush=True)
+        else:
+            print(f"{label:>16} {u:>4} {r:>12,.0f}", flush=True)
 
 
 def sweep():
