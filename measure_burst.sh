@@ -106,8 +106,6 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # budget: 6 Us x <=900s child timeout + slack
     need unroll   && probe && run_stage unroll \
                      timeout 6000 python perf_lstm.py unroll
-    need sweep    && probe && run_stage sweep \
-                     timeout 2400 python perf_lstm.py sweep
     # r5: ResNet50 HBM-wall experiments, split so a timeout loses one
     # sub-stage, not all eight configs
     need rescost  && probe && run_stage rescost \
@@ -117,6 +115,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
                      timeout 1800 python perf_exp.py bench2
     need resremat && probe && run_stage resremat \
                      timeout 2400 python perf_exp.py remat
+    need sweep    && probe && run_stage sweep \
+                     timeout 2400 python perf_lstm.py sweep
   fi
   if [ -f "$STATE/headline.ok" ] && [ -f "$STATE/all.ok" ] && \
      [ -f "$STATE/transformer.ok" ] && [ -f "$STATE/inception2.ok" ] && \
