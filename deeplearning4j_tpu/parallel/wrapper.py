@@ -377,7 +377,11 @@ class ParallelWrapper:
                 return params, states, upd, loss
 
             # mark the carry as device-varying: replicas diverge locally
-            # between averaging barriers (shard_map vma typing)
+            # between averaging barriers. Under check_vma=False (below)
+            # this is a no-op kept for documentation value and in case the
+            # vma check is ever re-enabled — the pmean barrier after the
+            # loop is what actually restores replica agreement; vma typing
+            # does NOT verify it here
             init = jax.tree_util.tree_map(
                 lambda x: pvary(x, (DATA_AXIS,)),
                 (params, states, upd, jnp.asarray(0.0, jnp.float32)))
@@ -391,10 +395,15 @@ class ParallelWrapper:
 
         repl = P()
         data = P(None, DATA_AXIS)  # [N, global_b, ...] split on batch dim
+        # check_vma=False: the step may route through Pallas kernels
+        # (persistent/fused LSTM), whose out_shape ShapeDtypeStructs carry
+        # no vma typing — same setting as every other shard_map in
+        # parallel/ (sequence.py, pipeline.py)
         fn = shard_map(local_run, mesh=mesh,
                        in_specs=(repl, repl, repl, repl, repl, data, data,
                                  data, data),
-                       out_specs=(repl, repl, repl, repl))
+                       out_specs=(repl, repl, repl, repl),
+                       check_vma=False)
         self._local_sgd_step = jax.jit(fn, donate_argnums=(0, 2))
         return self._local_sgd_step
 

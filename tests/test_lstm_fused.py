@@ -191,3 +191,39 @@ def test_masked_batches_take_per_layer_path(monkeypatch):
     net.fit(DataSet(f, l, features_mask=fm))
     assert not calls, "masked batch must not take the fused kernel"
     assert np.isfinite(float(net.score_))
+
+
+def test_fused_under_shard_map_local_sgd(monkeypatch):
+    """ParallelWrapper local SGD (averaging_frequency > 1) runs the step
+    inside shard_map. Regression pinned: Pallas kernels (persistent/fused
+    LSTM) declare out_shape ShapeDtypeStructs with no vma typing, which
+    shard_map's default check_vma=True rejects at trace time — the wrapper
+    must run its local-SGD shard_map with check_vma=False (like every
+    other shard_map in parallel/). The fused kernel must ENGAGE (per-shard
+    batch 8 satisfies the in-shard b%8 contract) and the fit must complete
+    with a finite score."""
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    V, H, b, T = 16, 128, 64, 8   # 8 per shard: the in-shard kernel
+    rng = np.random.default_rng(5)  # contract needs b%8 == 0 PER WORKER
+    ids = rng.integers(0, V, size=(b, T))
+    f = np.eye(V, dtype=np.float32)[ids]
+    l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    dsets = [DataSet(f, l), DataSet(f, l)]
+
+    calls = []
+    real = lf.lstm_scan2
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(lf, "lstm_scan2", spy)
+    net = _charrnn_net(V, H)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .averaging_frequency(2).build())
+    pw.fit(ListDataSetIterator(dsets))
+    assert calls, "fused kernel did not engage under shard_map local SGD"
+    assert np.isfinite(float(net.score_))
