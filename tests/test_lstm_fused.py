@@ -81,6 +81,29 @@ def test_fused_grads_match_composition(peep):
                                    rtol=5e-4, atol=5e-4)
 
 
+def _onehot_stream(V, b, T, seed):
+    """Char-stream one-hot (features, next-char labels) pair."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, size=(b, T))
+    f = np.eye(V, dtype=np.float32)[ids]
+    l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    return f, l
+
+
+def _spy_scan2(monkeypatch):
+    """Patch lf.lstm_scan2 with a call-recording passthrough; returns the
+    call list."""
+    calls = []
+    real = lf.lstm_scan2
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(lf, "lstm_scan2", spy)
+    return calls
+
+
 def _charrnn_net(V=16, H=128, tbptt=0):
     from deeplearning4j_tpu.nn.conf import (NeuralNetConfiguration,
                                             BackpropType)
@@ -110,20 +133,10 @@ def test_container_fuses_and_matches_per_layer_path(monkeypatch):
     from deeplearning4j_tpu.datasets.dataset import DataSet
 
     V, H, b, T = 16, 128, 8, 12
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, V, size=(b, T))
-    f = np.eye(V, dtype=np.float32)[ids]
-    l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    f, l = _onehot_stream(V, b, T, seed=0)
     ds = DataSet(f, l)
 
-    calls = []
-    real = lf.lstm_scan2
-
-    def spy(*a, **k):
-        calls.append(1)
-        return real(*a, **k)
-
-    monkeypatch.setattr(lf, "lstm_scan2", spy)
+    calls = _spy_scan2(monkeypatch)
     net = _charrnn_net(V, H)
     net.fit(ds)
     assert calls, "fused kernel did not engage for the eligible stack"
@@ -150,10 +163,7 @@ def test_fused_tbptt_stream_state_continuity():
     from deeplearning4j_tpu.datasets.dataset import DataSet
 
     V, H, b, T = 16, 128, 8, 12
-    rng = np.random.default_rng(1)
-    ids = rng.integers(0, V, size=(b, T))
-    f = np.eye(V, dtype=np.float32)[ids]
-    l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    f, l = _onehot_stream(V, b, T, seed=1)
 
     net_full = _charrnn_net(V, H)
     net_seg = _charrnn_net(V, H, tbptt=6)
@@ -172,21 +182,11 @@ def test_masked_batches_take_per_layer_path(monkeypatch):
     from deeplearning4j_tpu.datasets.dataset import DataSet
 
     V, H, b, T = 16, 128, 8, 12
-    rng = np.random.default_rng(2)
-    ids = rng.integers(0, V, size=(b, T))
-    f = np.eye(V, dtype=np.float32)[ids]
-    l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    f, l = _onehot_stream(V, b, T, seed=2)
     fm = np.ones((b, T), np.float32)
     fm[:, -3:] = 0.0
 
-    calls = []
-    real = lf.lstm_scan2
-
-    def spy(*a, **k):
-        calls.append(1)
-        return real(*a, **k)
-
-    monkeypatch.setattr(lf, "lstm_scan2", spy)
+    calls = _spy_scan2(monkeypatch)
     net = _charrnn_net(V, H)
     net.fit(DataSet(f, l, features_mask=fm))
     assert not calls, "masked batch must not take the fused kernel"
@@ -213,17 +213,32 @@ def test_fused_under_shard_map_local_sgd(monkeypatch):
     l = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
     dsets = [DataSet(f, l), DataSet(f, l)]
 
-    calls = []
-    real = lf.lstm_scan2
-
-    def spy(*a, **k):
-        calls.append(1)
-        return real(*a, **k)
-
-    monkeypatch.setattr(lf, "lstm_scan2", spy)
+    calls = _spy_scan2(monkeypatch)
     net = _charrnn_net(V, H)
     pw = (ParallelWrapper.Builder(net).workers(8)
           .averaging_frequency(2).build())
     pw.fit(ListDataSetIterator(dsets))
     assert calls, "fused kernel did not engage under shard_map local SGD"
+    assert np.isfinite(float(net.score_))
+
+
+def test_fused_under_sharded_jit_sync_dp(monkeypatch):
+    """averaging_frequency=1 takes the OTHER wrapper path (sharded jit /
+    GSPMD, not shard_map): the fused kernel must engage and the synced-DP
+    fit must complete — partitioning around a Pallas custom call is a
+    different mechanism than shard_map's vma typing, so both paths need
+    pinning."""
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    V, H, b, T = 16, 128, 64, 8
+    f, l = _onehot_stream(V, b, T, seed=6)
+
+    calls = _spy_scan2(monkeypatch)
+    net = _charrnn_net(V, H)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .averaging_frequency(1).build())
+    pw.fit(ListDataSetIterator([DataSet(f, l)]))
+    assert calls, "fused kernel did not engage under the sharded-jit path"
     assert np.isfinite(float(net.score_))
