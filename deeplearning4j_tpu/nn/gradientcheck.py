@@ -22,13 +22,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..compat import enable_x64 as _enable_x64
+
 log = logging.getLogger(__name__)
 
 
 @contextlib.contextmanager
 def double_precision():
     """Enable f64 for network construction + checking (reference double rule)."""
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         yield
 
 

@@ -43,7 +43,9 @@ def _match_vma(z, ref):
         return z
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(z, tuple(want), to="varying")
-    return jax.lax.pvary(z, tuple(want))
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(z, tuple(want))
+    return z  # pre-vma jax (0.4.x): no varying-axis typing to satisfy
 
 
 class _BaseLSTMImpl(LayerImpl):

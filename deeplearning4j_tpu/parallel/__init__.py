@@ -13,7 +13,7 @@ from .inference import ParallelInference, InferenceMode
 from .accumulation import (GradientsAccumulator, EncodedGradientsAccumulator,
                            EncodingHandler, threshold_encode, threshold_decode,
                            serialize_encoded, deserialize_encoded)
-from .transport import UpdateChannel
+from .transport import UpdateChannel, PeerFailedError
 from .distributed import (ProcessLocalIterator, is_chief,
                           TrainingMaster, ParameterAveragingTrainingMaster,
                           SharedTrainingMaster, SharedGradientsClusterTrainer,
@@ -40,7 +40,8 @@ __all__ = [
     "ParallelWrapper", "TrainingMode", "ParallelInference", "InferenceMode",
     "GradientsAccumulator", "EncodedGradientsAccumulator", "EncodingHandler",
     "threshold_encode", "threshold_decode", "serialize_encoded",
-    "deserialize_encoded", "UpdateChannel", "SharedGradientsClusterTrainer",
+    "deserialize_encoded", "UpdateChannel", "PeerFailedError",
+    "SharedGradientsClusterTrainer",
     "TrainingMaster", "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
     "DistributedMultiLayerNetwork", "DistributedComputationGraph",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "initialize_distributed",

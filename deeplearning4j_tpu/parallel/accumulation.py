@@ -78,6 +78,21 @@ class EncodingHandler:
         return (idx, signs, used), residual
 
 
+def flatten_tree_f32(tree):
+    """THE canonical pytree→flat-f32 layout for everything that crosses the
+    update wire or lives in a parameter server: ``jax.tree_util`` leaf
+    order, each leaf raveled as float32. Returns ``(vec, treedef, shapes)``.
+    ``EncodedGradientsAccumulator`` encodes updates in this layout and
+    ``paramserver`` holds/indexes parameters in it — both MUST go through
+    this one function or pushed updates would scatter into wrong offsets."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [np.shape(l) for l in leaves]
+    if not leaves:
+        return np.zeros(0, np.float32), treedef, shapes
+    vec = np.concatenate([np.asarray(l, np.float32).ravel() for l in leaves])
+    return vec, treedef, shapes
+
+
 class GradientsAccumulator:
     """SPI seam (reference ``GradientsAccumulator``): receives local updates,
     hands back the aggregate to apply. The base implementation is the ICI
@@ -118,12 +133,8 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
         self.last_encoded = None  # (idx, signs, threshold, n) — wire form
 
     def _flatten(self, grads) -> np.ndarray:
-        leaves, treedef = jax.tree_util.tree_flatten(grads)
-        self._treedef = treedef
-        self._shapes = [np.shape(l) for l in leaves]
-        return np.concatenate([np.asarray(l, np.float32).ravel()
-                               for l in leaves]) if leaves else np.zeros(0,
-                                                                         np.float32)
+        vec, self._treedef, self._shapes = flatten_tree_f32(grads)
+        return vec
 
     def _unflatten(self, flat: np.ndarray):
         out = []

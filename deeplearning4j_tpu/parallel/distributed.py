@@ -74,6 +74,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         kw["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)
     if initialization_timeout_s is not None:
         kw["initialization_timeout"] = int(initialization_timeout_s)
+    import inspect
+    supported = set(inspect.signature(jax.distributed.initialize).parameters)
+    dropped = sorted(set(kw) - supported)
+    if dropped:  # older jax: runtime defaults apply (detection still works,
+        # just at the stock heartbeat cadence)
+        log.warning("jax.distributed.initialize does not support %s on this "
+                    "jax version; using runtime defaults", dropped)
+        kw = {k: v for k, v in kw.items() if k in supported}
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kw)
@@ -129,7 +137,14 @@ class ProcessLocalIterator:
 
 class TrainingMaster:
     """SPI (reference ``TrainingMaster.java:28``): how distributed fitting is
-    executed. Implementations configure mesh + step strategy."""
+    executed. Implementations configure mesh + step strategy.
+
+    Implementations: :class:`ParameterAveragingTrainingMaster` (fused sync
+    all-reduce), :class:`SharedTrainingMaster` (async quantized sharing —
+    full-mesh ``UpdateChannel`` across hosts), and
+    ``deeplearning4j_tpu.paramserver.ParameterServerTrainingMaster``
+    (server-mediated async push/pull with bounded staleness — the mode where
+    a worker can die and rejoin without taking down training)."""
 
     def execute_training(self, net, iterator):
         raise NotImplementedError

@@ -227,4 +227,6 @@ def pvary(x, axis_names):
     the older ``lax.pvary`` name."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, tuple(axis_names), to="varying")
-    return jax.lax.pvary(x, tuple(axis_names))
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x  # pre-vma jax (0.4.x): no varying-axis typing to satisfy

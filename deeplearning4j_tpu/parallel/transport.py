@@ -22,7 +22,19 @@ import struct
 import time
 from typing import Dict, List, Sequence
 
-__all__ = ["UpdateChannel", "send_frame", "recv_exact", "recv_frame"]
+__all__ = ["UpdateChannel", "PeerFailedError", "send_frame", "recv_exact",
+           "recv_frame"]
+
+
+class PeerFailedError(ConnectionError):
+    """A specific peer's connection died mid-round. ``rank`` names the
+    failing process so survivors can log/evict it instead of dying on an
+    anonymous socket error (the reference's Aeron layer reports the
+    disconnected session id the same way)."""
+
+    def __init__(self, rank: int, message: str):
+        super().__init__(message)
+        self.rank = int(rank)
 
 
 # Shared length-prefixed framing (little-endian i64 length + payload). Also
@@ -71,7 +83,13 @@ class UpdateChannel:
         self._peers: Dict[int, socket.socket] = {}
         self._listener = None
         if self.P > 1:
-            self._connect(timeout)
+            try:
+                self._connect(timeout)
+            except BaseException:
+                # half-built mesh: release the listen port and any peer
+                # sockets so a retrying caller can bind again immediately
+                self.close()
+                raise
 
     # ------------------------------------------------------------- handshake
     def _connect(self, timeout: float):
@@ -100,7 +118,13 @@ class UpdateChannel:
             self._peers[q] = s
         for _ in expected_in:
             srv.settimeout(max(deadline - time.monotonic(), 0.1))
-            s, _ = srv.accept()
+            try:
+                s, _ = srv.accept()
+            except socket.timeout:
+                missing = sorted(set(expected_in) - set(self._peers))
+                raise TimeoutError(
+                    f"rank {self.p}: handshake timed out after {timeout:.1f}s;"
+                    f" ranks {missing} never connected") from None
             s.settimeout(None)
             q = struct.unpack("<i", recv_exact(s, 4))[0]
             self._peers[q] = s
@@ -109,17 +133,28 @@ class UpdateChannel:
     def broadcast(self, frame: bytes):
         """Send one frame to every peer (``SilentUpdatesMessage`` fan-out)."""
         header = struct.pack("<q", len(frame))
-        for s in self._peers.values():
-            s.sendall(header)
-            s.sendall(frame)
+        for q in sorted(self._peers):
+            s = self._peers[q]
+            try:
+                s.sendall(header)
+                s.sendall(frame)
+            except OSError as e:
+                raise PeerFailedError(
+                    q, f"peer {q} failed during broadcast: {e}") from e
 
     def gather(self) -> List[bytes]:
-        """Receive exactly one frame from every peer, rank order."""
+        """Receive exactly one frame from every peer, rank order. A dead
+        peer surfaces as :class:`PeerFailedError` naming the rank, not an
+        anonymous socket error."""
         out = []
         for q in sorted(self._peers):
             s = self._peers[q]
-            (n,) = struct.unpack("<q", recv_exact(s, 8))
-            out.append(recv_exact(s, n))
+            try:
+                (n,) = struct.unpack("<q", recv_exact(s, 8))
+                out.append(recv_exact(s, n))
+            except OSError as e:
+                raise PeerFailedError(
+                    q, f"peer {q} failed during gather: {e}") from e
         return out
 
     def exchange(self, frame: bytes) -> List[bytes]:

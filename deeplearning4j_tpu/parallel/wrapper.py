@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..compat import shard_map
 
 from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
                        shard_batch, put_replicated, data_parallel_step,

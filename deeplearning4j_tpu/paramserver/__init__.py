@@ -1,0 +1,23 @@
+"""TPU-native parameter-server subsystem (reference
+``nd4j-parameter-server`` / ``VoidParameterServer`` contract, PAPER.md's
+named external dependency, re-implemented over the repo's own framing +
+threshold codec): a standalone fault-tolerant server node
+(:class:`ParameterServer`), a retry/backoff client with bounded-staleness
+pulls (:class:`ParameterServerClient`), the async TrainingMaster that rides
+them (:class:`ParameterServerTrainingMaster`), and listener-bus metrics
+(:class:`ParamServerMetricsListener`). See docs/PARALLELISM.md "Parameter
+server"."""
+from .server import ParameterServer
+from .client import (ParameterServerClient, ServerUnavailableError,
+                     ParameterServerError)
+from .training import (ParameterServerTrainingMaster, flatten_params,
+                       set_params_from_flat)
+from .metrics import (ParamServerMetrics, ParamServerMetricsListener,
+                      LatencyHistogram)
+
+__all__ = [
+    "ParameterServer", "ParameterServerClient", "ServerUnavailableError",
+    "ParameterServerError", "ParameterServerTrainingMaster",
+    "flatten_params", "set_params_from_flat", "ParamServerMetrics",
+    "ParamServerMetricsListener", "LatencyHistogram",
+]

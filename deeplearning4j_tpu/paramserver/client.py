@@ -1,0 +1,195 @@
+"""Fault-tolerant parameter-server client.
+
+The reference's ``VoidParameterServer`` client role, hardened the way the
+ROADMAP's graceful-degradation goal demands: every op reconnects and retries
+with exponential backoff + jitter on transient socket errors, and when the
+retry budget is exhausted the caller sees a clean
+:class:`ServerUnavailableError` naming the server address and attempt count
+— never a raw ``ConnectionError`` bubbling out of a socket internals frame.
+
+Bounded staleness: :meth:`ParameterServerClient.pull_if_stale` asks the
+server for its version first (a 16-byte round trip) and skips the full
+parameter transfer while the local copy is within ``staleness`` pushes of
+the server — async SGD's freshness/bandwidth dial (0 = pull every step,
+k = tolerate k server-side updates before resyncing).
+"""
+from __future__ import annotations
+
+import json
+import random
+import socket
+import struct
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..parallel.transport import send_frame, recv_frame
+from .metrics import ParamServerMetrics
+from .server import (OP_INIT, OP_SET, OP_PUSH, OP_PULL, OP_VERSION, OP_STATS,
+                     ST_OK)
+
+__all__ = ["ParameterServerClient", "ServerUnavailableError",
+           "ParameterServerError"]
+
+
+class ServerUnavailableError(ConnectionError):
+    """The parameter server stayed unreachable through the whole retry
+    budget. Catchable as ``ConnectionError`` but carries the diagnosis
+    (address, attempts) instead of a bare socket message."""
+
+
+class ParameterServerError(RuntimeError):
+    """The server answered, but rejected the request (bad frame, length
+    mismatch, pull-before-init). Not retried — retrying can't fix it."""
+
+
+class ParameterServerClient:
+    """One TCP connection to a :class:`~deeplearning4j_tpu.paramserver.
+    server.ParameterServer`, lazily (re)established per request.
+
+    ``staleness``: version slack for :meth:`pull_if_stale`.
+    ``max_retries``: reconnect attempts per op before
+    :class:`ServerUnavailableError`; backoff sleeps are
+    ``backoff * 2^attempt`` (capped at ``backoff_max``) with ±``jitter``
+    randomization so rejoining clients don't thundering-herd the server.
+    """
+
+    def __init__(self, address: str, staleness: int = 0,
+                 max_retries: int = 5, backoff: float = 0.05,
+                 backoff_max: float = 2.0, jitter: float = 0.25,
+                 timeout: float = 30.0,
+                 metrics: Optional[ParamServerMetrics] = None):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host, int(port)
+        self.address = address
+        self.staleness = int(staleness)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.timeout = float(timeout)
+        self.metrics = metrics or ParamServerMetrics()
+        self._sock: Optional[socket.socket] = None
+        self._rand = random.Random()
+
+    # ---------------------------------------------------------- connection
+    def _ensure_sock(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+            self._sock = s
+        return self._sock
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _request(self, op: int, payload: bytes = b"") -> bytes:
+        """One request/response round with reconnect-retry-backoff."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.metrics.add("retries")
+                delay = min(self.backoff * (2 ** (attempt - 1)),
+                            self.backoff_max)
+                delay *= 1.0 + self.jitter * (2 * self._rand.random() - 1)
+                time.sleep(max(delay, 0.0))
+            try:
+                s = self._ensure_sock()
+                send_frame(s, bytes([op]) + payload)
+                resp = recv_frame(s)
+                if resp is None or not resp:
+                    raise ConnectionError("server closed the connection")
+                if resp[0] != ST_OK:
+                    raise ParameterServerError(
+                        resp[1:].decode("utf-8", "replace"))
+                return resp[1:]
+            except (OSError, socket.timeout) as e:  # incl. ConnectionError
+                last = e
+                self._drop_sock()
+        self.metrics.add("errors")
+        raise ServerUnavailableError(
+            f"parameter server {self.address} unavailable after "
+            f"{self.max_retries + 1} attempts: {last}") from last
+
+    # ----------------------------------------------------------------- ops
+    def init_params(self, vec: np.ndarray) -> Tuple[int, bool]:
+        """Initialize the server iff it holds nothing yet. Returns
+        ``(version, created)`` — ``created=False`` means another worker got
+        there first (or this is a rejoin) and the caller should pull."""
+        out = self._request(
+            OP_INIT, np.ascontiguousarray(vec, np.float32).tobytes())
+        version, created = struct.unpack("<qB", out)
+        return version, bool(created)
+
+    def set_params(self, vec: np.ndarray) -> int:
+        """Unconditional overwrite (checkpoint restore / debug). Returns the
+        new version."""
+        out = self._request(
+            OP_SET, np.ascontiguousarray(vec, np.float32).tobytes())
+        return struct.unpack("<q", out)[0]
+
+    def push_update(self, frame: bytes) -> int:
+        """Push one threshold-encoded update frame
+        (``EncodedGradientsAccumulator.serialize_last()`` wire form).
+        Returns the server version after application.
+
+        Delivery is at-least-once: a connection that dies between the send
+        and the response is retried, so a push can apply twice across a
+        server blip — the async-SGD trade (a quantized update re-applied is
+        noise of the same scale the staleness bound already tolerates); use
+        ``set_params`` for state that must be exact."""
+        t0 = time.perf_counter()
+        out = self._request(OP_PUSH, frame)
+        self.metrics.record_push((time.perf_counter() - t0) * 1e3,
+                                 len(frame))
+        return struct.unpack("<q", out)[0]
+
+    push = push_update
+
+    def pull(self, shard: int = -1) -> Tuple[int, np.ndarray]:
+        """Current parameters (``shard=-1``: full vector; ``shard=s``: the
+        round-robin slice ``s::num_shards``), stamped with the server
+        version they correspond to."""
+        t0 = time.perf_counter()
+        out = self._request(OP_PULL, struct.pack("<i", int(shard)))
+        self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
+                                 len(out) - 12)
+        version, _shard = struct.unpack("<qi", out[:12])
+        return version, np.frombuffer(out[12:], np.float32)
+
+    def pull_if_stale(self, local_version: int
+                      ) -> Optional[Tuple[int, np.ndarray]]:
+        """Bounded-staleness pull: fetch only when the server has advanced
+        more than ``staleness`` versions past ``local_version`` — otherwise
+        skip the transfer (counted as a ``staleness_hits`` metric) and
+        return None."""
+        server_version, _ = self.server_version()
+        if server_version - int(local_version) <= self.staleness:
+            self.metrics.add("staleness_hits")
+            return None
+        return self.pull()
+
+    def server_version(self) -> Tuple[int, int]:
+        """(version, param count) without transferring values."""
+        out = self._request(OP_VERSION)
+        return struct.unpack("<qq", out)
+
+    def stats(self) -> dict:
+        """Server-side metrics snapshot (counters, latency histograms,
+        version, size)."""
+        return json.loads(self._request(OP_STATS).decode("utf-8"))
+
+    def close(self):
+        self._drop_sock()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,138 @@
+"""Parameter-server observability: per-op counters + latency histograms.
+
+The reference ships no metrics for its Aeron parameter server beyond log
+lines; here every client and server carries a :class:`ParamServerMetrics`
+(push/pull counts, bytes, retries, staleness hits, op latency histograms)
+and :class:`ParamServerMetricsListener` surfaces the client's numbers on the
+training listener bus (``optimize/listeners.py``) alongside
+``PerformanceListener`` / ``StepTimerListener`` (``utils/profiling.py``) —
+same cadence, same ``summary()`` shape.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List
+
+from ..optimize.listeners import TrainingListener
+
+log = logging.getLogger(__name__)
+
+
+class LatencyHistogram:
+    """Log2-bucketed latency histogram (0.1 ms granularity floor): O(1)
+    memory regardless of op count, with mean exact and p50/p95 read from the
+    bucket upper edges — the shape ``StepTimerListener.summary()`` reports,
+    without retaining every sample."""
+
+    #: bucket b covers [0.1·2^b, 0.1·2^(b+1)) ms; 24 buckets reach ~28 min
+    N_BUCKETS = 24
+
+    def __init__(self):
+        self.counts = [0] * self.N_BUCKETS
+        self.total_ms = 0.0
+        self.n = 0
+        self.max_ms = 0.0
+
+    def record(self, ms: float):
+        ms = max(float(ms), 0.0)
+        b = 0
+        edge = 0.1
+        while ms >= edge * 2 and b < self.N_BUCKETS - 1:
+            edge *= 2
+            b += 1
+        self.counts[b] += 1
+        self.total_ms += ms
+        self.n += 1
+        self.max_ms = max(self.max_ms, ms)
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile sample."""
+        if not self.n:
+            return 0.0
+        rank = q * (self.n - 1)
+        seen = 0
+        edge = 0.1
+        for b, c in enumerate(self.counts):
+            seen += c
+            if seen > rank:
+                return min(edge * 2, self.max_ms) if c else edge * 2
+            edge *= 2
+        return self.max_ms
+
+    def summary(self) -> Dict[str, float]:
+        if not self.n:
+            return {}
+        return {"mean_ms": self.total_ms / self.n,
+                "p50_ms": self.quantile(0.50),
+                "p95_ms": self.quantile(0.95),
+                "max_ms": self.max_ms, "n": float(self.n)}
+
+
+#: counter names every metrics object carries (a fixed schema so dashboards
+#: and tests never probe for optional keys)
+COUNTERS = ("pushes", "pulls", "push_bytes", "pull_bytes", "retries",
+            "staleness_hits", "errors")
+
+
+class ParamServerMetrics:
+    """Thread-safe counters + per-op latency histograms shared by
+    :class:`~deeplearning4j_tpu.paramserver.server.ParameterServer` (ops
+    served) and :class:`~deeplearning4j_tpu.paramserver.client.
+    ParameterServerClient` (ops issued, retries, staleness skips)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {k: 0 for k in COUNTERS}
+        self.push_latency = LatencyHistogram()
+        self.pull_latency = LatencyHistogram()
+
+    def add(self, counter: str, value: int = 1):
+        with self._lock:
+            self.counters[counter] = self.counters.get(counter, 0) + value
+
+    def record_push(self, ms: float, nbytes: int):
+        with self._lock:
+            self.counters["pushes"] += 1
+            self.counters["push_bytes"] += int(nbytes)
+            self.push_latency.record(ms)
+
+    def record_pull(self, ms: float, nbytes: int):
+        with self._lock:
+            self.counters["pulls"] += 1
+            self.counters["pull_bytes"] += int(nbytes)
+            self.pull_latency.record(ms)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy: counters + histogram summaries."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "push_latency": self.push_latency.summary(),
+                    "pull_latency": self.pull_latency.summary()}
+
+
+class ParamServerMetricsListener(TrainingListener):
+    """Listener-bus bridge: every ``frequency`` iterations, snapshot a
+    client's metrics into ``rows`` and log the deltas (pushes, pulls, wire
+    bytes, retries, staleness skips) — the PS counterpart of
+    ``PerformanceListener``'s throughput lines."""
+
+    def __init__(self, client, frequency: int = 10):
+        self.client = client
+        self.frequency = max(1, frequency)
+        self.rows: List[Dict[str, object]] = []
+        self._prev: Dict[str, int] = {}
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency != 0:
+            return
+        snap = self.client.metrics.snapshot()
+        snap["iteration"] = iteration
+        self.rows.append(snap)
+        cur = snap["counters"]
+        delta = {k: cur[k] - self._prev.get(k, 0) for k in COUNTERS}
+        self._prev = dict(cur)
+        log.info("paramserver @%d: +%d push / +%d pull, +%dB out / +%dB in, "
+                 "%d retries, %d staleness skips", iteration, delta["pushes"],
+                 delta["pulls"], delta["push_bytes"], delta["pull_bytes"],
+                 delta["retries"], delta["staleness_hits"])

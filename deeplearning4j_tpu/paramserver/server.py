@@ -1,0 +1,279 @@
+"""Threaded TCP parameter-server node.
+
+TPU-native re-implementation of the contract the reference outsources to the
+Aeron-based ``nd4j-parameter-server`` (``VoidParameterServer`` +
+``ParameterServerNode``: a shard role holding the flat param buffer, clients
+pushing encoded updates and pulling current values). The wire reuses the
+length-prefixed framing from ``parallel/transport.py`` and the
+threshold-codec frames from ``parallel/accumulation.py`` — one frame format
+across the full-mesh channel, the streaming broker, and this server.
+
+State model: ONE flat float32 parameter vector, split round-robin across
+``num_shards`` virtual shards (shard ``s`` holds elements ``s::num_shards``
+— the cross-replica update-sharding layout, arXiv:2004.13336, applied to a
+server's storage). ``PUSH`` applies a threshold-encoded update frame
+(``p -= decode(frame)``), optionally through a server-side residual
+accumulator (``threshold > 0``: sub-threshold mass is retained and applied
+once it accumulates past the threshold — the ``EncodedGradientsAccumulator``
+residual rule run server-side). ``PULL`` returns current shard values
+stamped with a monotonically increasing version (bumped once per applied
+push/set), which is what makes bounded-staleness clients possible.
+
+``ParameterServer(port=0)`` auto-picks a free port (``.port`` / ``.address``
+after construction) — the in-process loopback mode tier-1 tests use.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.transport import send_frame, recv_frame
+from ..parallel.accumulation import (deserialize_encoded, threshold_decode,
+                                     encode_residual)
+from .metrics import ParamServerMetrics
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ParameterServer", "OP_INIT", "OP_SET", "OP_PUSH", "OP_PULL",
+           "OP_VERSION", "OP_STATS", "ST_OK", "ST_ERR"]
+
+# request = [op u8 | payload]; response = [status u8 | payload]
+OP_INIT = 1     # payload f32[n]; set params ONLY if uninitialized → [ver q | created u8]
+OP_SET = 2      # payload f32[n]; unconditional overwrite → [ver q]
+OP_PUSH = 3     # payload accumulation.serialize_encoded frame → [ver q]
+OP_PULL = 4     # payload [shard i32] (-1 = full vector) → [ver q | shard i32 | f32 bytes]
+OP_VERSION = 5  # no payload → [ver q | n q]
+OP_STATS = 6    # no payload → JSON bytes
+ST_OK = 0
+ST_ERR = 1
+
+
+class ParameterServer:
+    """Standalone parameter-server node: ``start()`` (or construct), point
+    :class:`~deeplearning4j_tpu.paramserver.client.ParameterServerClient` at
+    ``.address``, ``stop()`` when done (context manager supported).
+
+    ``restore``: a ``snapshot()`` tuple from a previous incarnation — the
+    restart path after a crash (version numbering continues, so client
+    staleness bookkeeping survives the restart, and the server-side
+    residual — sub-threshold pushed mass still awaiting application —
+    carries over too).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_shards: int = 1, threshold: float = 0.0,
+                 restore: Optional[tuple] = None):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = int(num_shards)
+        self.threshold = float(threshold)
+        self.metrics = ParamServerMetrics()
+        self._lock = threading.Lock()
+        self._shards: Optional[List[np.ndarray]] = None
+        self._n = 0
+        self._version = 0
+        self._residual: Optional[np.ndarray] = None
+        if restore is not None:
+            version, vec = restore[0], restore[1]
+            residual = restore[2] if len(restore) > 2 else None
+            self._store(np.asarray(vec, np.float32))
+            self._version = int(version)
+            if residual is not None:
+                self._residual = np.asarray(residual, np.float32)
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(64)
+        self.host = host
+        self.port = self._srv.getsockname()[1]
+        self.address = f"{host}:{self.port}"
+        self._running = True
+        self._conns: List[socket.socket] = []
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- storage
+    def _store(self, vec: np.ndarray):
+        """Split a flat vector round-robin into the virtual shards."""
+        self._n = vec.size
+        self._shards = [np.array(vec[s::self.num_shards], np.float32)
+                        for s in range(self.num_shards)]
+
+    def _assemble(self) -> np.ndarray:
+        out = np.empty(self._n, np.float32)
+        for s in range(self.num_shards):
+            out[s::self.num_shards] = self._shards[s]
+        return out
+
+    def snapshot(self) -> Tuple[int, np.ndarray, Optional[np.ndarray]]:
+        """(version, flat params, residual) — feed to ``restore=`` on
+        restart. The residual slot keeps the never-lose-sub-threshold-mass
+        guarantee across restarts of a ``threshold > 0`` server."""
+        with self._lock:
+            if self._shards is None:
+                return self._version, np.zeros(0, np.float32), None
+            residual = (None if self._residual is None
+                        else self._residual.copy())
+            return self._version, self._assemble(), residual
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # ------------------------------------------------------------ op logic
+    def _apply_push(self, payload: bytes) -> int:
+        idx, signs, thr, n = deserialize_encoded(payload)
+        with self._lock:
+            if self._shards is None:
+                raise ValueError("push before init: server holds no params")
+            if n != self._n:
+                raise ValueError(
+                    f"pushed update length {n} != model length {self._n}")
+            update = threshold_decode(idx, signs, thr, (n,))
+            if self.threshold > 0.0:
+                # server-side residual accumulation: retain sub-threshold
+                # mass, apply only what crossed the threshold this round
+                g = (update if self._residual is None
+                     else update + self._residual)
+                (i2, s2), self._residual = encode_residual(g, self.threshold)
+                update = threshold_decode(i2, s2, self.threshold, (n,))
+            for s in range(self.num_shards):
+                self._shards[s] -= update[s::self.num_shards]
+            self._version += 1
+            return self._version
+
+    def _handle(self, op: int, payload: bytes) -> bytes:
+        if op == OP_INIT:
+            vec = np.frombuffer(payload, np.float32)
+            with self._lock:
+                created = self._shards is None
+                if created:
+                    self._store(vec.copy())
+                    self._version += 1
+                return struct.pack("<qB", self._version, int(created))
+        if op == OP_SET:
+            vec = np.frombuffer(payload, np.float32)
+            with self._lock:
+                self._store(vec.copy())
+                self._residual = None
+                self._version += 1
+                return struct.pack("<q", self._version)
+        if op == OP_PUSH:
+            t0 = time.perf_counter()
+            version = self._apply_push(payload)
+            self.metrics.record_push((time.perf_counter() - t0) * 1e3,
+                                     len(payload))
+            return struct.pack("<q", version)
+        if op == OP_PULL:
+            (shard,) = struct.unpack("<i", payload)
+            t0 = time.perf_counter()
+            with self._lock:
+                if self._shards is None:
+                    raise ValueError("pull before init: server holds no params")
+                if shard < -1 or shard >= self.num_shards:
+                    raise ValueError(f"shard {shard} out of range "
+                                     f"(num_shards={self.num_shards}; "
+                                     f"-1 = full vector)")
+                data = (self._assemble() if shard < 0
+                        else self._shards[shard]).tobytes()
+                version = self._version
+            self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
+                                     len(data))
+            return struct.pack("<qi", version, shard) + data
+        if op == OP_VERSION:
+            with self._lock:
+                return struct.pack("<qq", self._version, self._n)
+        if op == OP_STATS:
+            stats = self.metrics.snapshot()
+            with self._lock:
+                stats["version"] = self._version
+                stats["n"] = self._n
+                stats["num_shards"] = self.num_shards
+            return json.dumps(stats).encode("utf-8")
+        raise ValueError(f"unknown op {op}")
+
+    # ------------------------------------------------------------- network
+    def _accept_loop(self):
+        while self._running:
+            try:
+                s, _ = self._srv.accept()
+            except OSError:
+                return
+            if not self._running:
+                # raced stop(): the blocked accept() kept the port alive
+                # until this connection arrived — refuse it, don't serve it
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                return
+            self._conns.append(s)
+            threading.Thread(target=self._serve_conn, args=(s,),
+                             daemon=True).start()
+
+    def _serve_conn(self, s: socket.socket):
+        try:
+            while True:
+                frame = recv_frame(s)
+                if frame is None or not frame:
+                    return  # client closed (or sent an empty keepalive)
+                op = frame[0]
+                try:
+                    out = self._handle(op, frame[1:])
+                    send_frame(s, bytes([ST_OK]) + out)
+                except Exception as e:  # malformed frame ≠ dead server: the
+                    # client gets a typed error, the connection stays up
+                    self.metrics.add("errors")
+                    send_frame(s, bytes([ST_ERR]) + str(e).encode("utf-8"))
+        except OSError:
+            pass  # client vanished mid-frame; its state is all server-side
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(s)
+            except ValueError:
+                pass
+
+    def stop(self):
+        self._running = False
+        try:  # wake a blocked accept() (close alone defers while it waits)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for s in list(self._conns):
+            # shutdown, not just close: a serve thread blocked in recv holds
+            # the connection open past close(); shutdown aborts the recv so
+            # clients see the death immediately instead of a live zombie
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=5.0)
+
+    close = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

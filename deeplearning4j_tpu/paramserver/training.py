@@ -1,0 +1,206 @@
+"""Server-mediated async training: ``ParameterServerTrainingMaster``.
+
+The third TrainingMaster (``parallel/distributed.py`` SPI): where
+``ParameterAveragingTrainingMaster`` is a fused sync all-reduce and
+``SharedGradientsClusterTrainer`` is a full-mesh peer exchange, this master
+routes every update through a standalone
+:class:`~deeplearning4j_tpu.paramserver.server.ParameterServer` — the
+reference ``SharedTrainingMaster``'s *other* deployment shape, where
+``VoidParameterServer`` shard nodes hold the parameters and Spark executors
+are pure clients. The operational win over the mesh: workers are decoupled
+— one can die, back off, and REJOIN (``init_params`` → adopt server state)
+without renegotiating a P-way handshake or taking down training.
+
+Per step: compute the updater-transformed update (``_raw_update_step``),
+threshold-encode it (client-side residual in the
+``EncodedGradientsAccumulator``), apply the decoded quantized update
+locally, push the encoded frame, then resync from the server under the
+bounded-staleness rule (``staleness=0``: adopt the server's merged state
+every step; ``k``: tolerate k unseen server versions between pulls).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ..parallel.distributed import TrainingMaster
+from ..parallel.accumulation import (EncodedGradientsAccumulator,
+                                     flatten_tree_f32)
+from .client import ParameterServerClient
+from .metrics import ParamServerMetricsListener  # noqa: F401  (re-export)
+
+__all__ = ["ParameterServerTrainingMaster", "flatten_params",
+           "set_params_from_flat"]
+
+
+def flatten_params(params) -> np.ndarray:
+    """Flat float32 vector in the wire layout (``flatten_tree_f32`` — the
+    same function ``EncodedGradientsAccumulator`` flattens updates with, so
+    pushed updates and server-held parameters index identically)."""
+    return flatten_tree_f32(params)[0]
+
+
+def set_params_from_flat(net, vec: np.ndarray):
+    """Inverse of :func:`flatten_params`: scatter a server vector back into
+    the network's param pytree (original shapes/dtypes kept)."""
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(net.params)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        out.append(jnp.asarray(vec[off:off + n].reshape(leaf.shape),
+                               dtype=leaf.dtype))
+        off += n
+    if off != vec.size:
+        raise ValueError(f"server vector length {vec.size} != model {off}")
+    net.params = jax.tree_util.tree_unflatten(treedef, out)
+
+
+class ParameterServerTrainingMaster(TrainingMaster):
+    """Async server-mediated data parallelism. Select it exactly like the
+    collective masters::
+
+        master = (ParameterServerTrainingMaster.Builder("127.0.0.1:40123")
+                  .staleness(2).threshold(1e-3).build())
+        DistributedMultiLayerNetwork(net, master).fit(iterator)
+
+    Fault behavior: transient server outages are absorbed by the client's
+    retry/backoff; a server gone past the retry budget surfaces as
+    :class:`~deeplearning4j_tpu.paramserver.client.ServerUnavailableError`
+    — catch it, keep the net (its params are the last adopted state), and
+    re-``fit`` once the server is back (the rejoin pulls current state).
+    """
+
+    class Builder:
+        def __init__(self, server_address: str):
+            self._address = server_address
+            self._staleness = 0
+            self._threshold = 1e-3
+            self._batch = 32
+            self._retries = 5
+            self._backoff = 0.05
+
+        def staleness(self, n):
+            self._staleness = int(n)
+            return self
+
+        def threshold(self, t):
+            self._threshold = float(t)
+            return self
+
+        def batch_size_per_worker(self, n):
+            self._batch = int(n)
+            return self
+
+        batchSizePerWorker = batch_size_per_worker
+
+        def max_retries(self, n):
+            self._retries = int(n)
+            return self
+
+        def backoff(self, seconds):
+            self._backoff = float(seconds)
+            return self
+
+        def build(self):
+            return ParameterServerTrainingMaster(
+                self._address, staleness=self._staleness,
+                threshold=self._threshold,
+                batch_size_per_worker=self._batch,
+                max_retries=self._retries, backoff=self._backoff)
+
+    def __init__(self, server_address: str, staleness: int = 0,
+                 threshold: float = 1e-3, batch_size_per_worker: int = 32,
+                 max_retries: int = 5, backoff: float = 0.05,
+                 client: Optional[ParameterServerClient] = None):
+        self.server_address = server_address
+        self.staleness = int(staleness)
+        self.threshold = float(threshold)
+        self.batch_size_per_worker = int(batch_size_per_worker)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.client = client
+        self.accumulator = EncodedGradientsAccumulator(
+            initial_threshold=threshold)
+        self.local_version = 0
+        self._update_step = None
+        self._apply_step = None
+        self._step_net = None
+
+    # ------------------------------------------------------------ plumbing
+    def _ensure_client(self) -> ParameterServerClient:
+        if self.client is None:
+            self.client = ParameterServerClient(
+                self.server_address, staleness=self.staleness,
+                max_retries=self.max_retries, backoff=self.backoff)
+        return self.client
+
+    def _ensure_steps(self, net):
+        # keyed on the net: the jitted step closes over ITS architecture and
+        # updater, so reusing the master with another net must re-jit — and
+        # the accumulator's residual/adaptive threshold belong to the
+        # previous net's update stream, so they reset too
+        if self._update_step is None or self._step_net is not net:
+            if self._step_net is not None:
+                self.accumulator.reset()
+            self._step_net = net
+            self._update_step = jax.jit(net._raw_update_step(),
+                                        donate_argnums=(2,))
+
+            def apply_fn(params, update):
+                return jax.tree_util.tree_map(
+                    lambda p, u: p - u.astype(p.dtype), params, update)
+
+            self._apply_step = jax.jit(apply_fn, donate_argnums=(0,))
+
+    # ------------------------------------------------------------ training
+    def execute_training(self, net, iterator):
+        import jax.numpy as jnp
+
+        client = self._ensure_client()
+        self._ensure_steps(net)
+        acc = self.accumulator
+
+        version, created = client.init_params(flatten_params(net.params))
+        if not created:
+            # join/rejoin: another worker (or a previous epoch) seeded the
+            # server — adopt its current merged state before stepping
+            version, vec = client.pull()
+            try:
+                set_params_from_flat(net, vec)
+            except ValueError as e:
+                from .client import ParameterServerError
+                raise ParameterServerError(
+                    f"server {client.address} holds parameters for a "
+                    f"different model: {e}") from e
+        self.local_version = version
+
+        for ds in iterator:
+            f = jnp.asarray(ds.features)
+            l = jnp.asarray(ds.labels)
+            itc = jnp.asarray(net.iteration_count, jnp.int32)
+            update, net.states, net.updater_state, loss = \
+                self._update_step(net.params, net.states, net.updater_state,
+                                  itc, net._next_rng(), f, l, None, None)
+            update = jax.tree_util.tree_map(np.asarray, update)
+            decoded_own = acc.store_update(update)
+            frame = acc.serialize_last()
+            # optimistic local apply: progress continues between pulls; the
+            # next adopted pull replaces it with the server's merged state
+            net.params = self._apply_step(
+                net.params, jax.tree_util.tree_map(jnp.asarray, decoded_own))
+            client.push_update(frame)
+            fresh = client.pull_if_stale(self.local_version)
+            if fresh is not None:
+                self.local_version, vec = fresh
+                set_params_from_flat(net, vec)
+            net.score_ = loss
+            net.iteration_count += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration_count - 1, float(loss))
+        return net
+
+    executeTraining = execute_training

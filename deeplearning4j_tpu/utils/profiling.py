@@ -13,6 +13,10 @@ Here the device is XLA, so the natural equivalents are:
 - :class:`StepTimerListener` — honest per-iteration wall times using a
   device→host value fetch as the barrier (``jax.block_until_ready`` can
   return early on the axon tunnel — PERF.md addendum 2).
+- :class:`ParamServerMetricsListener` (re-exported from
+  ``paramserver/metrics.py``) — push/pull counters, wire bytes, retries and
+  op-latency histograms for server-mediated async training, on the same
+  listener bus.
 """
 from __future__ import annotations
 
@@ -23,6 +27,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..optimize.listeners import TrainingListener
+
+
+def __getattr__(name):
+    # lazy re-export: pulling the PS listener eagerly would make a plain
+    # profiling import pay for the whole paramserver+parallel stack
+    if name == "ParamServerMetricsListener":
+        from ..paramserver.metrics import ParamServerMetricsListener
+        return ParamServerMetricsListener
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @contextlib.contextmanager
@@ -145,7 +158,9 @@ def step_cost(net, ds) -> Dict[str, Any]:
         net.params, net.states, net.updater_state,
         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
         feats, labels, None, None)
-    ca = lowered.compile().cost_analysis() or {}
+    from ..compat import cost_analysis
+
+    ca = cost_analysis(lowered.compile())
     flops = float(ca.get("flops", 0.0))
     by = float(ca.get("bytes accessed", 0.0))
     return {"flops": flops, "bytes_accessed": by, "batch": batch,

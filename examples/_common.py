@@ -18,12 +18,10 @@ def maybe_force_cpu():
     v = os.environ.get("DL4J_TPU_EXAMPLE_CPU", "").strip().lower()
     if v in ("", "0", "false", "no", "off"):
         return
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
     try:
         n = int(v)
     except ValueError:
         n = 1
-    if n > 1:
-        jax.config.update("jax_num_cpu_devices", n)
+    from deeplearning4j_tpu.compat import set_cpu_devices
+
+    set_cpu_devices(max(n, 1))

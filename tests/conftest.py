@@ -3,13 +3,28 @@
 The driver tests sharding on a virtual CPU mesh (no multi-chip TPU hardware in
 CI); the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter start, so we
 override via jax.config before any backend is initialized.
+``compat.set_cpu_devices`` picks the mechanism the running jax supports
+(``jax_num_cpu_devices`` config vs the 0.4.x XLA_FLAGS device-count flag) and
+strips any inherited force-flag so spawned worker subprocesses configure their
+own device count cleanly.
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from deeplearning4j_tpu.compat import set_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_cpu_devices(8)
+
+# NOTE (jax 0.4.x): jax_threefry_partitionable defaults False here but True
+# on the jax line the suite's seeded thresholds were tuned against. The two
+# schemes draw different weights; pinning True flips which single marginal
+# test trips (remat bitwise vs a convergence threshold). We keep the
+# runtime's default — remat bit-exactness is the stronger pin to preserve.
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running tests excluded from tier-1")

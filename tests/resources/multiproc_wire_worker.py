@@ -14,13 +14,12 @@ import os
 pid, nproc, port_base, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                                  int(sys.argv[3]), sys.argv[4])
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
-
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
+import jax
+from deeplearning4j_tpu.compat import set_cpu_devices
+
+set_cpu_devices(1)
 import numpy as np
 from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
                                 DataSet, ListDataSetIterator, Sgd)

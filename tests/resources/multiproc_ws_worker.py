@@ -11,13 +11,12 @@ import os
 pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                             int(sys.argv[3]), sys.argv[4])
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
+import jax
+from deeplearning4j_tpu.compat import set_cpu_devices
+
+set_cpu_devices(2)
 from deeplearning4j_tpu.parallel import (initialize_distributed,
                                          ParallelWrapper, TrainingMode,
                                          DATA_AXIS)

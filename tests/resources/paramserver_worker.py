@@ -1,0 +1,93 @@
+"""Worker for the multi-process parameter-server test.
+
+Role by rank: pid 0 runs a standalone :class:`ParameterServer` node; every
+other pid is an independent training client (own process, own jitted step —
+the separate-slices situation) running ``ParameterServerTrainingMaster``
+against the server over real TCP. File-based coordination in ``outdir``:
+clients drop ``ps_done_<pid>`` after training, wait for every peer, then
+take a FINAL pull (server state is quiescent by then, so all final pulls —
+and the server's own snapshot — must be bit-identical).
+
+Usage: python paramserver_worker.py <process_id> <num_processes> <port> <outdir>
+"""
+import sys
+import os
+import json
+import time
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), sys.argv[4])
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from deeplearning4j_tpu.compat import set_cpu_devices
+
+set_cpu_devices(1)
+import numpy as np
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.paramserver import (ParameterServer,
+                                            ParameterServerClient,
+                                            ParameterServerTrainingMaster)
+
+
+def _wait_for(paths, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(os.path.exists(p) for p in paths):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"missing: {[p for p in paths if not os.path.exists(p)]}")
+
+
+clients = list(range(1, nproc))
+
+if pid == 0:  # ------------------------------------------------- server role
+    srv = ParameterServer(port=port)
+    _wait_for([os.path.join(outdir, f"ps_exit_{q}") for q in clients])
+    version, vec = srv.snapshot()[:2]
+    np.save(os.path.join(outdir, "ps_params_server.npy"), vec)
+    with open(os.path.join(outdir, "ps_stats.json"), "w") as fh:
+        json.dump({"version": version, **srv.metrics.snapshot()}, fh)
+    srv.stop()
+    sys.exit(0)
+
+# ---------------------------------------------------------------- client role
+conf = (NeuralNetConfiguration.builder().seed(11)
+        .updater(Sgd(learning_rate=5e-2)).activation("tanh").list()
+        .layer(DenseLayer(n_in=6, n_out=16))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+# every client derives the same full stream; each trains ONLY its shard
+rng = np.random.default_rng(3)
+batches = []
+for i in range(12):
+    f = rng.normal(size=(16, 6)).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    batches.append(DataSet(f, l))
+local = [b for i, b in enumerate(batches)
+         if i % len(clients) == clients.index(pid)]
+
+# generous retry budget: the server process may still be starting up
+master = ParameterServerTrainingMaster(f"127.0.0.1:{port}", staleness=1,
+                                       threshold=1e-3, max_retries=8,
+                                       backoff=0.1)
+s0 = net.score(DataSet.merge(batches))
+for _ in range(4):
+    master.execute_training(net, ListDataSetIterator(local))
+s1 = net.score(DataSet.merge(batches))
+
+open(os.path.join(outdir, f"ps_done_{pid}"), "w").close()
+_wait_for([os.path.join(outdir, f"ps_done_{q}") for q in clients])
+# all clients are done pushing → the final pull sees the settled state
+version, vec = master.client.pull()
+np.save(os.path.join(outdir, f"ps_params_{pid}.npy"), vec)
+with open(os.path.join(outdir, f"ps_result_{pid}.txt"), "w") as fh:
+    fh.write(f"{s0} {s1} {version} "
+             f"{master.client.metrics.counters['pushes']}\n")
+open(os.path.join(outdir, f"ps_exit_{pid}"), "w").close()
+print(f"client {pid}: score {s0:.4f} -> {s1:.4f}, server version {version}")

@@ -170,9 +170,9 @@ def test_train_multihost_coordinator_flags(tmp_path):
         [str(tmp_path), repo, env.get("PYTHONPATH", "")])
     runner = tmp_path / "run_cli.py"
     runner.write_text(
-        "import sys, jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "jax.config.update('jax_num_cpu_devices', 2)\n"
+        "import sys\n"
+        "from deeplearning4j_tpu.compat import set_cpu_devices\n"
+        "set_cpu_devices(2)\n"
         "from deeplearning4j_tpu.main import main\n"
         "sys.exit(main(sys.argv[1:]))\n")
     procs = [subprocess.Popen(

@@ -1,0 +1,373 @@
+"""Parameter-server subsystem: protocol, fault tolerance, training.
+
+Everything here runs single-process against an in-process loopback server
+(``ParameterServer(port=0)`` auto-picks a free port) so tier-1 covers the
+full push/pull protocol, the retry/backoff/fault-injection story, and a
+real training run; the multi-process variant lives in
+``test_multiprocess.py`` behind ``@pytest.mark.slow``.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import DistributedMultiLayerNetwork
+from deeplearning4j_tpu.parallel.accumulation import (
+    EncodedGradientsAccumulator, serialize_encoded, threshold_decode)
+from deeplearning4j_tpu.paramserver import (
+    ParameterServer, ParameterServerClient, ServerUnavailableError,
+    ParameterServerError, ParameterServerTrainingMaster,
+    ParamServerMetricsListener, LatencyHistogram, flatten_params,
+    set_params_from_flat)
+
+
+def _client(srv, **kw):
+    kw.setdefault("max_retries", 2)
+    kw.setdefault("backoff", 0.01)
+    return ParameterServerClient(srv.address, **kw)
+
+
+def _toy_net(seed=11):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=5e-2)).activation("tanh").list()
+            .layer(DenseLayer(n_in=6, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_batches(n=8, seed=3):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(16, 6)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)])
+            for _ in range(n)]
+
+
+# ------------------------------------------------------------------ protocol
+def test_set_pull_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    vec = rng.normal(size=257).astype(np.float32)
+    with ParameterServer(port=0) as srv, _client(srv) as c:
+        v1 = c.set_params(vec)
+        v2, out = c.pull()
+        assert v2 == v1
+        np.testing.assert_array_equal(out, vec)  # bit-exact round trip
+
+
+def test_init_only_first_caller_seeds():
+    with ParameterServer(port=0) as srv:
+        a, b = _client(srv), _client(srv)
+        va, created_a = a.init_params(np.ones(5, np.float32))
+        vb, created_b = b.init_params(np.full(5, 9.0, np.float32))
+        assert created_a and not created_b
+        assert va == vb  # second init did not bump the version
+        _, out = b.pull()
+        np.testing.assert_array_equal(out, np.ones(5, np.float32))
+
+
+def test_encoded_push_applies_exact_update():
+    vec = np.arange(10, dtype=np.float32)
+    idx = np.array([0, 5], np.int32)
+    signs = np.array([1, -1], np.int8)
+    with ParameterServer(port=0) as srv, _client(srv) as c:
+        v0 = c.set_params(vec)
+        v1 = c.push_update(serialize_encoded((idx, signs, 0.5, 10)))
+        assert v1 == v0 + 1
+        v2, out = c.pull()
+        exp = vec.copy()
+        exp[0] -= 0.5  # applied as p -= decode(frame)
+        exp[5] += 0.5
+        assert v2 == v1
+        np.testing.assert_array_equal(out, exp)
+
+
+def test_round_robin_shards_reassemble():
+    rng = np.random.default_rng(1)
+    vec = rng.normal(size=103).astype(np.float32)  # not divisible by shards
+    with ParameterServer(port=0, num_shards=4) as srv, _client(srv) as c:
+        c.set_params(vec)
+        full = np.empty_like(vec)
+        versions = set()
+        for s in range(4):
+            v, part = c.pull(shard=s)
+            versions.add(v)
+            np.testing.assert_array_equal(part, vec[s::4])
+            full[s::4] = part
+        assert len(versions) == 1  # one consistent version across shards
+        np.testing.assert_array_equal(full, vec)
+
+
+def test_protocol_errors_are_typed_not_fatal():
+    with ParameterServer(port=0) as srv, _client(srv) as c:
+        with pytest.raises(ParameterServerError):
+            c.pull()  # pull before init
+        c.set_params(np.zeros(4, np.float32))
+        with pytest.raises(ParameterServerError):
+            c.push_update(b"garbage-frame")
+        with pytest.raises(ParameterServerError):
+            c.push_update(serialize_encoded(
+                (np.array([0], np.int32), np.array([1], np.int8), 0.5, 99)))
+        with pytest.raises(ParameterServerError):
+            c.pull(shard=1)   # out of range high (num_shards=1)
+        with pytest.raises(ParameterServerError):
+            c.pull(shard=-2)  # out of range low (-1 is the full vector)
+        # connection survived all five rejections
+        v, out = c.pull()
+        np.testing.assert_array_equal(out, np.zeros(4, np.float32))
+        assert c.stats()["counters"]["errors"] == 5
+
+
+def test_server_side_residual_accumulation():
+    """With a server threshold, sub-threshold pushed mass is retained, not
+    dropped: repeated small updates apply once they accumulate past the
+    threshold (the EncodedGradientsAccumulator rule, run server-side)."""
+    n = 6
+    with ParameterServer(port=0, threshold=0.5) as srv, _client(srv) as c:
+        c.set_params(np.zeros(n, np.float32))
+        frame = serialize_encoded(  # decodes to +0.2 at element 0
+            (np.array([0], np.int32), np.array([1], np.int8), 0.2, n))
+        c.push_update(frame)
+        c.push_update(frame)
+        _, out = c.pull()
+        np.testing.assert_array_equal(out, np.zeros(n, np.float32))  # 0.4 < thr
+        c.push_update(frame)  # accumulated 0.6 >= 0.5: applies quantized 0.5
+        _, out = c.pull()
+        exp = np.zeros(n, np.float32)
+        exp[0] = -0.5
+        np.testing.assert_array_equal(out, exp)
+        snap = srv.snapshot()
+        port = srv.port
+    # restart: the residual (0.1 left after the apply) must survive, so two
+    # more sub-threshold pushes reach 0.5 and apply — mass is never lost
+    with ParameterServer(port=port, threshold=0.5, restore=snap) as srv2, \
+            _client(srv2) as c2:
+        c2.push_update(frame)
+        c2.push_update(frame)
+        _, out = c2.pull()
+        exp[0] = -1.0
+        np.testing.assert_array_equal(out, exp)
+
+
+# ------------------------------------------------------- client fault model
+def test_retry_backoff_then_server_unavailable():
+    srv = ParameterServer(port=0)
+    c = _client(srv, max_retries=3, backoff=0.01, backoff_max=0.05)
+    c.set_params(np.zeros(3, np.float32))
+    srv.stop()
+    t0 = time.monotonic()
+    with pytest.raises(ServerUnavailableError) as ei:
+        c.pull()
+    elapsed = time.monotonic() - t0
+    assert c.metrics.counters["retries"] == 3          # budget honored
+    assert elapsed < 5.0                               # backoff stayed small
+    assert srv.address in str(ei.value)                # diagnosis names server
+    assert isinstance(ei.value, ConnectionError)       # catchable broadly
+
+
+def test_kill_restart_fresh_client_pulls_bit_exact():
+    """The acceptance fault-injection path: kill the server mid-use →
+    ServerUnavailableError after the retry budget; restart (restoring the
+    snapshot) → a FRESH client pulls values bit-exact with what was pushed,
+    version numbering intact."""
+    rng = np.random.default_rng(7)
+    vec = rng.normal(size=64).astype(np.float32)
+    srv = ParameterServer(port=0)
+    port = srv.port
+    c = _client(srv)
+    v_pushed = c.set_params(vec)
+    idx = np.array([3, 11], np.int32)
+    signs = np.array([-1, 1], np.int8)
+    v_pushed = c.push_update(serialize_encoded((idx, signs, 0.25, 64)))
+    expected = vec.copy()
+    expected[3] += 0.25
+    expected[11] -= 0.25
+    snap = srv.snapshot()
+    srv.stop()
+
+    with pytest.raises(ServerUnavailableError):
+        c.pull()
+
+    srv2 = ParameterServer(port=port, restore=snap)
+    try:
+        fresh = _client(srv2)
+        v, out = fresh.pull()
+        assert v == v_pushed                            # versioned PULL
+        np.testing.assert_array_equal(out, expected)    # bit-exact
+    finally:
+        srv2.stop()
+
+
+def test_client_reconnects_after_transient_blip():
+    """A dropped connection with the server still up is absorbed by the
+    retry loop — callers never see it."""
+    with ParameterServer(port=0) as srv:
+        c = _client(srv, max_retries=3)
+        c.set_params(np.arange(5, dtype=np.float32))
+        c._sock.close()  # simulate a transient network blip
+        v, out = c.pull()
+        np.testing.assert_array_equal(out, np.arange(5, dtype=np.float32))
+        assert c.metrics.counters["retries"] >= 1
+
+
+# ------------------------------------------------------------- staleness
+def test_bounded_staleness_skips_fresh_pulls():
+    with ParameterServer(port=0) as srv:
+        c = _client(srv, staleness=2)
+        v = c.set_params(np.zeros(4, np.float32))
+        assert c.pull_if_stale(v) is None               # in sync
+        frame = serialize_encoded(
+            (np.array([1], np.int32), np.array([1], np.int8), 0.5, 4))
+        c.push_update(frame)
+        c.push_update(frame)
+        assert c.pull_if_stale(v) is None               # 2 behind == budget
+        c.push_update(frame)
+        got = c.pull_if_stale(v)                        # 3 behind: must pull
+        assert got is not None
+        new_v, out = got
+        assert new_v == v + 3
+        assert out[1] == -1.5
+        assert c.metrics.counters["staleness_hits"] == 2
+
+
+# ------------------------------------------------------------- training
+def test_training_master_reduces_loss_and_counts_ops():
+    net = _toy_net()
+    batches = _toy_batches()
+    with ParameterServer(port=0) as srv:
+        master = (ParameterServerTrainingMaster.Builder(srv.address)
+                  .staleness(1).threshold(1e-3).backoff(0.01).build())
+        s0 = net.score(DataSet.merge(batches))
+        DistributedMultiLayerNetwork(net, master).fit(
+            ListDataSetIterator(batches), epochs=4)
+        s1 = net.score(DataSet.merge(batches))
+        assert s1 < s0, (s0, s1)
+        m = master.client.metrics.snapshot()
+        assert m["counters"]["pushes"] == 32            # 8 batches x 4 epochs
+        assert m["counters"]["staleness_hits"] > 0      # staleness=1 skipped
+        assert m["counters"]["pulls"] >= 1
+        assert m["push_latency"]["n"] == 32             # histogram populated
+        server_stats = master.client.stats()
+        assert server_stats["counters"]["pushes"] == 32
+        assert server_stats["version"] == 33            # init + 32 pushes
+
+
+def test_training_master_rejoin_adopts_server_state():
+    """A second worker joining later must adopt the server's current state
+    (init_params returns created=False → pull), not clobber it."""
+    net_a, net_b = _toy_net(seed=1), _toy_net(seed=2)
+    batches = _toy_batches()
+    with ParameterServer(port=0) as srv:
+        ma = ParameterServerTrainingMaster(srv.address, staleness=0,
+                                           backoff=0.01)
+        ma.execute_training(net_a, ListDataSetIterator(batches))
+        mb = ParameterServerTrainingMaster(srv.address, staleness=0,
+                                           backoff=0.01)
+        mb.execute_training(net_b, ListDataSetIterator([]))  # join, no steps
+        np.testing.assert_array_equal(flatten_params(net_b.params),
+                                      flatten_params(net_a.params))
+
+
+def test_training_master_net_switch_resets_accumulator():
+    """Reusing a master with a different net re-jits AND resets the
+    accumulator — the previous net's sub-threshold residual must not leak
+    into the new net's first pushed update."""
+    net_a, net_b = _toy_net(seed=1), _toy_net(seed=2)
+    batches = _toy_batches(n=2)
+    with ParameterServer(port=0) as srv:
+        m = ParameterServerTrainingMaster(srv.address, threshold=1e-2,
+                                          backoff=0.01)
+        m.execute_training(net_a, ListDataSetIterator(batches))
+        assert m.accumulator._residual is not None  # netA left a residual
+        m.execute_training(net_b, ListDataSetIterator([]))
+        assert m.accumulator._residual is None      # reset on the switch
+
+
+def test_training_master_architecture_mismatch_is_typed():
+    """A worker whose model doesn't match the server's held vector gets a
+    ParameterServerError naming both sizes, not a bare ValueError."""
+    small_conf = (NeuralNetConfiguration.builder().seed(1)
+                  .updater(Sgd(learning_rate=5e-2)).activation("tanh").list()
+                  .layer(DenseLayer(n_in=6, n_out=4))
+                  .layer(OutputLayer(n_in=4, n_out=4, activation="softmax",
+                                     loss="mcxent"))
+                  .build())
+    other = MultiLayerNetwork(small_conf).init()
+    with ParameterServer(port=0) as srv:
+        master = ParameterServerTrainingMaster(srv.address, backoff=0.01)
+        master.execute_training(_toy_net(), ListDataSetIterator([]))
+        master2 = ParameterServerTrainingMaster(srv.address, backoff=0.01)
+        with pytest.raises(ParameterServerError, match="different model"):
+            master2.execute_training(other, ListDataSetIterator([]))
+
+
+def test_flatten_set_params_roundtrip():
+    net = _toy_net()
+    vec = flatten_params(net.params)
+    assert vec.dtype == np.float32 and vec.size == net.num_params()
+    rng = np.random.default_rng(5)
+    new = rng.normal(size=vec.size).astype(np.float32)
+    set_params_from_flat(net, new)
+    np.testing.assert_array_equal(flatten_params(net.params), new)
+    with pytest.raises(ValueError):
+        set_params_from_flat(net, new[:-1])
+
+
+def test_training_master_server_death_surfaces_cleanly():
+    """Kill the server mid-training: the master must surface
+    ServerUnavailableError (after client retries), not a raw socket error,
+    and the net keeps its last adopted parameters."""
+    net = _toy_net()
+    batches = _toy_batches(n=4)
+
+    srv = ParameterServer(port=0)
+    master = ParameterServerTrainingMaster(srv.address, staleness=0,
+                                           max_retries=2, backoff=0.01)
+
+    class KillAfter:
+        def __init__(self, n):
+            self.left = n
+
+        def iteration_done(self, model, iteration, score):
+            self.left -= 1
+            if self.left == 0:
+                srv.stop()
+
+    net.set_listeners(KillAfter(2))
+    try:
+        with pytest.raises(ServerUnavailableError):
+            master.execute_training(net, ListDataSetIterator(batches))
+        assert np.all(np.isfinite(flatten_params(net.params)))
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- metrics
+def test_latency_histogram_summary():
+    h = LatencyHistogram()
+    assert h.summary() == {}
+    for ms in (0.2, 0.5, 1.0, 2.0, 100.0):
+        h.record(ms)
+    s = h.summary()
+    assert s["n"] == 5
+    assert s["max_ms"] == pytest.approx(100.0)
+    assert 0 < s["p50_ms"] <= s["p95_ms"] <= 2 * s["max_ms"]
+    assert s["mean_ms"] == pytest.approx(np.mean([0.2, 0.5, 1.0, 2.0, 100.0]))
+
+
+def test_metrics_listener_rows_on_bus():
+    net = _toy_net()
+    batches = _toy_batches(n=4)
+    with ParameterServer(port=0) as srv:
+        master = ParameterServerTrainingMaster(srv.address, backoff=0.01)
+        listener = ParamServerMetricsListener(master._ensure_client(),
+                                              frequency=2)
+        net.set_listeners(listener)
+        master.execute_training(net, ListDataSetIterator(batches))
+        assert len(listener.rows) == 2                  # iterations 0 and 2
+        last = listener.rows[-1]
+        assert last["counters"]["pushes"] >= 3
+        assert "push_latency" in last and "iteration" in last

@@ -1,0 +1,163 @@
+"""Transport hardening: framing edge cases + failure attribution.
+
+Covers the ``recv_frame`` clean-EOF and zero-length-frame contracts the
+streaming broker's EOS control depends on, the ``exchange`` deadlock
+avoidance with frames far larger than kernel socket buffers, and the typed
+errors (``PeerFailedError`` with a rank, ``TimeoutError`` naming the ranks
+that never connected) that replace anonymous socket failures.
+"""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.transport import (UpdateChannel,
+                                                   PeerFailedError,
+                                                   send_frame, recv_frame)
+
+
+def _free_port_block(n):
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        socks = []
+        try:
+            for q in range(n):
+                t = socket.socket()
+                t.bind(("127.0.0.1", base + q))
+                socks.append(t)
+            return base
+        except OSError:
+            continue
+        finally:
+            for t in socks:
+                t.close()
+    raise RuntimeError("no consecutive free port block")
+
+
+def _mesh(n, timeout=30.0):
+    """Build an n-way full mesh in-process: ranks 1..n-1 handshake on
+    threads while rank 0 handshakes on the caller's thread."""
+    base = _free_port_block(n)
+    addrs = [f"127.0.0.1:{base + q}" for q in range(n)]
+    out = [None] * n
+    errs = []
+
+    def build(q):
+        try:
+            out[q] = UpdateChannel(q, addrs, timeout=timeout)
+        except BaseException as e:
+            errs.append((q, e))
+
+    threads = [threading.Thread(target=build, args=(q,), daemon=True)
+               for q in range(1, n)]
+    for t in threads:
+        t.start()
+    build(0)
+    for t in threads:
+        t.join(timeout)
+    assert not errs, errs
+    return out
+
+
+# ------------------------------------------------------------------ framing
+def test_recv_frame_clean_eof_returns_none():
+    a, b = socket.socketpair()
+    a.close()
+    assert recv_frame(b) is None
+    b.close()
+
+
+def test_recv_frame_zero_length_is_empty_not_eof():
+    """b"" frames are control frames (streaming EOS); they must stay
+    distinguishable from a closed peer (None)."""
+    a, b = socket.socketpair()
+    send_frame(a, b"")
+    got = recv_frame(b)
+    assert got == b"" and got is not None
+    a.close()
+    assert recv_frame(b) is None  # and EOF afterwards is still None
+    b.close()
+
+
+def test_send_recv_roundtrip_payloads():
+    a, b = socket.socketpair()
+    for payload in (b"x", b"abc" * 100, np.arange(999, dtype=np.int32)
+                    .tobytes()):
+        send_frame(a, payload)
+        assert recv_frame(b) == payload
+    a.close()
+    b.close()
+
+
+def test_exchange_frames_larger_than_socket_buffers():
+    """Every rank sends before it reads; frames far beyond the kernel
+    socket buffer sizes would pairwise-deadlock without the helper send
+    thread in ``exchange``."""
+    chans = _mesh(2)
+    big = 8 << 20  # 8 MiB each way, ~64x a typical default buffer
+    frames = [bytes([q]) * big for q in range(2)]
+    got = [None, None]
+
+    def run(q):
+        got[q] = chans[q].exchange(frames[q])
+
+    t = threading.Thread(target=run, args=(1,), daemon=True)
+    t.start()
+    run(0)
+    t.join(60)
+    assert not t.is_alive(), "exchange deadlocked on large frames"
+    assert got[0] == [frames[1]]
+    assert got[1] == [frames[0]]
+    for c in chans:
+        c.close()
+
+
+# ------------------------------------------------------- failure attribution
+def test_gather_dead_peer_raises_peer_failed_error():
+    chans = _mesh(3)
+    chans[1].broadcast(b"healthy")  # rank 1 sends its round normally
+    chans[2].close()                # rank 2 dies instead of sending
+    with pytest.raises(PeerFailedError) as ei:
+        chans[0].gather()
+    assert ei.value.rank == 2
+    assert "2" in str(ei.value)
+    assert isinstance(ei.value, ConnectionError)  # old handlers still catch
+    chans[0].close()
+    chans[1].close()
+
+
+def test_handshake_timeout_names_missing_ranks():
+    # rank 0 of 3 expects ranks 1 and 2 to dial in; nobody does
+    base = _free_port_block(3)
+    addrs = [f"127.0.0.1:{base + q}" for q in range(3)]
+    with pytest.raises(TimeoutError) as ei:
+        UpdateChannel(0, addrs, timeout=0.5)
+    msg = str(ei.value)
+    assert "[1, 2]" in msg and "rank 0" in msg
+
+
+def test_handshake_timeout_names_partial_missing_rank():
+    """Rank 1 dials in, rank 2 never does — only 2 may be blamed."""
+    base = _free_port_block(3)
+    addrs = [f"127.0.0.1:{base + q}" for q in range(3)]
+    result = {}
+
+    def rank1():
+        # rank 1 dials rank 0 and then waits (it would also wait for rank
+        # 2's inbound dial, timing out on its own)
+        try:
+            UpdateChannel(1, addrs, timeout=2.0)
+        except TimeoutError as e:
+            result["r1"] = str(e)
+
+    t = threading.Thread(target=rank1, daemon=True)
+    t.start()
+    with pytest.raises(TimeoutError) as ei:
+        UpdateChannel(0, addrs, timeout=2.0)
+    t.join(10)
+    msg = str(ei.value)
+    assert "[2]" in msg and "[1, 2]" not in msg
