@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
 from .dataset import DataSet, DataSetIterator
+from ..monitor import get_registry
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -76,11 +78,21 @@ class AsyncDataSetIterator(DataSetIterator):
     def __next__(self):
         if self._queue is None:
             self.reset()
+        t0 = time.perf_counter()
         item = self._queue.get()
         if item is self._STOP:
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
+        # monitor seam: how long the training loop actually WAITED for data
+        # (≈0 when prefetch keeps up — a growing histogram tail means ETL,
+        # not the device, is the bottleneck)
+        reg = get_registry()
+        reg.histogram("dataset_next_ms",
+                      "blocking wait in AsyncDataSetIterator.next").observe(
+            (time.perf_counter() - t0) * 1e3)
+        reg.counter("dataset_batches_total",
+                    "minibatches served by AsyncDataSetIterator").inc()
         return item
 
     def batch(self):

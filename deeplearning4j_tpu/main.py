@@ -180,8 +180,9 @@ def cmd_serve_ui(args, block: bool = True) -> int:
                else InMemoryStatsStorage())
     server = UIServer.get_instance()
     server.attach(storage)
-    port = server.start(args.port)         # /remote receiver included
-    print(f"training UI on http://127.0.0.1:{port}", flush=True)
+    host = getattr(args, "host", None) or "127.0.0.1"
+    port = server.start(args.port, host=host)  # /remote receiver included
+    print(f"training UI on http://{host}:{port}", flush=True)
     if not block:                          # tests: caller owns the server
         return port
     try:
@@ -189,6 +190,56 @@ def cmd_serve_ui(args, block: bool = True) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop()
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Dump an observability snapshot (docs/OBSERVABILITY.md): metrics +
+    health from a running server's ``/metrics``+``/healthz`` when ``--url``
+    is given, else this process's own monitor registry/health state.
+    ``--trace-out`` additionally writes the Chrome trace-event JSON
+    (``/trace`` remotely, the local tracer otherwise) to a file for
+    Perfetto."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    def _fetch(base, path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            # /healthz answers 503 WITH a body when unhealthy — still a dump
+            return e.read().decode("utf-8")
+
+    if args.url:
+        base = args.url if "://" in args.url else f"http://{args.url}"
+        base = base.rstrip("/")
+        metrics_text = _fetch(base, "/metrics")
+        health = json.loads(_fetch(base, "/healthz"))
+        trace = _fetch(base, "/trace") if args.trace_out else None
+    else:
+        from .monitor import get_registry, get_health, get_tracer
+        metrics_text = get_registry().render_prometheus()
+        health = get_health().snapshot()
+        trace = (json.dumps(get_tracer().export())
+                 if args.trace_out else None)
+
+    if args.format == "json":
+        from .monitor import get_registry
+        out = {"health": health}
+        if args.url:
+            out["metrics_text"] = metrics_text
+        else:
+            out["metrics"] = get_registry().snapshot()
+        print(json.dumps(out, indent=2))
+    else:
+        print(metrics_text, end="")
+        print("# health " + json.dumps(health))
+    if args.trace_out and trace is not None:
+        with open(args.trace_out, "w") as fh:
+            fh.write(trace)
+        print(f"# trace written to {args.trace_out}", file=sys.stderr)
     return 0
 
 
@@ -205,7 +256,19 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("serve-ui", help="serve the training UI")
     s.add_argument("--stats-file", default=None)
     s.add_argument("--port", type=int, default=9000)
+    s.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 to allow remote scrapes)")
     s.set_defaults(fn=cmd_serve_ui)
+    m = sub.add_parser("monitor",
+                       help="dump a metrics/health snapshot (local process "
+                            "or a running UI server's /metrics+/healthz)")
+    m.add_argument("--url", default=None, metavar="HOST:PORT",
+                   help="scrape a running UI server instead of this process")
+    m.add_argument("--format", choices=("prometheus", "json"),
+                   default="prometheus")
+    m.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="also write Chrome trace-event JSON here")
+    m.set_defaults(fn=cmd_monitor)
     return p
 
 

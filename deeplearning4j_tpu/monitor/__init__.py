@@ -1,0 +1,87 @@
+"""Unified monitor subsystem (docs/OBSERVABILITY.md).
+
+One place to scrape, correlate, and alarm on everything the framework
+does — replacing the three ad-hoc holders observability was fragmented
+across (``ParamServerMetrics``, ``PerformanceListener``/
+``StepTimerListener``, ``ui/stats``):
+
+- :func:`get_registry` — the process-global :class:`MetricsRegistry`
+  (labeled counters / gauges / histograms, Prometheus text rendering;
+  served at ``GET /metrics`` on ``ui/server.py``).
+- :func:`get_tracer` — the host-side span :class:`Tracer` (ring buffer,
+  Chrome trace-event JSON at ``GET /trace``, nests
+  ``jax.profiler.TraceAnnotation``).
+- :func:`get_health` — the :class:`HealthState` behind ``GET /healthz``,
+  plus :class:`TrainingHealthListener`, the NaN/divergence/stall watchdog
+  with ``warn``/``raise``/``halt`` actions.
+
+The fit loops, transport channel, parameter-server client/server, and
+async dataset iterator are pre-instrumented against these globals. The
+per-iteration score fetch that instrumentation needs is a device→host
+VALUE fetch (the completion barrier rule from ``utils/profiling.py``);
+:func:`set_enabled` (False) turns the fit-loop instrumentation off for
+benchmarks that need maximally-async stepping with no listeners attached.
+"""
+from __future__ import annotations
+
+import os
+
+from .registry import (MetricsRegistry, LatencyHistogram, Counter, Gauge,
+                       Histogram, get_registry)
+from .tracer import Tracer, get_tracer
+from .health import (HealthState, get_health, TrainingHealthListener,
+                     TrainingHealthError)
+
+__all__ = [
+    "MetricsRegistry", "LatencyHistogram", "Counter", "Gauge", "Histogram",
+    "get_registry", "Tracer", "get_tracer", "HealthState", "get_health",
+    "TrainingHealthListener", "TrainingHealthError",
+    "set_enabled", "enabled", "record_training_iteration", "step_span",
+]
+
+#: fit-loop instrumentation switch — when False the containers skip the
+#: per-iteration value fetch (and all metric/health writes) unless
+#: listeners are attached, restoring fully-async dispatch. Defaults on
+#: (a bare fit populates /metrics and /healthz); flip per process with
+#: DL4J_TPU_MONITOR=0 or at runtime with set_enabled(False).
+_ENABLED = os.environ.get("DL4J_TPU_MONITOR", "1") not in ("0", "false", "")
+
+
+def set_enabled(value: bool):
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def step_span(iteration: int):
+    """The per-minibatch training span. The caller MUST perform its
+    device→host value fetch (``float(loss)``) inside this span so the span
+    measures the finished step, not its dispatch (value-fetch barrier rule,
+    ``utils/profiling.py``)."""
+    return get_tracer().span("step", cat="train", iteration=int(iteration))
+
+
+def record_training_iteration(model, iteration: int, score: float,
+                              batch_size: int = 0, step_ms: float = None,
+                              etl_ms: float = None):
+    """One call per applied minibatch from the container fit loops: bumps
+    the training counters/gauges and the health liveness state."""
+    reg = get_registry()
+    reg.counter("training_iterations_total",
+                "optimizer iterations applied").inc()
+    reg.gauge("training_score", "last minibatch score").set(score)
+    reg.gauge("training_iteration", "last iteration index").set(iteration)
+    if batch_size:
+        reg.counter("training_examples_total",
+                    "examples consumed by fit").inc(batch_size)
+    if step_ms is not None:
+        reg.histogram("training_step_ms",
+                      "wall-clock per applied step, value-fetch "
+                      "barrier included").observe(step_ms)
+    if etl_ms is not None:
+        reg.histogram("training_etl_ms",
+                      "host wait for the next minibatch").observe(etl_ms)
+    get_health().record_iteration(iteration, score)

@@ -1,0 +1,237 @@
+"""Training health: process-global liveness state + watchdog listener.
+
+Two pieces:
+
+- :class:`HealthState` (:func:`get_health`): the thread-safe snapshot the
+  ``GET /healthz`` endpoint serves — last-iteration age, last score, a NaN
+  latch, halt state, and parameter-server connectivity (fed by
+  ``paramserver/client.py``). The fit loops feed it automatically through
+  ``monitor.record_training_iteration``, so a NaN training score flips
+  ``/healthz`` unhealthy with no listener attached.
+
+- :class:`TrainingHealthListener`: a listener-bus watchdog detecting
+  NaN/Inf score (and optionally params), score divergence, and stalled
+  iterations, with configurable ``warn`` / ``raise`` / ``halt`` actions.
+  ``halt`` sets ``model.halt_requested``, which both containers' ``fit``
+  loops check between minibatches — a graceful stop instead of an
+  exception unwinding through the training stack.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..optimize.listeners import TrainingListener
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HealthState", "get_health", "TrainingHealthListener",
+           "TrainingHealthError"]
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by :class:`TrainingHealthListener` under ``action="raise"``.
+    ``kind`` is one of ``"nan"``, ``"divergence"``, ``"stall"``."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+class HealthState:
+    """Thread-safe process-global liveness snapshot (the ``/healthz``
+    payload). All times are wall-clock; ages are computed at snapshot
+    time so a stalled process reports a growing age, not a stale one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._last_iteration_time: Optional[float] = None
+            self._last_iteration: Optional[int] = None
+            self._last_score: Optional[float] = None
+            self._nan = False
+            self._halted: Optional[str] = None
+            self._problems: List[str] = []
+            self._ps_ops = 0
+            self._ps_errors = 0
+            self._ps_last_error: Optional[str] = None
+            self._ps_connected: Optional[bool] = None
+
+    # ------------------------------------------------------------- feeders
+    def record_iteration(self, iteration: int, score: float):
+        with self._lock:
+            self._last_iteration_time = time.time()
+            self._last_iteration = int(iteration)
+            self._last_score = float(score)
+            if not math.isfinite(float(score)):
+                self._nan = True
+
+    def record_problem(self, kind: str, message: str):
+        with self._lock:
+            if kind == "nan":
+                self._nan = True
+            self._problems.append(f"{kind}: {message}")
+            del self._problems[:-8]  # keep the newest few
+
+    def record_halt(self, reason: str):
+        with self._lock:
+            self._halted = reason
+
+    def clear_halt(self):
+        """A new fit() run supersedes a previous halt (the containers call
+        this on entry) — /healthz goes healthy again once training resumes."""
+        with self._lock:
+            self._halted = None
+
+    def record_ps_ok(self):
+        with self._lock:
+            self._ps_ops += 1
+            self._ps_connected = True
+
+    def record_ps_error(self, message: str):
+        with self._lock:
+            self._ps_errors += 1
+            self._ps_last_error = str(message)
+            self._ps_connected = False
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            age = (None if self._last_iteration_time is None
+                   else time.time() - self._last_iteration_time)
+            healthy = (not self._nan and self._halted is None
+                       and self._ps_connected is not False)
+            return {
+                "status": "ok" if healthy else "unhealthy",
+                "healthy": healthy,
+                "last_iteration": self._last_iteration,
+                "last_iteration_age_s": age,
+                "last_score": self._last_score,
+                "nan": self._nan,
+                "halted": self._halted,
+                "problems": list(self._problems),
+                "paramserver": {
+                    "connected": self._ps_connected,
+                    "ops": self._ps_ops,
+                    "errors": self._ps_errors,
+                    "last_error": self._ps_last_error,
+                },
+            }
+
+
+_HEALTH = HealthState()
+
+
+def get_health() -> HealthState:
+    return _HEALTH
+
+
+class TrainingHealthListener(TrainingListener):
+    """Listener-bus training watchdog.
+
+    Checks, per iteration:
+
+    - **NaN/Inf score** — always; with ``check_params_every=N > 0`` also
+      scans the param pytree for non-finite values every N iterations
+      (opt-in: the scan is a device→host fetch of every leaf).
+    - **Divergence** — score exceeding ``divergence_factor ×`` the best
+      score of the last ``divergence_window`` iterations, once the window
+      is full (positive scores only: the relative rule is meaningless for
+      losses at or below zero, e.g. ``minimize=False`` objectives).
+    - **Stall** — more than ``stall_timeout`` seconds elapsed between this
+      ``iteration_done`` and the previous one. (A *fully* wedged loop never
+      fires listeners at all — that case is the prober's job via
+      ``/healthz``'s ``last_iteration_age_s``.)
+
+    ``action``: ``"warn"`` logs and records the problem in
+    :func:`get_health`; ``"raise"`` raises :class:`TrainingHealthError`;
+    ``"halt"`` requests a graceful stop by setting
+    ``model.halt_requested`` (the containers' fit loops break at the next
+    minibatch boundary). Every trigger is appended to ``self.triggered``
+    as ``(kind, iteration, message)`` regardless of action.
+    """
+
+    ACTIONS = ("warn", "raise", "halt")
+
+    def __init__(self, action: str = "warn", divergence_window: int = 10,
+                 divergence_factor: float = 2.0,
+                 stall_timeout: Optional[float] = None,
+                 check_params_every: int = 0):
+        if action not in self.ACTIONS:
+            raise ValueError(f"action must be one of {self.ACTIONS}, "
+                             f"got {action!r}")
+        self.action = action
+        self.divergence_window = max(2, int(divergence_window))
+        self.divergence_factor = float(divergence_factor)
+        self.stall_timeout = stall_timeout
+        self.check_params_every = int(check_params_every)
+        self.triggered: List[Tuple[str, int, str]] = []
+        self._scores = deque(maxlen=self.divergence_window)
+        self._last_time: Optional[float] = None
+
+    # ------------------------------------------------------------- checks
+    def _fire(self, model, kind: str, iteration: int, message: str):
+        self.triggered.append((kind, iteration, message))
+        get_health().record_problem(kind, message)
+        if self.action == "raise":
+            raise TrainingHealthError(kind, message)
+        if self.action == "halt":
+            get_health().record_halt(message)
+            try:
+                model.halt_requested = True
+            except AttributeError:
+                pass  # read-only model object: the health latch still set
+            log.warning("TrainingHealthListener HALT: %s", message)
+        else:
+            log.warning("TrainingHealthListener: %s", message)
+
+    def _params_nonfinite(self, model) -> bool:
+        import numpy as np
+        import jax
+        params = getattr(model, "params", None)
+        if params is None:
+            return False
+        for leaf in jax.tree_util.tree_leaves(params):
+            if not bool(np.all(np.isfinite(np.asarray(leaf)))):
+                return True
+        return False
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if (self.stall_timeout is not None and self._last_time is not None
+                and now - self._last_time > self.stall_timeout):
+            self._fire(model, "stall", iteration,
+                       f"iteration {iteration} arrived "
+                       f"{now - self._last_time:.1f}s after the previous one "
+                       f"(stall_timeout={self.stall_timeout}s)")
+        self._last_time = now
+
+        score = float(score)
+        if not math.isfinite(score):
+            self._fire(model, "nan", iteration,
+                       f"non-finite score {score} at iteration {iteration}")
+            return  # divergence math is meaningless on a NaN stream
+        if (self.check_params_every > 0
+                and iteration % self.check_params_every == 0
+                and self._params_nonfinite(model)):
+            self._fire(model, "nan", iteration,
+                       f"non-finite parameter values at iteration "
+                       f"{iteration}")
+            return
+
+        if (len(self._scores) == self._scores.maxlen
+                and min(self._scores) > 0.0
+                and score > self.divergence_factor * min(self._scores)):
+            self._fire(model, "divergence", iteration,
+                       f"score {score:.6g} at iteration {iteration} exceeds "
+                       f"{self.divergence_factor}x the best of the last "
+                       f"{self.divergence_window} iterations "
+                       f"({min(self._scores):.6g})")
+        self._scores.append(score)

@@ -1,0 +1,288 @@
+"""Process-global metrics registry: labeled counters, gauges, histograms.
+
+The reference stack has no framework-internal metrics at all — deep
+profiling is delegated to ND4J's external ``OpProfiler`` and the Play UI's
+``StatsListener`` (PAPER.md §5) — so every subsystem here grew its own
+ad-hoc holder (``ParamServerMetrics``, ``PerformanceListener``,
+``ui/stats``). This module is the single place they all land: one
+thread-safe :class:`MetricsRegistry` per process (:func:`get_registry`)
+holding metric *families* (name + type + help) with labeled children, plus
+Prometheus text-format rendering for the ``GET /metrics`` endpoint on
+``ui/server.py``.
+
+The histogram implementation is :class:`LatencyHistogram` — the
+log2-bucketed fixed-memory histogram that previously lived in
+``paramserver/metrics.py`` (which now re-exports it and backs its
+``ParamServerMetrics`` facade with this registry).
+
+Handles are cheap and cached: ``REGISTRY.counter("x_total", peer="0")``
+returns the same :class:`Counter` child every time, so hot paths can either
+hold the handle or re-look it up per call.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class LatencyHistogram:
+    """Log2-bucketed latency histogram (0.1 ms granularity floor): O(1)
+    memory regardless of op count, with mean exact and p50/p95 read from the
+    bucket upper edges — the shape ``StepTimerListener.summary()`` reports,
+    without retaining every sample."""
+
+    #: bucket b covers [0.1·2^b, 0.1·2^(b+1)) ms; 24 buckets reach ~28 min
+    N_BUCKETS = 24
+
+    def __init__(self):
+        self.counts = [0] * self.N_BUCKETS
+        self.total_ms = 0.0
+        self.n = 0
+        self.max_ms = 0.0
+
+    def record(self, ms: float):
+        ms = max(float(ms), 0.0)
+        b = 0
+        edge = 0.1
+        while ms >= edge * 2 and b < self.N_BUCKETS - 1:
+            edge *= 2
+            b += 1
+        self.counts[b] += 1
+        self.total_ms += ms
+        self.n += 1
+        self.max_ms = max(self.max_ms, ms)
+
+    @classmethod
+    def bucket_edges(cls) -> List[float]:
+        """Upper edge (ms) of every bucket — the Prometheus ``le`` values."""
+        return [0.1 * (2 ** (b + 1)) for b in range(cls.N_BUCKETS)]
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile sample."""
+        if not self.n:
+            return 0.0
+        rank = q * (self.n - 1)
+        seen = 0
+        edge = 0.1
+        for b, c in enumerate(self.counts):
+            seen += c
+            if seen > rank:
+                return min(edge * 2, self.max_ms) if c else edge * 2
+            edge *= 2
+        return self.max_ms
+
+    def summary(self) -> Dict[str, float]:
+        if not self.n:
+            return {}
+        return {"mean_ms": self.total_ms / self.n,
+                "p50_ms": self.quantile(0.50),
+                "p95_ms": self.quantile(0.95),
+                "max_ms": self.max_ms, "n": float(self.n)}
+
+
+class Counter:
+    """Monotonic counter child. ``inc`` only — decreasing is a bug the
+    registry refuses to express (use a Gauge)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, value: float = 1.0):
+        if value < 0:
+            raise ValueError(f"counter increments must be >= 0, got {value}")
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Settable instantaneous value child."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0):
+        with self._lock:
+            self._value += value
+
+    def dec(self, value: float = 1.0):
+        self.inc(-value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Thread-safe wrapper over :class:`LatencyHistogram` (ms samples)."""
+
+    __slots__ = ("_lock", "_hist")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hist = LatencyHistogram()
+
+    def observe(self, ms: float):
+        with self._lock:
+            self._hist.record(ms)
+
+    record = observe
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            return self._hist.summary()
+
+    def state(self) -> Tuple[List[int], float, int]:
+        """(bucket counts, total_ms, n) snapshot for rendering."""
+        with self._lock:
+            return list(self._hist.counts), self._hist.total_ms, self._hist.n
+
+
+_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """One metric name: type, help text, and labeled children."""
+
+    def __init__(self, name: str, mtype: str, help_text: str):
+        self.name = name
+        self.type = mtype
+        self.help = help_text
+        self.children: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    # integral values render without a trailing .0 (Prometheus style)
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class MetricsRegistry:
+    """Thread-safe registry of metric families with labeled children.
+
+    ``counter``/``gauge``/``histogram`` create-or-return a child; re-using a
+    name with a different type raises (one name, one meaning). ``snapshot``
+    gives a point-in-time dict for programmatic use; ``render_prometheus``
+    the text exposition ``GET /metrics`` serves.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _child(self, mtype: str, name: str, help_text: str,
+               labels: Dict[str, str]):
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(name, mtype, help_text)
+            elif fam.type != mtype:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.type}, "
+                    f"cannot re-register as {mtype}")
+            child = fam.children.get(key)
+            if child is None:
+                child = fam.children[key] = _TYPES[mtype]()
+            return child
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._child("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._child("gauge", name, help, labels)
+
+    def histogram(self, name: str, help: str = "", **labels) -> Histogram:
+        return self._child("histogram", name, help, labels)
+
+    # ------------------------------------------------------------ export
+    def snapshot(self) -> Dict[str, List[dict]]:
+        """{name: [{"labels": {...}, "type": ..., "value"|"summary"}, ...]}"""
+        with self._lock:
+            fams = {n: (f.type, list(f.children.items()))
+                    for n, f in self._families.items()}
+        out: Dict[str, List[dict]] = {}
+        for name, (mtype, children) in sorted(fams.items()):
+            rows = []
+            for key, child in children:
+                row = {"labels": dict(key), "type": mtype}
+                if mtype == "histogram":
+                    row["summary"] = child.summary()
+                else:
+                    row["value"] = child.value
+                rows.append(row)
+            out[name] = rows
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4. Histograms render with
+        their log2 bucket upper edges as ``le`` (in ms, matching the
+        ``_ms``-suffixed metric names), plus ``_sum``/``_count``."""
+        with self._lock:
+            fams = [(f.name, f.type, f.help, list(f.children.items()))
+                    for f in self._families.values()]
+        lines: List[str] = []
+        for name, mtype, help_text, children in sorted(fams):
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for key, child in sorted(children):
+                labels = _fmt_labels(key)
+                if mtype == "histogram":
+                    counts, total_ms, n = child.state()
+                    cum = 0
+                    for edge, c in zip(LatencyHistogram.bucket_edges(),
+                                       counts):
+                        cum += c
+                        le = _fmt_labels(key, f'le="{edge:g}"')
+                        lines.append(f"{name}_bucket{le} {cum}")
+                    inf = _fmt_labels(key, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{inf} {n}")
+                    lines.append(f"{name}_sum{labels} {_fmt_value(total_ms)}")
+                    lines.append(f"{name}_count{labels} {n}")
+                else:
+                    lines.append(f"{name}{labels} {_fmt_value(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def clear(self):
+        """Drop every family (tests / process reuse)."""
+        with self._lock:
+            self._families.clear()
+
+
+#: the process-global registry every subsystem writes to
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY

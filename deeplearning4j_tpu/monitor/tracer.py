@@ -1,0 +1,125 @@
+"""Host-side span tracer: ring buffer → Chrome trace-event JSON.
+
+The reference delegates all tracing to external tools; ``utils/profiling``
+wraps ``jax.profiler`` for *device* traces. This tracer is the cheap
+*host*-side complement: a context-manager/decorator that records wall-clock
+spans into a bounded ring buffer and exports them as Chrome trace-event
+JSON (``GET /trace`` on the UI server, or :meth:`Tracer.export`) — open the
+dump in Perfetto / ``chrome://tracing``. When jax is importable, every span
+also nests a ``jax.profiler.TraceAnnotation`` so host spans line up with
+device traces captured through ``utils.profiling.trace``.
+
+Timing honesty (the value-fetch barrier rule, ``utils/profiling.py`` /
+PERF.md addendum 2): jitted dispatch is asynchronous and
+``block_until_ready`` can return early on tunneled backends, so a span
+around a bare dispatch measures *dispatch*, not the step. Only a
+device→host VALUE fetch (``float(loss)`` / ``np.asarray``) is a reliable
+completion barrier. The fit-loop instrumentation keeps its ``float(loss)``
+fetch INSIDE the step span for exactly this reason; spans you place around
+your own jitted calls must do their own value fetch to mean anything.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Tracer", "get_tracer"]
+
+
+def _trace_annotation():
+    """jax.profiler.TraceAnnotation class, or None when jax is absent.
+    Resolved lazily so a metrics-only import never pays for jax."""
+    global _ANNOTATION
+    if _ANNOTATION is _UNRESOLVED:
+        try:
+            from jax.profiler import TraceAnnotation
+            _ANNOTATION = TraceAnnotation
+        except Exception:
+            _ANNOTATION = None
+    return _ANNOTATION
+
+
+_UNRESOLVED = object()
+_ANNOTATION = _UNRESOLVED
+
+
+class Tracer:
+    """Bounded ring buffer of completed host spans.
+
+    ``capacity`` bounds memory: the newest ``capacity`` spans win (a
+    steady-state training loop keeps the recent window, which is what a
+    ``GET /trace`` snapshot wants). Spans on different threads interleave
+    naturally — the export carries ``tid`` so Perfetto lays them out per
+    thread, and nesting within a thread is reconstructed from ts/dur
+    containment.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=int(capacity))
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """Record one span around the enclosed block. ``args`` become the
+        trace event's ``args`` (must be JSON-serializable scalars)."""
+        ann_cls = _trace_annotation()
+        ann = ann_cls(name) if ann_cls is not None else None
+        if ann is not None:
+            ann.__enter__()
+        start = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dur = time.perf_counter() - start
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            ev = {"name": name, "cat": cat, "ph": "X",
+                  "ts": (start - self._t0) * 1e6, "dur": dur * 1e6,
+                  "pid": os.getpid(), "tid": threading.get_ident()}
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self._events.append(ev)
+
+    def trace(self, name: Optional[str] = None, cat: str = "host"):
+        """Decorator form: ``@tracer.trace()`` spans every call."""
+        def deco(fn):
+            span_name = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapped(*a, **kw):
+                with self.span(span_name, cat=cat):
+                    return fn(*a, **kw)
+            return wrapped
+        return deco
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def export(self) -> Dict:
+        """Chrome trace-event JSON object (the ``/trace`` payload): load it
+        in Perfetto or ``chrome://tracing`` as-is."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+
+#: the process-global tracer the fit loops / transport / PS client write to
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER

@@ -18,6 +18,7 @@ pytree and the TBPTT carry, replacing the reference's mutable layer fields.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from functools import partial
@@ -39,6 +40,7 @@ from .layers.recurrent import _BaseLSTMImpl
 from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 from ..datasets.iterators import AsyncDataSetIterator
 from ..optimize.updater import NetworkUpdater, normalize_gradients
+from .. import monitor as _mon
 
 log = logging.getLogger(__name__)
 
@@ -167,8 +169,12 @@ def _run_tbptt(net, f, l, fm, lm, single_iteration):
                 _map_streams(lambda x: x[:, sl], lm), rnn_state)
             net.iteration_count += n_applied
     net.score_ = loss
-    for lst in net.listeners:
-        lst.iteration_done(net, net.iteration_count - 1, float(loss))
+    if net.listeners or _mon.enabled():
+        score = float(loss)  # device→host value fetch: completion barrier
+        _mon.record_training_iteration(net, net.iteration_count - 1, score,
+                                       batch_size=int(first.shape[0]))
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration_count - 1, score)
 
 
 class MultiLayerNetwork:
@@ -186,6 +192,7 @@ class MultiLayerNetwork:
         self.score_ = float("nan")
         self.last_batch_size = 0
         self.last_etl_ms = 0.0
+        self.halt_requested = False  # TrainingHealthListener "halt" action
         self._rng = None
         self._jit_step = None
         self._jit_tbptt_step = None
@@ -575,17 +582,29 @@ class MultiLayerNetwork:
         if isinstance(it, DataSetIterator) and not isinstance(it, AsyncDataSetIterator):
             if it.async_supported():
                 it = AsyncDataSetIterator(it, queue_size=2)
+        # a new fit() supersedes a previous health halt — without this, one
+        # halt would silently truncate every later fit to a single batch
+        self.halt_requested = False
+        _mon.get_health().clear_halt()
         for epoch in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
-            t_etl = time.perf_counter()
-            for ds in it:
-                self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
-                self._fit_batch(ds)
+            with _mon.get_tracer().span("epoch", cat="train",
+                                        epoch=self.epoch_count):
                 t_etl = time.perf_counter()
+                for ds in it:
+                    self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                    self._fit_batch(ds)
+                    if self.halt_requested:
+                        break
+                    t_etl = time.perf_counter()
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
+            if self.halt_requested:
+                log.warning("fit halted at epoch %d (halt_requested; see "
+                            "TrainingHealthListener)", self.epoch_count)
+                break
         return self
 
     def _fit_batch(self, ds: DataSet, single_iteration=False):
@@ -609,14 +628,31 @@ class MultiLayerNetwork:
             return
         step = self._ensure_step(single_iteration=single_iteration)
         it = jnp.asarray(self.iteration_count, jnp.int32)
-        self.params, self.states, self.updater_state, loss = step(
-            self.params, self.states, self.updater_state, it, self._next_rng(),
-            f, l, fm, lm)
+        observe = bool(self.listeners) or _mon.enabled()
+        score = None
+        t0 = time.perf_counter()
+        # span only when observing: without the float(loss) barrier inside
+        # it, a span would record dispatch time and be worse than no data
+        with (_mon.step_span(self.iteration_count) if observe
+              else contextlib.nullcontext()):
+            self.params, self.states, self.updater_state, loss = step(
+                self.params, self.states, self.updater_state, it,
+                self._next_rng(), f, l, fm, lm)
+            if observe:
+                # device→host VALUE fetch: the completion barrier that makes
+                # the span (and step_ms) measure the step, not its dispatch
+                score = float(loss)
         self.score_ = loss
         self.iteration_count += (1 if single_iteration
                                  else _n_iterations(self.gc))
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration_count - 1, float(loss))
+        if observe:
+            _mon.record_training_iteration(
+                self, self.iteration_count - 1, score,
+                batch_size=self.last_batch_size,
+                step_ms=(time.perf_counter() - t0) * 1e3,
+                etl_ms=self.last_etl_ms)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count - 1, score)
 
     def _fit_tbptt(self, f, l, fm, lm, single_iteration=False):
         """Truncated BPTT (reference ``doTruncatedBPTT``): split time into

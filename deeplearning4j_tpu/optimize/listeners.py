@@ -69,7 +69,7 @@ class PerformanceListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
-        batch = getattr(model, "last_batch_size", 0)
+        batch = getattr(model, "last_batch_size", 0) or 0
         self._samples += batch
         self._batches += 1
         if self._last_time is None:
@@ -80,10 +80,18 @@ class PerformanceListener(TrainingListener):
         if self._batches >= self.frequency:
             dt = now - self._last_time
             if dt > 0:
-                self.last_samples_per_sec = self._samples / dt
                 self.last_batches_per_sec = self._batches / dt
-                msg = (f"iteration {iteration}: {self.last_samples_per_sec:.1f} samples/sec, "
-                       f"{self.last_batches_per_sec:.2f} batches/sec")
+                if self._samples:
+                    self.last_samples_per_sec = self._samples / dt
+                    msg = (f"iteration {iteration}: "
+                           f"{self.last_samples_per_sec:.1f} samples/sec, "
+                           f"{self.last_batches_per_sec:.2f} batches/sec")
+                else:
+                    # model never reported last_batch_size: a 0.0
+                    # samples/sec line would read as "training stalled" —
+                    # report the rate we actually measured
+                    msg = (f"iteration {iteration}: "
+                           f"{self.last_batches_per_sec:.2f} batches/sec")
                 if self.report_score:
                     msg += f", score {float(score):.5f}"
                 log.info("%s", msg)
@@ -108,13 +116,15 @@ class TimeIterationListener(TrainingListener):
     """Reference ``TimeIterationListener``: ETA logging."""
 
     def __init__(self, iteration_count: int, frequency: int = 10):
-        self.start = time.time()
+        # perf_counter, not time.time(): a wall-clock jump (NTP step, DST)
+        # would corrupt every subsequent ETA
+        self.start = time.perf_counter()
         self.total = iteration_count
         self.frequency = max(1, frequency)
 
     def iteration_done(self, model, iteration, score):
         if iteration and iteration % self.frequency == 0:
-            elapsed = time.time() - self.start
+            elapsed = time.perf_counter() - self.start
             per_it = elapsed / max(iteration, 1)
             remaining = per_it * max(self.total - iteration, 0)
             log.info("iteration %d/%d, ETA %.1fs", iteration, self.total, remaining)

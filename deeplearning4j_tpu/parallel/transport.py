@@ -22,6 +22,8 @@ import struct
 import time
 from typing import Dict, List, Sequence
 
+from ..monitor import get_registry, get_tracer
+
 __all__ = ["UpdateChannel", "PeerFailedError", "send_frame", "recv_exact",
            "recv_frame"]
 
@@ -130,31 +132,64 @@ class UpdateChannel:
             self._peers[q] = s
 
     # ----------------------------------------------------------------- frames
+    def _peer_failed(self, rank: int, op: str, exc: OSError):
+        get_registry().counter(
+            "transport_peer_failures_total",
+            "peers that died mid-round (PeerFailedError)",
+            peer=str(rank)).inc()
+        raise PeerFailedError(
+            rank, f"peer {rank} failed during {op}: {exc}") from exc
+
     def broadcast(self, frame: bytes):
-        """Send one frame to every peer (``SilentUpdatesMessage`` fan-out)."""
+        """Send one frame to every peer (``SilentUpdatesMessage`` fan-out).
+        Per-peer wire bytes and send latency land in the monitor registry
+        (``transport_bytes_total{direction="out"}`` /
+        ``transport_send_ms{peer=...}``)."""
+        reg = get_registry()
         header = struct.pack("<q", len(frame))
-        for q in sorted(self._peers):
-            s = self._peers[q]
-            try:
-                s.sendall(header)
-                s.sendall(frame)
-            except OSError as e:
-                raise PeerFailedError(
-                    q, f"peer {q} failed during broadcast: {e}") from e
+        with get_tracer().span("transport/broadcast", cat="transport",
+                               bytes=len(frame), peers=len(self._peers)):
+            for q in sorted(self._peers):
+                s = self._peers[q]
+                t0 = time.perf_counter()
+                try:
+                    s.sendall(header)
+                    s.sendall(frame)
+                except OSError as e:
+                    self._peer_failed(q, "broadcast", e)
+                reg.histogram("transport_send_ms",
+                              "per-peer frame send latency",
+                              peer=str(q)).observe(
+                    (time.perf_counter() - t0) * 1e3)
+                reg.counter("transport_bytes_total", "update-frame bytes "
+                            "on the wire", direction="out",
+                            peer=str(q)).inc(len(frame) + 8)
 
     def gather(self) -> List[bytes]:
         """Receive exactly one frame from every peer, rank order. A dead
         peer surfaces as :class:`PeerFailedError` naming the rank, not an
-        anonymous socket error."""
+        anonymous socket error. Per-peer wait latency and received bytes
+        land in the monitor registry (``transport_recv_ms`` includes the
+        blocking wait for the peer — the straggler signal)."""
+        reg = get_registry()
         out = []
-        for q in sorted(self._peers):
-            s = self._peers[q]
-            try:
-                (n,) = struct.unpack("<q", recv_exact(s, 8))
-                out.append(recv_exact(s, n))
-            except OSError as e:
-                raise PeerFailedError(
-                    q, f"peer {q} failed during gather: {e}") from e
+        with get_tracer().span("transport/gather", cat="transport",
+                               peers=len(self._peers)):
+            for q in sorted(self._peers):
+                s = self._peers[q]
+                t0 = time.perf_counter()
+                try:
+                    (n,) = struct.unpack("<q", recv_exact(s, 8))
+                    out.append(recv_exact(s, n))
+                except OSError as e:
+                    self._peer_failed(q, "gather", e)
+                reg.histogram("transport_recv_ms",
+                              "per-peer frame receive latency (incl. wait)",
+                              peer=str(q)).observe(
+                    (time.perf_counter() - t0) * 1e3)
+                reg.counter("transport_bytes_total", "update-frame bytes "
+                            "on the wire", direction="in",
+                            peer=str(q)).inc(n + 8)
         return out
 
     def exchange(self, frame: bytes) -> List[bytes]:

@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..monitor import get_health, get_tracer
 from ..parallel.transport import send_frame, recv_frame
 from .metrics import ParamServerMetrics
 from .server import (OP_INIT, OP_SET, OP_PUSH, OP_PULL, OP_VERSION, OP_STATS,
@@ -108,14 +109,17 @@ class ParameterServerClient:
                 if resp[0] != ST_OK:
                     raise ParameterServerError(
                         resp[1:].decode("utf-8", "replace"))
+                get_health().record_ps_ok()
                 return resp[1:]
             except (OSError, socket.timeout) as e:  # incl. ConnectionError
                 last = e
                 self._drop_sock()
         self.metrics.add("errors")
-        raise ServerUnavailableError(
+        err = ServerUnavailableError(
             f"parameter server {self.address} unavailable after "
-            f"{self.max_retries + 1} attempts: {last}") from last
+            f"{self.max_retries + 1} attempts: {last}")
+        get_health().record_ps_error(str(err))
+        raise err from last
 
     # ----------------------------------------------------------------- ops
     def init_params(self, vec: np.ndarray) -> Tuple[int, bool]:
@@ -145,7 +149,9 @@ class ParameterServerClient:
         noise of the same scale the staleness bound already tolerates); use
         ``set_params`` for state that must be exact."""
         t0 = time.perf_counter()
-        out = self._request(OP_PUSH, frame)
+        with get_tracer().span("ps/push", cat="paramserver",
+                               bytes=len(frame)):
+            out = self._request(OP_PUSH, frame)
         self.metrics.record_push((time.perf_counter() - t0) * 1e3,
                                  len(frame))
         return struct.unpack("<q", out)[0]
@@ -157,7 +163,9 @@ class ParameterServerClient:
         round-robin slice ``s::num_shards``), stamped with the server
         version they correspond to."""
         t0 = time.perf_counter()
-        out = self._request(OP_PULL, struct.pack("<i", int(shard)))
+        with get_tracer().span("ps/pull", cat="paramserver",
+                               shard=int(shard)):
+            out = self._request(OP_PULL, struct.pack("<i", int(shard)))
         self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
                                  len(out) - 12)
         version, _shard = struct.unpack("<qi", out[:12])

@@ -1,12 +1,18 @@
 """Parameter-server observability: per-op counters + latency histograms.
 
-The reference ships no metrics for its Aeron parameter server beyond log
-lines; here every client and server carries a :class:`ParamServerMetrics`
-(push/pull counts, bytes, retries, staleness hits, op latency histograms)
-and :class:`ParamServerMetricsListener` surfaces the client's numbers on the
-training listener bus (``optimize/listeners.py``) alongside
-``PerformanceListener`` / ``StepTimerListener`` (``utils/profiling.py``) —
-same cadence, same ``summary()`` shape.
+PR 2 moved the histogram implementation and the scrape surface into the
+unified monitor subsystem (``deeplearning4j_tpu/monitor/`` —
+docs/OBSERVABILITY.md): :class:`LatencyHistogram` now lives in
+``monitor.registry`` (re-exported here unchanged), and every
+:class:`ParamServerMetrics` is a *registry-backed facade* — its exact
+per-instance counters/histograms keep the original ``snapshot()`` shape
+for the listener bus and ``OP_STATS``, while every increment is mirrored
+into the process-global :class:`~deeplearning4j_tpu.monitor.
+MetricsRegistry` under ``paramserver_*`` names labeled by ``role``
+(``client``/``server``), which is what ``GET /metrics`` on the UI server
+scrapes. :class:`ParamServerMetricsListener` still surfaces a client's
+numbers on the training listener bus alongside ``PerformanceListener`` /
+``StepTimerListener`` — same cadence, same ``summary()`` shape.
 """
 from __future__ import annotations
 
@@ -14,59 +20,13 @@ import logging
 import threading
 from typing import Dict, List
 
+from ..monitor.registry import LatencyHistogram, get_registry
 from ..optimize.listeners import TrainingListener
 
+__all__ = ["LatencyHistogram", "COUNTERS", "ParamServerMetrics",
+           "ParamServerMetricsListener"]
+
 log = logging.getLogger(__name__)
-
-
-class LatencyHistogram:
-    """Log2-bucketed latency histogram (0.1 ms granularity floor): O(1)
-    memory regardless of op count, with mean exact and p50/p95 read from the
-    bucket upper edges — the shape ``StepTimerListener.summary()`` reports,
-    without retaining every sample."""
-
-    #: bucket b covers [0.1·2^b, 0.1·2^(b+1)) ms; 24 buckets reach ~28 min
-    N_BUCKETS = 24
-
-    def __init__(self):
-        self.counts = [0] * self.N_BUCKETS
-        self.total_ms = 0.0
-        self.n = 0
-        self.max_ms = 0.0
-
-    def record(self, ms: float):
-        ms = max(float(ms), 0.0)
-        b = 0
-        edge = 0.1
-        while ms >= edge * 2 and b < self.N_BUCKETS - 1:
-            edge *= 2
-            b += 1
-        self.counts[b] += 1
-        self.total_ms += ms
-        self.n += 1
-        self.max_ms = max(self.max_ms, ms)
-
-    def quantile(self, q: float) -> float:
-        """Upper edge of the bucket holding the q-quantile sample."""
-        if not self.n:
-            return 0.0
-        rank = q * (self.n - 1)
-        seen = 0
-        edge = 0.1
-        for b, c in enumerate(self.counts):
-            seen += c
-            if seen > rank:
-                return min(edge * 2, self.max_ms) if c else edge * 2
-            edge *= 2
-        return self.max_ms
-
-    def summary(self) -> Dict[str, float]:
-        if not self.n:
-            return {}
-        return {"mean_ms": self.total_ms / self.n,
-                "p50_ms": self.quantile(0.50),
-                "p95_ms": self.quantile(0.95),
-                "max_ms": self.max_ms, "n": float(self.n)}
 
 
 #: counter names every metrics object carries (a fixed schema so dashboards
@@ -78,10 +38,29 @@ COUNTERS = ("pushes", "pulls", "push_bytes", "pull_bytes", "retries",
 class ParamServerMetrics:
     """Thread-safe counters + per-op latency histograms shared by
     :class:`~deeplearning4j_tpu.paramserver.server.ParameterServer` (ops
-    served) and :class:`~deeplearning4j_tpu.paramserver.client.
-    ParameterServerClient` (ops issued, retries, staleness skips)."""
+    served, ``role="server"``) and :class:`~deeplearning4j_tpu.paramserver.
+    client.ParameterServerClient` (ops issued, retries, staleness skips —
+    ``role="client"``).
 
-    def __init__(self):
+    Dual-view by design: ``snapshot()`` reads this instance's own exact
+    numbers (unchanged shape — many clients coexist without mixing), while
+    the shared registry child for this ``role`` aggregates across instances
+    for the Prometheus scrape."""
+
+    def __init__(self, role: str = "client"):
+        self.role = str(role)
+        reg = get_registry()
+        # registry children: shared per role, so N clients aggregate into
+        # one scrape series instead of N unbounded label sets
+        self._reg_counters = {
+            k: reg.counter(f"paramserver_{k}_total",
+                           "parameter-server op counter", role=self.role)
+            for k in COUNTERS}
+        self._reg_push = reg.histogram(
+            "paramserver_push_ms", "push round-trip latency", role=self.role)
+        self._reg_pull = reg.histogram(
+            "paramserver_pull_ms", "pull round-trip latency", role=self.role)
+        # per-instance exact mirror (the snapshot()/OP_STATS view)
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {k: 0 for k in COUNTERS}
         self.push_latency = LatencyHistogram()
@@ -90,18 +69,30 @@ class ParamServerMetrics:
     def add(self, counter: str, value: int = 1):
         with self._lock:
             self.counters[counter] = self.counters.get(counter, 0) + value
+        child = self._reg_counters.get(counter)
+        if child is None:
+            child = self._reg_counters[counter] = get_registry().counter(
+                f"paramserver_{counter}_total",
+                "parameter-server op counter", role=self.role)
+        child.inc(value)
 
     def record_push(self, ms: float, nbytes: int):
         with self._lock:
             self.counters["pushes"] += 1
             self.counters["push_bytes"] += int(nbytes)
             self.push_latency.record(ms)
+        self._reg_counters["pushes"].inc()
+        self._reg_counters["push_bytes"].inc(int(nbytes))
+        self._reg_push.observe(ms)
 
     def record_pull(self, ms: float, nbytes: int):
         with self._lock:
             self.counters["pulls"] += 1
             self.counters["pull_bytes"] += int(nbytes)
             self.pull_latency.record(ms)
+        self._reg_counters["pulls"].inc()
+        self._reg_counters["pull_bytes"].inc(int(nbytes))
+        self._reg_pull.observe(ms)
 
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy: counters + histogram summaries."""

@@ -74,7 +74,7 @@ class ParameterServer:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
         self.num_shards = int(num_shards)
         self.threshold = float(threshold)
-        self.metrics = ParamServerMetrics()
+        self.metrics = ParamServerMetrics(role="server")
         self._lock = threading.Lock()
         self._shards: Optional[List[np.ndarray]] = None
         self._n = 0

@@ -7,11 +7,18 @@ TPU-native equivalent of reference ``deeplearning4j-play``
  - ``/train/sessions``       — JSON session list
  - ``/train/overview?sid=``  — JSON score/updates series for charts
  - ``/train/model?sid=``     — JSON per-parameter stats (histograms, norms)
+ - ``/metrics``              — Prometheus text exposition of the process's
+   :class:`~deeplearning4j_tpu.monitor.MetricsRegistry` (scrape target)
+ - ``/healthz``              — JSON liveness (last-iteration age, NaN flag,
+   PS connectivity; HTTP 503 when unhealthy)
+ - ``/trace``                — Chrome trace-event JSON from the monitor's
+   span :class:`~deeplearning4j_tpu.monitor.Tracer` (open in Perfetto)
  - POST ``/remote``          — remote StatsReport receiver (the reference's
    remote listener posting seam)
 
 No Play/SBE/webjars: the data API is plain JSON and the page is a single
-self-contained HTML document with inline SVG charts.
+self-contained HTML document with inline SVG charts. See
+docs/OBSERVABILITY.md for the monitor endpoints.
 """
 from __future__ import annotations
 
@@ -21,7 +28,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..monitor import get_health, get_registry, get_tracer
 from .stats import StatsStorage, StatsReport, InMemoryStatsStorage
+
+#: POST bodies larger than this are refused with 413 (a remote stats report
+#: is a few KB; anything megabytes-deep is a bug or abuse, and reading it
+#: would buffer it all in RAM)
+MAX_POST_BYTES = 8 << 20
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j-tpu training</title>
@@ -139,6 +152,23 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
+        if url.path == "/metrics":
+            # Prometheus scrape of the process-global monitor registry
+            payload = get_registry().render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if url.path == "/healthz":
+            snap = get_health().snapshot()
+            self._json(snap, 200 if snap["healthy"] else 503)
+            return
+        if url.path == "/trace":
+            self._json(get_tracer().export())
+            return
         if url.path in ("/", "/train", "/train/overview.html"):
             payload = _PAGE.encode("utf-8")
             self.send_response(200)
@@ -200,7 +230,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urlparse(self.path).path
-        length = int(self.headers.get("Content-Length", 0))
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            self._json({"error": "bad Content-Length"}, 400)
+            return
+        if length < 0:
+            # rfile.read(-1) would block until the client closes the socket
+            self._json({"error": "bad Content-Length"}, 400)
+            return
+        if length > MAX_POST_BYTES:
+            # refuse before reading: the body never enters memory
+            self._json({"error": f"body of {length} bytes exceeds the "
+                        f"{MAX_POST_BYTES}-byte limit"}, 413)
+            return
         body = self.rfile.read(length).decode("utf-8")
         if path == "/remote":
             try:
@@ -232,8 +275,9 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
         self.port = port
+        self.host = host
         self.storage: StatsStorage = InMemoryStatsStorage()
         self._httpd = None
         self._thread = None
@@ -252,14 +296,22 @@ class UIServer:
             self._httpd.RequestHandlerClass.storage = storage
         return self
 
-    def start(self, port: Optional[int] = None) -> int:
-        """Start serving; returns the bound port (0 → ephemeral)."""
+    def start(self, port: Optional[int] = None,
+              host: Optional[str] = None) -> int:
+        """Start serving; returns the bound port (0 → ephemeral).
+
+        ``host`` defaults to the constructor's (loopback): pass
+        ``"0.0.0.0"`` to make ``/metrics`` scrapeable from another machine
+        — the endpoints are unauthenticated, so only widen the bind on a
+        trusted network."""
         if self._httpd is not None:
             return self.port
         if port is not None:
             self.port = port
+        if host is not None:
+            self.host = host
         handler = type("BoundHandler", (_Handler,), {"storage": self.storage})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

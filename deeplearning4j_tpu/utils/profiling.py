@@ -17,6 +17,13 @@ Here the device is XLA, so the natural equivalents are:
   ``paramserver/metrics.py``) — push/pull counters, wire bytes, retries and
   op-latency histograms for server-mediated async training, on the same
   listener bus.
+
+This module covers *device* traces and per-step timing; the process-wide
+metrics/span/health layer lives in ``deeplearning4j_tpu/monitor/`` (one
+``MetricsRegistry`` scraped at ``GET /metrics``, a host-side span tracer
+exporting Chrome trace JSON, and a NaN/divergence/stall watchdog) — see
+docs/OBSERVABILITY.md. The value-fetch barrier rule stated on
+:class:`StepTimerListener` applies to the monitor's spans identically.
 """
 from __future__ import annotations
 

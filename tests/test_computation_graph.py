@@ -43,6 +43,18 @@ def _data(n=32, n_in=8, n_out=3, seed=0):
 class TestBasics:
     def test_fit_reduces_score(self):
         net = ComputationGraph(_mlp_graph()).init()
+        # overwrite the jax-PRNG-drawn init with numpy-seeded weights: the
+        # two threefry schemes (jax_threefry_partitionable True/False) draw
+        # different seed-7 values, which made this assertion threshold-skate
+        # (0.853 vs the 0.849 cutoff on the 0.4.x floor — see conftest
+        # note). Training has no other rng dependence (no dropout/noise),
+        # so with numpy init the whole trajectory is scheme-independent.
+        rng = np.random.default_rng(7)
+        for name in net.params:
+            for k, v in net.params[name].items():
+                shape = np.asarray(v).shape
+                net.params[name][k] = (
+                    0.5 * rng.standard_normal(shape)).astype(np.float32)
         ds = _data()
         s0 = net.score(ds)
         net.fit(ListDataSetIterator([ds]), epochs=30)

@@ -1,0 +1,77 @@
+"""Listener-bus interface-drift guard.
+
+Every ``TrainingListener`` subclass in the package must only override hook
+names/signatures defined on the base class: a listener defining
+``on_epoch_finish`` (typo) or adding a positional arg to
+``iteration_done`` would silently never fire / blow up at dispatch time as
+the bus grows. This walks every package module, collects the full subclass
+tree, and pins both rules."""
+import importlib
+import inspect
+import pkgutil
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+def _import_all_modules():
+    """Import every package module so the subclass tree is complete.
+    Modules with optional external deps are skipped, not failed."""
+    skipped = []
+    for info in pkgutil.walk_packages(deeplearning4j_tpu.__path__,
+                                      deeplearning4j_tpu.__name__ + "."):
+        if info.name.endswith("__main__"):
+            continue  # importing it runs the CLI entry point
+        try:
+            importlib.import_module(info.name)
+        except Exception as e:
+            skipped.append((info.name, repr(e)))
+    return skipped
+
+
+def _all_subclasses(cls):
+    out = set()
+    for sub in cls.__subclasses__():
+        out.add(sub)
+        out |= _all_subclasses(sub)
+    return out
+
+
+def _hook_signatures():
+    return {name: inspect.signature(fn)
+            for name, fn in vars(TrainingListener).items()
+            if not name.startswith("_") and callable(fn)}
+
+
+def test_listener_subclasses_only_override_known_hooks():
+    skipped = _import_all_modules()
+    hooks = _hook_signatures()
+    assert "iteration_done" in hooks  # the contract this test guards
+
+    subclasses = _all_subclasses(TrainingListener)
+    # the walk must actually have found the stock listeners — an empty or
+    # tiny tree means the import sweep broke, not that the bus is clean
+    names = {c.__name__ for c in subclasses}
+    assert {"ScoreIterationListener", "PerformanceListener",
+            "StatsListener", "ParamServerMetricsListener",
+            "TrainingHealthListener"} <= names, (names, skipped)
+
+    problems = []
+    for cls in sorted(subclasses, key=lambda c: c.__qualname__):
+        for name, member in vars(cls).items():
+            if name.startswith("_") or not inspect.isfunction(member):
+                continue
+            if name in hooks:
+                got = list(inspect.signature(member).parameters)
+                want = list(hooks[name].parameters)
+                if got != want:
+                    problems.append(
+                        f"{cls.__module__}.{cls.__qualname__}.{name} "
+                        f"signature {got} != bus contract {want}")
+            elif name.startswith("on_") or name in ("iterationDone",):
+                # looks like a bus hook but the bus will never call it
+                problems.append(
+                    f"{cls.__module__}.{cls.__qualname__}.{name} looks "
+                    f"like a listener hook but TrainingListener defines "
+                    f"no such method (known hooks: {sorted(hooks)})")
+    assert not problems, "\n".join(problems)
