@@ -1,0 +1,23 @@
+"""tpulint — AST-based static analysis for this stack's real hazards.
+
+Three subsystems here enforce whole bug classes only by convention: the
+device→host value-fetch barrier rule in the fit loops, lock discipline
+across the threaded paramserver/monitor/transport stack, and exception
+hygiene. ``tpulint`` machine-checks those conventions the same way
+``tests/test_listener_contract.py`` guards listener drift — as a tier-1
+test over the whole package (``tests/test_analysis.py``) and a CLI::
+
+    python -m deeplearning4j_tpu.main lint [--format json] [--baseline P]
+
+Rule catalog + fix guidance: docs/STATIC_ANALYSIS.md. Suppress a single
+line with ``# tpulint: disable=RULE`` and a comment saying why; everything
+pre-existing lives in ``analysis/baseline.json`` (ratchet-only — the
+tier-1 run fails on any NEW finding).
+"""
+from .linter import (Finding, Linter, load_baseline, load_baseline_reasons,
+                     save_baseline, DEFAULT_BASELINE_PATH, PACKAGE_ROOT)
+from .rules import all_rules, get_rule
+
+__all__ = ["Finding", "Linter", "load_baseline", "load_baseline_reasons",
+           "save_baseline", "DEFAULT_BASELINE_PATH", "PACKAGE_ROOT",
+           "all_rules", "get_rule"]

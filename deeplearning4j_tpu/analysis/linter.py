@@ -1,0 +1,297 @@
+"""tpulint core: file walker, pragma suppression, baseline, output.
+
+Pieces (docs/STATIC_ANALYSIS.md has the user-facing story):
+
+- :class:`Finding` — one violation, fingerprinted by ``(path, rule,
+  stripped source line)`` so baselines survive unrelated line-number
+  drift.
+- pragma suppression — ``# tpulint: disable=RULE1,RULE2`` (or a bare
+  ``# tpulint: disable``) on the *reported* line of the finding. Pragmas
+  are for deliberate, commented exceptions; everything else belongs in
+  code fixes or the baseline.
+- baseline — ``analysis/baseline.json`` grandfathers pre-existing
+  findings so the pass lands green and becomes ratchet-only: the tier-1
+  run (``tests/test_analysis.py``) fails on any finding NOT covered by a
+  baseline entry, and entries can only be removed (by fixing the code),
+  never silently added.
+- :class:`Linter` — walks files, parses once per file, runs every
+  registered rule's AST check, applies pragmas, partitions findings
+  against the baseline. Output is deterministic (sorted by path, line,
+  column, rule) so CI diffs are stable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "Linter", "load_baseline", "load_baseline_reasons",
+           "save_baseline", "DEFAULT_BASELINE_PATH", "PACKAGE_ROOT",
+           "REPO_ROOT", "SKIP_DIRS"]
+
+#: deeplearning4j_tpu package directory (the default lint target)
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: repository root — findings carry paths relative to this
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+#: shipped grandfather list
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+SKIP_DIRS = {".git", "__pycache__", ".venv", "venv", "node_modules",
+             "build", "dist", ".eggs"}
+
+_PRAGMA = re.compile(r"#\s*tpulint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str          # repo-root-relative posix path (abs if outside)
+    line: int          # 1-based
+    col: int           # 0-based, ast convention
+    message: str
+    snippet: str = ""  # stripped source line — the fingerprint component
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        """Line-number-free identity used for baseline matching: the same
+        (file, rule, source text) keeps matching after unrelated edits
+        shift line numbers."""
+        return (self.path, self.rule, self.snippet)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} " \
+               f"{self.message}"
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline JSON → ``{(path, rule, snippet): allowed_count}``.
+
+    Schema (``analysis/baseline.json``)::
+
+        {"version": 1, "findings": [
+            {"rule": "THR001", "path": "deeplearning4j_tpu/x.py",
+             "snippet": "...stripped flagged line...",
+             "count": 1, "reason": "why this is deliberate"}]}
+
+    ``reason`` is documentation for humans; the matcher ignores it.
+    ``count`` (default 1) allows that many identical fingerprints —
+    extras are NEW findings (the ratchet).
+    """
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("findings", ()):
+        key = (str(e["path"]), str(e["rule"]), str(e.get("snippet", "")))
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def load_baseline_reasons(path: str) -> Dict[Tuple[str, str, str], str]:
+    """``{fingerprint: reason}`` for the entries that carry one — so a
+    baseline rewrite (``lint --write-baseline``) preserves the written
+    justifications instead of silently dropping them."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[Tuple[str, str, str], str] = {}
+    for e in data.get("findings", ()):
+        if e.get("reason"):
+            out[(str(e["path"]), str(e["rule"]),
+                 str(e.get("snippet", "")))] = str(e["reason"])
+    return out
+
+
+def save_baseline(path: str, findings: Iterable[Finding],
+                  reasons: Optional[Dict[Tuple[str, str, str], str]] = None):
+    """Write the given findings as a fresh baseline (``lint
+    --write-baseline``). Counts collapse identical fingerprints."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    entries = []
+    for (fpath, rule, snippet), n in sorted(counts.items()):
+        e: Dict[str, object] = {"rule": rule, "path": fpath,
+                                "snippet": snippet}
+        if n != 1:
+            e["count"] = n
+        reason = (reasons or {}).get((fpath, rule, snippet))
+        if reason:
+            e["reason"] = reason
+        entries.append(e)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "tool": "tpulint", "findings": entries},
+                  fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+# -------------------------------------------------------------------- linter
+@dataclass
+class LintResult:
+    """Partitioned outcome of one lint run."""
+    files_checked: int = 0
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    #: baseline fingerprints never matched this run — fixed code whose
+    #: entry should now be deleted (reported, never fatal: the ratchet
+    #: only tightens on NEW findings)
+    stale_baseline: List[Tuple[str, str, str]] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "version": 1, "tool": "tpulint",
+            "files_checked": self.files_checked,
+            "new_count": len(self.new),
+            "baselined_count": len(self.baselined),
+            "findings": [dict(f.to_dict(), baselined=False)
+                         for f in self.new]
+                        + [dict(f.to_dict(), baselined=True)
+                           for f in self.baselined],
+            "stale_baseline": [
+                {"path": p, "rule": r, "snippet": s}
+                for (p, r, s) in self.stale_baseline],
+        }
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.new]
+        for p, r, s in self.stale_baseline:
+            lines.append(f"# stale baseline entry (fixed? delete it): "
+                         f"{p}: {r} {s!r}")
+        lines.append(f"tpulint: {self.files_checked} files, "
+                     f"{len(self.new)} new finding(s), "
+                     f"{len(self.baselined)} baselined")
+        return "\n".join(lines)
+
+
+class Linter:
+    """Run the registered rules over files/trees.
+
+    ``rules``: rule id list to run (default: every registered rule).
+    ``root``: directory findings' paths are made relative to
+    (default: the repository root).
+    """
+
+    def __init__(self, rules: Optional[Sequence[str]] = None,
+                 root: Optional[str] = None):
+        from .rules import all_rules, get_rule
+        if rules is None:
+            self.rules = [cls() for cls in all_rules().values()]
+        else:
+            self.rules = [get_rule(r)() for r in rules]
+        self.root = os.path.abspath(root or REPO_ROOT)
+
+    # ------------------------------------------------------------ plumbing
+    def _relpath(self, path: str) -> str:
+        ap = os.path.abspath(path)
+        if ap.startswith(self.root + os.sep):
+            ap = os.path.relpath(ap, self.root)
+        return ap.replace(os.sep, "/")
+
+    @staticmethod
+    def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+        if not 1 <= finding.line <= len(lines):
+            return False
+        m = _PRAGMA.search(lines[finding.line - 1])
+        if not m:
+            return False
+        which = m.group(1)
+        if which is None:
+            return True                      # bare disable: every rule
+        ids = {w.strip().upper() for w in which.split(",") if w.strip()}
+        return finding.rule.upper() in ids
+
+    # ------------------------------------------------------------- linting
+    def lint_source(self, source: str, path: str) -> List[Finding]:
+        """Lint one already-read source blob (unit of everything else)."""
+        rel = self._relpath(path)
+        lines = source.splitlines()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [Finding("SYN000", rel, int(e.lineno or 1),
+                            int((e.offset or 1) - 1),
+                            f"syntax error: {e.msg}",
+                            snippet=(lines[e.lineno - 1].strip()
+                                     if e.lineno and
+                                     e.lineno <= len(lines) else ""))]
+        out: List[Finding] = []
+        for rule in self.rules:
+            for f in rule.check(tree, lines, rel):
+                if not self._suppressed(f, lines):
+                    out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+    def lint_file(self, path: str) -> List[Finding]:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            # one unreadable file must not kill the verdict for the rest
+            # of the tree — report it as a finding (always new → exit 1)
+            return [Finding("SYN000", self._relpath(path), 1, 0,
+                            f"cannot read file: {e}")]
+        return self.lint_source(source, path)
+
+    @staticmethod
+    def iter_files(paths: Sequence[str]) -> List[str]:
+        """Expand files/dirs into a sorted, de-duplicated .py file list."""
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = sorted(d for d in dirnames
+                                         if d not in SKIP_DIRS)
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            out.append(os.path.join(dirpath, fn))
+            else:
+                out.append(p)
+        seen, uniq = set(), []
+        for p in out:
+            ap = os.path.abspath(p)
+            if ap not in seen:
+                seen.add(ap)
+                uniq.append(p)
+        return uniq
+
+    def run(self, paths: Sequence[str],
+            baseline: Optional[Dict[Tuple[str, str, str], int]] = None
+            ) -> LintResult:
+        """Lint paths and partition findings against ``baseline``."""
+        res = LintResult()
+        findings: List[Finding] = []
+        checked: set = set()
+        for fp in self.iter_files(paths):
+            findings.extend(self.lint_file(fp))
+            checked.add(self._relpath(fp))
+            res.files_checked += 1
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        remaining = dict(baseline or {})
+        for f in findings:
+            if remaining.get(f.fingerprint, 0) > 0:
+                remaining[f.fingerprint] -= 1
+                res.baselined.append(f)
+            else:
+                res.new.append(f)
+        # staleness is only decidable for entries this run could have
+        # re-observed: a subset-path or --select run must not advise
+        # deleting entries it never looked at
+        active = {r.id for r in self.rules}
+        res.stale_baseline = sorted(
+            k for k, n in remaining.items()
+            if n > 0 and k[0] in checked and k[1] in active)
+        return res

@@ -1,0 +1,113 @@
+"""tpulint rule registry + shared AST helpers.
+
+A rule is a class with an ``id`` (``JAX001``…), a one-line ``title``, a
+``rationale`` (why this is a real hazard *in this stack* — surfaces in
+``--format json`` and docs), and ``check(tree, lines, path)`` yielding
+:class:`~deeplearning4j_tpu.analysis.linter.Finding` objects. Register
+with ``@register``; the registry is what the CLI's ``--select`` /
+``--ignore`` and the docs' rule catalog enumerate.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Type
+
+from ..linter import Finding
+
+__all__ = ["Rule", "register", "all_rules", "get_rule",
+           "terminal_name", "call_callee", "make_finding"]
+
+_REGISTRY: Dict[str, Type["Rule"]] = {}
+
+
+class Rule:
+    """Base class for tpulint rules."""
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def check(self, tree: ast.AST, lines: Sequence[str],
+              path: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    # convenience for subclasses
+    def finding(self, node: ast.AST, lines: Sequence[str], path: str,
+                message: str) -> Finding:
+        return make_finding(self.id, node, lines, path, message)
+
+
+def make_finding(rule_id: str, node: ast.AST, lines: Sequence[str],
+                 path: str, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    snippet = lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+    return Finding(rule_id, path, line, col, message, snippet=snippet)
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    """Every registered rule, id-sorted. Importing the rule modules here
+    (not at package import) keeps ``analysis.linter`` import-light and
+    cycle-free."""
+    from . import exception_rules, jax_rules, threading_rules  # noqa: F401
+    return dict(sorted(_REGISTRY.items()))
+
+
+def get_rule(rule_id: str) -> Type[Rule]:
+    rules = all_rules()
+    try:
+        return rules[rule_id.upper()]
+    except KeyError:
+        raise KeyError(f"unknown rule {rule_id!r} "
+                       f"(have: {', '.join(rules)})") from None
+
+
+# -------------------------------------------------------------- AST helpers
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last identifier of a Name/Attribute/Subscript chain:
+    ``self._send_locks[s]`` → ``_send_locks``, ``a.b.c`` → ``c``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_callee(call: ast.Call) -> Optional[str]:
+    """Terminal identifier of a call's callee (or None for exotic ones)."""
+    return terminal_name(call.func)
+
+
+def assigned_names(stmt: ast.AST) -> List[str]:
+    """Terminal identifiers (re)bound by an assignment-like statement."""
+    out: List[str] = []
+
+    def targets_of(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+        else:
+            n = terminal_name(t)
+            if n:
+                out.append(n)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets_of(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets_of(stmt.target)
+    elif isinstance(stmt, ast.NamedExpr):
+        targets_of(stmt.target)
+    return out

@@ -1,0 +1,75 @@
+"""Exception hygiene: broad handlers that swallow silently.
+
+The failure mode this guards (and has bitten this stack): a ``try`` around
+a jax/socket/IO call grows an ``except Exception: pass`` "for robustness",
+and from then on REAL defects — a renamed attribute after a jax upgrade, a
+protocol error, a corrupted stats row — vanish instead of failing loudly
+or at least leaving a log line. The monitor subsystem exists to make this
+system observable; silent swallows are the anti-observability primitive.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import Rule, register, terminal_name
+
+_BROAD = {"Exception", "BaseException"}
+#: a call to any of these inside the handler counts as "the failure was
+#: reported somewhere" — logging methods, warnings, print, health hooks
+_REPORTING = {"debug", "info", "warning", "warn", "error", "exception",
+              "critical", "log", "print", "write", "record_ps_error",
+              "record_exception", "fail"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                                    # bare except:
+    if isinstance(t, ast.Tuple):
+        return any(terminal_name(e) in _BROAD for e in t.elts)
+    return terminal_name(t) in _BROAD
+
+
+def _reports_or_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee in _REPORTING:
+                return True
+        # `except Exception as e:` + any READ of e — the exception is kept
+        # and routed elsewhere (stored for a later re-raise, sent to the
+        # peer, put on a Future), not swallowed
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+@register
+class SilentBroadExcept(Rule):
+    id = "EXC001"
+    title = "broad except that neither logs nor re-raises"
+    rationale = (
+        "`except Exception:` with a silent body turns every future defect "
+        "in the protected block into invisible data loss. Narrow the type "
+        "to what the fallback actually handles (OSError, ImportError, "
+        "AttributeError…), or keep it broad and LOG the swallow "
+        "(log.debug/warning with exc_info) so the monitor story stays "
+        "true. A deliberate must-never-raise path gets a line pragma WITH "
+        "a comment saying why (see monitor/tracer.py).")
+
+    def check(self, tree, lines, path) -> Iterator:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and not _reports_or_reraises(node):
+                what = ("bare except:" if node.type is None
+                        else f"except {terminal_name(node.type) if not isinstance(node.type, ast.Tuple) else 'Exception'}:")
+                yield self.finding(
+                    node, lines, path,
+                    f"{what} swallows without logging or re-raising; "
+                    f"narrow the exception type, log the swallow, or "
+                    f"pragma it with a reason")

@@ -1,0 +1,227 @@
+"""Concurrency hazards: blocking calls under a lock, leaked threads.
+
+Calibrated for this stack's threaded modules (``paramserver/server.py``,
+``monitor/registry.py``, ``parallel/transport.py``, ``datasets/
+streaming.py``): locks are ``threading.Lock``/``RLock`` instances held in
+attributes whose terminal identifier contains ``lock`` (``self._lock``,
+``self._send_locks[s]``, a bare ``lock``), and the wire layer's blocking
+primitives are the ``send_frame``/``recv_frame`` helpers from
+``parallel/transport.py`` as much as raw socket methods.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Set
+
+from . import Rule, register, terminal_name
+
+#: socket/OS methods that park the calling thread
+_BLOCKING_METHODS = {
+    "accept": "socket accept",
+    "recv": "socket recv",
+    "recvfrom": "socket recv",
+    "recv_into": "socket recv",
+    "send": "socket send",
+    "sendall": "socket send",
+    "connect": "socket connect",
+    "sleep": "sleep",
+    "urlopen": "HTTP request",
+    "getresponse": "HTTP response read",
+}
+#: repo wire helpers (parallel/transport.py, datasets/streaming.py) — the
+#: actual blocking layer most of this stack calls instead of raw sockets
+_BLOCKING_FUNCS = {"send_frame", "recv_frame", "_send_frame", "_recv_frame",
+                   "urlopen", "sleep"}
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = terminal_name(node)
+    return bool(name) and "lock" in name.lower()
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None if it doesn't (statically)."""
+    callee = terminal_name(call.func)
+    if callee is None:
+        return None
+    if isinstance(call.func, ast.Name):
+        return ("blocking call" if callee in _BLOCKING_FUNCS else None)
+    # attribute call
+    if callee in _BLOCKING_METHODS:
+        if callee == "send" and isinstance(call.func, ast.Attribute):
+            # generator.send(x) false-positive guard: socket send takes
+            # bytes-ish, still 1 arg — keep, but skip obvious str targets
+            base = call.func.value
+            if isinstance(base, ast.Constant):
+                return None
+        return _BLOCKING_METHODS[callee]
+    if callee == "join" and not call.args:
+        # thread/process join: zero positional args (str.join/os.path.join
+        # always take the iterable/components positionally)
+        has_timeout = any(kw.arg == "timeout" and
+                          not (isinstance(kw.value, ast.Constant)
+                               and kw.value.value is None)
+                          for kw in call.keywords)
+        return None if has_timeout else "join() without timeout"
+    if callee == "get" and not call.args:
+        # queue get: zero positional args (dict.get always passes the key
+        # positionally); a timeout= or block=False makes it bounded
+        for kw in call.keywords:
+            if kw.arg == "timeout" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                return None            # timeout=None blocks forever: flag
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+        return "queue get() without timeout"
+    return None
+
+
+@register
+class BlockingUnderLock(Rule):
+    id = "THR001"
+    title = "blocking call while holding a lock"
+    rationale = (
+        "Every other thread touching that lock stalls for the full socket/"
+        "sleep/join latency — the paramserver serve loop, the monitor "
+        "scrape path and the transport fan-out all share locks with the "
+        "training thread, so one slow peer under a lock becomes a "
+        "training-wide latency cliff (or a deadlock when the blocked "
+        "operation itself needs another lock). Copy state out under the "
+        "lock, do the blocking work outside (see MetricsRegistry."
+        "render_prometheus, ParameterServer._handle).")
+
+    def check(self, tree, lines, path) -> Iterator:
+        seen: set = set()      # nested locks: report each call site once
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                    _is_lock_expr(i.context_expr) for i in node.items):
+                lock_name = next(
+                    (terminal_name(i.context_expr) for i in node.items
+                     if _is_lock_expr(i.context_expr)), "lock")
+                for f in self._scan_body(node.body, lock_name, lines,
+                                         path):
+                    if (f.line, f.col) not in seen:
+                        seen.add((f.line, f.col))
+                        yield f
+
+    def _scan_body(self, body: Sequence[ast.stmt], lock_name, lines, path):
+        for stmt in body:
+            for node in self._walk_same_thread(stmt):
+                if isinstance(node, ast.Call):
+                    reason = _blocking_reason(node)
+                    if reason:
+                        yield self.finding(
+                            node, lines, path,
+                            f"{reason} while holding {lock_name!r}; move "
+                            f"the blocking work outside the lock (snapshot "
+                            f"under the lock, send/sleep/join after)")
+
+    @staticmethod
+    def _walk_same_thread(stmt: ast.AST):
+        """ast.walk minus nested function/lambda bodies — a closure defined
+        under the lock usually RUNS outside it."""
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue                   # closure body runs later
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class LeakedThread(Rule):
+    id = "THR002"
+    title = "non-daemon thread started and never joined"
+    rationale = (
+        "A forgotten non-daemon thread keeps the process alive after "
+        "main() returns — CLI runs and tests hang on exit instead of "
+        "failing loudly. Every long-lived service thread here is either "
+        "daemon=True with an explicit stop() (paramserver accept loop, UI "
+        "httpd) or joined on shutdown (transport exchange). Pick one.")
+
+    def check(self, tree, lines, path) -> Iterator:
+        joined: Set[str] = set()          # names X with X.join(...) present
+        daemoned: Set[str] = set()        # names X with X.daemon = True
+        ctors: List[tuple] = []           # (call node, bound name or None)
+
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                callee = terminal_name(node.func)
+                if callee == "join" and isinstance(node.func,
+                                                   ast.Attribute):
+                    n = terminal_name(node.func.value)
+                    if n:
+                        joined.add(n)
+                if callee in {"Thread", "Timer"} and self._is_threading(
+                        node.func, tree):
+                    ctors.append((node, self._bound_name(node, parents)))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                            and isinstance(node.value, ast.Constant) \
+                            and node.value.value is True:
+                        n = terminal_name(t.value)
+                        if n:
+                            daemoned.add(n)
+
+        for call, bound in ctors:
+            if self._daemon_kw(call):
+                continue
+            if bound is not None and (bound in joined or bound in daemoned):
+                continue
+            where = (f"bound to {bound!r} but" if bound is not None
+                     else "never bound, so it")
+            yield self.finding(
+                call, lines, path,
+                f"thread {where} is neither daemon=True nor .join()ed "
+                f"anywhere in this module — it outlives the process's "
+                f"intent; pass daemon=True (with an explicit stop path) "
+                f"or join it on shutdown")
+
+    @staticmethod
+    def _is_threading(func: ast.AST, tree: ast.AST) -> bool:
+        """threading.Thread(...) always; bare Thread(...) only when the
+        module imports it from threading."""
+        if isinstance(func, ast.Attribute):
+            return terminal_name(func.value) == "threading"
+        if isinstance(func, ast.Name):
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "threading" \
+                        and any((a.asname or a.name) == func.id
+                                for a in node.names):
+                    return True
+        return False
+
+    @staticmethod
+    def _daemon_kw(call: ast.Call) -> bool:
+        return any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in call.keywords)
+
+    @staticmethod
+    def _bound_name(call: ast.Call, parents) -> Optional[str]:
+        """`t = Thread(...)` / `self._thread = Thread(...)` → the terminal
+        target name; chained `Thread(...).start()` or bare expression →
+        None (can never be joined)."""
+        parent = parents.get(call)
+        if isinstance(parent, ast.Assign) and parent.value is call:
+            for t in parent.targets:
+                n = terminal_name(t)
+                if n:
+                    return n
+        if isinstance(parent, (ast.AnnAssign, ast.AugAssign)) \
+                and parent.value is call:
+            return terminal_name(parent.target)
+        if isinstance(parent, ast.NamedExpr) and parent.value is call:
+            return terminal_name(parent.target)
+        return None

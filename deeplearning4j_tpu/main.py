@@ -243,6 +243,56 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """tpulint (docs/STATIC_ANALYSIS.md): AST-check the package (or the
+    given paths) for this stack's hazard classes — host-sync barriers in
+    jitted code (JAX001), PRNG key reuse (JAX002), blocking calls under a
+    lock (THR001), leaked threads (THR002), silent broad excepts (EXC001).
+    Exit 0 iff no finding outside the baseline; deterministic output."""
+    import json as _json
+    import os
+    from .analysis import (Linter, load_baseline, load_baseline_reasons,
+                           save_baseline, DEFAULT_BASELINE_PATH,
+                           PACKAGE_ROOT)
+
+    if args.write_baseline and (args.paths or args.select):
+        # a ratchet reset is inherently whole-package: a subset rewrite
+        # would silently delete grandfathered entries for files/rules the
+        # run never examined
+        raise SystemExit("--write-baseline requires a full default run "
+                         "(no explicit paths, no --select)")
+    paths = args.paths or [PACKAGE_ROOT]
+    rules = ([r.strip() for r in args.select.split(",") if r.strip()]
+             if args.select else None)
+    try:
+        linter = Linter(rules=rules)
+    except KeyError as e:
+        raise SystemExit(f"lint: {e.args[0]}")
+
+    baseline = {}
+    baseline_path = args.baseline or DEFAULT_BASELINE_PATH
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+    res = linter.run(paths, baseline=baseline)
+
+    if args.write_baseline:
+        # ratchet reset: current findings become the new grandfather list,
+        # keeping the surviving entries' written reasons
+        reasons = (load_baseline_reasons(baseline_path)
+                   if os.path.exists(baseline_path) else {})
+        save_baseline(baseline_path, res.new + res.baselined,
+                      reasons=reasons)
+        print(f"# baseline written to {baseline_path} "
+              f"({len(res.new) + len(res.baselined)} findings)",
+              file=sys.stderr)
+        return 0
+    if args.format == "json":
+        print(_json.dumps(res.to_dict(), indent=2))
+    else:
+        print(res.render_text())
+    return res.exit_code
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
@@ -269,6 +319,25 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--trace-out", default=None, metavar="PATH",
                    help="also write Chrome trace-event JSON here")
     m.set_defaults(fn=cmd_monitor)
+    li = sub.add_parser("lint",
+                        help="tpulint: AST static analysis for JAX/"
+                             "concurrency/exception hazards "
+                             "(docs/STATIC_ANALYSIS.md)")
+    li.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the installed "
+                         "deeplearning4j_tpu package)")
+    li.add_argument("--format", choices=("text", "json"), default="text")
+    li.add_argument("--baseline", default=None, metavar="PATH",
+                    help="grandfather list (default: the shipped "
+                         "analysis/baseline.json)")
+    li.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    li.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    li.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(ratchet reset — review the diff!)")
+    li.set_defaults(fn=cmd_lint)
     return p
 
 

@@ -39,7 +39,10 @@ def _trace_annotation():
         try:
             from jax.profiler import TraceAnnotation
             _ANNOTATION = TraceAnnotation
-        except Exception:
+        # deliberately broad + silent: ANY import failure (absent jax,
+        # broken profiler build) means "no device annotations", and trace
+        # emission must never raise into the training loop
+        except Exception:  # tpulint: disable=EXC001
             _ANNOTATION = None
     return _ANNOTATION
 

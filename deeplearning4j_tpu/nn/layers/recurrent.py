@@ -37,7 +37,9 @@ def _match_vma(z, ref):
     rejects the carry-type mismatch. Outside shard_map this is a no-op."""
     try:
         want = set(jax.typeof(ref).vma) - set(jax.typeof(z).vma)
-    except Exception:
+    except (AttributeError, TypeError):
+        # jax < typeof/vma (0.4.x), or a non-jax ref type: no varying-axis
+        # typing exists to satisfy, so the zero init is already fine
         return z
     if not want:
         return z

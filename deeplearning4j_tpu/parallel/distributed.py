@@ -68,7 +68,9 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
-        pass  # TPU backends use ICI/DCN natively
+        # TPU backends use ICI/DCN natively — but log the skip so a
+        # renamed config flag can't silently disable CPU collectives
+        log.debug("gloo CPU-collectives config not applied", exc_info=True)
     kw = {}
     if heartbeat_timeout_s is not None:
         kw["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)

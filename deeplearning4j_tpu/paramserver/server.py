@@ -198,6 +198,10 @@ class ParameterServer:
                 stats["version"] = self._version
                 stats["n"] = self._n
                 stats["num_shards"] = self.num_shards
+            # immutable after construction; lets clients detect
+            # server-side residual merging (see training.py's
+            # count_own_pushes drift warning)
+            stats["threshold"] = self.threshold
             return json.dumps(stats).encode("utf-8")
         raise ValueError(f"unknown op {op}")
 

@@ -20,6 +20,7 @@ every step; ``k``: tolerate k unseen server versions between pulls).
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import numpy as np
@@ -33,6 +34,8 @@ from .metrics import ParamServerMetricsListener  # noqa: F401  (re-export)
 
 __all__ = ["ParameterServerTrainingMaster", "flatten_params",
            "set_params_from_flat"]
+
+log = logging.getLogger(__name__)
 
 
 def flatten_params(params) -> np.ndarray:
@@ -82,6 +85,7 @@ class ParameterServerTrainingMaster(TrainingMaster):
             self._batch = 32
             self._retries = 5
             self._backoff = 0.05
+            self._count_own_pushes = True
 
         def staleness(self, n):
             self._staleness = int(n)
@@ -105,16 +109,24 @@ class ParameterServerTrainingMaster(TrainingMaster):
             self._backoff = float(seconds)
             return self
 
+        def count_own_pushes(self, flag: bool = True):
+            self._count_own_pushes = bool(flag)
+            return self
+
+        countOwnPushes = count_own_pushes
+
         def build(self):
             return ParameterServerTrainingMaster(
                 self._address, staleness=self._staleness,
                 threshold=self._threshold,
                 batch_size_per_worker=self._batch,
-                max_retries=self._retries, backoff=self._backoff)
+                max_retries=self._retries, backoff=self._backoff,
+                count_own_pushes=self._count_own_pushes)
 
     def __init__(self, server_address: str, staleness: int = 0,
                  threshold: float = 1e-3, batch_size_per_worker: int = 32,
                  max_retries: int = 5, backoff: float = 0.05,
+                 count_own_pushes: bool = True,
                  client: Optional[ParameterServerClient] = None):
         self.server_address = server_address
         self.staleness = int(staleness)
@@ -122,6 +134,18 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self.batch_size_per_worker = int(batch_size_per_worker)
         self.max_retries = int(max_retries)
         self.backoff = float(backoff)
+        #: staleness accounting (ROADMAP open item, closed in PR 3). True
+        #: (the PR-1 pinned contract): ``local_version`` only advances on
+        #: pulls, so a worker's OWN pushes count toward the pull trigger
+        #: and ``staleness=0`` means "resync against the server's merged
+        #: state after every push" — the tight-coupling behavior residual
+        #: merging (``threshold > 0``) relies on. False: adopt the version
+        #: ``push_update`` returns when it is contiguous (exactly
+        #: ``local_version + 1``, i.e. provably just our own push), so a
+        #: lone low-churn worker stops re-pulling its own updates — saving
+        #: a full-vector transfer per step — while interleaved foreign
+        #: pushes still trigger pulls under the staleness bound.
+        self.count_own_pushes = bool(count_own_pushes)
         self.client = client
         self.accumulator = EncodedGradientsAccumulator(
             initial_threshold=threshold)
@@ -164,6 +188,19 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self._ensure_steps(net)
         acc = self.accumulator
 
+        if not self.count_own_pushes \
+                and float(client.stats().get("threshold", 0.0)) > 0.0:
+            # server-side residual merging withholds sub-threshold mass,
+            # so the optimistic local apply differs from the server's
+            # applied state by the residual — and with own pushes not
+            # counting toward staleness, a lone worker skips exactly the
+            # resyncs that would reconcile it
+            log.warning(
+                "count_own_pushes=False against a residual-merging server "
+                "(threshold > 0): skipped pulls let local params drift "
+                "from the server's merged state; prefer the default "
+                "count_own_pushes=True on threshold>0 servers")
+
         version, created = client.init_params(flatten_params(net.params))
         if not created:
             # join/rejoin: another worker (or a previous epoch) seeded the
@@ -192,7 +229,17 @@ class ParameterServerTrainingMaster(TrainingMaster):
             # next adopted pull replaces it with the server's merged state
             net.params = self._apply_step(
                 net.params, jax.tree_util.tree_map(jnp.asarray, decoded_own))
-            client.push_update(frame)
+            pushed_version = client.push_update(frame)
+            if not self.count_own_pushes \
+                    and pushed_version == self.local_version + 1:
+                # contiguity guard: the returned version is the GLOBAL
+                # counter, so it only provably covers just our own push
+                # when it is exactly local+1. Adopt it then (the local
+                # optimistic apply above already holds this update's
+                # effect); any gap means other workers' pushes interleaved
+                # — leave local_version alone so pull_if_stale still sees
+                # them and the staleness=k bound stays honest.
+                self.local_version = pushed_version
             fresh = client.pull_if_stale(self.local_version)
             if fresh is not None:
                 self.local_version, vec = fresh

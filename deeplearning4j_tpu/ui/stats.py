@@ -11,6 +11,7 @@ its Java frontend; the information content is preserved.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import sqlite3
@@ -21,6 +22,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..optimize.listeners import TrainingListener
+
+log = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------- stat record
@@ -65,7 +68,9 @@ def _system_stats() -> Dict[str, Any]:
             out["device_peak_bytes_in_use"] = int(
                 ms.get("peak_bytes_in_use", 0))
     except Exception:
-        pass  # CPU backends may not report memory stats
+        # CPU backends may not report memory stats — non-fatal, but leave
+        # a trace so a broken TPU memory_stats surface doesn't hide forever
+        log.debug("device memory stats unavailable", exc_info=True)
     out["gc_collections"] = [s.get("collections", 0) for s in gc.get_stats()]
     out["gc_collected"] = [s.get("collected", 0) for s in gc.get_stats()]
     return out

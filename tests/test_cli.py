@@ -198,3 +198,28 @@ def test_train_multihost_coordinator_flags(tmp_path):
     assert out.exists()                       # chief wrote the model
     assert "model written" in outs[0]         # pid 0 is chief
     assert "model written" not in outs[1]     # non-chief stays quiet
+
+
+def test_lint_subcommand_smoke(tmp_path, capsys):
+    """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over the
+    shipped package (self-hosting against analysis/baseline.json), emits
+    schema-stable JSON, and exits 1 deterministically on a violation."""
+    # the package itself is clean against the shipped baseline
+    assert main(["lint"]) == 0
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+
+    assert main(["lint", "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["tool"] == "tpulint" and data["new_count"] == 0
+
+    # a fresh violation exits 1 (twice: deterministic)
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    for _ in range(2):
+        assert main(["lint", str(bad)]) == 1
+        assert "EXC001" in capsys.readouterr().out
+
+    # rule selection: a THR-only run ignores the EXC001 violation
+    assert main(["lint", str(bad), "--select", "THR001,THR002"]) == 0
+    capsys.readouterr()

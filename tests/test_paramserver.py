@@ -371,3 +371,107 @@ def test_metrics_listener_rows_on_bus():
         last = listener.rows[-1]
         assert last["counters"]["pushes"] >= 3
         assert "push_latency" in last and "iteration" in last
+
+
+def test_count_own_pushes_dial_saves_pull_bandwidth():
+    """ROADMAP open item (closed in PR 3): by default a worker's OWN pushes
+    advance the server version past its pull-time ``local_version``, so a
+    lone ``staleness=0`` worker re-pulls the full vector after every push —
+    the pinned tight-coupling contract. ``count_own_pushes=False`` tracks
+    the version ``push_update`` returns instead, so only OTHER workers'
+    pushes accumulate staleness and the lone worker's full-vector re-pulls
+    disappear. Verified with the PR-2 ``paramserver_pull_bytes`` registry
+    metric (the Prometheus series ``GET /metrics`` exposes)."""
+    from deeplearning4j_tpu.monitor import get_registry
+    pull_bytes = get_registry().counter(
+        "paramserver_pull_bytes_total", "parameter-server op counter",
+        role="client")
+
+    def run(**master_kw):
+        net = _toy_net(seed=4)
+        batches = _toy_batches(n=6, seed=9)
+        with ParameterServer(port=0) as srv:
+            master = ParameterServerTrainingMaster(
+                srv.address, staleness=0, backoff=0.01, **master_kw)
+            before = pull_bytes.value
+            DistributedMultiLayerNetwork(net, master).fit(
+                ListDataSetIterator(batches), epochs=2)
+            snap = master.client.metrics.snapshot()["counters"]
+            return net, snap, pull_bytes.value - before
+
+    net_dflt, snap_dflt, wire_dflt = run()
+    net_dial, snap_dial, wire_dial = run(count_own_pushes=False)
+
+    n_params = flatten_params(net_dflt.params).size
+    # default contract: one full-vector pull per push, plus epoch 2's
+    # rejoin pull (init_params → created=False → adopt server state)
+    assert snap_dflt["pushes"] == 12
+    assert snap_dflt["pulls"] == 13
+    assert wire_dflt == pytest.approx(13 * 4 * n_params)
+    # dial off, single worker: every per-step pull collapses to a
+    # staleness skip; only the epoch-2 rejoin pull remains on the wire
+    assert snap_dial["pushes"] == 12
+    assert snap_dial["pulls"] == 1
+    assert snap_dial["staleness_hits"] == 12
+    assert wire_dial == pytest.approx(4 * n_params)
+    assert wire_dial < wire_dflt / 10
+    # and training still actually trained
+    assert net_dial.iteration_count == 12
+    assert np.isfinite(float(net_dial.score_))
+
+
+def test_count_own_pushes_still_pulls_foreign_updates():
+    """The contiguity guard behind ``count_own_pushes=False``: the version
+    ``push_update`` returns is the GLOBAL counter, so it is only adopted
+    when it is exactly ``local_version + 1`` (provably just our own push).
+    A foreign update interleaved mid-epoch leaves a gap → the next
+    ``pull_if_stale`` must still fetch it, keeping the staleness bound
+    honest in multi-worker runs."""
+    net = _toy_net(seed=7)
+    batches = _toy_batches(n=2, seed=6)
+    with ParameterServer(port=0) as srv:
+        master = ParameterServerTrainingMaster(
+            srv.address, staleness=0, backoff=0.01, count_own_pushes=False)
+        foreign = np.full(flatten_params(net.params).size, 0.5, np.float32)
+
+        def feed():
+            yield batches[0]
+            with _client(srv) as c:       # another worker's update lands
+                c.set_params(foreign)
+            yield batches[1]
+
+        master.execute_training(net, feed())
+        snap = master.client.metrics.snapshot()["counters"]
+        assert snap["pushes"] == 2
+        # step 1: contiguous push adopted, pull skipped; step 2: the
+        # foreign set_params broke contiguity → real pull
+        assert snap["pulls"] == 1
+        assert snap["staleness_hits"] == 1
+        # and the resync adopted the server's post-push merged state
+        _, server_vec = master.client.pull()
+        np.testing.assert_array_equal(flatten_params(net.params),
+                                      server_vec)
+
+
+def test_count_own_pushes_warns_on_residual_merging_server(caplog):
+    """count_own_pushes=False skips exactly the resyncs that reconcile a
+    threshold>0 server's residual-withheld mass — the master detects the
+    combination via the server's OP_STATS threshold and warns."""
+    import logging as _logging
+    batches = _toy_batches(n=1, seed=8)
+    logger = "deeplearning4j_tpu.paramserver.training"
+    with ParameterServer(port=0, threshold=0.5) as srv:
+        m = ParameterServerTrainingMaster(srv.address, staleness=0,
+                                          backoff=0.01,
+                                          count_own_pushes=False)
+        with caplog.at_level(_logging.WARNING, logger=logger):
+            m.execute_training(_toy_net(seed=9), ListDataSetIterator(batches))
+        assert any("residual-merging" in r.message for r in caplog.records)
+    caplog.clear()
+    with ParameterServer(port=0) as srv:        # threshold=0: no warning
+        m = ParameterServerTrainingMaster(srv.address, staleness=0,
+                                          backoff=0.01,
+                                          count_own_pushes=False)
+        with caplog.at_level(_logging.WARNING, logger=logger):
+            m.execute_training(_toy_net(seed=9), ListDataSetIterator(batches))
+        assert not [r for r in caplog.records if "residual" in r.message]
