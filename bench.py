@@ -534,6 +534,11 @@ def _run_one_subprocess(name, timeout_s=2400):
         try:
             doc = json.loads(line)
             if doc.get("one") == name:
+                if doc.get("monitor") is not None:
+                    # latch the child's registry snapshot so the final
+                    # headline (the line BENCH_*.json banks) carries the
+                    # runtime metrics of the run that produced the number
+                    _FINAL["monitor"] = doc["monitor"]
                 return doc.get("value")
         except (ValueError, AttributeError):
             continue
@@ -624,6 +629,20 @@ _FINAL = {
 _CHILDREN = set()
 
 
+def _monitor_snapshot():
+    """The measuring process's monitor-registry snapshot (step/ETL
+    histograms, transport bytes, …), embedded in each emitted record so
+    BENCH_*.json correlates the perf trajectory with the runtime metrics
+    behind it. None when the registry is unavailable or empty — a bench
+    record must never fail over its telemetry garnish."""
+    try:
+        from deeplearning4j_tpu.monitor import get_registry
+        return get_registry().snapshot() or None
+    except Exception as e:
+        print(f"# monitor snapshot unavailable: {e}", file=sys.stderr)
+        return None
+
+
 def _headline_doc(value, base_val, *, stale=False, measured_utc=None,
                   error=None):
     vs = (value / base_val) if (base_val and value) else (1.0 if value else None)
@@ -636,6 +655,10 @@ def _headline_doc(value, base_val, *, stale=False, measured_utc=None,
         doc["measured_utc"] = measured_utc
     if error:
         doc["error"] = error
+    # the measurement child's monitor snapshot, lifted by
+    # _run_one_subprocess — absent on stale replays and error paths
+    if _FINAL.get("monitor") is not None:
+        doc["monitor"] = _FINAL["monitor"]
     return doc
 
 
@@ -774,7 +797,8 @@ def main():
                       file=sys.stderr)
                 sys.exit(3)
             _write_partial(base_doc, {name: value})
-        print(json.dumps({"one": name, "value": value}))
+        print(json.dumps({"one": name, "value": value,
+                          "monitor": _monitor_snapshot()}))
         return
 
     run_all = "--all" in sys.argv

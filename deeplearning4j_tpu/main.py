@@ -199,7 +199,9 @@ def cmd_monitor(args) -> int:
     is given, else this process's own monitor registry/health state.
     ``--trace-out`` additionally writes the Chrome trace-event JSON
     (``/trace`` remotely, the local tracer otherwise) to a file for
-    Perfetto."""
+    Perfetto. ``--fleet`` switches to the aggregated per-worker view
+    (``/fleet``); ``--events`` prints the flight recorder's structured
+    event log as JSONL."""
     import json
     import urllib.error
     import urllib.request
@@ -212,9 +214,44 @@ def cmd_monitor(args) -> int:
             # /healthz answers 503 WITH a body when unhealthy — still a dump
             return e.read().decode("utf-8")
 
+    base = None
     if args.url:
         base = args.url if "://" in args.url else f"http://{args.url}"
         base = base.rstrip("/")
+
+    if args.events:
+        # flight-recorder view: one JSON object per line (JSONL — the same
+        # shape the on-disk halt/crash dumps use, so tooling reads both)
+        if base:
+            events = json.loads(_fetch(base, "/events"))["events"]
+        else:
+            from .monitor import get_flight_recorder
+            events = get_flight_recorder().events()
+        for rec in events:
+            print(json.dumps(rec, default=repr))
+        return 0
+
+    if args.fleet:
+        # aggregated per-worker view: only meaningful where OP_TELEMETRY
+        # reports land (the paramserver-server process, or --url to it)
+        if args.format == "json":
+            payload = (_fetch(base, "/fleet?format=json") if base
+                       else None)
+            if payload is None:
+                from .monitor import get_fleet
+                doc = get_fleet().liveness()
+            else:
+                doc = json.loads(payload)
+            print(json.dumps(doc, indent=2))
+        else:
+            if base:
+                print(_fetch(base, "/fleet"), end="")
+            else:
+                from .monitor import get_fleet
+                print(get_fleet().render_prometheus(), end="")
+        return 0
+
+    if base:
         metrics_text = _fetch(base, "/metrics")
         health = json.loads(_fetch(base, "/healthz"))
         trace = _fetch(base, "/trace") if args.trace_out else None
@@ -318,6 +355,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default="prometheus")
     m.add_argument("--trace-out", default=None, metavar="PATH",
                    help="also write Chrome trace-event JSON here")
+    m.add_argument("--fleet", action="store_true",
+                   help="aggregated per-worker fleet view (/fleet): "
+                        "Prometheus text with a worker label, or the "
+                        "liveness table with --format json")
+    m.add_argument("--events", action="store_true",
+                   help="print the crash flight recorder's structured "
+                        "event log as JSONL")
     m.set_defaults(fn=cmd_monitor)
     li = sub.add_parser("lint",
                         help="tpulint: AST static analysis for JAX/"

@@ -14,6 +14,12 @@ across (``ParamServerMetrics``, ``PerformanceListener``/
 - :func:`get_health` — the :class:`HealthState` behind ``GET /healthz``,
   plus :class:`TrainingHealthListener`, the NaN/divergence/stall watchdog
   with ``warn``/``raise``/``halt`` actions.
+- :func:`get_flight_recorder` — the bounded structured event log (worker
+  join/leave/rejoin, retry exhaustion, peer failures, health transitions)
+  that dumps JSONL to disk on halt or crash.
+- :func:`get_fleet` — per-worker telemetry shipped over the paramserver's
+  ``OP_TELEMETRY``: the merged ``GET /fleet`` scrape, the merged
+  multi-``pid`` Chrome trace, and worker staleness for ``/healthz``.
 
 The fit loops, transport channel, parameter-server client/server, and
 async dataset iterator are pre-instrumented against these globals. The
@@ -27,15 +33,20 @@ from __future__ import annotations
 import os
 
 from .registry import (MetricsRegistry, LatencyHistogram, Counter, Gauge,
-                       Histogram, get_registry)
-from .tracer import Tracer, get_tracer
+                       Histogram, get_registry, render_prometheus_dump)
+from .tracer import SpanContext, Tracer, get_tracer
 from .health import (HealthState, get_health, TrainingHealthListener,
                      TrainingHealthError)
+from .flightrec import FlightRecorder, get_flight_recorder
+from .fleet import FleetState, get_fleet, merge_traces
 
 __all__ = [
     "MetricsRegistry", "LatencyHistogram", "Counter", "Gauge", "Histogram",
-    "get_registry", "Tracer", "get_tracer", "HealthState", "get_health",
+    "get_registry", "render_prometheus_dump", "SpanContext", "Tracer",
+    "get_tracer", "HealthState", "get_health",
     "TrainingHealthListener", "TrainingHealthError",
+    "FlightRecorder", "get_flight_recorder", "FleetState", "get_fleet",
+    "merge_traces",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 

@@ -1,0 +1,167 @@
+"""Fleet state: per-worker telemetry aggregated at the parameter server.
+
+A data-parallel run over the paramserver is N processes with N disjoint
+monitor registries, N trace ring buffers, and N health states. Workers
+periodically ship a compact telemetry report over the PS protocol's
+``OP_TELEMETRY`` (``paramserver/client.py .send_telemetry``); the server
+lands every report here, in the process-global :class:`FleetState`
+(:func:`get_fleet`). What that buys:
+
+- ``GET /fleet`` (``ui/server.py``): the merged registry view as
+  Prometheus text, every worker's series re-labeled with ``worker=<id>``
+  (via ``registry.render_prometheus_dump``), plus synthesized
+  ``fleet_worker_up`` / ``fleet_worker_last_seen_age_s`` liveness series.
+- ``GET /fleet/trace``: a merged Chrome-trace export — each process on
+  its own ``pid`` row (metadata ``process_name`` events), with the
+  propagated trace IDs (``tracer.SpanContext``) tying a client ``ps/push``
+  span to the server's ``ps/apply`` span across rows.
+- Per-worker liveness folded into ``/healthz``: a worker whose last
+  report is older than ``stale_after`` is marked stale (the dead-worker
+  signal an external prober alarms on).
+
+See docs/OBSERVABILITY.md "Fleet observability".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import render_prometheus_dump
+
+__all__ = ["FleetState", "get_fleet", "merge_traces"]
+
+#: seconds without a telemetry report before a worker counts as stale
+DEFAULT_STALE_AFTER = 15.0
+
+
+def merge_traces(named_events: Dict[str, List[dict]]) -> dict:
+    """Merge per-process trace-event lists into ONE Chrome-trace document:
+    each label gets its own ``pid`` row (with a ``process_name`` metadata
+    event, so Perfetto shows 'worker:w1' instead of a bare number) while
+    ``tid`` and the propagated ``trace_id``/``span_id`` args survive
+    untouched — causality across rows stays visible."""
+    events: List[dict] = []
+    for pid, label in enumerate(sorted(named_events)):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        for ev in named_events[label]:
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class FleetState:
+    """Thread-safe per-worker last-report table.
+
+    One per process via :func:`get_fleet` (the parameter server feeds it;
+    the UI server and ``/healthz`` read it), or standalone in tests.
+    Staleness is computed at READ time from ``last_seen`` — a silent
+    worker's age keeps growing, exactly like ``/healthz``'s
+    ``last_iteration_age_s``.
+    """
+
+    def __init__(self, stale_after: float = DEFAULT_STALE_AFTER):
+        self.stale_after = float(stale_after)
+        self._lock = threading.Lock()
+        self._workers: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------- feeding
+    def record_report(self, worker: str, report: dict):
+        """Land one OP_TELEMETRY report: ``registry`` (a
+        ``MetricsRegistry.dump()``), optional ``trace_events`` (Chrome
+        trace events) and ``flight_events`` — all already plain JSON from
+        the wire."""
+        worker = str(worker)
+        with self._lock:
+            entry = self._workers.setdefault(
+                worker, {"first_seen": time.time(), "reports": 0})
+            entry["last_seen"] = time.time()
+            entry["reports"] += 1
+            entry["registry"] = report.get("registry") or {}
+            if report.get("trace_events") is not None:
+                entry["trace_events"] = list(report["trace_events"])
+            if report.get("flight_events") is not None:
+                entry["flight_events"] = list(report["flight_events"])
+
+    def clear(self):
+        with self._lock:
+            self._workers.clear()
+
+    # ------------------------------------------------------------- reading
+    def liveness(self) -> dict:
+        """JSON liveness table: the ``/fleet?format=json`` payload and the
+        block ``/healthz`` folds in."""
+        now = time.time()
+        with self._lock:
+            workers = {
+                w: {"last_seen_age_s": now - e["last_seen"],
+                    "stale": (now - e["last_seen"]) > self.stale_after,
+                    "reports": e["reports"],
+                    "series": len(e.get("registry") or {})}
+                for w, e in self._workers.items()}
+        return {"stale_after_s": self.stale_after,
+                "workers": workers,
+                "stale": sorted(w for w, i in workers.items()
+                                if i["stale"])}
+
+    def render_prometheus(self) -> str:
+        """The merged fleet scrape: every worker's shipped registry dump
+        re-rendered with a ``worker`` label, preceded by the synthesized
+        liveness series. Type conflicts across workers (same family name,
+        different type — a half-upgraded fleet) keep the first-seen type
+        and drop the conflicting worker's children for that family rather
+        than emitting an invalid exposition."""
+        now = time.time()
+        with self._lock:
+            items = [(w, e.get("registry") or {}, now - e["last_seen"])
+                     for w, e in sorted(self._workers.items())]
+        up = {"type": "gauge", "help": "1 while the worker's telemetry is "
+              "fresh, 0 once stale", "children": []}
+        age = {"type": "gauge",
+               "help": "seconds since the worker's last telemetry report",
+               "children": []}
+        merged: Dict[str, dict] = {"fleet_worker_up": up,
+                                   "fleet_worker_last_seen_age_s": age}
+        for worker, dump, age_s in items:
+            up["children"].append(
+                {"labels": {"worker": worker},
+                 "value": 0.0 if age_s > self.stale_after else 1.0})
+            age["children"].append(
+                {"labels": {"worker": worker}, "value": age_s})
+            for name, fam in dump.items():
+                tgt = merged.setdefault(
+                    name, {"type": fam["type"],
+                           "help": fam.get("help", ""), "children": []})
+                if tgt["type"] != fam["type"]:
+                    continue        # mixed-version fleet: skip, don't lie
+                for row in fam["children"]:
+                    row = dict(row)
+                    row["labels"] = {**row["labels"], "worker": worker}
+                    tgt["children"].append(row)
+        return render_prometheus_dump(merged)
+
+    def merged_trace(self, local_events: Optional[List[dict]] = None,
+                     local_label: str = "server") -> dict:
+        """One Chrome-trace document for the whole fleet: every worker's
+        shipped trace events plus this process's own (default: the global
+        tracer — the server-side ``ps/apply`` spans live there), each on
+        its own ``pid`` row."""
+        with self._lock:
+            named = {f"worker:{w}": list(e.get("trace_events") or [])
+                     for w, e in self._workers.items()}
+        if local_events is None:
+            from .tracer import get_tracer
+            local_events = get_tracer().events()
+        named[local_label] = list(local_events)
+        return merge_traces(named)
+
+
+#: the process-global fleet table (the parameter server writes, the UI
+#: server and /healthz read)
+_FLEET = FleetState()
+
+
+def get_fleet() -> FleetState:
+    return _FLEET

@@ -79,10 +79,20 @@ class HealthState:
                 self._nan = True
             self._problems.append(f"{kind}: {message}")
             del self._problems[:-8]  # keep the newest few
+        from .flightrec import get_flight_recorder
+        get_flight_recorder().record("health_problem", kind=kind,
+                                     message=message)
 
     def record_halt(self, reason: str):
         with self._lock:
             self._halted = reason
+        # the black-box moment: training is stopping on purpose — persist
+        # the event history NOW, while the process is still healthy enough
+        # to write it (docs/OBSERVABILITY.md flight recorder)
+        from .flightrec import get_flight_recorder
+        fr = get_flight_recorder()
+        fr.record("halt", reason=reason)
+        fr.dump(reason="training halt")
 
     def clear_halt(self):
         """A new fit() run supersedes a previous halt (the containers call
@@ -108,7 +118,7 @@ class HealthState:
                    else time.time() - self._last_iteration_time)
             healthy = (not self._nan and self._halted is None
                        and self._ps_connected is not False)
-            return {
+            out = {
                 "status": "ok" if healthy else "unhealthy",
                 "healthy": healthy,
                 "last_iteration": self._last_iteration,
@@ -124,6 +134,16 @@ class HealthState:
                     "last_error": self._ps_last_error,
                 },
             }
+        # fleet liveness fold-in (outside the lock: the fleet table has its
+        # own): on a paramserver-server process /healthz also answers "are
+        # the WORKERS alive" — stale workers are listed but do not flip
+        # this process unhealthy (a dead worker is the fleet view's alarm;
+        # this process is still serving)
+        from .fleet import get_fleet
+        fleet = get_fleet().liveness()
+        if fleet["workers"]:
+            out["fleet"] = fleet
+        return out
 
 
 _HEALTH = HealthState()

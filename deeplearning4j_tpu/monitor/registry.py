@@ -226,6 +226,33 @@ class MetricsRegistry:
         return self._child("histogram", name, help, labels)
 
     # ------------------------------------------------------------ export
+    def dump(self) -> Dict[str, dict]:
+        """Full JSON-serializable state: every family with type/help and
+        every child with its exact value — histograms keep their bucket
+        counts (not just the summary), so a dump can be re-rendered as
+        Prometheus text elsewhere. This is the wire form workers ship to
+        the parameter server over ``OP_TELEMETRY`` for the fleet view
+        (``GET /fleet`` re-renders dumps with a ``worker`` label via
+        :func:`render_prometheus_dump`)."""
+        with self._lock:
+            fams = [(f.name, f.type, f.help, list(f.children.items()))
+                    for f in self._families.values()]
+        out: Dict[str, dict] = {}
+        for name, mtype, help_text, children in fams:
+            rows = []
+            for key, child in children:
+                row = {"labels": dict(key)}
+                if mtype == "histogram":
+                    counts, total_ms, n = child.state()
+                    row["buckets"] = counts
+                    row["sum"] = total_ms
+                    row["count"] = n
+                else:
+                    row["value"] = child.value
+                rows.append(row)
+            out[name] = {"type": mtype, "help": help_text, "children": rows}
+        return out
+
     def snapshot(self) -> Dict[str, List[dict]]:
         """{name: [{"labels": {...}, "type": ..., "value"|"summary"}, ...]}"""
         with self._lock:
@@ -248,36 +275,51 @@ class MetricsRegistry:
         """Prometheus text exposition format 0.0.4. Histograms render with
         their log2 bucket upper edges as ``le`` (in ms, matching the
         ``_ms``-suffixed metric names), plus ``_sum``/``_count``."""
-        with self._lock:
-            fams = [(f.name, f.type, f.help, list(f.children.items()))
-                    for f in self._families.values()]
-        lines: List[str] = []
-        for name, mtype, help_text, children in sorted(fams):
-            if help_text:
-                lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} {mtype}")
-            for key, child in sorted(children):
-                labels = _fmt_labels(key)
-                if mtype == "histogram":
-                    counts, total_ms, n = child.state()
-                    cum = 0
-                    for edge, c in zip(LatencyHistogram.bucket_edges(),
-                                       counts):
-                        cum += c
-                        le = _fmt_labels(key, f'le="{edge:g}"')
-                        lines.append(f"{name}_bucket{le} {cum}")
-                    inf = _fmt_labels(key, 'le="+Inf"')
-                    lines.append(f"{name}_bucket{inf} {n}")
-                    lines.append(f"{name}_sum{labels} {_fmt_value(total_ms)}")
-                    lines.append(f"{name}_count{labels} {n}")
-                else:
-                    lines.append(f"{name}{labels} {_fmt_value(child.value)}")
-        return "\n".join(lines) + "\n"
+        return render_prometheus_dump(self.dump())
 
     def clear(self):
         """Drop every family (tests / process reuse)."""
         with self._lock:
             self._families.clear()
+
+
+def render_prometheus_dump(dump: Dict[str, dict],
+                           extra_labels: Optional[Dict[str, str]] = None
+                           ) -> str:
+    """Render a :meth:`MetricsRegistry.dump` (possibly one that crossed the
+    wire as JSON) as Prometheus text exposition 0.0.4. ``extra_labels`` are
+    merged into every child — the fleet view re-renders each worker's dump
+    with ``{"worker": id}`` so N processes' series coexist in one scrape.
+    Local ``render_prometheus`` is this function over the local dump, so
+    the two text forms cannot diverge."""
+    extra = dict(extra_labels or {})
+    lines: List[str] = []
+    for name in sorted(dump):
+        fam = dump[name]
+        mtype, help_text = fam["type"], fam.get("help", "")
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        children = sorted(fam["children"],
+                          key=lambda row: _label_key({**row["labels"],
+                                                      **extra}))
+        for row in children:
+            key = _label_key({**row["labels"], **extra})
+            labels = _fmt_labels(key)
+            if mtype == "histogram":
+                counts, total_ms, n = row["buckets"], row["sum"], row["count"]
+                cum = 0
+                for edge, c in zip(LatencyHistogram.bucket_edges(), counts):
+                    cum += c
+                    le = _fmt_labels(key, f'le="{edge:g}"')
+                    lines.append(f"{name}_bucket{le} {cum}")
+                inf = _fmt_labels(key, 'le="+Inf"')
+                lines.append(f"{name}_bucket{inf} {n}")
+                lines.append(f"{name}_sum{labels} {_fmt_value(total_ms)}")
+                lines.append(f"{name}_count{labels} {n}")
+            else:
+                lines.append(f"{name}{labels} {_fmt_value(row['value'])}")
+    return "\n".join(lines) + "\n"
 
 
 #: the process-global registry every subsystem writes to

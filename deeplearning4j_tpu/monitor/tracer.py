@@ -17,18 +17,41 @@ device→host VALUE fetch (``float(loss)`` / ``np.asarray``) is a reliable
 completion barrier. The fit-loop instrumentation keeps its ``float(loss)``
 fetch INSIDE the step span for exactly this reason; spans you place around
 your own jitted calls must do their own value fetch to mean anything.
+
+Trace-context propagation: every span carries a ``trace_id`` shared with
+its whole causal chain and a fresh ``span_id``; :meth:`Tracer.current_span`
+exposes the active :class:`SpanContext` so an RPC layer can ship it to the
+peer (the paramserver client prefixes flagged ops with it), and
+``span(parent=ctx)`` lets the receiving side record a child span under the
+REMOTE parent — a merged export then shows client push → server apply as
+one chain across processes (docs/OBSERVABILITY.md "Fleet observability").
 """
 from __future__ import annotations
 
 import contextlib
 import functools
 import os
+import random
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
-__all__ = ["Tracer", "get_tracer"]
+__all__ = ["SpanContext", "Tracer", "get_tracer"]
+
+
+class SpanContext(NamedTuple):
+    """Identity of one span in one trace. IDs are 63-bit ints (JSON-safe,
+    16 hex chars on the wire); ``parent_span_id`` is 0 for a root span."""
+
+    trace_id: int
+    span_id: int
+    parent_span_id: int = 0
+
+
+def _new_id() -> int:
+    # 63 bits: fits JSON/JS number precision limits and struct "<Q"
+    return random.getrandbits(63) | 1       # never 0 (0 = "no parent")
 
 
 def _trace_annotation():
@@ -66,29 +89,66 @@ class Tracer:
         self._lock = threading.Lock()
         self._events = deque(maxlen=int(capacity))
         self._t0 = time.perf_counter()
+        self._local = threading.local()     # per-thread span-context stack
+        self.dropped = 0                    # ring-buffer overflow count
+
+    # ----------------------------------------------------- span contexts
+    def _stack(self) -> List[SpanContext]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_span(self) -> Optional[SpanContext]:
+        """The innermost open span's context on THIS thread, or None. This
+        is what an RPC client ships to the server so the server's handling
+        span becomes a child of the in-flight client span."""
+        stack = self._stack()
+        return stack[-1] if stack else None
 
     @contextlib.contextmanager
-    def span(self, name: str, cat: str = "host", **args):
-        """Record one span around the enclosed block. ``args`` become the
-        trace event's ``args`` (must be JSON-serializable scalars)."""
+    def span(self, name: str, cat: str = "host",
+             parent: Optional[SpanContext] = None, **args):
+        """Record one span around the enclosed block; yields the span's
+        :class:`SpanContext`. ``args`` become the trace event's ``args``
+        (must be JSON-serializable scalars). The trace/parent IDs come from
+        the innermost open span on this thread, or from ``parent`` — pass a
+        context that arrived over the wire to join a REMOTE trace."""
         ann_cls = _trace_annotation()
         ann = ann_cls(name) if ann_cls is not None else None
         if ann is not None:
             ann.__enter__()
+        stack = self._stack()
+        up = parent if parent is not None else (stack[-1] if stack else None)
+        ctx = SpanContext(up.trace_id if up else _new_id(), _new_id(),
+                          up.span_id if up else 0)
+        stack.append(ctx)
         start = time.perf_counter()
         try:
-            yield self
+            yield ctx
         finally:
             dur = time.perf_counter() - start
+            stack.pop()
             if ann is not None:
                 ann.__exit__(None, None, None)
             ev = {"name": name, "cat": cat, "ph": "X",
                   "ts": (start - self._t0) * 1e6, "dur": dur * 1e6,
                   "pid": os.getpid(), "tid": threading.get_ident()}
-            if args:
-                ev["args"] = args
+            ev["args"] = {"trace_id": f"{ctx.trace_id:x}",
+                          "span_id": f"{ctx.span_id:x}", **args}
+            if ctx.parent_span_id:
+                ev["args"]["parent_span_id"] = f"{ctx.parent_span_id:x}"
             with self._lock:
+                overflow = len(self._events) == self._events.maxlen
+                if overflow:
+                    self.dropped += 1
                 self._events.append(ev)
+            if overflow:
+                # registry write OUTSIDE the ring lock (scrapes take both)
+                from .registry import get_registry
+                get_registry().counter(
+                    "tracer_spans_dropped_total",
+                    "spans evicted from the trace ring buffer").inc()
 
     def trace(self, name: Optional[str] = None, cat: str = "host"):
         """Decorator form: ``@tracer.trace()`` spans every call."""

@@ -22,7 +22,7 @@ import struct
 import time
 from typing import Dict, List, Sequence
 
-from ..monitor import get_registry, get_tracer
+from ..monitor import get_flight_recorder, get_registry, get_tracer
 
 __all__ = ["UpdateChannel", "PeerFailedError", "send_frame", "recv_exact",
            "recv_frame"]
@@ -137,6 +137,10 @@ class UpdateChannel:
             "transport_peer_failures_total",
             "peers that died mid-round (PeerFailedError)",
             peer=str(rank)).inc()
+        # black-box record: the merged fleet timeline needs WHICH rank died
+        # and during which collective, not just an exception in one log
+        get_flight_recorder().record("peer_failed", rank=int(rank), op=op,
+                                     local_rank=self.p, error=str(exc))
         raise PeerFailedError(
             rank, f"peer {rank} failed during {op}: {exc}") from exc
 

@@ -7,7 +7,8 @@ pulls (:class:`ParameterServerClient`), the async TrainingMaster that rides
 them (:class:`ParameterServerTrainingMaster`), and listener-bus metrics
 (:class:`ParamServerMetricsListener`). See docs/PARALLELISM.md "Parameter
 server"."""
-from .server import ParameterServer
+from .server import (ParameterServer, OP_TELEMETRY, FLAG_TRACE,
+                     PROTO_VERSION)
 from .client import (ParameterServerClient, ServerUnavailableError,
                      ParameterServerError)
 from .training import (ParameterServerTrainingMaster, flatten_params,
@@ -16,7 +17,8 @@ from .metrics import (ParamServerMetrics, ParamServerMetricsListener,
                       LatencyHistogram)
 
 __all__ = [
-    "ParameterServer", "ParameterServerClient", "ServerUnavailableError",
+    "ParameterServer", "OP_TELEMETRY", "FLAG_TRACE", "PROTO_VERSION",
+    "ParameterServerClient", "ServerUnavailableError",
     "ParameterServerError", "ParameterServerTrainingMaster",
     "flatten_params", "set_params_from_flat", "ParamServerMetrics",
     "ParamServerMetricsListener", "LatencyHistogram",

@@ -16,6 +16,8 @@ k = tolerate k server-side updates before resyncing).
 from __future__ import annotations
 
 import json
+import logging
+import os
 import random
 import socket
 import struct
@@ -24,14 +26,21 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ..monitor import get_health, get_tracer
+from ..monitor import (get_flight_recorder, get_health, get_registry,
+                       get_tracer)
 from ..parallel.transport import send_frame, recv_frame
 from .metrics import ParamServerMetrics
 from .server import (OP_INIT, OP_SET, OP_PUSH, OP_PULL, OP_VERSION, OP_STATS,
-                     ST_OK)
+                     OP_TELEMETRY, FLAG_TRACE, ST_OK)
+
+log = logging.getLogger(__name__)
 
 __all__ = ["ParameterServerClient", "ServerUnavailableError",
            "ParameterServerError"]
+
+#: newest trace events shipped per telemetry report — a snapshot window,
+#: not the whole ring buffer (reports are meant to stay "compact")
+TELEMETRY_TRACE_EVENTS = 512
 
 
 class ServerUnavailableError(ConnectionError):
@@ -60,7 +69,8 @@ class ParameterServerClient:
                  max_retries: int = 5, backoff: float = 0.05,
                  backoff_max: float = 2.0, jitter: float = 0.25,
                  timeout: float = 30.0,
-                 metrics: Optional[ParamServerMetrics] = None):
+                 metrics: Optional[ParamServerMetrics] = None,
+                 worker_id: Optional[str] = None, tracer=None):
         host, _, port = address.rpartition(":")
         self.host, self.port = host, int(port)
         self.address = address
@@ -71,6 +81,15 @@ class ParameterServerClient:
         self.jitter = float(jitter)
         self.timeout = float(timeout)
         self.metrics = metrics or ParamServerMetrics()
+        #: fleet identity this client reports telemetry under; spans land
+        #: in ``tracer`` (default: the process-global one) so an in-process
+        #: multi-worker test can give each worker its own trace buffer
+        self.worker_id = worker_id or f"{socket.gethostname()}:{os.getpid()}"
+        self.tracer = tracer if tracer is not None else get_tracer()
+        #: negotiated server protocol version — None until the first
+        #: OP_STATS answer; 1 for pre-OP_TELEMETRY servers (no flag bits,
+        #: no telemetry), >= 2 to use the v2 extensions
+        self._proto: Optional[int] = None
         self._sock: Optional[socket.socket] = None
         self._rand = random.Random()
 
@@ -119,7 +138,35 @@ class ParameterServerClient:
             f"parameter server {self.address} unavailable after "
             f"{self.max_retries + 1} attempts: {last}")
         get_health().record_ps_error(str(err))
+        get_flight_recorder().record(
+            "retry_exhausted", worker=self.worker_id, server=self.address,
+            attempts=self.max_retries + 1, error=str(last))
         raise err from last
+
+    # ------------------------------------------------------ proto v2 seam
+    def negotiate(self) -> int:
+        """The server's protocol version, negotiated once per client via
+        OP_STATS (``proto`` key; absent on v1 servers → 1). Flag bits and
+        OP_TELEMETRY are only ever used after this answers >= 2, which is
+        what keeps a v2 client safe against a v1 server."""
+        if self._proto is None:
+            try:
+                self._proto = int(self.stats().get("proto", 1))
+            except ParameterServerError as e:
+                # the server answered but can't do stats: oldest possible
+                # peer — stay on the v1 wire forms
+                log.debug("proto negotiation fell back to v1: %s", e)
+                self._proto = 1
+        return self._proto
+
+    def _traced(self, op: int, payload: bytes, ctx) -> Tuple[int, bytes]:
+        """Attach the active span context to an op when the server speaks
+        proto v2: sets FLAG_TRACE and prefixes the 16-byte context header
+        the server parses in ``_serve_conn``."""
+        if ctx is None or self.negotiate() < 2:
+            return op, payload
+        return (op | FLAG_TRACE,
+                struct.pack("<QQ", ctx.trace_id, ctx.span_id) + payload)
 
     # ----------------------------------------------------------------- ops
     def init_params(self, vec: np.ndarray) -> Tuple[int, bool]:
@@ -149,9 +196,10 @@ class ParameterServerClient:
         noise of the same scale the staleness bound already tolerates); use
         ``set_params`` for state that must be exact."""
         t0 = time.perf_counter()
-        with get_tracer().span("ps/push", cat="paramserver",
-                               bytes=len(frame)):
-            out = self._request(OP_PUSH, frame)
+        with self.tracer.span("ps/push", cat="paramserver",
+                              bytes=len(frame)) as ctx:
+            op, payload = self._traced(OP_PUSH, frame, ctx)
+            out = self._request(op, payload)
         self.metrics.record_push((time.perf_counter() - t0) * 1e3,
                                  len(frame))
         return struct.unpack("<q", out)[0]
@@ -163,9 +211,11 @@ class ParameterServerClient:
         round-robin slice ``s::num_shards``), stamped with the server
         version they correspond to."""
         t0 = time.perf_counter()
-        with get_tracer().span("ps/pull", cat="paramserver",
-                               shard=int(shard)):
-            out = self._request(OP_PULL, struct.pack("<i", int(shard)))
+        with self.tracer.span("ps/pull", cat="paramserver",
+                              shard=int(shard)) as ctx:
+            op, payload = self._traced(OP_PULL,
+                                       struct.pack("<i", int(shard)), ctx)
+            out = self._request(op, payload)
         self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
                                  len(out) - 12)
         version, _shard = struct.unpack("<qi", out[:12])
@@ -190,8 +240,31 @@ class ParameterServerClient:
 
     def stats(self) -> dict:
         """Server-side metrics snapshot (counters, latency histograms,
-        version, size)."""
+        version, size; proto v2 adds ``proto``, ``uptime_s`` and per-op
+        ``ops`` request counters)."""
         return json.loads(self._request(OP_STATS).decode("utf-8"))
+
+    def send_telemetry(self, registry=None, tracer=None,
+                       flight_events=None) -> bool:
+        """Ship one fleet telemetry report over OP_TELEMETRY: this
+        worker's registry dump, the newest trace events, and (optionally)
+        flight-recorder events — the feed behind the server's ``GET
+        /fleet`` and merged-trace views. Returns False without touching
+        the wire when the server predates the extension (proto < 2)."""
+        if self.negotiate() < 2:
+            return False
+        reg = registry if registry is not None else get_registry()
+        tr = tracer if tracer is not None else self.tracer
+        report = {"worker": self.worker_id, "registry": reg.dump(),
+                  "trace_events": tr.events()[-TELEMETRY_TRACE_EVENTS:]}
+        if flight_events is not None:
+            report["flight_events"] = list(flight_events)
+        # default=repr: flight-recorder fields may be non-serializable by
+        # contract (they degrade, same as FlightRecorder.dump) — telemetry
+        # must never raise into the training loop over a weird field
+        out = self._request(OP_TELEMETRY,
+                            json.dumps(report, default=repr).encode("utf-8"))
+        return bool(json.loads(out.decode("utf-8")).get("ok"))
 
     def close(self):
         self._drop_sock()

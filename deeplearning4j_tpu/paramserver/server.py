@@ -34,6 +34,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..monitor import SpanContext, get_fleet, get_registry, get_tracer
 from ..parallel.transport import send_frame, recv_frame
 from ..parallel.accumulation import (deserialize_encoded, threshold_decode,
                                      encode_residual)
@@ -42,7 +43,8 @@ from .metrics import ParamServerMetrics
 log = logging.getLogger(__name__)
 
 __all__ = ["ParameterServer", "OP_INIT", "OP_SET", "OP_PUSH", "OP_PULL",
-           "OP_VERSION", "OP_STATS", "ST_OK", "ST_ERR"]
+           "OP_VERSION", "OP_STATS", "OP_TELEMETRY", "FLAG_TRACE",
+           "OP_MASK", "PROTO_VERSION", "ST_OK", "ST_ERR"]
 
 # request = [op u8 | payload]; response = [status u8 | payload]
 OP_INIT = 1     # payload f32[n]; set params ONLY if uninitialized → [ver q | created u8]
@@ -51,8 +53,26 @@ OP_PUSH = 3     # payload accumulation.serialize_encoded frame → [ver q]
 OP_PULL = 4     # payload [shard i32] (-1 = full vector) → [ver q | shard i32 | f32 bytes]
 OP_VERSION = 5  # no payload → [ver q | n q]
 OP_STATS = 6    # no payload → JSON bytes
+OP_TELEMETRY = 7  # payload JSON {worker, registry, trace_events, ...} → JSON
 ST_OK = 0
 ST_ERR = 1
+
+# --- proto v2 extension (fleet observability, docs/OBSERVABILITY.md) ----
+# The op byte's LOW 7 bits are the op; the HIGH bit is a flags bit:
+# FLAG_TRACE means the payload is prefixed with a 16-byte trace-context
+# header [trace_id u64 | parent span_id u64] and the server records its
+# handling as a child span of that remote context. Version negotiation:
+# OP_STATS answers carry "proto"; a v2 client only sets flag bits / sends
+# OP_TELEMETRY after seeing proto >= 2, so v2 clients interoperate with v1
+# servers (no flags, no telemetry) and v1 clients — which only ever send
+# plain op bytes 1..6 — work against v2 servers unchanged.
+FLAG_TRACE = 0x80
+OP_MASK = 0x7F
+PROTO_VERSION = 2
+
+OP_NAMES = {OP_INIT: "init", OP_SET: "set", OP_PUSH: "push",
+            OP_PULL: "pull", OP_VERSION: "version", OP_STATS: "stats",
+            OP_TELEMETRY: "telemetry"}
 
 
 class ParameterServer:
@@ -69,12 +89,20 @@ class ParameterServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  num_shards: int = 1, threshold: float = 0.0,
-                 restore: Optional[tuple] = None):
+                 restore: Optional[tuple] = None, tracer=None, fleet=None):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
         self.num_shards = int(num_shards)
         self.threshold = float(threshold)
         self.metrics = ParamServerMetrics(role="server")
+        #: where server-side child spans land (the merged fleet trace reads
+        #: these) and where worker telemetry reports aggregate; both default
+        #: to the process-globals — tests pass their own for isolation
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.fleet = fleet if fleet is not None else get_fleet()
+        self._t_start = time.time()
+        self._op_lock = threading.Lock()
+        self._op_counts = {name: 0 for name in OP_NAMES.values()}
         self._lock = threading.Lock()
         self._shards: Optional[List[np.ndarray]] = None
         self._n = 0
@@ -202,7 +230,24 @@ class ParameterServer:
             # server-side residual merging (see training.py's
             # count_own_pushes drift warning)
             stats["threshold"] = self.threshold
+            # proto v2 additions: capability advertisement (the version-
+            # negotiation seam v2 clients key flagged ops / telemetry on),
+            # server-side load visible without log scraping
+            stats["proto"] = PROTO_VERSION
+            stats["uptime_s"] = time.time() - self._t_start
+            with self._op_lock:
+                stats["ops"] = dict(self._op_counts)
             return json.dumps(stats).encode("utf-8")
+        if op == OP_TELEMETRY:
+            report = json.loads(payload.decode("utf-8"))
+            worker = report.get("worker")
+            if not worker:
+                raise ValueError("telemetry report carries no worker id")
+            self.fleet.record_report(str(worker), report)
+            return json.dumps(
+                {"ok": True,
+                 "workers": len(self.fleet.liveness()["workers"])}
+            ).encode("utf-8")
         raise ValueError(f"unknown op {op}")
 
     # ------------------------------------------------------------- network
@@ -224,15 +269,51 @@ class ParameterServer:
             threading.Thread(target=self._serve_conn, args=(s,),
                              daemon=True).start()
 
+    def _count_op(self, op: int):
+        name = OP_NAMES.get(op)
+        if name is None:
+            return
+        with self._op_lock:
+            self._op_counts[name] += 1
+        get_registry().counter("paramserver_requests_total",
+                               "requests served by op", role="server",
+                               op=name).inc()
+
     def _serve_conn(self, s: socket.socket):
         try:
             while True:
                 frame = recv_frame(s)
                 if frame is None or not frame:
                     return  # client closed (or sent an empty keepalive)
-                op = frame[0]
+                # proto v2: high bit of the op byte = FLAG_TRACE (a 16-byte
+                # remote span context precedes the payload). v1 clients
+                # never set it, so for them this is the old [op | payload].
+                op = frame[0] & OP_MASK
+                flags = frame[0] & ~OP_MASK
+                payload = frame[1:]
+                parent = None
+                self._count_op(op)
                 try:
-                    out = self._handle(op, frame[1:])
+                    if flags & FLAG_TRACE:
+                        if len(payload) < 16:
+                            raise ValueError(
+                                "FLAG_TRACE set but no 16-byte trace-"
+                                "context header precedes the payload")
+                        tid, sid = struct.unpack_from("<QQ", payload)
+                        payload = payload[16:]
+                        parent = SpanContext(tid, sid)
+                    if parent is not None:
+                        # the server half of the causal chain: this span
+                        # shares the client's trace_id and parents to the
+                        # in-flight client span, so the merged fleet trace
+                        # shows push → apply across pid rows
+                        with self.tracer.span(
+                                f"ps/apply_{OP_NAMES.get(op, op)}",
+                                cat="paramserver", parent=parent,
+                                bytes=len(payload)):
+                            out = self._handle(op, payload)
+                    else:
+                        out = self._handle(op, payload)
                     send_frame(s, bytes([ST_OK]) + out)
                 except Exception as e:  # malformed frame ≠ dead server: the
                     # client gets a typed error, the connection stays up

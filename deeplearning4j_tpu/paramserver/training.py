@@ -21,15 +21,17 @@ every step; ``k``: tolerate k unseen server versions between pulls).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import numpy as np
 import jax
 
+from ..monitor import get_flight_recorder
 from ..parallel.distributed import TrainingMaster
 from ..parallel.accumulation import (EncodedGradientsAccumulator,
                                      flatten_tree_f32)
-from .client import ParameterServerClient
+from .client import ParameterServerClient, ParameterServerError
 from .metrics import ParamServerMetricsListener  # noqa: F401  (re-export)
 
 __all__ = ["ParameterServerTrainingMaster", "flatten_params",
@@ -86,6 +88,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
             self._retries = 5
             self._backoff = 0.05
             self._count_own_pushes = True
+            self._worker_id = None
+            self._telemetry_interval = 5.0
 
         def staleness(self, n):
             self._staleness = int(n)
@@ -115,18 +119,34 @@ class ParameterServerTrainingMaster(TrainingMaster):
 
         countOwnPushes = count_own_pushes
 
+        def worker_id(self, wid: str):
+            self._worker_id = str(wid)
+            return self
+
+        workerId = worker_id
+
+        def telemetry_interval(self, seconds: float):
+            self._telemetry_interval = float(seconds)
+            return self
+
+        telemetryInterval = telemetry_interval
+
         def build(self):
             return ParameterServerTrainingMaster(
                 self._address, staleness=self._staleness,
                 threshold=self._threshold,
                 batch_size_per_worker=self._batch,
                 max_retries=self._retries, backoff=self._backoff,
-                count_own_pushes=self._count_own_pushes)
+                count_own_pushes=self._count_own_pushes,
+                worker_id=self._worker_id,
+                telemetry_interval=self._telemetry_interval)
 
     def __init__(self, server_address: str, staleness: int = 0,
                  threshold: float = 1e-3, batch_size_per_worker: int = 32,
                  max_retries: int = 5, backoff: float = 0.05,
                  count_own_pushes: bool = True,
+                 worker_id: Optional[str] = None,
+                 telemetry_interval: float = 5.0,
                  client: Optional[ParameterServerClient] = None):
         self.server_address = server_address
         self.staleness = int(staleness)
@@ -146,6 +166,12 @@ class ParameterServerTrainingMaster(TrainingMaster):
         #: a full-vector transfer per step — while interleaved foreign
         #: pushes still trigger pulls under the staleness bound.
         self.count_own_pushes = bool(count_own_pushes)
+        #: fleet identity (defaults to the client's host:pid) and how often
+        #: a registry/trace telemetry report ships to the server over
+        #: OP_TELEMETRY mid-training (seconds; 0 = every step; None/inf
+        #: never mid-epoch — join and leave still report)
+        self.worker_id = worker_id
+        self.telemetry_interval = telemetry_interval
         self.client = client
         self.accumulator = EncodedGradientsAccumulator(
             initial_threshold=threshold)
@@ -153,14 +179,39 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self._update_step = None
         self._apply_step = None
         self._step_net = None
+        self._joined_once = False
+        self._last_telemetry = 0.0
 
     # ------------------------------------------------------------ plumbing
     def _ensure_client(self) -> ParameterServerClient:
         if self.client is None:
             self.client = ParameterServerClient(
                 self.server_address, staleness=self.staleness,
-                max_retries=self.max_retries, backoff=self.backoff)
+                max_retries=self.max_retries, backoff=self.backoff,
+                worker_id=self.worker_id)
         return self.client
+
+    def _ship_telemetry(self, client: ParameterServerClient,
+                        force: bool = False):
+        """Best-effort OP_TELEMETRY report under the interval dial —
+        telemetry must never take training down with it, so transport
+        failures are logged and swallowed (the NEXT op's retry loop owns
+        reconnecting)."""
+        now = time.monotonic()
+        if not force:
+            # interval=None disables only the PERIODIC reports — the
+            # forced join/leave reports still ship
+            if self.telemetry_interval is None:
+                return
+            if now - self._last_telemetry < self.telemetry_interval:
+                return
+        try:
+            client.send_telemetry(
+                flight_events=get_flight_recorder().events()[-64:])
+            self._last_telemetry = now
+        except (ConnectionError, ParameterServerError) as e:
+            log.debug("telemetry report to %s skipped: %s",
+                      client.address, e)
 
     def _ensure_steps(self, net):
         # keyed on the net: the jitted step closes over ITS architecture and
@@ -201,6 +252,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
                 "from the server's merged state; prefer the default "
                 "count_own_pushes=True on threshold>0 servers")
 
+        fr = get_flight_recorder()
+        join_kind = "worker_rejoin" if self._joined_once else "worker_join"
         version, created = client.init_params(flatten_params(net.params))
         if not created:
             # join/rejoin: another worker (or a previous epoch) seeded the
@@ -214,40 +267,64 @@ class ParameterServerTrainingMaster(TrainingMaster):
                     f"server {client.address} holds parameters for a "
                     f"different model: {e}") from e
         self.local_version = version
+        fr.record(join_kind, worker=client.worker_id,
+                  server=client.address, seeded=created,
+                  version=int(version))
+        self._joined_once = True
+        self._ship_telemetry(client, force=True)
 
-        for ds in iterator:
-            f = jnp.asarray(ds.features)
-            l = jnp.asarray(ds.labels)
-            itc = jnp.asarray(net.iteration_count, jnp.int32)
-            update, net.states, net.updater_state, loss = \
-                self._update_step(net.params, net.states, net.updater_state,
-                                  itc, net._next_rng(), f, l, None, None)
-            update = jax.tree_util.tree_map(np.asarray, update)
-            decoded_own = acc.store_update(update)
-            frame = acc.serialize_last()
-            # optimistic local apply: progress continues between pulls; the
-            # next adopted pull replaces it with the server's merged state
-            net.params = self._apply_step(
-                net.params, jax.tree_util.tree_map(jnp.asarray, decoded_own))
-            pushed_version = client.push_update(frame)
-            if not self.count_own_pushes \
-                    and pushed_version == self.local_version + 1:
-                # contiguity guard: the returned version is the GLOBAL
-                # counter, so it only provably covers just our own push
-                # when it is exactly local+1. Adopt it then (the local
-                # optimistic apply above already holds this update's
-                # effect); any gap means other workers' pushes interleaved
-                # — leave local_version alone so pull_if_stale still sees
-                # them and the staleness=k bound stays honest.
-                self.local_version = pushed_version
-            fresh = client.pull_if_stale(self.local_version)
-            if fresh is not None:
-                self.local_version, vec = fresh
-                set_params_from_flat(net, vec)
-            net.score_ = loss
-            net.iteration_count += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration_count - 1, float(loss))
+        steps = 0
+        try:
+            for ds in iterator:
+                f = jnp.asarray(ds.features)
+                l = jnp.asarray(ds.labels)
+                itc = jnp.asarray(net.iteration_count, jnp.int32)
+                update, net.states, net.updater_state, loss = \
+                    self._update_step(net.params, net.states,
+                                      net.updater_state,
+                                      itc, net._next_rng(), f, l, None, None)
+                update = jax.tree_util.tree_map(np.asarray, update)
+                decoded_own = acc.store_update(update)
+                frame = acc.serialize_last()
+                # optimistic local apply: progress continues between pulls;
+                # the next adopted pull replaces it with the server's
+                # merged state
+                net.params = self._apply_step(
+                    net.params,
+                    jax.tree_util.tree_map(jnp.asarray, decoded_own))
+                pushed_version = client.push_update(frame)
+                if not self.count_own_pushes \
+                        and pushed_version == self.local_version + 1:
+                    # contiguity guard: the returned version is the GLOBAL
+                    # counter, so it only provably covers just our own push
+                    # when it is exactly local+1. Adopt it then (the local
+                    # optimistic apply above already holds this update's
+                    # effect); any gap means other workers' pushes
+                    # interleaved — leave local_version alone so
+                    # pull_if_stale still sees them and the staleness=k
+                    # bound stays honest.
+                    self.local_version = pushed_version
+                fresh = client.pull_if_stale(self.local_version)
+                if fresh is not None:
+                    self.local_version, vec = fresh
+                    set_params_from_flat(net, vec)
+                net.score_ = loss
+                net.iteration_count += 1
+                steps += 1
+                for lst in net.listeners:
+                    lst.iteration_done(net, net.iteration_count - 1,
+                                       float(loss))
+                self._ship_telemetry(client)
+        except BaseException as e:
+            # the flight-recorder "worker died" record: whatever unwinds
+            # (server loss, health raise, a KeyboardInterrupt) leaves an
+            # ordered leave event behind so a later rejoin is attributable
+            fr.record("worker_leave", worker=client.worker_id,
+                      reason=f"error: {e!r}", steps=steps)
+            raise
+        fr.record("worker_leave", worker=client.worker_id,
+                  reason="completed", steps=steps)
+        self._ship_telemetry(client, force=True)
         return net
 
     executeTraining = execute_training

@@ -13,6 +13,13 @@ TPU-native equivalent of reference ``deeplearning4j-play``
    PS connectivity; HTTP 503 when unhealthy)
  - ``/trace``                — Chrome trace-event JSON from the monitor's
    span :class:`~deeplearning4j_tpu.monitor.Tracer` (open in Perfetto)
+ - ``/fleet``                — merged per-worker metrics (Prometheus text,
+   ``worker`` label; ``?format=json`` for the liveness table) aggregated
+   from ``OP_TELEMETRY`` reports on a paramserver-server process
+ - ``/fleet/trace``          — whole-fleet Chrome trace, one ``pid`` row
+   per process, propagated trace IDs intact
+ - ``/events``               — the crash flight recorder's structured
+   event log (worker join/leave, peer failures, health transitions)
  - POST ``/remote``          — remote StatsReport receiver (the reference's
    remote listener posting seam)
 
@@ -28,7 +35,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from ..monitor import get_health, get_registry, get_tracer
+from ..monitor import (get_fleet, get_flight_recorder, get_health,
+                       get_registry, get_tracer)
 from .stats import StatsStorage, StatsReport, InMemoryStatsStorage
 
 #: POST bodies larger than this are refused with 413 (a remote stats report
@@ -141,8 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def _json(self, obj, code=200):
-        payload = json.dumps(obj).encode("utf-8")
+    def _json(self, obj, code=200, default=None):
+        payload = json.dumps(obj, default=default).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
@@ -168,6 +176,35 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if url.path == "/trace":
             self._json(get_tracer().export())
+            return
+        if url.path == "/fleet":
+            # merged per-worker registry view (OP_TELEMETRY reports landed
+            # in the process-global FleetState): Prometheus text with a
+            # worker label, or the liveness table as JSON (?format=json)
+            fleet = get_fleet()
+            if q.get("format", [""])[0] == "json":
+                self._json(fleet.liveness())
+                return
+            payload = fleet.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if url.path == "/fleet/trace":
+            # whole-fleet Chrome trace: every worker's shipped spans plus
+            # this process's own, one pid row each (open in Perfetto)
+            self._json(get_fleet().merged_trace())
+            return
+        if url.path == "/events":
+            rec = get_flight_recorder()
+            # default=repr: event fields may be non-serializable by the
+            # recorder's contract — they degrade here exactly as in dumps
+            self._json({"events": rec.events(), "dropped": rec.dropped,
+                        "last_dump_path": rec.last_dump_path},
+                       default=repr)
             return
         if url.path in ("/", "/train", "/train/overview.html"):
             payload = _PAGE.encode("utf-8")

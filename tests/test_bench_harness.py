@@ -191,6 +191,33 @@ def test_headline_json_shape(bench, capfd):
     assert doc["value"] is None and doc["error"] == "wedged"
 
 
+def test_monitor_snapshot_embedded_in_records(bench, monkeypatch):
+    """The --one record and the final headline both carry the measuring
+    process's monitor-registry snapshot (perf ↔ runtime-metric
+    correlation), and its absence never breaks the headline contract."""
+    # child side: snapshot of a populated registry (never raises)
+    from deeplearning4j_tpu.monitor import get_registry
+    get_registry().counter("bench_probe_total").inc()
+    snap = bench._monitor_snapshot()
+    assert snap is not None and "bench_probe_total" in snap
+
+    # parent side: _run_one_subprocess latches the child's snapshot...
+    out = (json.dumps({"one": "x", "value": 5.0,
+                       "monitor": {"m_total": [{"value": 1.0}]}})
+           + "\n").encode()
+    import subprocess as sp
+    monkeypatch.setattr(sp, "Popen",
+                        lambda *a, **k: _FakeProc(stdout=out))
+    assert bench._run_one_subprocess("x") == 5.0
+    assert bench._FINAL["monitor"] == {"m_total": [{"value": 1.0}]}
+
+    # ...and the headline embeds it — but only when one was latched
+    doc = bench._headline_doc(100.0, 100.0)
+    assert doc["monitor"] == {"m_total": [{"value": 1.0}]}
+    bench._FINAL["monitor"] = None
+    assert "monitor" not in bench._headline_doc(100.0, 100.0)
+
+
 def test_startup_replay_emits_stale_headline(bench, tmp_path, monkeypatch,
                                              capfd):
     """Defense 1: before any backend contact there is already a parseable

@@ -200,6 +200,73 @@ def test_train_multihost_coordinator_flags(tmp_path):
     assert "model written" not in outs[1]     # non-chief stays quiet
 
 
+def test_monitor_fleet_subcommand_smoke(capsys):
+    """`monitor --fleet`: the aggregated per-worker view, local and over
+    --url, in both output formats (exit codes + JSON shape)."""
+    from deeplearning4j_tpu.monitor import get_fleet, MetricsRegistry
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    fleet = get_fleet()
+    fleet.clear()
+    reg = MetricsRegistry()
+    reg.counter("cli_fleet_probe_total").inc(2)
+    fleet.record_report("cli-w", {"registry": reg.dump()})
+    try:
+        assert main(["monitor", "--fleet", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["workers"]["cli-w"]["stale"] is False
+        assert doc["stale_after_s"] > 0 and doc["stale"] == []
+
+        assert main(["monitor", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert 'fleet_worker_up{worker="cli-w"} 1' in out
+        assert 'cli_fleet_probe_total{worker="cli-w"} 2' in out
+
+        srv_ui = UIServer(port=0)
+        srv_ui.attach(InMemoryStatsStorage())
+        port = srv_ui.start()
+        try:
+            assert main(["monitor", "--fleet", "--url",
+                         f"127.0.0.1:{port}", "--format", "json"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert "cli-w" in doc["workers"]
+            assert main(["monitor", "--fleet", "--url",
+                         f"127.0.0.1:{port}"]) == 0
+            assert "fleet_worker_up" in capsys.readouterr().out
+        finally:
+            srv_ui.stop()
+    finally:
+        fleet.clear()
+
+
+def test_monitor_events_subcommand_smoke(capsys):
+    """`monitor --events`: the flight-recorder view prints one JSON object
+    per line (the same JSONL shape the halt/crash dumps use)."""
+    from deeplearning4j_tpu.monitor import get_flight_recorder
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    rec = get_flight_recorder()
+    rec.record("cli_probe_event", detail=7)
+    assert main(["monitor", "--events"]) == 0
+    rows = [json.loads(line)
+            for line in capsys.readouterr().out.splitlines()]
+    probe = [r for r in rows if r["event"] == "cli_probe_event"]
+    assert probe and probe[-1]["detail"] == 7
+    assert all({"t", "seq", "event"} <= set(r) for r in rows)
+
+    srv_ui = UIServer(port=0)
+    srv_ui.attach(InMemoryStatsStorage())
+    port = srv_ui.start()
+    try:
+        assert main(["monitor", "--events", "--url",
+                     f"127.0.0.1:{port}"]) == 0
+        rows = [json.loads(line)
+                for line in capsys.readouterr().out.splitlines()]
+        assert any(r["event"] == "cli_probe_event" for r in rows)
+    finally:
+        srv_ui.stop()
+
+
 def test_lint_subcommand_smoke(tmp_path, capsys):
     """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over the
     shipped package (self-hosting against analysis/baseline.json), emits

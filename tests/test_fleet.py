@@ -1,0 +1,306 @@
+"""Fleet observability (docs/OBSERVABILITY.md "Fleet observability"):
+cross-process trace propagation over the paramserver wire, the aggregated
+``/fleet`` view, the merged multi-``pid`` Chrome trace, and the proto-v2
+back-compat story — everything runs single-process against loopback
+servers (``port=0``), so tier-1 covers the whole tentpole.
+"""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.monitor import (FleetState, MetricsRegistry, Tracer,
+                                        get_fleet, get_tracer, merge_traces)
+from deeplearning4j_tpu.parallel.transport import send_frame, recv_frame
+from deeplearning4j_tpu.paramserver import (
+    ParameterServer, ParameterServerClient, ParameterServerTrainingMaster,
+    OP_TELEMETRY, FLAG_TRACE, PROTO_VERSION)
+from deeplearning4j_tpu.paramserver.server import (OP_SET, OP_PULL, OP_STATS,
+                                                   ST_OK, ST_ERR)
+from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+
+def _toy_net(seed=11):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=5e-2)).activation("tanh").list()
+            .layer(DenseLayer(n_in=6, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_batches(n=2, seed=3):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(16, 6)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)])
+            for _ in range(n)]
+
+
+def _get(port, path):
+    import urllib.request
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.read().decode()
+
+
+def _run_worker(srv, worker, seed, batches, tracer):
+    """One in-process 'worker': its own client tracer (so in one process
+    each worker still owns a distinct trace buffer, like a real process
+    would) shipping telemetry every step."""
+    master = ParameterServerTrainingMaster(
+        srv.address, staleness=0, backoff=0.01, worker_id=worker,
+        telemetry_interval=0.0)
+    master.client = ParameterServerClient(
+        srv.address, worker_id=worker, tracer=tracer, backoff=0.01)
+    master.execute_training(_toy_net(seed), ListDataSetIterator(batches))
+    return master
+
+
+# -------------------------------------------------- tentpole acceptance
+def test_two_worker_merged_trace_and_fleet_http():
+    """THE acceptance scenario: a two-worker in-process run must yield
+    (a) ``GET /fleet`` carrying both workers' ``paramserver_*`` series
+    under distinct ``worker`` labels, and (b) a merged Chrome trace
+    (``GET /fleet/trace``) where a client ``ps/push`` span and the
+    server-side apply span share a trace ID on DISTINCT ``pid`` rows —
+    the client → server causal chain, reconstructed across processes."""
+    fleet = get_fleet()
+    fleet.clear()
+    get_tracer().clear()      # the server-side apply spans land here
+    try:
+        with ParameterServer(port=0) as srv:
+            _run_worker(srv, "w1", 1, _toy_batches(n=2), Tracer())
+            _run_worker(srv, "w2", 2, _toy_batches(n=2, seed=5), Tracer())
+
+            ui = UIServer(port=0)
+            ui.attach(InMemoryStatsStorage())
+            port = ui.start()
+            try:
+                text = _get(port, "/fleet")
+                for w in ("w1", "w2"):
+                    assert (f'paramserver_pushes_total{{role="client",'
+                            f'worker="{w}"}}') in text
+                    assert f'fleet_worker_up{{worker="{w}"}} 1' in text
+
+                merged = json.loads(_get(port, "/fleet/trace"))
+            finally:
+                ui.stop()
+    finally:
+        fleet.clear()
+
+    evs = merged["traceEvents"]
+    pid_of = {e["args"]["name"]: e["pid"] for e in evs
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"worker:w1", "worker:w2", "server"} <= set(pid_of)
+    assert len({pid_of[k] for k in pid_of}) == len(pid_of)  # distinct rows
+
+    pushes = [e for e in evs if e["name"] == "ps/push"
+              and e["pid"] == pid_of["worker:w1"]]
+    applies = [e for e in evs if e["name"] == "ps/apply_push"
+               and e["pid"] == pid_of["server"]]
+    assert pushes and applies
+    # every w1 push has its server-side child: same trace, parented to the
+    # in-flight client span, on a different pid row
+    matched = [(p, a) for p in pushes for a in applies
+               if a["args"]["trace_id"] == p["args"]["trace_id"]
+               and a["args"].get("parent_span_id") == p["args"]["span_id"]]
+    assert len(matched) == len(pushes)
+    assert all(a["pid"] != p["pid"] for p, a in matched)
+
+
+# -------------------------------------------------- protocol back-compat
+def test_v1_client_against_v2_server_roundtrip():
+    """Old client ↔ new server: a raw socket speaking the PR-1 wire form
+    (plain op byte, no flags, no telemetry) round-trips set/pull/stats
+    against a v2 server unchanged — the flags bit lives in op-byte space a
+    v1 client never sets."""
+    vec = np.arange(6, dtype=np.float32)
+    with ParameterServer(port=0) as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            send_frame(s, bytes([OP_SET]) + vec.tobytes())
+            resp = recv_frame(s)
+            assert resp[0] == ST_OK
+            (ver,) = struct.unpack("<q", resp[1:])
+
+            send_frame(s, bytes([OP_PULL]) + struct.pack("<i", -1))
+            resp = recv_frame(s)
+            assert resp[0] == ST_OK
+            v2, shard = struct.unpack("<qi", resp[1:13])
+            assert v2 == ver and shard == -1
+            np.testing.assert_array_equal(
+                np.frombuffer(resp[13:], np.float32), vec)
+
+            # stats still parses for a v1 client (v2 keys are additive)
+            send_frame(s, bytes([OP_STATS]))
+            resp = recv_frame(s)
+            stats = json.loads(resp[1:].decode())
+            assert stats["version"] == ver
+            assert stats["proto"] == PROTO_VERSION  # advertised, ignorable
+        finally:
+            s.close()
+
+
+class _V1Server(ParameterServer):
+    """A PR-1-era server: rejects OP_TELEMETRY as an unknown op and
+    advertises no ``proto``/``uptime_s``/``ops`` in stats — what a v2
+    client must negotiate DOWN against."""
+
+    def _handle(self, op, payload):
+        if op == OP_TELEMETRY:
+            raise ValueError(f"unknown op {op}")
+        out = super()._handle(op, payload)
+        if op == OP_STATS:
+            stats = json.loads(out.decode("utf-8"))
+            for key in ("proto", "uptime_s", "ops"):
+                stats.pop(key, None)
+            out = json.dumps(stats).encode("utf-8")
+        return out
+
+
+def test_v2_client_against_v1_server_falls_back():
+    """New client ↔ old server: negotiation sees no ``proto`` → the client
+    stays on the v1 wire forms for its whole life (no flag bits — proven
+    by the absence of server-side apply spans — and ``send_telemetry``
+    declines without touching the wire)."""
+    srv_tracer = Tracer()
+    with _V1Server(port=0, tracer=srv_tracer) as srv:
+        with ParameterServerClient(srv.address, worker_id="wx",
+                                   max_retries=1, backoff=0.01) as c:
+            c.set_params(np.zeros(4, np.float32))
+            v = c.push_update(_encoded_frame(4))
+            _, out = c.pull()
+            assert v >= 1 and out.size == 4
+            assert c.negotiate() == 1
+            assert c.send_telemetry() is False
+            # no flagged op ever reached the server → no apply spans
+            assert not [e for e in srv_tracer.events()
+                        if e["name"].startswith("ps/apply")]
+            # and no telemetry frame means no server error was provoked
+            assert c.stats()["counters"]["errors"] == 0
+
+
+def _encoded_frame(n):
+    from deeplearning4j_tpu.parallel.accumulation import serialize_encoded
+    return serialize_encoded((np.array([0], np.int32),
+                              np.array([1], np.int8), 0.25, n))
+
+
+# -------------------------------------------------------- FleetState unit
+def test_fleet_state_staleness_and_liveness():
+    fleet = FleetState(stale_after=0.15)
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs", kind="a").inc(3)
+    fleet.record_report("w1", {"registry": reg.dump()})
+    live = fleet.liveness()
+    assert live["workers"]["w1"]["stale"] is False
+    assert live["workers"]["w1"]["reports"] == 1
+    assert live["stale"] == []
+    time.sleep(0.2)
+    fleet.record_report("w2", {"registry": reg.dump()})
+    live = fleet.liveness()
+    assert live["stale"] == ["w1"]
+    assert live["workers"]["w2"]["stale"] is False
+    text = fleet.render_prometheus()
+    assert 'fleet_worker_up{worker="w1"} 0' in text
+    assert 'fleet_worker_up{worker="w2"} 1' in text
+    assert 'jobs_total{kind="a",worker="w2"} 3' in text
+
+
+def test_fleet_render_skips_type_conflicts():
+    """A mixed-version fleet reporting one family name under two types
+    must not produce an invalid exposition: first-seen type wins, the
+    conflicting worker's children for that family are dropped."""
+    fleet = FleetState()
+    fleet.record_report("a", {"registry": {
+        "x_total": {"type": "counter", "help": "", "children":
+                    [{"labels": {}, "value": 1.0}]}}})
+    fleet.record_report("b", {"registry": {
+        "x_total": {"type": "gauge", "help": "", "children":
+                    [{"labels": {}, "value": 9.0}]}}})
+    text = fleet.render_prometheus()
+    assert text.count("# TYPE x_total") == 1
+    assert 'x_total{worker="a"} 1' in text
+    assert 'x_total{worker="b"}' not in text
+
+
+def test_merge_traces_assigns_pid_rows():
+    doc = merge_traces({
+        "worker:w1": [{"name": "s", "ph": "X", "pid": 4242, "tid": 1,
+                       "ts": 0, "dur": 1}],
+        "server": [{"name": "t", "ph": "X", "pid": 4242, "tid": 1,
+                    "ts": 0, "dur": 1}]})
+    evs = doc["traceEvents"]
+    metas = {e["args"]["name"]: e["pid"] for e in evs if e.get("ph") == "M"}
+    assert set(metas) == {"worker:w1", "server"}
+    spans = {e["name"]: e["pid"] for e in evs if e.get("ph") == "X"}
+    assert spans["s"] == metas["worker:w1"]
+    assert spans["t"] == metas["server"]
+    assert spans["s"] != spans["t"]     # original identical pids split
+
+
+def test_telemetry_survives_nonserializable_flight_events():
+    """The recorder's contract allows non-JSON field values (degraded to
+    repr at dump time) — a weird event in the buffer must not kill
+    telemetry shipping, the /events endpoint, or the training loop."""
+    from deeplearning4j_tpu.monitor import get_flight_recorder
+    rec = get_flight_recorder()
+    rec.record("weird_payload", obj=object())
+    try:
+        fleet = FleetState()
+        with ParameterServer(port=0, fleet=fleet, tracer=Tracer()) as srv:
+            master = ParameterServerTrainingMaster(
+                srv.address, backoff=0.01, worker_id="wz",
+                telemetry_interval=0.0)
+            master.execute_training(_toy_net(seed=4),
+                                    ListDataSetIterator(_toy_batches(n=1)))
+            assert "wz" in fleet.liveness()["workers"]
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        port = ui.start()
+        try:
+            doc = json.loads(_get(port, "/events"))
+            weird = [e for e in doc["events"]
+                     if e["event"] == "weird_payload"]
+            assert weird and "object" in weird[0]["obj"]   # repr-degraded
+        finally:
+            ui.stop()
+    finally:
+        rec.clear()
+
+
+def test_telemetry_interval_none_still_reports_join_leave():
+    """interval=None disables only the periodic mid-epoch reports; the
+    forced join/leave reports still land, so the worker stays visible in
+    /fleet."""
+    fleet = FleetState()
+    with ParameterServer(port=0, fleet=fleet, tracer=Tracer()) as srv:
+        master = ParameterServerTrainingMaster(
+            srv.address, backoff=0.01, worker_id="wn",
+            telemetry_interval=None)
+        master.execute_training(_toy_net(seed=4),
+                                ListDataSetIterator(_toy_batches(n=2)))
+        live = fleet.liveness()
+        assert "wn" in live["workers"]
+        assert live["workers"]["wn"]["reports"] == 2       # join + leave
+
+
+def test_healthz_folds_in_fleet_liveness():
+    from deeplearning4j_tpu.monitor import get_health
+    fleet = get_fleet()
+    fleet.clear()
+    try:
+        fleet.record_report("hw", {"registry": {}})
+        snap = get_health().snapshot()
+        assert "hw" in snap["fleet"]["workers"]
+        assert snap["fleet"]["workers"]["hw"]["stale"] is False
+    finally:
+        fleet.clear()
+    assert "fleet" not in get_health().snapshot()   # empty table: no block

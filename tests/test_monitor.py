@@ -101,6 +101,24 @@ class TestRegistry:
                                       "type": "counter", "value": 2.0}
         assert snap["b_ms"][0]["summary"]["n"] == 1.0
 
+    def test_dump_json_roundtrip_rerenders_identically(self):
+        """dump() is the OP_TELEMETRY wire form: sending it through JSON
+        and re-rendering with render_prometheus_dump must reproduce the
+        local exposition byte for byte; extra labels (the fleet's
+        ``worker``) merge into every child."""
+        from deeplearning4j_tpu.monitor import render_prometheus_dump
+        reg = MetricsRegistry()
+        reg.counter("reqs_total", "requests", route="/a").inc(3)
+        reg.gauge("temp", "temperature").set(21.5)
+        reg.histogram("lat_ms", "latency", op="push").observe(1.0)
+        text = reg.render_prometheus()
+        wire = json.loads(json.dumps(reg.dump()))
+        assert render_prometheus_dump(wire) == text
+        relabeled = render_prometheus_dump(wire, {"worker": "w9"})
+        assert 'reqs_total{route="/a",worker="w9"} 3' in relabeled
+        assert 'temp{worker="w9"} 21.5' in relabeled
+        assert 'lat_ms_count{op="push",worker="w9"} 1' in relabeled
+
 
 # ------------------------------------------------------------------- tracer
 class TestTracer:
@@ -120,7 +138,12 @@ class TestTracer:
         outer = next(e for e in evs if e["name"] == "outer")
         assert outer["ts"] <= inner["ts"]
         assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
-        assert outer["args"] == {"k": 1}
+        assert outer["args"]["k"] == 1
+        # trace-context stamping: both spans share one trace, the inner
+        # span parents to the outer one, the root has no parent
+        assert inner["args"]["trace_id"] == outer["args"]["trace_id"]
+        assert inner["args"]["parent_span_id"] == outer["args"]["span_id"]
+        assert "parent_span_id" not in outer["args"]
 
     def test_ring_buffer_bounded(self):
         tr = Tracer(capacity=10)
@@ -130,6 +153,38 @@ class TestTracer:
         evs = tr.export()["traceEvents"]
         assert len(evs) == 10
         assert evs[-1]["name"] == "s24"  # newest survive
+
+    def test_ring_overflow_counts_drops(self):
+        """Satellite: ring-buffer eviction is no longer silent — drops
+        land on the instance AND in the registry's
+        tracer_spans_dropped_total, which /metrics exposes."""
+        counter = get_registry().counter(
+            "tracer_spans_dropped_total",
+            "spans evicted from the trace ring buffer")
+        before = counter.value
+        tr = Tracer(capacity=5)
+        for i in range(12):
+            with tr.span(f"s{i}"):
+                pass
+        assert tr.dropped == 7
+        assert counter.value - before == 7
+        assert "tracer_spans_dropped_total" in \
+            get_registry().render_prometheus()
+
+    def test_remote_parent_joins_trace(self):
+        """span(parent=ctx) with a context that 'arrived over the wire'
+        records a child of the REMOTE span — the server half of the
+        propagation story, without a socket."""
+        from deeplearning4j_tpu.monitor import SpanContext
+        client_tr, server_tr = Tracer(), Tracer()
+        with client_tr.span("rpc") as ctx:
+            wire = SpanContext(ctx.trace_id, ctx.span_id)   # 16-byte header
+            with server_tr.span("handle", parent=wire):
+                pass
+        handle = server_tr.events()[0]
+        rpc = client_tr.events()[0]
+        assert handle["args"]["trace_id"] == rpc["args"]["trace_id"]
+        assert handle["args"]["parent_span_id"] == rpc["args"]["span_id"]
 
     def test_decorator(self):
         tr = Tracer()
@@ -157,6 +212,53 @@ class TestTracer:
             assert any(ep["ts"] <= st["ts"] and
                        st["ts"] + st["dur"] <= ep["ts"] + ep["dur"] + 1
                        for ep in epochs)
+
+
+# ---------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_bounded_ordered_and_dropped_counted(self):
+        from deeplearning4j_tpu.monitor import FlightRecorder
+        fr = FlightRecorder(capacity=4)
+        for i in range(7):
+            fr.record("e", i=i)
+        evs = fr.events()
+        assert len(evs) == 4 and fr.dropped == 3
+        assert [e["i"] for e in evs] == [3, 4, 5, 6]         # newest win
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs)                          # provable order
+
+    def test_dump_jsonl_and_nonserializable_degrade(self, tmp_path):
+        from deeplearning4j_tpu.monitor import FlightRecorder
+        fr = FlightRecorder()
+        fr.record("weird", obj=object())     # degrades to repr, not raise
+        fr.record("plain", x=1)
+        path = fr.dump(path=str(tmp_path / "fr.jsonl"))
+        rows = [json.loads(line)
+                for line in open(path).read().splitlines()]
+        assert [r["event"] for r in rows] == ["weird", "plain"]
+        assert "object" in rows[0]["obj"]
+        assert fr.last_dump_path == path
+
+    def test_halt_dumps_flight_recorder(self, tmp_path, monkeypatch):
+        """The black-box contract: a TrainingHealthListener halt persists
+        the event log to disk (DL4J_TPU_FLIGHT_DIR) without being asked."""
+        from deeplearning4j_tpu.monitor import get_flight_recorder
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_DIR", str(tmp_path))
+        rec = get_flight_recorder()
+        rec.clear()
+        rec.record("before_halt", marker=1)
+        get_health().record_halt("test halt")
+        try:
+            dumps = list(tmp_path.glob("flightrec-*.jsonl"))
+            assert dumps, "halt must leave a JSONL dump behind"
+            rows = [json.loads(line) for line
+                    in dumps[0].read_text().splitlines()]
+            kinds = [r["event"] for r in rows]
+            assert "before_halt" in kinds and kinds[-1] == "halt"
+            assert rows[-1]["reason"] == "test halt"
+        finally:
+            get_health().reset()
+            rec.clear()
 
 
 # ------------------------------------------------------------------- health

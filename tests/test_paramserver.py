@@ -6,6 +6,7 @@ full push/pull protocol, the retry/backoff/fault-injection story, and a
 real training run; the multi-process variant lives in
 ``test_multiprocess.py`` behind ``@pytest.mark.slow``.
 """
+import json
 import time
 
 import numpy as np
@@ -451,6 +452,86 @@ def test_count_own_pushes_still_pulls_foreign_updates():
         _, server_vec = master.client.pull()
         np.testing.assert_array_equal(flatten_params(net.params),
                                       server_vec)
+
+
+def test_op_stats_uptime_and_per_op_counters():
+    """Satellite: OP_STATS carries server uptime and per-op request
+    counters, so the fleet view (and humans) see server-side load without
+    scraping logs."""
+    with ParameterServer(port=0) as srv, _client(srv) as c:
+        c.set_params(np.zeros(3, np.float32))
+        c.pull()
+        c.pull()
+        stats = c.stats()
+        assert stats["proto"] >= 2
+        assert stats["uptime_s"] >= 0.0
+        assert stats["ops"]["set"] == 1
+        assert stats["ops"]["pull"] == 2
+        assert stats["ops"]["stats"] >= 1      # incl. proto negotiation
+        assert stats["ops"]["push"] == 0
+
+
+def test_worker_die_rejoin_flight_recorder_and_fleet_stale(tmp_path):
+    """Worker die/rejoin observability: kill a WORKER mid-epoch (the
+    server stays up) and the flight recorder must hold ordered
+    join → leave → rejoin events that survive a JSONL dump, while
+    ``/fleet`` marks the dead worker stale and the surviving one fresh."""
+    from deeplearning4j_tpu.monitor import (FleetState, Tracer,
+                                            get_flight_recorder)
+    rec = get_flight_recorder()
+    rec.clear()
+    fleet = FleetState(stale_after=0.25)
+    batches = _toy_batches(n=3, seed=2)
+
+    with ParameterServer(port=0, fleet=fleet, tracer=Tracer()) as srv:
+        def master(worker):
+            return ParameterServerTrainingMaster(
+                srv.address, staleness=0, backoff=0.01, worker_id=worker,
+                telemetry_interval=0.0)
+
+        alive, dying = master("alive"), master("dying")
+        alive.execute_training(_toy_net(seed=5),
+                               ListDataSetIterator(batches[:1]))
+
+        def feed():                     # the worker dies mid-epoch
+            yield batches[0]
+            raise RuntimeError("worker killed")
+
+        net = _toy_net(seed=3)
+        with pytest.raises(RuntimeError, match="worker killed"):
+            dying.execute_training(net, feed())
+
+        # let the dead worker go stale while the survivor keeps reporting
+        time.sleep(0.3)
+        alive.client.send_telemetry()
+        live = fleet.liveness()
+        assert live["stale"] == ["dying"]
+        assert live["workers"]["alive"]["stale"] is False
+        assert 'fleet_worker_up{worker="dying"} 0' in \
+            fleet.render_prometheus()
+
+        # rejoin: same master, fresh epoch — adopts server state again
+        dying.execute_training(net, ListDataSetIterator(batches[:1]))
+        assert fleet.liveness()["workers"]["dying"]["stale"] is False
+
+    kinds = [e["event"] for e in rec.events()
+             if e.get("worker") == "dying"
+             and e["event"].startswith("worker_")]
+    assert kinds == ["worker_join", "worker_leave", "worker_rejoin",
+                     "worker_leave"]
+    leaves = [e for e in rec.events() if e.get("worker") == "dying"
+              and e["event"] == "worker_leave"]
+    assert "worker killed" in leaves[0]["reason"]      # the death, named
+    assert leaves[1]["reason"] == "completed"
+
+    # the JSONL dump preserves content and order (seq strictly increases)
+    path = rec.dump(path=str(tmp_path / "flight.jsonl"))
+    rows = [json.loads(line)
+            for line in open(path).read().splitlines()]
+    assert [r["event"] for r in rows if r.get("worker") == "dying"
+            and r["event"].startswith("worker_")] == kinds
+    seqs = [r["seq"] for r in rows]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
 
 
 def test_count_own_pushes_warns_on_residual_merging_server(caplog):
