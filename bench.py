@@ -539,6 +539,10 @@ def _run_one_subprocess(name, timeout_s=2400):
                     # headline (the line BENCH_*.json banks) carries the
                     # runtime metrics of the run that produced the number
                     _FINAL["monitor"] = doc["monitor"]
+                if doc.get("jitwatch") is not None:
+                    # same for the compile-cost block: the headline must
+                    # separate compile seconds from steady-state step time
+                    _FINAL["jitwatch"] = doc["jitwatch"]
                 return doc.get("value")
         except (ValueError, AttributeError):
             continue
@@ -643,6 +647,35 @@ def _monitor_snapshot():
         return None
 
 
+def _jitwatch_snapshot():
+    """Compact jitwatch block (compiles / compile seconds / cache-miss
+    ratio, per-fn detail) embedded in each --one record and the final
+    headline, so BENCH trajectories separate compile cost from
+    steady-state step time. None when nothing was monitored — the
+    record must never fail over its telemetry garnish."""
+    try:
+        from deeplearning4j_tpu.monitor.jitwatch import get_jit_registry
+        table = get_jit_registry().table()
+        if not table:
+            return None
+        compiles = sum(r["compiles"] for r in table.values())
+        calls = sum(r["calls"] for r in table.values())
+        return {
+            "compiles": compiles,
+            "compile_s": round(sum(r["compile_seconds"]
+                                    for r in table.values()), 3),
+            "cache_miss_ratio": (round(compiles / calls, 4)
+                                 if calls else None),
+            "per_fn": {n: {"compiles": r["compiles"],
+                           "calls": r["calls"],
+                           "compile_s": r["compile_seconds"]}
+                       for n, r in table.items()},
+        }
+    except Exception as e:
+        print(f"# jitwatch snapshot unavailable: {e}", file=sys.stderr)
+        return None
+
+
 def _headline_doc(value, base_val, *, stale=False, measured_utc=None,
                   error=None):
     vs = (value / base_val) if (base_val and value) else (1.0 if value else None)
@@ -655,10 +688,12 @@ def _headline_doc(value, base_val, *, stale=False, measured_utc=None,
         doc["measured_utc"] = measured_utc
     if error:
         doc["error"] = error
-    # the measurement child's monitor snapshot, lifted by
+    # the measurement child's monitor + jitwatch snapshots, lifted by
     # _run_one_subprocess — absent on stale replays and error paths
     if _FINAL.get("monitor") is not None:
         doc["monitor"] = _FINAL["monitor"]
+    if _FINAL.get("jitwatch") is not None:
+        doc["jitwatch"] = _FINAL["jitwatch"]
     return doc
 
 
@@ -798,7 +833,8 @@ def main():
                 sys.exit(3)
             _write_partial(base_doc, {name: value})
         print(json.dumps({"one": name, "value": value,
-                          "monitor": _monitor_snapshot()}))
+                          "monitor": _monitor_snapshot(),
+                          "jitwatch": _jitwatch_snapshot()}))
         return
 
     run_all = "--all" in sys.argv

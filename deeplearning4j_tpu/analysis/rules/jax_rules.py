@@ -42,8 +42,11 @@ def _walk_pruned(root: ast.AST):
 
 
 def _is_jit_expr(node: ast.AST) -> bool:
-    """``jit`` / ``jax.jit`` (any attribute chain ending in .jit)."""
-    return terminal_name(node) == "jit"
+    """``jit`` / ``jax.jit`` (any attribute chain ending in .jit), plus the
+    package's own ``monitored_jit`` wrapper (``monitor/jitwatch.py``) — a
+    function routed through jitwatch is every bit as traced as a bare-jit
+    one, so JAX001's barrier analysis must follow it."""
+    return terminal_name(node) in ("jit", "monitored_jit")
 
 
 def _jit_decorated(fn: ast.AST) -> bool:
@@ -369,3 +372,62 @@ class PRNGKeyReuse(Rule):
                     f"PRNG key {name!r} consumed inside a loop but never "
                     f"rebound there — every iteration repeats the same "
                     f"draw; split or fold_in per iteration")
+
+
+@register
+class BareJit(Rule):
+    id = "JAX003"
+    title = "bare jax.jit not routed through monitored_jit"
+    rationale = (
+        "A bare jax.jit compiles invisibly: no compile counter, no "
+        "compile-time histogram, no compile/<fn> span on /trace, no "
+        "cost_analysis capture, and — critically — no retrace-storm "
+        "detection, so shape/dtype churn silently re-traces the step and "
+        "training gets 10x slower with nothing on /metrics to say why. "
+        "monitor.jitwatch.monitored_jit(name=...) is a drop-in wrapper "
+        "that records all of the above (docs/OBSERVABILITY.md "
+        "'Compilation & memory'). Exempt: tests/ and jitwatch.py itself "
+        "(the one sanctioned jax.jit call). Ratchet-only via "
+        "analysis/baseline.json for sites that genuinely cannot migrate.")
+
+    def check(self, tree, lines, path) -> Iterator:
+        p = path.replace("\\", "/")
+        if "tests" in p.split("/") or p.endswith("monitor/jitwatch.py"):
+            return
+        # `from jax import jit [as alias]` makes the bare name a jit
+        # ref; `import jax as j` makes `j.jit` one (evading the guard
+        # through a module alias must not lint clean)
+        bare: Set[str] = set()
+        jax_mods: Set[str] = {"jax"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "jit":
+                        bare.add(a.asname or "jit")
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" and a.asname:
+                        jax_mods.add(a.asname)
+        # flagging the REFERENCE (Attribute/Name), not just calls, covers
+        # every spelling in one pass: jax.jit(f, ...), @jax.jit,
+        # @jax.jit(static_argnums=...), functools.partial(jax.jit, ...)
+        seen: Set[tuple] = set()
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Attribute) and node.attr == "jit" \
+                    and terminal_name(node.value) in jax_mods:
+                hit = node
+            elif isinstance(node, ast.Name) and node.id in bare \
+                    and isinstance(node.ctx, ast.Load):
+                hit = node
+            if hit is None:
+                continue
+            key = (hit.lineno, hit.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                hit, lines, path,
+                "bare jax.jit — route it through monitor.jitwatch."
+                "monitored_jit(name=\"area/fn\") so compiles are counted, "
+                "timed, traced, cost-profiled, and retrace-storm-watched")

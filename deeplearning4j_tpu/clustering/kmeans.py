@@ -15,8 +15,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..monitor.jitwatch import monitored_jit
 
-@jax.jit
+
+@monitored_jit(name="clustering/kmeans_step")
 def _assign_update(points, centroids):
     """(assignments, new centroids, inertia) — one Lloyd iteration."""
     d2 = (jnp.sum(points ** 2, axis=1)[:, None]

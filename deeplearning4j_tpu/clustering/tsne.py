@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .trees import SpTree, VPTree
+from ..monitor.jitwatch import monitored_jit
 
 
 # ------------------------------------------------------------ P construction
@@ -62,7 +63,7 @@ def _binary_search_p(d2: np.ndarray, perplexity: float, tol: float = 1e-5,
 
 
 # ------------------------------------------------------------- exact stepper
-@jax.jit
+@monitored_jit(name="clustering/tsne_step")
 def _tsne_step(y, P, gains, vel, lr, momentum):
     d2 = (jnp.sum(y ** 2, 1)[:, None] - 2 * y @ y.T + jnp.sum(y ** 2, 1)[None, :])
     num = 1.0 / (1.0 + d2)

@@ -201,7 +201,9 @@ def cmd_monitor(args) -> int:
     (``/trace`` remotely, the local tracer otherwise) to a file for
     Perfetto. ``--fleet`` switches to the aggregated per-worker view
     (``/fleet``); ``--events`` prints the flight recorder's structured
-    event log as JSONL."""
+    event log as JSONL; ``--profile`` prints the step-anatomy report
+    (per-fn jit compiles/times/flops + device memory + step/ETL split,
+    ``/profile`` remotely)."""
     import json
     import urllib.error
     import urllib.request
@@ -218,6 +220,23 @@ def cmd_monitor(args) -> int:
     if args.url:
         base = args.url if "://" in args.url else f"http://{args.url}"
         base = base.rstrip("/")
+
+    if args.profile:
+        # step-anatomy view (docs/OBSERVABILITY.md "Compilation & memory")
+        if base:
+            if args.format == "json":
+                print(json.dumps(json.loads(_fetch(base, "/profile")),
+                                 indent=2))
+            else:
+                print(_fetch(base, "/profile?format=text"), end="")
+        else:
+            from .monitor import profile_report, render_profile_text
+            rep = profile_report()
+            if args.format == "json":
+                print(json.dumps(rep, indent=2))
+            else:
+                print(render_profile_text(rep), end="")
+        return 0
 
     if args.events:
         # flight-recorder view: one JSON object per line (JSONL — the same
@@ -362,6 +381,10 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--events", action="store_true",
                    help="print the crash flight recorder's structured "
                         "event log as JSONL")
+    m.add_argument("--profile", action="store_true",
+                   help="step-anatomy report: per-fn jit compile counts/"
+                        "seconds/flops, device-memory gauges, step/ETL "
+                        "timing split (text, or JSON with --format json)")
     m.set_defaults(fn=cmd_monitor)
     li = sub.add_parser("lint",
                         help="tpulint: AST static analysis for JAX/"

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import os
 
+import contextlib
+
 from .registry import (MetricsRegistry, LatencyHistogram, Counter, Gauge,
                        Histogram, get_registry, render_prometheus_dump)
 from .tracer import SpanContext, Tracer, get_tracer
@@ -39,6 +41,10 @@ from .health import (HealthState, get_health, TrainingHealthListener,
                      TrainingHealthError)
 from .flightrec import FlightRecorder, get_flight_recorder
 from .fleet import FleetState, get_fleet, merge_traces
+from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
+                       get_jit_registry, sample_device_memory,
+                       maybe_sample_device_memory, profile_report,
+                       render_profile_text)
 
 __all__ = [
     "MetricsRegistry", "LatencyHistogram", "Counter", "Gauge", "Histogram",
@@ -46,7 +52,10 @@ __all__ = [
     "get_tracer", "HealthState", "get_health",
     "TrainingHealthListener", "TrainingHealthError",
     "FlightRecorder", "get_flight_recorder", "FleetState", "get_fleet",
-    "merge_traces",
+    "merge_traces", "MonitoredJit", "JitRegistry", "monitored_jit",
+    "get_jit_registry", "sample_device_memory",
+    "maybe_sample_device_memory", "profile_report",
+    "render_profile_text",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 
@@ -67,12 +76,23 @@ def enabled() -> bool:
     return _ENABLED
 
 
+@contextlib.contextmanager
 def step_span(iteration: int):
     """The per-minibatch training span. The caller MUST perform its
     device→host value fetch (``float(loss)``) inside this span so the span
     measures the finished step, not its dispatch (value-fetch barrier rule,
-    ``utils/profiling.py``)."""
-    return get_tracer().span("step", cat="train", iteration=int(iteration))
+    ``utils/profiling.py``). Span close also samples the device-memory
+    gauges (throttled, AFTER the span ends so the sampling cost never
+    inflates the step duration) — the step boundary is where
+    donation/sharding decisions have just landed, so
+    ``device_memory_bytes_in_use`` tracks the working set step-by-step
+    (docs/OBSERVABILITY.md "Compilation & memory")."""
+    try:
+        with get_tracer().span("step", cat="train",
+                               iteration=int(iteration)) as ctx:
+            yield ctx
+    finally:
+        maybe_sample_device_memory()
 
 
 def record_training_iteration(model, iteration: int, score: float,

@@ -35,7 +35,8 @@ __all__ = ["HealthState", "get_health", "TrainingHealthListener",
 
 class TrainingHealthError(RuntimeError):
     """Raised by :class:`TrainingHealthListener` under ``action="raise"``.
-    ``kind`` is one of ``"nan"``, ``"divergence"``, ``"stall"``."""
+    ``kind`` is one of ``"nan"``, ``"divergence"``, ``"stall"``,
+    ``"retrace"``."""
 
     def __init__(self, kind: str, message: str):
         super().__init__(message)
@@ -169,6 +170,15 @@ class TrainingHealthListener(TrainingListener):
       ``iteration_done`` and the previous one. (A *fully* wedged loop never
       fires listeners at all — that case is the prober's job via
       ``/healthz``'s ``last_iteration_age_s``.)
+    - **Retrace storm** — the jitwatch detector
+      (``monitor/jitwatch.py``) flagged a monitored jit function
+      recompiling repeatedly within its window (shape/dtype churn). The
+      detector itself already recorded the health problem and the
+      ``retrace_storm`` flight event (with the argument-signature delta)
+      at compile time; this listener drains the pending storms each
+      iteration to apply the configured ``action`` — so ``action="halt"``
+      stops a fit that would otherwise grind through per-step
+      recompilation. Disable with ``watch_retrace=False``.
 
     ``action``: ``"warn"`` logs and records the problem in
     :func:`get_health`; ``"raise"`` raises :class:`TrainingHealthError`;
@@ -183,7 +193,7 @@ class TrainingHealthListener(TrainingListener):
     def __init__(self, action: str = "warn", divergence_window: int = 10,
                  divergence_factor: float = 2.0,
                  stall_timeout: Optional[float] = None,
-                 check_params_every: int = 0):
+                 check_params_every: int = 0, watch_retrace: bool = True):
         if action not in self.ACTIONS:
             raise ValueError(f"action must be one of {self.ACTIONS}, "
                              f"got {action!r}")
@@ -192,14 +202,24 @@ class TrainingHealthListener(TrainingListener):
         self.divergence_factor = float(divergence_factor)
         self.stall_timeout = stall_timeout
         self.check_params_every = int(check_params_every)
+        self.watch_retrace = bool(watch_retrace)
+        # storms that fired BEFORE this listener existed are history
+        # (already on /healthz and in the flight recorder) — acting on
+        # them here would punish the current run for an earlier one
+        self._armed_at = time.time()
         self.triggered: List[Tuple[str, int, str]] = []
         self._scores = deque(maxlen=self.divergence_window)
         self._last_time: Optional[float] = None
 
     # ------------------------------------------------------------- checks
-    def _fire(self, model, kind: str, iteration: int, message: str):
+    def _fire(self, model, kind: str, iteration: int, message: str,
+              record: bool = True):
         self.triggered.append((kind, iteration, message))
-        get_health().record_problem(kind, message)
+        if record:
+            # retrace storms arrive pre-recorded by the jitwatch detector
+            # (record=False): recording again would double the /healthz
+            # problem and the flight event
+            get_health().record_problem(kind, message)
         if self.action == "raise":
             raise TrainingHealthError(kind, message)
         if self.action == "halt":
@@ -224,6 +244,24 @@ class TrainingHealthListener(TrainingListener):
         return False
 
     def iteration_done(self, model, iteration, score):
+        if self.watch_retrace:
+            from .jitwatch import get_jit_registry
+            reg = get_jit_registry()
+            storms = reg.drain_storms()
+            if storms:
+                me = threading.get_ident()
+                # storms carry the fit thread they fired on: act only
+                # on THIS thread's (= this model's) storms and requeue
+                # the rest — halting model B for model A's shape churn
+                # would punish the healthy fit and starve the sick one
+                foreign = [s for s in storms
+                           if s.get("thread") not in (None, me)]
+                reg.requeue_storms(foreign)
+                for storm in storms:
+                    if storm in foreign or storm.get("t", 0) < self._armed_at:
+                        continue
+                    self._fire(model, "retrace", iteration,
+                               storm["message"], record=False)
         now = time.perf_counter()
         if (self.stall_timeout is not None and self._last_time is not None
                 and now - self._last_time > self.stall_timeout):

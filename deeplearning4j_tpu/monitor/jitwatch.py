@@ -1,0 +1,707 @@
+"""jitwatch: compilation & device-memory observability for every jit.
+
+The two dominant invisible costs on an XLA device are **recompilation**
+(shape/dtype churn silently re-tracing a step — the classic "training
+mysteriously 10x slower" failure) and **device memory** (donation and
+sharding decisions live or die by peak HBM). Neither shows up in step
+timings: a retrace storm just makes every step slow, and an OOM arrives
+long after the allocation decisions that caused it. This module makes
+both first-class monitor citizens:
+
+- :func:`monitored_jit` — the package-wide replacement for bare
+  ``jax.jit`` (tpulint rule JAX003 enforces the migration stays
+  complete). Per named function it records compile count vs call count
+  (cache-miss ratio), compile wall-time (``jit_compile_seconds``
+  histogram + ``jit_compiles_total{fn=}`` / ``jit_calls_total{fn=}``
+  series), a ``compile/<name>`` tracer span (compiles appear on
+  ``/trace`` and the merged fleet trace, parented under the step span
+  they interrupted), and on-compile ``cost_analysis`` capture (flops /
+  bytes / peak memory per compiled variant, via ``compat.cost_analysis``
+  — the same numbers ``utils.profiling.step_cost`` reports).
+- the **retrace-storm detector**: ``RETRACE_THRESHOLD`` compiles of the
+  same wrapper within ``RETRACE_WINDOW`` seconds records a health
+  problem and a ``retrace_storm`` flight-recorder event naming the
+  function and the argument-signature delta that triggered the retrace
+  (the runbook: read the delta, pad/bucket your batch shapes —
+  docs/OBSERVABILITY.md "Compilation & memory"). ``TrainingHealthListener``
+  drains :meth:`JitRegistry.drain_storms` per iteration to apply its
+  warn/raise/halt action.
+- :func:`sample_device_memory` — ``device_memory_bytes_in_use{device=}``
+  / ``device_memory_peak_bytes{device=}`` / ``device_live_buffers``
+  gauges, sampled on every ``/metrics`` scrape and at step-span close,
+  degrading gracefully on backends without memory stats (CPU's
+  ``memory_stats()`` is None; the live-buffer count still works).
+- :func:`profile_report` — the step-anatomy view behind ``GET /profile``
+  and ``monitor --profile``: the per-fn jit table, the memory gauges,
+  and the step/ETL timing split merged into one JSON+text report.
+
+Hot-path cost per monitored call: two counter increments, two
+``perf_counter`` reads, and one C++-side jit-cache-size probe — all the
+expensive work (signatures, spans) happens only on a compile, which is
+already a multi-ms event, and the cost_analysis re-lower runs on a
+background worker thread so it never extends the training call that
+triggered the compile.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["monitored_jit", "MonitoredJit", "JitRegistry",
+           "get_jit_registry", "sample_device_memory",
+           "maybe_sample_device_memory", "wait_cost_captures",
+           "profile_report", "render_profile_text",
+           "RETRACE_THRESHOLD", "RETRACE_WINDOW"]
+
+#: compiles of ONE wrapper instance within RETRACE_WINDOW seconds that
+#: count as a retrace storm. Per instance, not per name: fifty networks
+#: each compiling their own "mln/step" once is healthy; one network
+#: compiling its step three times in a minute is shape churn.
+RETRACE_THRESHOLD = int(os.environ.get("DL4J_TPU_RETRACE_THRESHOLD", "3"))
+RETRACE_WINDOW = float(os.environ.get("DL4J_TPU_RETRACE_WINDOW", "60"))
+
+#: "0" skips the on-compile cost_analysis capture (it re-lowers the
+#: function abstractly — cheap next to the compile it annotates, but not
+#: free on very large graphs)
+_COST_CAPTURE = os.environ.get("DL4J_TPU_JITWATCH_COST", "1") \
+    not in ("0", "false", "")
+
+
+# ------------------------------------------------------------- signatures
+def _leaf_sig(x) -> str:
+    """One leaf's cache identity: ``f32[16,4]`` for array-likes (shape
+    metadata survives buffer donation — only the data is freed), repr for
+    static/python leaves."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        try:
+            return f"{dtype.name}[{','.join(str(int(d)) for d in shape)}]"
+        # exotic dtype/shape objects (symbolic dims, custom dtypes):
+        # the repr fallback below IS the answer, nothing to log
+        except Exception:  # tpulint: disable=EXC001
+            pass
+    r = repr(x)
+    return r if len(r) <= 40 else r[:37] + "..."
+
+
+def _signature(args, kwargs) -> Tuple[Tuple[Tuple[str, str], ...], str]:
+    """((keypath, leaf-sig), ...) plus the treedef repr — the abstract
+    identity jax's jit cache keys on, path-labeled so a retrace delta can
+    name the argument that changed."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten_with_path((args,
+                                                            dict(kwargs)))
+    sig = tuple((jax.tree_util.keystr(kp), _leaf_sig(leaf))
+                for kp, leaf in leaves)
+    return sig, str(treedef)
+
+
+def _sig_delta(old, new) -> str:
+    """Human-readable diff between two signatures: WHICH arguments changed
+    shape/dtype (the retrace-storm runbook's first question)."""
+    if old is None:
+        return "first compile"
+    o, n = dict(old[0]), dict(new[0])
+    diffs = [f"{k}: {o[k]} -> {n[k]}" for k in n if k in o and o[k] != n[k]]
+    added = [k for k in n if k not in o]
+    removed = [k for k in o if k not in n]
+    if added:
+        diffs.append(f"+{len(added)} new leaves ({added[0]}, ...)"
+                     if len(added) > 1 else f"new leaf {added[0]}")
+    if removed:
+        diffs.append(f"-{len(removed)} leaves")
+    if not diffs:
+        return ("tree structure changed" if old[1] != new[1]
+                else "signature unchanged (static-argument retrace)")
+    head = "; ".join(diffs[:4])
+    if len(diffs) > 4:
+        head += f" (+{len(diffs) - 4} more)"
+    return head
+
+
+def _abstractify(x):
+    """Array-likes → ShapeDtypeStruct for a data-free re-lower (donated
+    inputs are already dead by the time a compile is detected); python
+    scalars and other statics pass through concretely."""
+    import jax
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    return x
+
+
+# ------------------------------------------------------------- registry
+class _FnStats:
+    """Per-NAME aggregate (instances of the same named fn pool here)."""
+
+    __slots__ = ("name", "compiles", "compile_seconds", "variants",
+                 "last_cost", "last_delta", "storms")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.variants: Dict[str, Dict[str, Any]] = {}
+        self.last_cost: Optional[Dict[str, float]] = None
+        self.last_delta: Optional[str] = None
+        self.storms = 0
+
+
+class JitRegistry:
+    """Process-global table of monitored jit functions: per-fn compile /
+    call / cost aggregates (:meth:`table` is the ``/profile`` jit block)
+    and the pending retrace-storm queue ``TrainingHealthListener`` drains
+    to apply its action."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, _FnStats] = {}
+        self._pending_storms: List[Dict[str, Any]] = []
+
+    def stats(self, name: str) -> _FnStats:
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = _FnStats(name)
+            return st
+
+    def note_compile(self, name: str, seconds: float, sig_key: str,
+                     delta: str):
+        st = self.stats(name)
+        with self._lock:
+            st.compiles += 1
+            st.compile_seconds += seconds
+            st.last_delta = delta
+            var = st.variants.setdefault(sig_key, {"compiles": 0})
+            var["compiles"] += 1
+            var["compile_seconds"] = round(
+                var.get("compile_seconds", 0.0) + seconds, 4)
+
+    def note_cost(self, name: str, sig_key: str,
+                  cost: Dict[str, float]):
+        """Landing point for the async cost worker (may arrive any time
+        after the compile it describes)."""
+        st = self.stats(name)
+        with self._lock:
+            var = st.variants.setdefault(sig_key, {"compiles": 0})
+            var["cost"] = cost
+            st.last_cost = cost
+
+    def report_storm(self, name: str, count: int, delta: str):
+        msg = (f"retrace storm: jit fn {name!r} compiled {count} times "
+               f"within {RETRACE_WINDOW:.0f}s — argument-signature churn "
+               f"({delta}); pad or bucket the offending shapes "
+               f"(docs/OBSERVABILITY.md, 'Compilation & memory')")
+        # thread affinity: detection runs synchronously inside the
+        # training call, on the fit thread — the listener driving THAT
+        # fit runs iteration_done on the same thread, so "thread" lets
+        # it act only on its own model's storms (health.py)
+        info = {"t": time.time(), "fn": name, "count": count,
+                "window_s": RETRACE_WINDOW, "signature_delta": delta,
+                "message": msg, "thread": threading.get_ident()}
+        with self._lock:
+            self._stats.setdefault(name, _FnStats(name)).storms += 1
+            self._pending_storms.append(info)
+            del self._pending_storms[:-32]    # bounded, newest win
+        log.warning("jitwatch %s", msg)
+        # flight recorder first (the delta is the forensic payload), then
+        # the health problem (visible on /healthz without any listener)
+        from .flightrec import get_flight_recorder
+        get_flight_recorder().record("retrace_storm", fn=name, count=count,
+                                     window_s=RETRACE_WINDOW,
+                                     signature_delta=delta)
+        from .health import get_health
+        get_health().record_problem("retrace", msg)
+
+    def drain_storms(self) -> List[Dict[str, Any]]:
+        """Pop the pending storms (listener action seam)."""
+        with self._lock:
+            out, self._pending_storms = self._pending_storms, []
+        return out
+
+    def requeue_storms(self, storms: List[Dict[str, Any]]):
+        """Put drained storms back (a listener drained storms belonging
+        to ANOTHER fit thread — its own listener must still see them).
+        Original timestamps are kept, so arm-time filtering and the
+        bounded queue still expire them."""
+        if not storms:
+            return
+        with self._lock:
+            self._pending_storms.extend(storms)
+            del self._pending_storms[:-32]
+
+    def table(self) -> Dict[str, Dict[str, Any]]:
+        """{name: {calls, compiles, cache_miss_ratio, compile_seconds,
+        variants, flops, bytes_accessed, peak_memory_bytes, ...}} — the
+        jit block of the step-anatomy report."""
+        from .registry import get_registry
+        reg = get_registry()
+        with self._lock:
+            stats = list(self._stats.items())
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, st in sorted(stats):
+            calls = int(reg.counter("jit_calls_total",
+                                    "calls into monitored jit functions",
+                                    fn=name).value)
+            row: Dict[str, Any] = {
+                "calls": calls,
+                "compiles": st.compiles,
+                "cache_miss_ratio": (round(st.compiles / calls, 4)
+                                     if calls else None),
+                "compile_seconds": round(st.compile_seconds, 4),
+                "variants": len(st.variants),
+                "storms": st.storms,
+            }
+            if st.last_cost:
+                row.update(st.last_cost)
+            if st.last_delta:
+                row["last_signature_delta"] = st.last_delta
+            out[name] = row
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._stats.clear()
+            self._pending_storms.clear()
+
+
+_JIT_REGISTRY = JitRegistry()
+
+
+def get_jit_registry() -> JitRegistry:
+    return _JIT_REGISTRY
+
+
+# -------------------------------------------------------------- wrapper
+class MonitoredJit:
+    """``jax.jit`` plus the bookkeeping above. Calls pass straight
+    through; compile detection is a jit-cache-size delta (falling back to
+    a shadow signature set on jax builds without ``_cache_size``), so the
+    compiled path pays no tracing, hashing, or locking beyond two counter
+    bumps."""
+
+    def __init__(self, fn, name: Optional[str] = None, **jit_kwargs):
+        import jax
+        self._fn = fn
+        self.name = name or getattr(fn, "__qualname__",
+                                    getattr(fn, "__name__", "jit_fn"))
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self._last_sig = None
+        self._seen_sigs = set()           # fallback-mode shadow cache
+        self._seen_cache_size = 0         # compiles claimed so far
+        self._compile_times = deque(maxlen=max(RETRACE_THRESHOLD, 8))
+        self._handles = None
+        self._has_cache_size = hasattr(self._jit, "_cache_size")
+        functools.update_wrapper(self, fn, updated=())
+
+    def _metric_handles(self):
+        # lazy: importing a module full of decorated steps must not
+        # populate /metrics with never-called fn labels
+        if self._handles is None:
+            from .registry import get_registry
+            reg = get_registry()
+            self._handles = (
+                reg.counter("jit_calls_total",
+                            "calls into monitored jit functions",
+                            fn=self.name),
+                reg.counter("jit_compiles_total",
+                            "XLA compilations (jit cache misses)",
+                            fn=self.name),
+                reg.histogram("jit_compile_seconds",
+                              "wall-clock seconds per jit compilation "
+                              "(trace+compile, first-call latency)",
+                              fn=self.name),
+            )
+        return self._handles
+
+    def __call__(self, *args, **kwargs):
+        calls_c, compiles_c, hist = self._metric_handles()
+        calls_c.inc()
+        with self._lock:
+            self.calls += 1
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        dur = time.perf_counter() - t0
+        if self._has_cache_size:
+            # claim-the-delta: N threads racing through one compile all
+            # observe the same grown cache, but only the first to take
+            # the lock claims it — no double-counted compiles, no
+            # spurious retrace storm from a thread pile-up, and only
+            # the claimer's wall-time lands in the histogram
+            compiled = False
+            after = self._jit._cache_size()
+            if after > self._seen_cache_size:
+                with self._lock:
+                    if after > self._seen_cache_size:
+                        self._seen_cache_size = after
+                        compiled = True
+            sig = None
+        else:
+            sig = self._safe_signature(args, kwargs)
+            key = sig[0] if sig else None
+            with self._lock:
+                compiled = key not in self._seen_sigs
+                self._seen_sigs.add(key)
+        if compiled:
+            try:
+                self._record_compile(args, kwargs, t0, dur, sig,
+                                     compiles_c, hist)
+            except Exception as e:
+                # observability must never fail the training step it
+                # observes — degrade to the bare counters
+                log.debug("jitwatch: compile bookkeeping for %s failed: %r",
+                          self.name, e)
+        return out
+
+    def _safe_signature(self, args, kwargs):
+        try:
+            return _signature(args, kwargs)
+        except Exception as e:
+            log.debug("jitwatch: signature of %s failed: %r", self.name, e)
+            return None
+
+    def _record_compile(self, args, kwargs, t0, dur, sig, compiles_c, hist):
+        if sig is None:
+            sig = self._safe_signature(args, kwargs)
+        compiles_c.inc()
+        hist.observe(dur)          # seconds (the metric name carries units)
+        delta = _sig_delta(self._last_sig, sig) if sig else "unknown"
+        now = time.time()
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += dur
+            self._last_sig = sig
+            self._compile_times.append(now)
+            recent = [t for t in self._compile_times
+                      if now - t <= RETRACE_WINDOW]
+            storm = len(recent) >= RETRACE_THRESHOLD
+            if storm:
+                self._compile_times.clear()   # re-arm: a sustained storm
+                                              # re-fires every N compiles
+        # the compile happened inside whatever span is open on this thread
+        # (usually the step span), so parent it there — step anatomy shows
+        # the compile eating the step it interrupted
+        from .tracer import get_tracer
+        get_tracer().record_complete(f"compile/{self.name}", t0, dur,
+                                     cat="compile", fn=self.name,
+                                     signature_delta=delta)
+        sig_key = ";".join(f"{k}={v}" for k, v in sig[0]) if sig else "?"
+        reg = get_jit_registry()
+        reg.note_compile(self.name, dur, sig_key, delta)
+        if _COST_CAPTURE:
+            _submit_cost_capture(self._jit, self.name, sig_key,
+                                 args, kwargs)
+        if storm:
+            reg.report_storm(self.name, len(recent), delta)
+
+    # ------------------------------------------------- jit API passthrough
+    def lower(self, *args, **kwargs):
+        """AOT lowering passthrough (``utils.profiling.step_cost`` seam)."""
+        return self._jit.lower(*args, **kwargs)
+
+    @property
+    def cache_miss_ratio(self) -> Optional[float]:
+        with self._lock:
+            return self.compiles / self.calls if self.calls else None
+
+    def __repr__(self):
+        return (f"MonitoredJit({self.name!r}, calls={self.calls}, "
+                f"compiles={self.compiles})")
+
+
+def monitored_jit(fn=None, name: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with compile observability (see module docstring).
+
+    Use exactly like ``jax.jit`` — ``monitored_jit(step, name="mln/step",
+    donate_argnums=(0, 2))`` — or as a decorator factory::
+
+        @monitored_jit(name="nlp/hs_step", donate_argnums=(0, 1))
+        def _hs_step(...): ...
+
+    ``name`` labels every metric/span/flight event; it defaults to the
+    function's qualname but SHOULD be set to a stable ``area/fn`` slug so
+    dashboards survive refactors.
+    """
+    if fn is None:
+        return functools.partial(monitored_jit, name=name, **jit_kwargs)
+    return MonitoredJit(fn, name=name, **jit_kwargs)
+
+
+# ---------------------------------------------------- async cost capture
+# Single-thread ThreadPoolExecutor, NOT a bare daemon thread: a daemon
+# thread mid-XLA-compile when the interpreter finalizes aborts the whole
+# process ("terminate called without an active exception" — seen in the
+# multiprocess worker tests). Executor threads are JOINED at interpreter
+# shutdown; _cancel_pending_captures (registered BEFORE the executor
+# module's own shutdown hook) cancels not-yet-started captures first, so
+# exit waits only for the one in-flight compile, never the whole queue.
+_COST_WORKER_LOCK = threading.Lock()
+_COST_EXECUTOR = None
+_COST_FUTURES: deque = deque()
+_COST_MAX_PENDING = 16
+_COST_SHUTDOWN = False
+
+
+def _cancel_pending_captures():
+    global _COST_SHUTDOWN
+    _COST_SHUTDOWN = True
+    with _COST_WORKER_LOCK:
+        futures = list(_COST_FUTURES)
+        _COST_FUTURES.clear()
+    for f in futures:
+        f.cancel()
+
+
+def _ensure_cost_executor():
+    global _COST_EXECUTOR
+    with _COST_WORKER_LOCK:
+        if _COST_EXECUTOR is None:
+            # import (and thereby let concurrent.futures install its
+            # join-at-shutdown hook) FIRST, then register our canceller:
+            # threading._shutdown runs _threading_atexits in REVERSED
+            # registration order, so the later-registered canceller runs
+            # before the executor's join — pending captures are cancelled
+            # and exit waits only for the one in-flight compile
+            from concurrent.futures import ThreadPoolExecutor
+            _COST_EXECUTOR = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="jitwatch-cost")
+            try:
+                threading._register_atexit(_cancel_pending_captures)
+            # private API absent (older python): the atexit fallback below
+            # IS the handling — exit then waits for queued captures too
+            except Exception:  # tpulint: disable=EXC001
+                import atexit
+                atexit.register(_cancel_pending_captures)
+        return _COST_EXECUTOR
+
+
+def _submit_cost_capture(jitted, name: str, sig_key: str, args, kwargs):
+    """Queue an XLA cost_analysis capture for the variant just compiled.
+    The abstract signature (ShapeDtypeStructs — no data, donation-safe) is
+    built eagerly on the calling thread; the expensive lower+compile runs
+    on the worker, so cost capture never extends the training call that
+    triggered the compile. Bounded: a retrace storm must not queue
+    unbounded recompilation work — overflow drops the capture (the compile
+    counters/spans already landed)."""
+    if _COST_SHUTDOWN:
+        return
+    try:
+        import jax
+        a_args, a_kwargs = jax.tree_util.tree_map(_abstractify,
+                                                  (args, dict(kwargs)))
+    except Exception as e:
+        log.debug("jitwatch: abstractify for %s failed: %r", name, e)
+        return
+    ex = _ensure_cost_executor()
+    with _COST_WORKER_LOCK:
+        while _COST_FUTURES and _COST_FUTURES[0].done():
+            _COST_FUTURES.popleft()
+        if len(_COST_FUTURES) >= _COST_MAX_PENDING:
+            log.debug("jitwatch: cost queue full, dropping capture for %s",
+                      name)
+            return
+    try:
+        fut = ex.submit(_capture_cost_task, jitted, name, sig_key,
+                        a_args, a_kwargs)
+    except RuntimeError:      # executor already shut down (interpreter exit)
+        return
+    with _COST_WORKER_LOCK:
+        _COST_FUTURES.append(fut)
+
+
+def _capture_cost_task(jitted, name, sig_key, a_args, a_kwargs):
+    try:
+        _capture_cost_now(jitted, name, sig_key, a_args, a_kwargs)
+    except Exception as e:
+        log.debug("jitwatch: cost capture for %s failed: %r", name, e)
+
+
+def _capture_cost_now(jitted, name: str, sig_key: str, a_args, a_kwargs):
+    """Worker body: abstract re-lower + compile + cost_analysis /
+    memory_analysis. Best-effort by contract — sharded/exotic signatures
+    that refuse the abstract re-lower simply report no cost."""
+    compiled = jitted.lower(*a_args, **a_kwargs).compile()
+    from ..compat import cost_analysis
+    ca = cost_analysis(compiled)
+    cost = {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+    try:
+        ma = compiled.memory_analysis()
+        peak = sum(float(getattr(ma, k, 0) or 0)
+                   for k in ("temp_size_in_bytes",
+                             "argument_size_in_bytes",
+                             "output_size_in_bytes"))
+        if peak:
+            cost["peak_memory_bytes"] = peak
+    # older jax builds lack Compiled.memory_analysis — the flops/bytes
+    # cost block above is still the full answer
+    except Exception:  # tpulint: disable=EXC001
+        pass
+    get_jit_registry().note_cost(name, sig_key, cost)
+
+
+def wait_cost_captures(timeout: float = 10.0) -> bool:
+    """Block until every queued cost capture has landed (tests and
+    snapshot-then-exit CLI paths want deterministic flops). Returns False
+    on timeout — the report is then merely missing its newest cost rows."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with _COST_WORKER_LOCK:
+            pending = [f for f in _COST_FUTURES if not f.done()]
+        if not pending:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# --------------------------------------------------------- device memory
+def sample_device_memory(registry=None) -> Dict[str, Any]:
+    """Sample per-device allocator stats + the process live-buffer count
+    into gauges; returns the same data as a dict (the ``/profile`` memory
+    block). Backends without ``memory_stats()`` (CPU) just skip the byte
+    gauges — the sampler never raises."""
+    out: Dict[str, Any] = {"devices": {}, "live_buffers": None}
+    try:
+        import jax
+        from .registry import get_registry
+        reg = registry if registry is not None else get_registry()
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            # documented graceful degradation: backends without
+            # allocator stats (CPU) skip the byte gauges entirely
+            except Exception:  # tpulint: disable=EXC001
+                stats = None
+            if not stats:
+                continue
+            dev = f"{d.platform}:{d.id}"
+            row = out["devices"].setdefault(dev, {})
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                reg.gauge("device_memory_bytes_in_use",
+                          "device bytes currently allocated",
+                          device=dev).set(float(in_use))
+                row["bytes_in_use"] = int(in_use)
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                reg.gauge("device_memory_peak_bytes",
+                          "peak device bytes over the process lifetime",
+                          device=dev).set(float(peak))
+                row["peak_bytes_in_use"] = int(peak)
+            limit = stats.get("bytes_limit")
+            if limit:
+                row["bytes_limit"] = int(limit)
+        n = len(jax.live_arrays())
+        reg.gauge("device_live_buffers",
+                  "live jax arrays held by this process").set(float(n))
+        out["live_buffers"] = n
+    except Exception as e:
+        log.debug("jitwatch: device memory sample failed: %r", e)
+    return out
+
+
+#: per-step sampling throttle (seconds): the fit loops sample at step-span
+#: close, but jax.live_arrays() is O(live buffers) — once a second is
+#: plenty for a gauge and keeps the hot loop honest
+_SAMPLE_INTERVAL = float(os.environ.get("DL4J_TPU_MEMSAMPLE_INTERVAL", "1.0"))
+_LAST_SAMPLE = [0.0]
+
+
+def maybe_sample_device_memory():
+    """Throttled :func:`sample_device_memory` for per-step call sites: at
+    most one sample per ``DL4J_TPU_MEMSAMPLE_INTERVAL`` seconds (default
+    1.0; scrape-time sampling on ``/metrics`` stays unthrottled)."""
+    now = time.monotonic()
+    if now - _LAST_SAMPLE[0] < _SAMPLE_INTERVAL:
+        return
+    _LAST_SAMPLE[0] = now
+    sample_device_memory()
+
+
+# ----------------------------------------------------------- step anatomy
+def profile_report() -> Dict[str, Any]:
+    """The step-anatomy report (``GET /profile`` / ``monitor --profile``):
+    per-fn jit table + device memory + the step/ETL timing split, merged
+    from the monitor registry — one view answering "where does a step's
+    wall-clock actually go: compute, compile, or ETL?"."""
+    from .registry import get_registry
+    snap = get_registry().snapshot()
+
+    def value(metric):
+        rows = snap.get(metric, [])
+        return sum(r.get("value", 0) for r in rows) if rows else None
+
+    def summary(metric):
+        rows = snap.get(metric, [])
+        return rows[0].get("summary") if rows else None
+
+    return {
+        "jit": get_jit_registry().table(),
+        "memory": sample_device_memory(),
+        "steps": {
+            "iterations": value("training_iterations_total"),
+            "examples": value("training_examples_total"),
+            "step_ms": summary("training_step_ms"),
+            "etl_ms": summary("training_etl_ms"),
+        },
+    }
+
+
+def render_profile_text(report: Dict[str, Any]) -> str:
+    """Plain-text rendering of :func:`profile_report` for terminals."""
+    lines = ["# jit (per named function)"]
+    jit = report.get("jit") or {}
+    if jit:
+        lines.append(f"{'fn':<28} {'calls':>8} {'compiles':>8} "
+                     f"{'miss':>7} {'compile_s':>10} {'gflops':>10} "
+                     f"{'peak_mb':>8}")
+        for name, r in jit.items():
+            miss = r.get("cache_miss_ratio")
+            flops = r.get("flops")
+            peak = r.get("peak_memory_bytes")
+            lines.append(
+                f"{name:<28} {r['calls']:>8} {r['compiles']:>8} "
+                f"{miss if miss is not None else '-':>7} "
+                f"{r['compile_seconds']:>10} "
+                f"{round(flops / 1e9, 3) if flops else '-':>10} "
+                f"{round(peak / 1e6, 1) if peak else '-':>8}")
+            if r.get("storms"):
+                lines.append(f"  !! {r['storms']} retrace storm(s); last "
+                             f"delta: {r.get('last_signature_delta')}")
+    else:
+        lines.append("(no monitored jit activity yet)")
+    lines.append("")
+    lines.append("# device memory")
+    mem = report.get("memory") or {}
+    for dev, row in (mem.get("devices") or {}).items():
+        lines.append(f"{dev}: in_use={row.get('bytes_in_use')} "
+                     f"peak={row.get('peak_bytes_in_use')} "
+                     f"limit={row.get('bytes_limit')}")
+    if not mem.get("devices"):
+        lines.append("(backend reports no memory stats)")
+    lines.append(f"live_buffers: {mem.get('live_buffers')}")
+    lines.append("")
+    lines.append("# steps")
+    steps = report.get("steps") or {}
+    lines.append(f"iterations={steps.get('iterations')} "
+                 f"examples={steps.get('examples')}")
+    for k in ("step_ms", "etl_ms"):
+        s = steps.get(k)
+        if s:
+            lines.append(f"{k}: mean={s.get('mean_ms'):.3f} "
+                         f"p50={s.get('p50_ms'):.3f} "
+                         f"p95={s.get('p95_ms'):.3f} n={int(s.get('n', 0))}")
+    return "\n".join(lines) + "\n"

@@ -138,17 +138,41 @@ class Tracer:
                           "span_id": f"{ctx.span_id:x}", **args}
             if ctx.parent_span_id:
                 ev["args"]["parent_span_id"] = f"{ctx.parent_span_id:x}"
-            with self._lock:
-                overflow = len(self._events) == self._events.maxlen
-                if overflow:
-                    self.dropped += 1
-                self._events.append(ev)
+            self._append(ev)
+
+    def record_complete(self, name: str, start: float, dur: float,
+                        cat: str = "host", **args):
+        """Record an ALREADY-timed span after the fact — for events only
+        detectable at their end (e.g. a jit compile, recognized by the
+        cache-size delta once the call returns). ``start`` is the
+        ``perf_counter`` value at the event's start, ``dur`` seconds. The
+        span is parented under the innermost OPEN span on this thread (a
+        compile detected mid-step nests under the step span) but does not
+        touch the context stack itself."""
+        up = self.current_span()
+        ctx = SpanContext(up.trace_id if up else _new_id(), _new_id(),
+                          up.span_id if up else 0)
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": (start - self._t0) * 1e6, "dur": dur * 1e6,
+              "pid": os.getpid(), "tid": threading.get_ident(),
+              "args": {"trace_id": f"{ctx.trace_id:x}",
+                       "span_id": f"{ctx.span_id:x}", **args}}
+        if ctx.parent_span_id:
+            ev["args"]["parent_span_id"] = f"{ctx.parent_span_id:x}"
+        self._append(ev)
+
+    def _append(self, ev: Dict):
+        with self._lock:
+            overflow = len(self._events) == self._events.maxlen
             if overflow:
-                # registry write OUTSIDE the ring lock (scrapes take both)
-                from .registry import get_registry
-                get_registry().counter(
-                    "tracer_spans_dropped_total",
-                    "spans evicted from the trace ring buffer").inc()
+                self.dropped += 1
+            self._events.append(ev)
+        if overflow:
+            # registry write OUTSIDE the ring lock (scrapes take both)
+            from .registry import get_registry
+            get_registry().counter(
+                "tracer_spans_dropped_total",
+                "spans evicted from the trace ring buffer").inc()
 
     def trace(self, name: Optional[str] = None, cat: str = "host"):
         """Decorator form: ``@tracer.trace()`` spans every call."""

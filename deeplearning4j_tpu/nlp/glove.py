@@ -16,11 +16,12 @@ import jax
 import jax.numpy as jnp
 
 from .vocab import VocabCache, build_vocab
+from ..monitor.jitwatch import monitored_jit
 from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
                    SentenceIterator, TokenizerFactory)
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+@monitored_jit(name="nlp/glove_step", donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
 def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
     """One AdaGrad batch: J = f(x) (w_i·wc_j + b_i + bc_j − log x)²."""
     wi = w[rows]

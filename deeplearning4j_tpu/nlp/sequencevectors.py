@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from functools import partial
 
 from .vocab import VocabCache, VocabWord, Huffman, build_vocab
+from ..monitor.jitwatch import monitored_jit
 
 log = logging.getLogger(__name__)
 
@@ -72,7 +73,7 @@ class InMemoryLookupTable:
 # negative-sampling labels are synthesized on-device — so a batch costs one
 # 64 KB transfer instead of seven, and one compiled shape serves every batch.
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@monitored_jit(name="nlp/hs_step", donate_argnums=(0, 1))
 def _hs_step(syn0, syn1, packed, hs_points, hs_codes, hs_mask):
     """Hierarchical-softmax skip-gram/CBOW update, batched.
 
@@ -102,7 +103,7 @@ def _hs_step(syn0, syn1, packed, hs_points, hs_codes, hs_mask):
     return syn0, syn1
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@monitored_jit(name="nlp/ns_step", donate_argnums=(0, 1))
 def _ns_step(syn0, syn1neg, packed):
     """Negative-sampling update, single-transfer like :func:`_hs_step`.
 

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..compat import enable_x64 as _enable_x64
+from ..monitor.jitwatch import monitored_jit
 
 log = logging.getLogger(__name__)
 
@@ -70,7 +71,7 @@ def check_function_gradients(loss_fn, params, epsilon: float = 1e-6,
     ``expect_zero``: leaf-path substrings whose analytic gradient must be
     exactly zero (frozen layers) — those leaves skip the numeric comparison
     and instead assert the zero."""
-    loss_fn = jax.jit(loss_fn)
+    loss_fn = monitored_jit(loss_fn, name="gradientcheck/loss")
     analytic = jax.grad(loss_fn)(params)
     leaves = jax.tree_util.tree_flatten_with_path(params)[0]
     analytic_map = {_key_str(kp): np.asarray(v) for kp, v in
@@ -134,7 +135,8 @@ class GradientCheckUtil:
                 f"gradientcheck.double_precision() (reference "
                 f"GradientCheckUtil.java:122-127 double-precision rule)")
 
-        loss_fn = jax.jit(lambda p: _loss_at(net, p, ds))
+        loss_fn = monitored_jit(lambda p: _loss_at(net, p, ds),
+                                name="gradientcheck/loss_at")
         analytic = jax.grad(loss_fn)(net.params)
         analytic_leaves = {}
         for keypath, leaf in jax.tree_util.tree_flatten_with_path(analytic)[0]:

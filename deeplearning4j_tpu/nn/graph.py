@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .conf import BackpropType, CacheMode, GradientNormalization
+from ..monitor.jitwatch import monitored_jit
 from .conf.graph import ComputationGraphConfiguration
 from .conf.layers import Layer
 from .conf.inputs import InputTypeConvolutional
@@ -295,7 +296,8 @@ class ComputationGraph:
         n_iter = 1 if single_iteration else _n_iterations(self.gc)
         if n_iter > 1:
             step = _scan_iterations(step, n_iter, with_rnn_state=with_rnn_state)
-        return jax.jit(step, donate_argnums=(0, 2))
+        return monitored_jit(step, name="cg/step",
+                             donate_argnums=(0, 2))
 
     def _ensure_step(self, single_iteration=False):
         if single_iteration and _n_iterations(self.gc) > 1:
@@ -344,25 +346,33 @@ class ComputationGraph:
         # halt would silently truncate every later fit to a single batch
         self.halt_requested = False
         _mon.get_health().clear_halt()
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            with _mon.get_tracer().span("epoch", cat="train",
-                                        epoch=self.epoch_count):
-                t_etl = time.perf_counter()
-                for ds in it:
-                    self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
-                    self._fit_batch(ds)
-                    if self.halt_requested:
-                        break
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                with _mon.get_tracer().span("epoch", cat="train",
+                                            epoch=self.epoch_count):
                     t_etl = time.perf_counter()
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            if self.halt_requested:
-                log.warning("fit halted at epoch %d (halt_requested; see "
-                            "TrainingHealthListener)", self.epoch_count)
-                break
+                    for ds in it:
+                        self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                        self._fit_batch(ds)
+                        if self.halt_requested:
+                            break
+                        t_etl = time.perf_counter()
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch_count)
+                self.epoch_count += 1
+                if self.halt_requested:
+                    log.warning("fit halted at epoch %d (halt_requested; see "
+                                "TrainingHealthListener)", self.epoch_count)
+                    break
+        except BaseException as e:
+            # error seam: listeners holding process-global resources (an
+            # active ProfilerListener trace window) must release them
+            # before the exception unwinds out of fit
+            from ..optimize.listeners import dispatch_training_error
+            dispatch_training_error(self, self.listeners, e)
+            raise
         return self
 
     def _as_multi(self, ds):
@@ -472,7 +482,8 @@ class ComputationGraph:
                                                     rnn_state_in=rnn_state)
                 outs = tuple(acts[n] for n in self.conf.network_outputs)
                 return outs, ctx.get("rnn_state_out")
-            self._jit_rnn_step = jax.jit(fwd)
+            self._jit_rnn_step = monitored_jit(fwd,
+                                               name="cg/rnn_step")
         outs, self._rnn_state = self._jit_rnn_step(self.params, self.states, xs,
                                                    self._rnn_state)
         if single_step:
@@ -514,7 +525,8 @@ class ComputationGraph:
                 new_params = _tm(lambda p, u: p - u.astype(p.dtype), params, updates)
                 return new_params, new_upd
 
-            self._jit_ext_step = jax.jit(ext_step, donate_argnums=(0, 2))
+            self._jit_ext_step = monitored_jit(
+                ext_step, name="cg/ext_grad_step", donate_argnums=(0, 2))
         it = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.updater_state = self._jit_ext_step(
             self.params, self.states, self.updater_state, it, inputs, epsilons)
@@ -534,7 +546,8 @@ class ComputationGraph:
                 xs = self._adapt_inputs(xs)
                 acts, _, _, _ = self._apply_graph(params, states, xs, ms, train, None)
                 return tuple(acts[n] for n in self.conf.network_outputs)
-            self._jit_output[key] = jax.jit(fwd)
+            self._jit_output[key] = monitored_jit(fwd,
+                                                  name="cg/output")
         outs = self._jit_output[key](self.params, self.states, xs, ms)
         return outs[0] if len(outs) == 1 else list(outs)
 
@@ -571,7 +584,8 @@ class ComputationGraph:
                 loss, _ = self._loss_fn(params, states, xs, labels, fms,
                                         lms, training, None)
                 return loss
-            self._jit_score[key] = jax.jit(score_fn)
+            self._jit_score[key] = monitored_jit(score_fn,
+                                                 name="cg/score")
         loss = self._jit_score[key](self.params, self.states, inputs, labels,
                                     fms, lms)
         return float(loss)

@@ -41,6 +41,7 @@ from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
 from ..datasets.iterators import AsyncDataSetIterator
 from ..optimize.updater import NetworkUpdater, normalize_gradients
 from .. import monitor as _mon
+from ..monitor.jitwatch import monitored_jit
 
 log = logging.getLogger(__name__)
 
@@ -109,7 +110,8 @@ def _build_tbptt_scan(step, n_iter):
             body, init, (f_s, l_s, fm_s, lm_s))
         return params, states, upd, losses[-1]
 
-    return jax.jit(scanned, donate_argnums=(0, 2))
+    return monitored_jit(scanned, name="nn/tbptt_scan",
+                         donate_argnums=(0, 2))
 
 
 def _map_streams(fn, x):
@@ -513,7 +515,8 @@ class MultiLayerNetwork:
         n_iter = 1 if single_iteration else _n_iterations(self.gc)
         if n_iter > 1:
             step = _scan_iterations(step, n_iter, with_rnn_state)
-        return jax.jit(step, donate_argnums=(0, 2))
+        return monitored_jit(step, name="mln/step",
+                             donate_argnums=(0, 2))
 
     def _ensure_step(self, single_iteration=False):
         if single_iteration and _n_iterations(self.gc) > 1:
@@ -586,25 +589,33 @@ class MultiLayerNetwork:
         # halt would silently truncate every later fit to a single batch
         self.halt_requested = False
         _mon.get_health().clear_halt()
-        for epoch in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            with _mon.get_tracer().span("epoch", cat="train",
-                                        epoch=self.epoch_count):
-                t_etl = time.perf_counter()
-                for ds in it:
-                    self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
-                    self._fit_batch(ds)
-                    if self.halt_requested:
-                        break
+        try:
+            for epoch in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                with _mon.get_tracer().span("epoch", cat="train",
+                                            epoch=self.epoch_count):
                     t_etl = time.perf_counter()
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            if self.halt_requested:
-                log.warning("fit halted at epoch %d (halt_requested; see "
-                            "TrainingHealthListener)", self.epoch_count)
-                break
+                    for ds in it:
+                        self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                        self._fit_batch(ds)
+                        if self.halt_requested:
+                            break
+                        t_etl = time.perf_counter()
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch_count)
+                self.epoch_count += 1
+                if self.halt_requested:
+                    log.warning("fit halted at epoch %d (halt_requested; see "
+                                "TrainingHealthListener)", self.epoch_count)
+                    break
+        except BaseException as e:
+            # error seam: listeners holding process-global resources (an
+            # active ProfilerListener trace window) must release them
+            # before the exception unwinds out of fit
+            from ..optimize.listeners import dispatch_training_error
+            dispatch_training_error(self, self.listeners, e)
+            raise
         return self
 
     def _fit_batch(self, ds: DataSet, single_iteration=False):
@@ -703,7 +714,8 @@ class MultiLayerNetwork:
                              updates)
             return new_params, new_upd, loss
 
-        jstep = jax.jit(step, donate_argnums=(0, 1))
+        jstep = monitored_jit(step, name="mln/pretrain_step",
+                              donate_argnums=(0, 1))
         upd_state = updater.init_state(self.params[key])
         it_count = 0
         for _ in range(epochs):
@@ -735,7 +747,8 @@ class MultiLayerNetwork:
                 return y
             # jax.jit itself specializes per shape/dtype; one callable per
             # (train, has_mask) keeps the python-side cache bounded
-            self._jit_output[key] = jax.jit(fwd)
+            self._jit_output[key] = monitored_jit(fwd,
+                                                  name="mln/output")
         return self._jit_output[key](self.params, self.states, x, mask)
 
     def feed_forward(self, x, train=False):
@@ -787,7 +800,8 @@ class MultiLayerNetwork:
                 y, _, ctx = self._apply_layers(params, states, f, None, False,
                                                None, rnn_state_in=rnn_state)
                 return y, ctx.get("rnn_state_out")
-            self._jit_rnn_step = jax.jit(fwd)
+            self._jit_rnn_step = monitored_jit(fwd,
+                                               name="mln/rnn_step")
         y, self._rnn_state = self._jit_rnn_step(self.params, self.states, x,
                                                 self._rnn_state)
         return y[:, -1, :] if single_step else y
@@ -821,7 +835,8 @@ class MultiLayerNetwork:
                 loss, _ = self._loss_fn(params, states, f2, l, fm, lm,
                                         training, None)
                 return loss
-            self._jit_score[key] = jax.jit(score_fn)
+            self._jit_score[key] = monitored_jit(score_fn,
+                                                 name="mln/score")
         loss = self._jit_score[key](self.params, self.states, f, l, fm, lm)
         return float(loss)
 

@@ -39,8 +39,31 @@ class TrainingListener:
     def on_backward_pass(self, model):
         pass
 
+    def on_training_error(self, model, exception):
+        """``fit`` is unwinding on ``exception`` — release any
+        process-global resource this listener holds (e.g. an active
+        ``jax.profiler`` trace window). Must not raise; a failing
+        cleanup hook is logged and skipped, never masks the original
+        error."""
+        pass
+
 
 IterationListener = TrainingListener  # reference naming alias
+
+
+def dispatch_training_error(model, listeners, exception):
+    """Best-effort ``on_training_error`` fan-out from the fit loops'
+    except seam: every listener gets the hook even if an earlier one
+    fails, and nothing here can mask the original exception."""
+    for lst in listeners:
+        hook = getattr(lst, "on_training_error", None)
+        if hook is None:
+            continue
+        try:
+            hook(model, exception)
+        except Exception as e:
+            log.warning("on_training_error hook of %r failed: %r",
+                        lst, e)
 
 
 class ScoreIterationListener(TrainingListener):

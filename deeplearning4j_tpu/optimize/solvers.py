@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.conf import OptimizationAlgorithm
+from ..monitor.jitwatch import monitored_jit
 
 log = logging.getLogger(__name__)
 
@@ -83,8 +84,11 @@ class BaseOptimizer:
         def loss_on_tree(p):
             return _loss_at(net, p, ds)
 
-        self._loss_tree = jax.jit(loss_on_tree)
-        self._grad_tree = jax.jit(jax.value_and_grad(loss_on_tree))
+        self._loss_tree = monitored_jit(loss_on_tree,
+                                        name="solvers/loss")
+        self._grad_tree = monitored_jit(
+            jax.value_and_grad(loss_on_tree),
+            name="solvers/value_and_grad")
 
     def f(self, x: np.ndarray) -> float:
         return float(self._loss_tree(_unflatten_params(x, self._meta,

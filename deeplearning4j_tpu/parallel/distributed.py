@@ -32,6 +32,7 @@ import numpy as np
 import jax
 
 from .sharding import DATA_AXIS, make_mesh
+from ..monitor.jitwatch import monitored_jit
 from .wrapper import ParallelWrapper, TrainingMode
 from .accumulation import EncodedGradientsAccumulator
 
@@ -276,14 +277,17 @@ class SharedGradientsClusterTrainer:
         self.net = net
         self.channel = channel
         self.accumulator = accumulator or EncodedGradientsAccumulator()
-        self._update_step = jax.jit(net._raw_update_step(),
-                                    donate_argnums=(2,))
+        self._update_step = monitored_jit(net._raw_update_step(),
+                                          name="distributed/update_step",
+                                          donate_argnums=(2,))
 
         def apply_fn(params, update):
             return jax.tree_util.tree_map(
                 lambda p, u: p - u.astype(p.dtype), params, update)
 
-        self._apply_step = jax.jit(apply_fn, donate_argnums=(0,))
+        self._apply_step = monitored_jit(apply_fn,
+                                         name="distributed/apply_step",
+                                         donate_argnums=(0,))
         self.wire_bytes_sent = 0
         self.dense_bytes_equiv = 0
 

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .sharding import DATA_AXIS, make_mesh, replicated, batch_sharded
+from ..monitor.jitwatch import monitored_jit
 
 class InferenceMode:
     SEQUENTIAL = "sequential"
@@ -94,8 +95,9 @@ class ParallelInference:
                 return y
             repl = replicated(self.mesh)
             data = batch_sharded(self.mesh)
-            self._jit_fwd = jax.jit(fwd, in_shardings=(repl, repl, data),
-                                    out_shardings=data)
+            self._jit_fwd = monitored_jit(
+                fwd, name="inference/fwd",
+                in_shardings=(repl, repl, data), out_shardings=data)
             net.params = jax.device_put(net.params, repl)
             net.states = jax.device_put(net.states, repl)
         b = x.shape[0]

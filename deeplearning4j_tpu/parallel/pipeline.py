@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
+from ..monitor.jitwatch import monitored_jit
 
 from .sharding import pvary
 
@@ -256,10 +257,11 @@ class GPipe:
         tree_sh = {"blocks": blk, "head": repl}
         dsh = (NamedSharding(self.mesh, P(self.data_axis))
                if self.data_axis else repl)
-        return jax.jit(step,
-                       in_shardings=(tree_sh, tree_sh, repl, dsh, dsh),
-                       out_shardings=(tree_sh, tree_sh, repl),
-                       donate_argnums=(0, 1))
+        return monitored_jit(
+            step, name="pipeline/step",
+            in_shardings=(tree_sh, tree_sh, repl, dsh, dsh),
+            out_shardings=(tree_sh, tree_sh, repl),
+            donate_argnums=(0, 1))
 
     def train_step(self, params, upd_state, iteration, x, y):
         """One pipelined training step. Returns (params, upd_state, loss)."""
@@ -627,11 +629,11 @@ class _PipelinedBase:
         repl = NamedSharding(self.mesh, P())
         dsh = (NamedSharding(self.mesh, P(self.data_axis))
                if self.data_axis else repl)
-        return jax.jit(step,
-                       in_shardings=(sh, sh, sh, repl, repl, dsh, dsh, dsh,
-                                     dsh),
-                       out_shardings=(sh, sh, sh, repl),
-                       donate_argnums=(0, 1, 2))
+        return monitored_jit(
+            step, name="pipeline/container_step",
+            in_shardings=(sh, sh, sh, repl, repl, dsh, dsh, dsh, dsh),
+            out_shardings=(sh, sh, sh, repl),
+            donate_argnums=(0, 1, 2))
 
     def fit_batch(self, f, l, features_mask=None, labels_mask=None):
         """One pipelined optimizer step on a (features, labels) batch — each

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
+from ..monitor.jitwatch import monitored_jit
 
 from .sharding import SEQUENCE_AXIS, pvary
 
@@ -661,7 +662,8 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                    in_specs=(repl, repl, repl, repl, repl, tsh, tsh),
                    out_specs=(repl, repl, repl, repl),
                    check_vma=False)
-    step = jax.jit(fn, donate_argnums=(0, 2) if donate else ())
+    step = monitored_jit(fn, name="sequence/step",
+                         donate_argnums=(0, 2) if donate else ())
 
     def place(model):
         r = NamedSharding(mesh, P())

@@ -20,6 +20,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 import jax
+
+from ..monitor.jitwatch import monitored_jit
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
@@ -169,8 +171,9 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
            if shard_params else repl)
     in_sh = (par, repl, upd, repl, repl, data, data, data, data)
     out_sh = (par, repl, upd, repl)
-    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
-                   donate_argnums=(0, 2) if donate else ())
+    return monitored_jit(raw, name="sharding/dp_step",
+                         in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=(0, 2) if donate else ())
 
 
 def _rnn_state_shardings(net, mesh: Mesh, axis: str):
@@ -203,8 +206,9 @@ def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
            if shard_params else repl)
     in_sh = (par, repl, upd, repl, repl, data, data, data, data, state_sh)
     out_sh = (par, repl, upd, repl, state_sh)
-    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
-                   donate_argnums=(0, 2) if donate else ())
+    return monitored_jit(raw, name="sharding/dp_tbptt_step",
+                         in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=(0, 2) if donate else ())
 
 
 def data_parallel_tbptt_update_step(net, mesh: Mesh, axis: str = DATA_AXIS):
@@ -217,8 +221,9 @@ def data_parallel_tbptt_update_step(net, mesh: Mesh, axis: str = DATA_AXIS):
     state_sh = _rnn_state_shardings(net, mesh, axis)
     in_sh = (repl, repl, repl, repl, repl, data, data, data, data, state_sh)
     out_sh = (repl, repl, repl, repl, state_sh)
-    return jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
-                   donate_argnums=(2,))
+    return monitored_jit(raw, name="sharding/dp_tbptt_update_step",
+                         in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=(2,))
 
 
 def pvary(x, axis_names):

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .sharding import DATA_AXIS, MODEL_AXIS, batch_sharded, replicated
+from ..monitor.jitwatch import monitored_jit
 
 
 def megatron_rules(net, axis: str = MODEL_AXIS) -> Dict[str, P]:
@@ -122,8 +123,9 @@ def tensor_parallel_step(net, mesh: Mesh, rules: Optional[Dict[str, P]] = None,
     in_sh = (p_sh, repl, upd_sh, repl, repl, data, data, None, None)
     out_sh = (p_sh, repl, upd_sh, repl)
 
-    step = jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh,
-                   donate_argnums=(0, 2) if donate else ())
+    step = monitored_jit(raw, name="tensor/step",
+                         in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=(0, 2) if donate else ())
 
     def place(model):
         model.params = jax.device_put(model.params, p_sh)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
+from ..monitor.jitwatch import monitored_jit
 
 from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
                        shard_batch, put_replicated, data_parallel_step,
@@ -404,7 +405,8 @@ class ParallelWrapper:
                                  data, data),
                        out_specs=(repl, repl, repl, repl),
                        check_vma=False)
-        self._local_sgd_step = jax.jit(fn, donate_argnums=(0, 2))
+        self._local_sgd_step = monitored_jit(
+            fn, name="wrapper/local_sgd_step", donate_argnums=(0, 2))
         return self._local_sgd_step
 
     # ------------------------------------------------------------------ fit
@@ -601,8 +603,8 @@ class ParallelWrapper:
         net = self.net
         repl = replicated(self.mesh)
         data = batch_sharded(self.mesh)
-        update_step = jax.jit(
-            net._raw_update_step(),
+        update_step = monitored_jit(
+            net._raw_update_step(), name="wrapper/shared_update_step",
             in_shardings=(repl, repl, repl, repl, repl, data, data, data, data),
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(2,))
@@ -611,7 +613,8 @@ class ParallelWrapper:
             new = _tm(lambda p, u: p - u.astype(p.dtype), params, update)
             return net._apply_constraints(new)
 
-        apply_step = jax.jit(apply_fn, out_shardings=repl, donate_argnums=(0,))
+        apply_step = monitored_jit(apply_fn, name="wrapper/shared_apply_step",
+                                   out_shardings=repl, donate_argnums=(0,))
         self._shared_steps = (update_step, apply_step)
         return self._shared_steps
 

@@ -28,6 +28,7 @@ import numpy as np
 import jax
 
 from ..monitor import get_flight_recorder
+from ..monitor.jitwatch import monitored_jit
 from ..parallel.distributed import TrainingMaster
 from ..parallel.accumulation import (EncodedGradientsAccumulator,
                                      flatten_tree_f32)
@@ -222,14 +223,17 @@ class ParameterServerTrainingMaster(TrainingMaster):
             if self._step_net is not None:
                 self.accumulator.reset()
             self._step_net = net
-            self._update_step = jax.jit(net._raw_update_step(),
-                                        donate_argnums=(2,))
+            self._update_step = monitored_jit(
+                net._raw_update_step(), name="paramserver/update_step",
+                donate_argnums=(2,))
 
             def apply_fn(params, update):
                 return jax.tree_util.tree_map(
                     lambda p, u: p - u.astype(p.dtype), params, update)
 
-            self._apply_step = jax.jit(apply_fn, donate_argnums=(0,))
+            self._apply_step = monitored_jit(
+                apply_fn, name="paramserver/apply_step",
+                donate_argnums=(0,))
 
     # ------------------------------------------------------------ training
     def execute_training(self, net, iterator):

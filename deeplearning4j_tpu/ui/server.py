@@ -13,6 +13,9 @@ TPU-native equivalent of reference ``deeplearning4j-play``
    PS connectivity; HTTP 503 when unhealthy)
  - ``/trace``                — Chrome trace-event JSON from the monitor's
    span :class:`~deeplearning4j_tpu.monitor.Tracer` (open in Perfetto)
+ - ``/profile``              — step-anatomy report: per-fn jit compile
+   counts/times/flops, device-memory gauges, step/ETL timing split
+   (``?format=text`` for the terminal rendering)
  - ``/fleet``                — merged per-worker metrics (Prometheus text,
    ``worker`` label; ``?format=json`` for the liveness table) aggregated
    from ``OP_TELEMETRY`` reports on a paramserver-server process
@@ -36,7 +39,8 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..monitor import (get_fleet, get_flight_recorder, get_health,
-                       get_registry, get_tracer)
+                       get_registry, get_tracer, profile_report,
+                       render_profile_text, sample_device_memory)
 from .stats import StatsStorage, StatsReport, InMemoryStatsStorage
 
 #: POST bodies larger than this are refused with 413 (a remote stats report
@@ -161,7 +165,10 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         if url.path == "/metrics":
-            # Prometheus scrape of the process-global monitor registry
+            # Prometheus scrape of the process-global monitor registry.
+            # Device-memory gauges are sampled scrape-time (pull-model
+            # freshness; a no-op on backends without memory stats).
+            sample_device_memory()
             payload = get_registry().render_prometheus().encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type",
@@ -176,6 +183,22 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if url.path == "/trace":
             self._json(get_tracer().export())
+            return
+        if url.path == "/profile":
+            # step-anatomy report (docs/OBSERVABILITY.md "Compilation &
+            # memory"): per-fn jit compile/call/cost table + device-memory
+            # gauges + the step/ETL timing split, one view
+            rep = profile_report()
+            if q.get("format", [""])[0] == "text":
+                payload = render_profile_text(rep).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            self._json(rep)
             return
         if url.path == "/fleet":
             # merged per-worker registry view (OP_TELEMETRY reports landed

@@ -103,6 +103,12 @@ class ProfilerListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         self.close()
 
+    def on_training_error(self, model, exception):
+        # fit raised mid-window: an active jax.profiler trace is
+        # process-global and leaking it breaks the NEXT start_trace —
+        # the fit loops' error seam guarantees this close runs
+        self.close()
+
 
 class StepTimerListener(TrainingListener):
     """Per-iteration wall-clock times with a value-fetch barrier.
@@ -161,7 +167,8 @@ def step_cost(net, ds) -> Dict[str, Any]:
         batch = int(ds.features[0].shape[0])
 
     raw = net._raw_step(False)  # both containers take with_rnn_state
-    lowered = jax.jit(raw).lower(
+    from ..monitor.jitwatch import monitored_jit
+    lowered = monitored_jit(raw, name="profiling/step_cost").lower(
         net.params, net.states, net.updater_state,
         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
         feats, labels, None, None)

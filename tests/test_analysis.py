@@ -17,10 +17,14 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          DEFAULT_BASELINE_PATH,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
-RULE_IDS = {"JAX001", "JAX002", "THR001", "THR002", "EXC001"}
+RULE_IDS = {"JAX001", "JAX002", "JAX003", "THR001", "THR002",
+            "EXC001"}
 
 
-def lint_src(src, rules=None, path="fixture.py"):
+# default fixture path lives under tests/ so the JAX003 bare-jit rule
+# (tests-exempt by design) does not fire on every jax.jit fixture the
+# OTHER rules legitimately use; JAX003 tests pass package-like paths
+def lint_src(src, rules=None, path="tests/fixture.py"):
     return Linter(rules=rules).lint_source(textwrap.dedent(src), path)
 
 
@@ -557,3 +561,81 @@ def test_unreadable_file_reports_finding_not_crash(tmp_path, capsys):
     assert "2 files" in out                      # ok.py still got linted
     assert cli_main(["lint", str(tmp_path / "nope.py")]) == 1
     assert "cannot read file" in capsys.readouterr().out
+
+
+# --------------------------------------------- JAX003 bare jax.jit sites
+def test_jax003_flags_call_decorator_and_partial_forms():
+    src = """
+        import jax
+        from functools import partial
+
+        def build(step):
+            return jax.jit(step, donate_argnums=(0,))
+
+        @jax.jit
+        def f(x):
+            return x
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def g(x):
+            return x
+        """
+    fs = lint_src(src, path="deeplearning4j_tpu/somemod.py")
+    assert rule_ids(fs) == ["JAX003"] * 3
+    assert "monitored_jit" in fs[0].message
+
+
+def test_jax003_flags_bare_jit_import():
+    fs = lint_src("""
+        from jax import jit
+
+        def build(step):
+            return jit(step)
+        """, path="deeplearning4j_tpu/somemod.py")
+    assert rule_ids(fs) == ["JAX003"]
+
+
+def test_jax003_follows_module_aliases():
+    # `import jax as j; j.jit(...)` must not evade the guard
+    fs = lint_src("""
+        import jax as j
+
+        def build(step):
+            return j.jit(step)
+        """, path="deeplearning4j_tpu/somemod.py")
+    assert rule_ids(fs) == ["JAX003"]
+
+
+def test_jax003_accepts_monitored_jit_and_exempt_paths():
+    src = """
+        from deeplearning4j_tpu.monitor.jitwatch import monitored_jit
+
+        def build(step):
+            return monitored_jit(step, name="area/step", donate_argnums=(0,))
+        """
+    assert lint_src(src, path="deeplearning4j_tpu/somemod.py") == []
+    bare = """
+        import jax
+
+        def build(step):
+            return jax.jit(step)
+        """
+    # tests/ and jitwatch.py itself are exempt by design
+    assert lint_src(bare, path="tests/test_x.py") == []
+    assert lint_src(bare,
+                    path="deeplearning4j_tpu/monitor/jitwatch.py") == []
+    assert lint_src(bare, path="deeplearning4j_tpu/x.py") != []
+
+
+def test_jax001_follows_monitored_jit_wrapped_defs():
+    # the migration must not blind JAX001: a monitored_jit-wrapped def is
+    # just as traced as a jax.jit-wrapped one
+    fs = lint_src("""
+        from ..monitor.jitwatch import monitored_jit
+
+        def build(self):
+            def step(x):
+                return float(x.sum())
+            return monitored_jit(step, name="mln/step")
+        """, rules=["JAX001"])
+    assert rule_ids(fs) == ["JAX001"]

@@ -267,6 +267,39 @@ def test_monitor_events_subcommand_smoke(capsys):
         srv_ui.stop()
 
 
+def test_monitor_profile_subcommand_smoke(capsys):
+    """`monitor --profile`: the step-anatomy report, local and over --url,
+    text and JSON (docs/OBSERVABILITY.md "Compilation & memory")."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.monitor import monitored_jit
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    f = monitored_jit(lambda x: x + 1, name="cli/profile_probe")
+    f(jnp.ones((2,)))
+    assert main(["monitor", "--profile"]) == 0
+    out = capsys.readouterr().out
+    assert "cli/profile_probe" in out and "# device memory" in out
+
+    assert main(["monitor", "--profile", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["jit"]["cli/profile_probe"]["compiles"] == 1
+    assert "memory" in doc and "steps" in doc
+
+    srv_ui = UIServer(port=0)
+    srv_ui.attach(InMemoryStatsStorage())
+    port = srv_ui.start()
+    try:
+        assert main(["monitor", "--profile", "--url",
+                     f"127.0.0.1:{port}"]) == 0
+        assert "cli/profile_probe" in capsys.readouterr().out
+        assert main(["monitor", "--profile", "--url",
+                     f"127.0.0.1:{port}", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "cli/profile_probe" in doc["jit"]
+    finally:
+        srv_ui.stop()
+
+
 def test_lint_subcommand_smoke(tmp_path, capsys):
     """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over the
     shipped package (self-hosting against analysis/baseline.json), emits

@@ -304,3 +304,43 @@ def test_healthz_folds_in_fleet_liveness():
     finally:
         fleet.clear()
     assert "fleet" not in get_health().snapshot()   # empty table: no block
+
+
+def test_jitwatch_and_memory_series_flow_through_fleet():
+    """PR-5 satellite pin: jitwatch compile counters and the device-memory
+    gauges are plain registry series, so they must ride OP_TELEMETRY into
+    ``GET /fleet`` with the worker label attached and fn/device labels
+    unchanged — compile storms on a remote worker are visible from the
+    server's scrape with zero extra wiring."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.monitor import (monitored_jit,
+                                            sample_device_memory)
+
+    f = monitored_jit(lambda x: x * 2, name="fleettest/step")
+    f(jnp.ones((4,)))                 # populates jit_* in the GLOBAL registry
+    sample_device_memory()            # populates device_live_buffers
+    fleet = get_fleet()
+    fleet.clear()
+    try:
+        with ParameterServer(port=0) as srv:
+            master = ParameterServerTrainingMaster(
+                srv.address, staleness=0, backoff=0.01, worker_id="wjit",
+                telemetry_interval=0.0)
+            master.execute_training(_toy_net(seed=9),
+                                    ListDataSetIterator(_toy_batches(n=1)))
+            ui = UIServer(port=0)
+            ui.attach(InMemoryStatsStorage())
+            port = ui.start()
+            try:
+                text = _get(port, "/fleet")
+            finally:
+                ui.stop()
+        assert ('jit_compiles_total{fn="fleettest/step",worker="wjit"} 1'
+                in text)
+        assert 'jit_calls_total{fn="fleettest/step",worker="wjit"} 1' in text
+        # the worker's own training step rode along under its fn label too
+        assert ('jit_compiles_total{fn="paramserver/update_step",'
+                'worker="wjit"}' in text)
+        assert 'device_live_buffers{worker="wjit"}' in text
+    finally:
+        fleet.clear()
