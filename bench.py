@@ -268,6 +268,105 @@ def bench_graves_lstm(batch=64, seq_len=200, tbptt=50, vocab=80, width=512):
     return batch * seq_len * n / dt
 
 
+#: latched by bench_input_pipeline; embedded in its --one record so the
+#: BENCH trajectory carries the prefetch-off/on ETL comparison, not just
+#: the headline number
+INPUT_PIPELINE_STATS = {}
+
+
+def bench_input_pipeline(batch=256, n_batches=32, delay_ms=25.0, workers=8):
+    """Input-bound benchmark (datasets/prefetch.py): the base iterator
+    sleeps ``delay_ms`` per batch — a slow decode/augment stand-in — so a
+    synchronous fit pays the full ETL latency on the training thread every
+    step. Runs the same fit with the input pipeline OFF
+    (``DL4J_TPU_PREFETCH_WORKERS=0``) and ON (multi-worker prefetch +
+    device-put-ahead), reading ``etl_ms`` from the monitor registry, and
+    latches the comparison into ``INPUT_PIPELINE_STATS`` for the ``--one``
+    record. Headline value: images/sec with the pipeline on."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+    from deeplearning4j_tpu.monitor import get_registry
+
+    class SlowIter(DataSetIterator):
+        """Slow synthetic source: the per-batch cost (the sleep — a
+        decode/augment stand-in) sits in ``__next__`` itself, so only
+        CONCURRENT pulls can hide it. The counter is lock-guarded and the
+        sleep runs outside the lock: safe for N prefetch workers."""
+
+        def __init__(self, ds, n, delay_s):
+            self._ds, self._n, self._delay = ds, n, delay_s
+            self._pos = 0
+            self._lock = threading.Lock()
+
+        def __next__(self):
+            with self._lock:
+                if self._pos >= self._n:
+                    raise StopIteration
+                self._pos += 1
+            time.sleep(self._delay)
+            return self._ds
+
+        def reset(self):
+            with self._lock:
+                self._pos = 0
+
+        def batch(self):
+            return self._ds.num_examples()
+
+        def concurrent_pull_supported(self):
+            return True
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.05)).activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=256))
+            .layer(OutputLayer(n_in=256, n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(batch, 784)).astype(np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    etl_hist = get_registry().histogram(
+        "training_etl_ms", "host wait for the next minibatch")
+
+    def phase(n_workers):
+        prev = os.environ.get("DL4J_TPU_PREFETCH_WORKERS")
+        os.environ["DL4J_TPU_PREFETCH_WORKERS"] = str(n_workers)
+        try:
+            _, total0, n0 = etl_hist.state()
+            t0 = time.perf_counter()
+            net.fit(SlowIter(ds, n_batches, delay_ms / 1e3))
+            _sync(net.score_)
+            wall = time.perf_counter() - t0
+            _, total1, n1 = etl_hist.state()
+            served = max(n1 - n0, 1)
+            etl_mean = (total1 - total0) / served
+            return etl_mean, batch * served / wall
+        finally:
+            if prev is None:
+                os.environ.pop("DL4J_TPU_PREFETCH_WORKERS", None)
+            else:
+                os.environ["DL4J_TPU_PREFETCH_WORKERS"] = prev
+
+    net.fit(ds)                   # compile outside both timed phases
+    _sync(net.score_)
+    etl_sync, ips_sync = phase(0)
+    etl_pre, ips_pre = phase(workers)
+    INPUT_PIPELINE_STATS.update({
+        "delay_ms": delay_ms, "workers": workers, "batches": n_batches,
+        "etl_ms_sync": round(etl_sync, 3),
+        "etl_ms_prefetch": round(etl_pre, 3),
+        "etl_reduction": round(etl_sync / max(etl_pre, 1e-9), 1),
+        "overlap_ratio": round(1.0 - etl_pre / max(etl_sync, 1e-9), 4),
+        "sync_images_per_sec": round(ips_sync, 1),
+        "prefetch_images_per_sec": round(ips_pre, 1),
+    })
+    return ips_pre
+
+
 def bench_word2vec(n_sentences=20000, sent_len=40, vocab_target=5000):
     """Word2Vec skip-gram (HS) words/sec through the jitted kernels.
     800k-word corpus so steady-state batch throughput dominates the one-time
@@ -401,6 +500,7 @@ def bench_transformer_lm(batch=4, seq_len=8192, vocab=4096, embed=512,
 # headline has its own dedicated stage anyway.
 ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
+    ("input_pipeline_images_per_sec", "images/sec", bench_input_pipeline),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -834,7 +934,10 @@ def main():
             _write_partial(base_doc, {name: value})
         print(json.dumps({"one": name, "value": value,
                           "monitor": _monitor_snapshot(),
-                          "jitwatch": _jitwatch_snapshot()}))
+                          "jitwatch": _jitwatch_snapshot(),
+                          # prefetch-off/on ETL comparison — populated only
+                          # by the input_pipeline config, None elsewhere
+                          "input_pipeline": INPUT_PIPELINE_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

@@ -19,6 +19,8 @@ from .nn.multilayer import MultiLayerNetwork
 from .nn.graph import ComputationGraph
 from .nn.conf.graph import ComputationGraphConfiguration
 from .datasets.dataset import DataSet, MultiDataSet, DataSetIterator, ListDataSetIterator
+from .datasets.prefetch import PrefetchDataSetIterator
+from .datasets.bucketing import ShapeBucketingDataSetIterator
 from .datasets.normalizers import (NormalizerStandardize, NormalizerMinMaxScaler,
                                    ImagePreProcessingScaler)
 from .utils.model_serializer import ModelSerializer

@@ -1,0 +1,179 @@
+"""Shape-bucketed batching: feed the jit cache a CLOSED set of signatures.
+
+``jax.jit`` specializes per input shape, so a stream with ragged batch
+sizes (tail batches) or ragged sequence lengths re-traces and re-compiles
+the train step per distinct shape — the retrace-storm failure the
+jitwatch detector (JAX003 machinery, docs/OBSERVABILITY.md "Compilation &
+memory") diagnoses but cannot fix. This module is the fix the runbook
+points at: :class:`ShapeBucketingDataSetIterator` pads every batch up to
+a configurable set of bucket shapes (batch dim and, for sequence data,
+the time dim), guaranteeing the jitted step sees at most
+``len(batch_buckets) × len(time_buckets)`` signatures — measurable as the
+jitwatch cache-miss ratio flattening after warmup.
+
+Padding never trains, by the same masking conventions as
+``datasets/records.py`` (``SequenceRecordReaderDataSetIterator`` pads
+ragged sequences with zero features and a zero ``[b, T]`` mask):
+
+- padded time steps get a zero ``features_mask``/``labels_mask`` entry;
+- padded batch rows get a zero ``labels_mask`` row, so their loss
+  contribution is exactly 0;
+- the surviving mask entries are scaled by ``padded_b / real_b``
+  (``_reduce`` in ``nn/losses.py`` divides by the minibatch size, which
+  padding inflates — the rescale makes the bucketed loss AND its
+  gradients bit-match the unpadded batch, so bucketing changes compile
+  behavior, not training trajectories). ``rescale_loss=False`` keeps 0/1
+  masks if exact reference ``average=true`` semantics over the padded
+  size are wanted instead.
+
+A ``labels_mask`` is synthesized for EVERY batch (all-real batches get a
+constant one), and sequence batches always carry a ``features_mask`` —
+mask presence is part of the jit signature, so an optional mask would
+double the signature set the buckets exist to close.
+
+Caveats: batch-statistics layers (BatchNormalization) see the padded
+rows in their running statistics; evaluation treats mask values as
+weights, so pad rows (weight 0) drop out there too. Compose with
+:class:`~deeplearning4j_tpu.datasets.prefetch.PrefetchDataSetIterator`
+to move the padding work off the training thread.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet, DataSetIterator, MultiDataSet
+
+__all__ = ["ShapeBucketingDataSetIterator"]
+
+
+def _buckets(values: Sequence[int], kind: str):
+    out = sorted({int(v) for v in values})
+    if not out or out[0] < 1:
+        raise ValueError(f"{kind} buckets must be positive ints, got "
+                         f"{list(values)}")
+    return out
+
+
+def _bucket_for(buckets, n: int, kind: str) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(
+        f"{kind} size {n} exceeds the largest configured bucket "
+        f"{buckets[-1]} — add a bucket >= {n} (buckets: {buckets})")
+
+
+def _pad_axis0(arr: np.ndarray, b: int, t: Optional[int] = None):
+    """Zero-pad ``arr`` to ``b`` rows (and, when ``t`` is given and the
+    array has a time axis, ``t`` steps)."""
+    shape = list(arr.shape)
+    shape[0] = b
+    if t is not None and arr.ndim >= 2:
+        shape[1] = t
+    if shape == list(arr.shape):
+        return arr
+    out = np.zeros(shape, arr.dtype)
+    sl = (slice(0, arr.shape[0]),) + (
+        (slice(0, arr.shape[1]),) if t is not None and arr.ndim >= 2 else ())
+    out[sl] = arr
+    return out
+
+
+class ShapeBucketingDataSetIterator(DataSetIterator):
+    """Pad each batch up to the smallest admitting bucket shape.
+
+    ``batch_buckets``: allowed batch sizes (e.g. ``(32, 64, 128)``).
+    ``time_buckets``: allowed sequence lengths for rank-3 ``[b, T, f]``
+    features (None → the time dim passes through unbucketed).
+    ``rescale_loss``: scale the synthesized ``labels_mask`` by
+    ``padded_b / real_b`` so the padded batch's loss/gradients equal the
+    unpadded ones (see module docstring).
+    """
+
+    def __init__(self, base: DataSetIterator,
+                 batch_buckets: Sequence[int],
+                 time_buckets: Optional[Sequence[int]] = None,
+                 rescale_loss: bool = True):
+        self._base = base
+        self._bb = _buckets(batch_buckets, "batch")
+        self._tb = _buckets(time_buckets, "time") if time_buckets else None
+        self._rescale = bool(rescale_loss)
+        self._it = None
+
+    @property
+    def buckets(self):
+        return list(self._bb)
+
+    def __iter__(self):
+        self._it = iter(self._base)
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._it is None:
+            self._it = iter(self._base)
+        return self.pad(next(self._it))
+
+    def reset(self):
+        self._base.reset()
+        self._it = iter(self._base)
+
+    def batch(self):
+        return self._base.batch()
+
+    # ------------------------------------------------------------- padding
+    def pad(self, ds: DataSet) -> DataSet:
+        if isinstance(ds, MultiDataSet):
+            raise TypeError(
+                "ShapeBucketingDataSetIterator pads DataSet streams; wrap "
+                "the per-stream iterators before merging into MultiDataSets")
+        f = np.asarray(ds.features)
+        b = int(f.shape[0])
+        tb = _bucket_for(self._bb, b, "batch")
+        seq = f.ndim == 3
+        T = int(f.shape[1]) if seq else None
+        tt = (_bucket_for(self._tb, T, "time")
+              if seq and self._tb is not None else T)
+
+        out = DataSet(_pad_axis0(f, tb, tt if seq else None))
+        out.synthetic = getattr(ds, "synthetic", False)
+        if ds.labels is not None:
+            l = np.asarray(ds.labels)
+            # rank-2 labels are per-timestep SPARSE ids when they span the
+            # sequence's time dim ([b, T] integer classes — the keras
+            # sparse_categorical_crossentropy import shape); their time
+            # dim pads with the features'. Otherwise rank-2 labels are
+            # [b, n_classes] vectors and only the batch dim pads.
+            per_step = l.ndim == 3 or (seq and l.ndim == 2
+                                       and l.shape[1] == T)
+            out.labels = _pad_axis0(l, tb, tt if per_step else None)
+        if seq:
+            fm = (np.asarray(ds.features_mask, np.float32)
+                  if ds.features_mask is not None
+                  else np.ones((b, T), np.float32))
+            out.features_mask = _pad_axis0(fm, tb, tt)
+        if ds.labels is not None:
+            out.labels_mask = self._labels_mask(ds, b, tb, T, tt)
+        return out
+
+    def _labels_mask(self, ds: DataSet, b: int, tb: int,
+                     T: Optional[int], tt: Optional[int]) -> np.ndarray:
+        l = np.asarray(ds.labels)
+        per_step = l.ndim == 3 or (T is not None and l.ndim == 2
+                                   and l.shape[1] == T)
+        if ds.labels_mask is not None:
+            lm = np.asarray(ds.labels_mask, np.float32)
+        elif per_step:
+            # inherit the features mask so time padding in the LABELS also
+            # stays out of the loss (records.py convention: one [b, T] mask)
+            lm = (np.asarray(ds.features_mask, np.float32)
+                  if ds.features_mask is not None
+                  else np.ones((b, T), np.float32))
+        else:
+            lm = np.ones((b,), np.float32)
+        if self._rescale and tb != b:
+            # nn/losses._reduce divides by the PADDED minibatch size; the
+            # rescale restores the unpadded batch's loss/gradient magnitude
+            lm = lm * (tb / float(b))
+        return _pad_axis0(lm, tb, tt if lm.ndim >= 2 else None)

@@ -247,6 +247,16 @@ class DataSetIterator:
     def async_supported(self) -> bool:
         return True
 
+    def concurrent_pull_supported(self) -> bool:
+        """True when ``__next__`` is safe to call from MULTIPLE prefetch
+        workers at once (``datasets/prefetch.py``): required for a slow
+        *source* (disk decode, network fetch) to parallelize, not just a
+        slow transform. Default False — most iterators hold unguarded
+        position state. Opt in only when the iterator serializes its own
+        bookkeeping and tolerates best-effort ordering at the stream
+        tail."""
+        return False
+
 
 class ListDataSetIterator(DataSetIterator):
     """Reference ``ListDataSetIterator``: iterate a pre-built list of DataSets."""

@@ -79,7 +79,30 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._queue is None:
             self.reset()
         t0 = time.perf_counter()
-        item = self._queue.get()
+        while True:
+            # bounded get + liveness check: a worker that dies WITHOUT
+            # managing to enqueue its stop token (hard thread death, an
+            # error inside the finally) must re-raise on the consumer
+            # thread, not park fit on queue.get() forever
+            try:
+                item = self._queue.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if self._thread is not None and self._thread.is_alive():
+                    continue            # slow producer, not a dead one
+                # TOCTOU guard: the worker may have enqueued its final
+                # batch or stop token and exited between the timeout and
+                # the liveness check — drain once before declaring a crash
+                try:
+                    item = self._queue.get_nowait()
+                    break
+                except queue.Empty:
+                    pass
+                if self._exc is not None:
+                    raise self._exc
+                raise RuntimeError(
+                    "AsyncDataSetIterator: prefetch worker died without "
+                    "delivering a batch or a stop token")
         if item is self._STOP:
             if self._exc is not None:
                 raise self._exc

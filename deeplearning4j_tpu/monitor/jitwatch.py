@@ -632,6 +632,18 @@ def maybe_sample_device_memory():
 
 
 # ----------------------------------------------------------- step anatomy
+def _snap_value(snap, metric) -> Optional[float]:
+    """Sum of a snapshot family's scalar children (None when absent)."""
+    rows = snap.get(metric, [])
+    return sum(r.get("value", 0) for r in rows) if rows else None
+
+
+def _snap_summary(snap, metric) -> Optional[Dict[str, float]]:
+    """First child's histogram summary from a snapshot (None when absent)."""
+    rows = snap.get(metric, [])
+    return rows[0].get("summary") if rows else None
+
+
 def profile_report() -> Dict[str, Any]:
     """The step-anatomy report (``GET /profile`` / ``monitor --profile``):
     per-fn jit table + device memory + the step/ETL timing split, merged
@@ -641,12 +653,10 @@ def profile_report() -> Dict[str, Any]:
     snap = get_registry().snapshot()
 
     def value(metric):
-        rows = snap.get(metric, [])
-        return sum(r.get("value", 0) for r in rows) if rows else None
+        return _snap_value(snap, metric)
 
     def summary(metric):
-        rows = snap.get(metric, [])
-        return rows[0].get("summary") if rows else None
+        return _snap_summary(snap, metric)
 
     return {
         "jit": get_jit_registry().table(),
@@ -657,7 +667,40 @@ def profile_report() -> Dict[str, Any]:
             "step_ms": summary("training_step_ms"),
             "etl_ms": summary("training_etl_ms"),
         },
+        "pipeline": _pipeline_block(snap),
     }
+
+
+def _pipeline_block(snap) -> Dict[str, Any]:
+    """Input-pipeline anatomy (datasets/prefetch.py): queue depth, the
+    residual blocking wait, bytes fed, and the compute/ETL overlap split —
+    ``etl_fraction`` near 0 means prefetch+put-ahead hid the ETL behind
+    device compute; near 1 means the accelerator starves on input."""
+    # wait stats: mean/max/n only — all EXACT on LatencyHistogram. Its
+    # bucket quantiles assume ms-scale samples (first edge 0.1 units), so
+    # for a seconds-valued series every sub-100ms pop collapses into
+    # bucket 0 and p50/p95 would degenerate to the worst stall observed
+    w = _snap_summary(snap, "input_wait_seconds")
+    out: Dict[str, Any] = {
+        "queue_depth": _snap_value(snap, "input_queue_depth"),
+        "batches": _snap_value(snap, "input_batches_total"),
+        "bytes_total": _snap_value(snap, "input_bytes_total"),
+        "wait_seconds": (None if not w else
+                         {"mean_s": round(w["mean_ms"], 6),
+                          "max_s": round(w["max_ms"], 6),
+                          "n": int(w["n"])}),
+    }
+    etl = _snap_summary(snap, "training_etl_ms")
+    step = _snap_summary(snap, "training_step_ms")
+    if etl and step:
+        etl_total = etl["mean_ms"] * etl["n"]
+        step_total = step["mean_ms"] * step["n"]
+        out["etl_ms_total"] = round(etl_total, 3)
+        out["step_ms_total"] = round(step_total, 3)
+        if etl_total + step_total > 0:
+            out["etl_fraction"] = round(
+                etl_total / (etl_total + step_total), 4)
+    return out
 
 
 def render_profile_text(report: Dict[str, Any]) -> str:
@@ -704,4 +747,19 @@ def render_profile_text(report: Dict[str, Any]) -> str:
             lines.append(f"{k}: mean={s.get('mean_ms'):.3f} "
                          f"p50={s.get('p50_ms'):.3f} "
                          f"p95={s.get('p95_ms'):.3f} n={int(s.get('n', 0))}")
+    pipe = report.get("pipeline") or {}
+    if any(v is not None for v in pipe.values()):
+        lines.append("")
+        lines.append("# pipeline")
+        lines.append(f"queue_depth={pipe.get('queue_depth')} "
+                     f"batches={pipe.get('batches')} "
+                     f"bytes_total={pipe.get('bytes_total')}")
+        w = pipe.get("wait_seconds")
+        if w:
+            lines.append(f"wait_s: mean={w.get('mean_s'):.4f} "
+                         f"max={w.get('max_s'):.4f} n={int(w.get('n', 0))}")
+        if pipe.get("etl_fraction") is not None:
+            lines.append(f"etl_fraction={pipe['etl_fraction']} "
+                         f"(etl {pipe.get('etl_ms_total')} ms / step "
+                         f"{pipe.get('step_ms_total')} ms)")
     return "\n".join(lines) + "\n"

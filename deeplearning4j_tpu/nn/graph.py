@@ -37,7 +37,7 @@ from .layers.base import remat_enabled, remat_policy
 from .multilayer import _n_iterations, _scan_iterations
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
-from ..datasets.iterators import AsyncDataSetIterator
+from ..datasets.prefetch import wrap_for_training
 from ..optimize.updater import NetworkUpdater, normalize_gradients
 from .. import monitor as _mon
 
@@ -338,10 +338,10 @@ class ComputationGraph:
             data = DataSet(np.asarray(data), np.asarray(labels))
         if isinstance(data, (DataSet, MultiDataSet)):
             data = ListDataSetIterator([data])
-        it = data
-        if isinstance(it, DataSetIterator) and not isinstance(it, AsyncDataSetIterator):
-            if it.async_supported():
-                it = AsyncDataSetIterator(it, queue_size=2)
+        # multi-worker prefetch + device-put-ahead (datasets/prefetch.py):
+        # see MultiLayerNetwork.fit
+        it, own_pipeline = wrap_for_training(
+            data, cache_device=self.gc.cache_mode == CacheMode.DEVICE)
         # a new fit() supersedes a previous health halt — without this, one
         # halt would silently truncate every later fit to a single batch
         self.halt_requested = False
@@ -373,6 +373,9 @@ class ComputationGraph:
             from ..optimize.listeners import dispatch_training_error
             dispatch_training_error(self, self.listeners, e)
             raise
+        finally:
+            if own_pipeline:
+                it.shutdown()   # no prefetch worker outlives its fit
         return self
 
     def _as_multi(self, ds):
@@ -386,10 +389,21 @@ class ComputationGraph:
         """One minibatch. ``single_iteration=True`` applies exactly ONE
         optimizer update even under ``iterations(n)`` (ParallelWrapper
         tail-batch fallback — see MultiLayerNetwork._fit_batch)."""
-        if self.gc.cache_mode == CacheMode.DEVICE and isinstance(ds, DataSet):
-            # cache on the CALLER's DataSet — _as_multi builds a fresh
-            # wrapper per batch, so a wrapper-side cache would never hit
-            f, l, fm, lm = ds.device_arrays()
+        if isinstance(ds, DataSet):
+            if self.gc.cache_mode == CacheMode.DEVICE:
+                # cache on the CALLER's DataSet — _as_multi builds a fresh
+                # wrapper per batch, so a wrapper-side cache would never hit
+                f, l, fm, lm = ds.device_arrays()
+            else:
+                # direct, not via _as_multi: MultiDataSet.__init__ calls
+                # np.asarray, which would pull a put-ahead (device-resident)
+                # batch straight back to the host
+                f = jnp.asarray(ds.features)
+                l = jnp.asarray(ds.labels)
+                fm = (None if ds.features_mask is None
+                      else jnp.asarray(ds.features_mask))
+                lm = (None if ds.labels_mask is None
+                      else jnp.asarray(ds.labels_mask))
             inputs, labels = (f,), (l,)
             fms = None if fm is None else (fm,)
             lms = None if lm is None else (lm,)

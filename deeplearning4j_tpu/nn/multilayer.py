@@ -38,7 +38,7 @@ from .layers import impl_for
 from .layers.base import remat_enabled, remat_policy
 from .layers.recurrent import _BaseLSTMImpl
 from ..datasets.dataset import DataSet, DataSetIterator, ListDataSetIterator
-from ..datasets.iterators import AsyncDataSetIterator
+from ..datasets.prefetch import wrap_for_training
 from ..optimize.updater import NetworkUpdater, normalize_gradients
 from .. import monitor as _mon
 from ..monitor.jitwatch import monitored_jit
@@ -581,10 +581,12 @@ class MultiLayerNetwork:
         if self.conf.pretrain and not getattr(self, "_pretrained", False):
             self.pretrain(data)
             self._pretrained = True
-        it = data
-        if isinstance(it, DataSetIterator) and not isinstance(it, AsyncDataSetIterator):
-            if it.async_supported():
-                it = AsyncDataSetIterator(it, queue_size=2)
+        # multi-worker prefetch + device-put-ahead (datasets/prefetch.py):
+        # batch k+1 is transferred while step k computes, so etl_ms
+        # measures a queue pop. DL4J_TPU_PREFETCH_WORKERS=0 restores the
+        # fully synchronous path.
+        it, own_pipeline = wrap_for_training(
+            data, cache_device=self.gc.cache_mode == CacheMode.DEVICE)
         # a new fit() supersedes a previous health halt — without this, one
         # halt would silently truncate every later fit to a single batch
         self.halt_requested = False
@@ -616,6 +618,9 @@ class MultiLayerNetwork:
             from ..optimize.listeners import dispatch_training_error
             dispatch_training_error(self, self.listeners, e)
             raise
+        finally:
+            if own_pipeline:
+                it.shutdown()   # no prefetch worker outlives its fit
         return self
 
     def _fit_batch(self, ds: DataSet, single_iteration=False):

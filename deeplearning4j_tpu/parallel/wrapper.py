@@ -41,6 +41,7 @@ from ..nn.conf import BackpropType, CacheMode
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
                                 ListDataSetIterator)
 from ..datasets.iterators import AsyncDataSetIterator
+from ..datasets.prefetch import PrefetchDataSetIterator
 
 log = logging.getLogger(__name__)
 _tm = jax.tree_util.tree_map
@@ -61,6 +62,7 @@ class ParallelWrapper:
             self._net = net
             self._workers = None
             self._prefetch = 2
+            self._prefetch_workers = 2
             self._freq = 1
             self._mode = TrainingMode.AVERAGING
             self._report_after_avg = True
@@ -79,6 +81,17 @@ class ParallelWrapper:
             return self
 
         prefetchBuffer = prefetch_buffer
+
+        def prefetch_workers(self, n):
+            """Host ETL worker threads feeding the batch grouper
+            (``datasets/prefetch.py`` multi-worker pipeline; default 2).
+            The device placement itself stays with ``_global_batch`` —
+            it shards over the wrapper's mesh — so the workers
+            parallelize the iterator/decode/augment side only."""
+            self._prefetch_workers = int(n)
+            return self
+
+        prefetchWorkers = prefetch_workers
 
         def averaging_frequency(self, n):
             self._freq = int(n)
@@ -154,6 +167,7 @@ class ParallelWrapper:
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._net, workers=self._workers,
                                    prefetch_buffer=self._prefetch,
+                                   prefetch_workers=self._prefetch_workers,
                                    averaging_frequency=self._freq,
                                    training_mode=self._mode,
                                    report_score_after_averaging=self._report_after_avg,
@@ -164,7 +178,8 @@ class ParallelWrapper:
                                    host_transfer_dtype=self._host_dtype)
 
     def __init__(self, net, workers: Optional[int] = None,
-                 prefetch_buffer: int = 2, averaging_frequency: int = 1,
+                 prefetch_buffer: int = 2, prefetch_workers: int = 2,
+                 averaging_frequency: int = 1,
                  training_mode: str = TrainingMode.AVERAGING,
                  report_score_after_averaging: bool = True,
                  accumulator: Optional[GradientsAccumulator] = None,
@@ -224,6 +239,7 @@ class ParallelWrapper:
         self.sharded_cache_budget = int(
             os.environ.get("DL4J_TPU_PW_CACHE_BYTES", 4 << 30))
         self.prefetch_buffer = prefetch_buffer
+        self.prefetch_workers = max(0, int(prefetch_workers))
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.training_mode = training_mode
         self.report_score_after_averaging = report_score_after_averaging
@@ -416,19 +432,32 @@ class ParallelWrapper:
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
         it = data
+        owned = False
         if (isinstance(it, DataSetIterator)
-                and not isinstance(it, AsyncDataSetIterator)
-                and it.async_supported()):
-            it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
+                and not isinstance(it, (AsyncDataSetIterator,
+                                        PrefetchDataSetIterator))
+                and it.async_supported()
+                and self.prefetch_workers > 0):
+            # multi-worker host ETL ahead of the batch grouper. NO
+            # device_put here: placement is _global_batch's job — it
+            # merges one batch per device then shards over the mesh
+            it = PrefetchDataSetIterator(it, workers=self.prefetch_workers,
+                                         queue_size=self.prefetch_buffer,
+                                         device_put=False)
+            owned = True
         net = self.net
-        for _ in range(epochs):
-            if self.training_mode == TrainingMode.SHARED_GRADIENTS:
-                self._fit_shared(it)
-            elif self.averaging_frequency == 1:
-                self._fit_sync(it)
-            else:
-                self._fit_local_sgd(it)
-            net.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                if self.training_mode == TrainingMode.SHARED_GRADIENTS:
+                    self._fit_shared(it)
+                elif self.averaging_frequency == 1:
+                    self._fit_sync(it)
+                else:
+                    self._fit_local_sgd(it)
+                net.epoch_count += 1
+        finally:
+            if owned:
+                it.shutdown()
         return self
 
     def _device_put_model(self):

@@ -335,3 +335,17 @@ def test_driver_contract_deadline_self_exit(tmp_path):
     assert proc.returncode == 2
     assert docs and docs[-1]["value"] == 999.9
     assert docs[-1]["stale"] is True and "deadline" in docs[-1]["error"]
+
+
+def test_input_pipeline_bench_hides_etl(bench):
+    """Acceptance (PR 6): the input-bound bench must show etl_ms reduced
+    >= 5x with prefetch + device-put-ahead vs the synchronous path, and
+    latch the comparison for the --one record."""
+    value = bench.bench_input_pipeline(batch=32, n_batches=24,
+                                       delay_ms=20.0, workers=8)
+    stats = bench.INPUT_PIPELINE_STATS
+    assert value > 0
+    assert stats["etl_ms_sync"] >= 15.0          # the source really is slow
+    assert stats["etl_reduction"] >= 5.0
+    assert 0.0 < stats["overlap_ratio"] <= 1.0
+    assert stats["prefetch_images_per_sec"] > stats["sync_images_per_sec"]

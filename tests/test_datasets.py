@@ -266,3 +266,434 @@ def test_cache_mode_device_computation_graph_caches_on_dataset():
     first = ds.device_arrays()
     net.fit(ds)
     assert ds.device_arrays() is first  # cached across fits, on the DataSet
+
+
+# ---------------------------------------------------------------------------
+# PR 6: high-throughput input pipeline — multi-worker prefetch,
+# device-put-ahead, shape bucketing (datasets/prefetch.py, bucketing.py)
+# ---------------------------------------------------------------------------
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import (DataSetIterator,
+                                                 ListDataSetIterator)
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.prefetch import (PrefetchDataSetIterator,
+                                                  wrap_for_training)
+from deeplearning4j_tpu.datasets.bucketing import ShapeBucketingDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def _numbered(n, feat=3):
+    """n single-example DataSets whose feature value IS the index."""
+    return [DataSet(np.full((1, feat), i, np.float32),
+                    np.eye(2, dtype=np.float32)[[i % 2]]) for i in range(n)]
+
+
+def _dense_net(seed=7):
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+            .layer(DenseLayer(n_in=3, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestPrefetch:
+    def test_order_preserved_under_n_workers_across_epochs(self):
+        pf = PrefetchDataSetIterator(ListDataSetIterator(_numbered(50)),
+                                     workers=4)
+        try:
+            for _ in range(2):          # __iter__ resets: fresh epoch
+                got = [float(ds.features[0, 0]) for ds in pf]
+                assert got == [float(i) for i in range(50)]
+        finally:
+            pf.shutdown()
+
+    def test_worker_exception_propagates_in_order(self):
+        class Boom(ListDataSetIterator):
+            def __next__(self):
+                if self._pos == 5:
+                    raise ValueError("boom")
+                return super().__next__()
+
+        pf = PrefetchDataSetIterator(Boom(_numbered(20)), workers=3)
+        seen = []
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                for ds in pf:
+                    seen.append(float(ds.features[0, 0]))
+            # every batch BEFORE the failure was delivered, in order
+            assert seen == [0.0, 1.0, 2.0, 3.0, 4.0]
+        finally:
+            pf.shutdown()
+
+    def test_dead_workers_raise_instead_of_hanging(self):
+        """All workers dying WITHOUT an end-of-stream marker (hard thread
+        death) must surface as an error on the consumer, never a hang."""
+        pf = PrefetchDataSetIterator(ListDataSetIterator(_numbered(4)),
+                                     workers=2)
+        pf._worker_loop = lambda ep: None       # dies instantly, marks nothing
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="workers died"):
+            next(iter(pf))
+        assert time.perf_counter() - t0 < 10
+        pf.shutdown()
+
+    def test_reset_mid_epoch_no_leaked_threads(self):
+        base_threads = threading.active_count()
+        pf = PrefetchDataSetIterator(ListDataSetIterator(_numbered(30)),
+                                     workers=4)
+        it = iter(pf)
+        for _ in range(3):
+            next(it)
+        pf.reset()                          # mid-epoch: old workers joined
+        got = [float(ds.features[0, 0]) for ds in pf]
+        assert got == [float(i) for i in range(30)]  # clean fresh epoch
+        pf.shutdown()
+        deadline = time.time() + 5
+        while (threading.active_count() > base_threads
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert threading.active_count() <= base_threads
+
+    def test_device_put_ahead_delivers_device_arrays_and_identical_fit(self):
+        import os
+        import jax
+        rng = np.random.default_rng(0)
+        batches = [DataSet(rng.normal(size=(8, 3)).astype(np.float32),
+                           np.eye(2, dtype=np.float32)[
+                               rng.integers(0, 2, 8)]) for _ in range(5)]
+        pf = PrefetchDataSetIterator(ListDataSetIterator(list(batches)),
+                                     workers=2, device_put=True)
+        try:
+            ds = next(iter(pf))
+            assert isinstance(ds.features, jax.Array)   # put ahead of the step
+            assert isinstance(ds.labels, jax.Array)
+            # the caller's DataSet was NOT mutated (view, not in-place put)
+            assert isinstance(batches[0].features, np.ndarray)
+        finally:
+            pf.shutdown()
+        # prefetch+put-ahead is a transport change, not a math change:
+        # training through it is bit-identical to the synchronous path
+        old = os.environ.get("DL4J_TPU_PREFETCH_WORKERS")
+        try:
+            os.environ["DL4J_TPU_PREFETCH_WORKERS"] = "0"
+            a = _dense_net()
+            a.fit(ListDataSetIterator(list(batches)), epochs=2)
+            os.environ["DL4J_TPU_PREFETCH_WORKERS"] = "3"
+            b = _dense_net()
+            b.fit(ListDataSetIterator(list(batches)), epochs=2)
+        finally:
+            if old is None:
+                os.environ.pop("DL4J_TPU_PREFETCH_WORKERS", None)
+            else:
+                os.environ["DL4J_TPU_PREFETCH_WORKERS"] = old
+        for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                        jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_concurrent_pull_overlaps_slow_source(self):
+        """A pull-thread-safe source (declared via
+        concurrent_pull_supported) is pulled by N workers at once — the
+        only way a slow __next__ (decode/fetch) parallelizes."""
+        class SlowSafe(ListDataSetIterator):
+            def __init__(self, dsets):
+                super().__init__(dsets)
+                self._lock = threading.Lock()
+
+            def __next__(self):
+                with self._lock:
+                    if self._pos >= len(self._data):
+                        raise StopIteration
+                    d = self._data[self._pos]
+                    self._pos += 1
+                time.sleep(0.01)
+                return d
+
+            def concurrent_pull_supported(self):
+                return True
+
+        pf = PrefetchDataSetIterator(SlowSafe(_numbered(30)), workers=6)
+        try:
+            t0 = time.perf_counter()
+            got = [float(ds.features[0, 0]) for ds in pf]
+            dt = time.perf_counter() - t0
+        finally:
+            pf.shutdown()
+        assert sorted(got) == [float(i) for i in range(30)]   # none lost
+        assert dt < 0.30 * 0.7      # serial floor is 30 × 10 ms = 300 ms
+
+    def test_wrap_for_training_dials(self, monkeypatch):
+        base = ListDataSetIterator(_numbered(4))
+        monkeypatch.setenv("DL4J_TPU_PREFETCH_WORKERS", "0")
+        it, owned = wrap_for_training(base)
+        assert it is base and not owned            # 0 = fully synchronous
+        monkeypatch.setenv("DL4J_TPU_PREFETCH_WORKERS", "3")
+        it, owned = wrap_for_training(base)
+        assert isinstance(it, PrefetchDataSetIterator) and owned
+        assert it._workers == 3 and it._device_put
+        it.shutdown()
+        # never double-wrap an async iterator
+        it2, owned2 = wrap_for_training(AsyncDataSetIterator(base))
+        assert not owned2
+        monkeypatch.setenv("DL4J_TPU_PUT_AHEAD", "0")
+        it3, owned3 = wrap_for_training(base)
+        assert owned3 and not it3._device_put
+        it3.shutdown()
+
+    def test_pipeline_metrics_populated(self):
+        from deeplearning4j_tpu.monitor import get_registry, profile_report
+        reg = get_registry()
+        before = reg.counter("input_batches_total").value
+        pf = PrefetchDataSetIterator(ListDataSetIterator(_numbered(6)),
+                                     workers=2, device_put=True)
+        try:
+            list(pf)
+        finally:
+            pf.shutdown()
+        assert reg.counter("input_batches_total").value == before + 6
+        assert reg.counter("input_bytes_total").value > 0
+        _, _, n = reg.histogram("input_wait_seconds").state()
+        assert n >= 6
+        pipe = profile_report()["pipeline"]
+        assert pipe["batches"] >= 6
+        assert pipe["wait_seconds"] is not None
+
+
+class TestAsyncIteratorLiveness:
+    """Satellite: AsyncDataSetIterator.next must never block forever on a
+    dead worker (bounded-timeout get + liveness check)."""
+
+    def test_dead_worker_raises_promptly(self, monkeypatch):
+        # the deliberate thread death below would otherwise print through
+        # threading.excepthook and trip pytest's unhandled-thread warning
+        monkeypatch.setattr(threading, "excepthook", lambda args: None)
+        it = AsyncDataSetIterator(ListDataSetIterator(_numbered(4)))
+
+        def dying_worker(q, stop):        # hard death: no _exc, no _STOP
+            raise SystemExit
+
+        it._worker = dying_worker         # instance attr shadows the method
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="died without"):
+            next(iter(it))
+        assert time.perf_counter() - t0 < 10
+
+    def test_worker_exception_reraised_on_consumer(self):
+        class Boom(ListDataSetIterator):
+            def __next__(self):
+                if self._pos == 2:
+                    raise OSError("decode failed")
+                return super().__next__()
+
+        it = AsyncDataSetIterator(Boom(_numbered(6)))
+        seen = []
+        with pytest.raises(OSError, match="decode failed"):
+            for ds in it:
+                seen.append(float(ds.features[0, 0]))
+        assert seen == [0.0, 1.0]
+
+
+class TestShapeBucketing:
+    def test_batch_padding_shapes_and_masks(self):
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(5, 3)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)])
+        out = ShapeBucketingDataSetIterator(
+            ListDataSetIterator([ds]), batch_buckets=(8, 16)).pad(ds)
+        assert out.features.shape == (8, 3)
+        assert out.labels.shape == (8, 2)
+        np.testing.assert_array_equal(out.features[5:], 0.0)
+        # padding never trains: zero mask on pad rows, real rows rescaled
+        # by padded/real so the loss matches the unpadded batch
+        np.testing.assert_allclose(out.labels_mask[:5], 8 / 5)
+        np.testing.assert_array_equal(out.labels_mask[5:], 0.0)
+
+    def test_time_and_batch_padding_for_sequences(self):
+        rng = np.random.default_rng(1)
+        ds = DataSet(rng.normal(size=(3, 5, 4)).astype(np.float32),
+                     rng.normal(size=(3, 5, 2)).astype(np.float32),
+                     features_mask=np.ones((3, 5), np.float32))
+        it = ShapeBucketingDataSetIterator(ListDataSetIterator([ds]),
+                                           batch_buckets=(4,),
+                                           time_buckets=(4, 8))
+        out = it.pad(ds)
+        assert out.features.shape == (4, 8, 4)
+        assert out.labels.shape == (4, 8, 2)
+        assert out.features_mask.shape == (4, 8)
+        np.testing.assert_array_equal(out.features_mask[:, 5:], 0.0)
+        np.testing.assert_array_equal(out.features_mask[3:], 0.0)
+        np.testing.assert_allclose(out.labels_mask[:3, :5], 4 / 3)
+
+    def test_oversize_batch_rejected_loudly(self):
+        ds = DataSet(np.zeros((32, 3), np.float32),
+                     np.eye(2, dtype=np.float32)[[0] * 32])
+        it = ShapeBucketingDataSetIterator(ListDataSetIterator([ds]),
+                                           batch_buckets=(8, 16))
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            it.pad(ds)
+
+    def test_padded_fit_numerically_matches_unpadded(self):
+        import jax
+        rng = np.random.default_rng(2)
+        ds = DataSet(rng.normal(size=(5, 3)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)])
+        a, b = _dense_net(), _dense_net()
+        a.fit(ds)
+        b.fit(ShapeBucketingDataSetIterator(ListDataSetIterator([ds]),
+                                            batch_buckets=(8,)))
+        for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                        jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestBucketingClosesJitSignatures:
+    """Acceptance: a shape-churning stream through the bucketing iterator
+    records exactly ``len(buckets)`` jit compiles (no retrace storm),
+    while the unbucketed control feed trips the storm detector."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_monitor_state(self):
+        from deeplearning4j_tpu.monitor import (get_health,
+                                                get_flight_recorder,
+                                                get_jit_registry)
+        get_health().reset()
+        get_flight_recorder().clear()
+        get_jit_registry().drain_storms()
+        yield
+        get_health().reset()
+        get_flight_recorder().clear()
+        get_jit_registry().drain_storms()
+
+    @staticmethod
+    def _churny_batches(sizes, seed=0):
+        rng = np.random.default_rng(seed)
+        return [DataSet(rng.normal(size=(b, 3)).astype(np.float32),
+                        np.eye(2, dtype=np.float32)[rng.integers(0, 2, b)])
+                for b in sizes]
+
+    def test_bucketed_stream_compiles_exactly_len_buckets(self):
+        from deeplearning4j_tpu.monitor import get_flight_recorder
+        buckets = (8, 16)
+        net = _dense_net()
+        it = ShapeBucketingDataSetIterator(
+            ListDataSetIterator(self._churny_batches((5, 6, 7, 9, 11, 13))),
+            batch_buckets=buckets)
+        net.fit(it)
+        assert net._jit_step.compiles == len(buckets)
+        storms = [e for e in get_flight_recorder().events()
+                  if e["event"] == "retrace_storm"]
+        assert not storms
+
+    def test_unbucketed_control_trips_retrace_storm(self):
+        from deeplearning4j_tpu.monitor import get_flight_recorder
+        net = _dense_net()
+        net.fit(ListDataSetIterator(self._churny_batches((5, 6, 7, 9))))
+        assert net._jit_step.compiles == 4
+        storms = [e for e in get_flight_recorder().events()
+                  if e["event"] == "retrace_storm" and e["fn"] == "mln/step"]
+        assert storms, "shape churn did not trip the retrace-storm detector"
+
+    def test_concurrent_pull_never_loses_the_tail_batch(self):
+        """Regression (review finding): when workers' next() calls
+        complete out of claim order at stream end, the racing last item
+        must still be delivered — exhaustion is only final once every
+        in-flight pull has resolved. Stressed over many tiny epochs (the
+        original bug lost a batch in ~1/600 epochs)."""
+        class SafeIter(ListDataSetIterator):
+            def __init__(self, dsets):
+                super().__init__(dsets)
+                self._lock = threading.Lock()
+
+            def __next__(self):
+                with self._lock:
+                    if self._pos >= len(self._data):
+                        raise StopIteration
+                    d = self._data[self._pos]
+                    self._pos += 1
+                return d
+
+            def concurrent_pull_supported(self):
+                return True
+
+        pf = PrefetchDataSetIterator(SafeIter(_numbered(7)), workers=4)
+        try:
+            for _ in range(300):
+                got = sorted(float(ds.features[0, 0]) for ds in pf)
+                assert got == [float(i) for i in range(7)], got
+        finally:
+            pf.shutdown()
+
+    def test_dead_worker_with_queued_items_drains_before_raising(self):
+        """TOCTOU regression (review finding): a worker that enqueued its
+        final batch + stop token and exited must read as a normal end of
+        stream, not a crash — the consumer drains the queue before
+        declaring the dead worker an error."""
+        import queue as _queue
+        it = AsyncDataSetIterator(ListDataSetIterator(_numbered(1)))
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        it._queue = _queue.Queue()
+        it._queue.put(_numbered(1)[0])
+        it._queue.put(it._STOP)
+        it._thread = dead
+        it._exc = None
+        assert float(next(it).features[0, 0]) == 0.0
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_rank2_per_timestep_sparse_labels_pad_and_train(self):
+        """Regression (review finding): [b, T] integer per-timestep labels
+        (the keras sparse_categorical_crossentropy import shape) must pad
+        their time dim with the features' and get a [b, T] mask — not a
+        [b] mask that crashes broadcasting in the loss."""
+        import jax
+        from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                        MultiLayerNetwork, Sgd)
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+        rng = np.random.default_rng(3)
+        ds = DataSet(rng.normal(size=(3, 5, 4)).astype(np.float32),
+                     rng.integers(0, 2, size=(3, 5)))
+        it = ShapeBucketingDataSetIterator(ListDataSetIterator([ds]),
+                                           batch_buckets=(4,),
+                                           time_buckets=(8,))
+        out = it.pad(ds)
+        assert out.features.shape == (4, 8, 4)
+        assert out.labels.shape == (4, 8)          # time dim padded too
+        assert out.labels_mask.shape == (4, 8)     # per-timestep mask
+        np.testing.assert_array_equal(out.labels_mask[:, 5:], 0.0)
+        np.testing.assert_array_equal(out.labels_mask[3:], 0.0)
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+                .layer(LSTM(n_in=4, n_out=8))
+                .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                      loss="sparse_mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it)                                # must not crash the step
+        assert np.isfinite(float(net.score_))
+
+    def test_pipeline_wait_stats_are_exact_not_bucket_quantiles(self):
+        """Regression (review finding): the /profile wait block reports
+        the exact mean/max, not LatencyHistogram bucket quantiles that
+        collapse sub-100ms (seconds-valued) samples into one bucket."""
+        from deeplearning4j_tpu.monitor import get_registry
+        from deeplearning4j_tpu.monitor.jitwatch import _pipeline_block
+        reg = get_registry()
+        h = reg.histogram("input_wait_seconds")
+        for _ in range(99):
+            h.observe(50e-6)
+        h.observe(0.15)                            # one transient stall
+        w = _pipeline_block(reg.snapshot())["wait_seconds"]
+        assert w["max_s"] == pytest.approx(0.15)
+        assert w["mean_s"] < 0.01                  # NOT the 0.15 the bucket
+        assert "p95_ms" not in w                   # quantiles would report

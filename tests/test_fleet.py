@@ -344,3 +344,40 @@ def test_jitwatch_and_memory_series_flow_through_fleet():
         assert 'device_live_buffers{worker="wjit"}' in text
     finally:
         fleet.clear()
+
+
+def test_input_pipeline_series_flow_through_fleet():
+    """PR-6 satellite pin: the input-pipeline series (queue-depth gauge,
+    wait histogram, byte/batch counters from ``datasets/prefetch.py``) are
+    plain registry series, so a worker's ETL health rides OP_TELEMETRY
+    into ``GET /fleet`` under its worker label with zero extra wiring."""
+    from deeplearning4j_tpu.datasets.prefetch import PrefetchDataSetIterator
+
+    pf = PrefetchDataSetIterator(ListDataSetIterator(_toy_batches(n=3)),
+                                 workers=2, device_put=True)
+    try:
+        list(pf)                       # populates input_* in the registry
+    finally:
+        pf.shutdown()
+    fleet = get_fleet()
+    fleet.clear()
+    try:
+        with ParameterServer(port=0) as srv:
+            master = ParameterServerTrainingMaster(
+                srv.address, staleness=0, backoff=0.01, worker_id="wpipe",
+                telemetry_interval=0.0)
+            master.execute_training(_toy_net(seed=13),
+                                    ListDataSetIterator(_toy_batches(n=1)))
+            ui = UIServer(port=0)
+            ui.attach(InMemoryStatsStorage())
+            port = ui.start()
+            try:
+                text = _get(port, "/fleet")
+            finally:
+                ui.stop()
+        assert 'input_queue_depth{worker="wpipe"}' in text
+        assert 'input_bytes_total{worker="wpipe"}' in text
+        assert 'input_batches_total{worker="wpipe"}' in text
+        assert 'input_wait_seconds_count{worker="wpipe"}' in text
+    finally:
+        fleet.clear()
