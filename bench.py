@@ -367,6 +367,93 @@ def bench_input_pipeline(batch=256, n_batches=32, delay_ms=25.0, workers=8):
     return ips_pre
 
 
+#: latched by bench_paramserver; embedded in its --one record so the BENCH
+#: trajectory carries the 1-server-full-vector vs N-server-delta wire and
+#: throughput comparison, not just the headline number
+PARAMSERVER_STATS = {}
+
+
+def bench_paramserver(steps=32, n_in=1024, hidden=1024, classes=10,
+                      batch=64, num_servers=3):
+    """Parameter-server fleet throughput (paramserver/sharded.py): the same
+    async-SGD fit run against (a) ONE server with dense full-vector pulls
+    (the PR-1 wire: staleness=0 re-pulls the whole parameter vector every
+    step) and (b) a ``num_servers``-node sharded group speaking the proto
+    v3 delta wire (per-shard sparse pushes in parallel, journal-replay
+    pulls). Latches {steps/sec, push+pull wire bytes per step} for both
+    into ``PARAMSERVER_STATS`` for the ``--one`` record; wire bytes come
+    from the master's own exact per-instance client counters
+    (``push_bytes``/``pull_bytes``), deltaed around the timed fit.
+    Headline value: N-server-delta steps/sec."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, DataSet,
+                                    ListDataSetIterator, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import DistributedMultiLayerNetwork
+    from deeplearning4j_tpu.paramserver import (
+        ParameterServer, ParameterServerTrainingMaster,
+        ShardedParameterServerGroup)
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(batch, n_in)).astype(np.float32),
+                       np.eye(classes, dtype=np.float32)[
+                           rng.integers(0, classes, batch)])
+               for _ in range(steps)]
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden))
+                .layer(OutputLayer(n_in=hidden, n_out=classes,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def run(servers, delta):
+        net = build_net()
+        group = srv = None
+        if servers == 1 and not delta:
+            srv = ParameterServer(port=0)
+            address = srv.address
+        else:
+            group = ShardedParameterServerGroup(servers)
+            address = group.address
+        try:
+            master = (ParameterServerTrainingMaster.Builder(address)
+                      .staleness(0).threshold(1e-3).backoff(0.01)
+                      .delta_push(delta).build())
+            dnet = DistributedMultiLayerNetwork(net, master)
+            dnet.fit(ListDataSetIterator(batches[:2]))   # compile, un-timed
+            c0 = dict(master.client.metrics.snapshot()["counters"])
+            t0 = time.perf_counter()
+            dnet.fit(ListDataSetIterator(batches))
+            dt = time.perf_counter() - t0
+            c1 = master.client.metrics.snapshot()["counters"]
+            wire = (c1["push_bytes"] - c0["push_bytes"]
+                    + c1["pull_bytes"] - c0["pull_bytes"])
+            master.client.close()
+            return steps / dt, wire / steps
+        finally:
+            if srv is not None:
+                srv.stop()
+            if group is not None:
+                group.stop()
+
+    sps_dense, wire_dense = run(1, delta=False)
+    sps_delta, wire_delta = run(num_servers, delta=True)
+    n_params = n_in * hidden + hidden + hidden * classes + classes
+    PARAMSERVER_STATS.update({
+        "num_servers": num_servers, "steps": steps, "params": n_params,
+        "dense_steps_per_sec": round(sps_dense, 1),
+        "delta_steps_per_sec": round(sps_delta, 1),
+        "dense_wire_bytes_per_step": int(wire_dense),
+        "delta_wire_bytes_per_step": int(wire_delta),
+        "wire_reduction": round(wire_dense / max(wire_delta, 1.0), 1),
+        "speedup": round(sps_delta / max(sps_dense, 1e-9), 2),
+    })
+    return sps_delta
+
+
 def bench_word2vec(n_sentences=20000, sent_len=40, vocab_target=5000):
     """Word2Vec skip-gram (HS) words/sec through the jitted kernels.
     800k-word corpus so steady-state batch throughput dominates the one-time
@@ -501,6 +588,7 @@ def bench_transformer_lm(batch=4, seq_len=8192, vocab=4096, embed=512,
 ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
     ("input_pipeline_images_per_sec", "images/sec", bench_input_pipeline),
+    ("paramserver_steps_per_sec", "steps/sec", bench_paramserver),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -937,7 +1025,10 @@ def main():
                           "jitwatch": _jitwatch_snapshot(),
                           # prefetch-off/on ETL comparison — populated only
                           # by the input_pipeline config, None elsewhere
-                          "input_pipeline": INPUT_PIPELINE_STATS or None}))
+                          "input_pipeline": INPUT_PIPELINE_STATS or None,
+                          # 1-server-dense vs N-server-delta comparison —
+                          # populated only by the paramserver config
+                          "paramserver": PARAMSERVER_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

@@ -92,7 +92,9 @@ class FleetState:
     # ------------------------------------------------------------- reading
     def liveness(self) -> dict:
         """JSON liveness table: the ``/fleet?format=json`` payload and the
-        block ``/healthz`` folds in."""
+        block ``/healthz`` folds in. When workers report sharded-
+        paramserver series, a per-shard rollup rides along as
+        ``"shards"`` (see :meth:`shard_block`)."""
         now = time.time()
         with self._lock:
             workers = {
@@ -101,10 +103,58 @@ class FleetState:
                     "reports": e["reports"],
                     "series": len(e.get("registry") or {})}
                 for w, e in self._workers.items()}
-        return {"stale_after_s": self.stale_after,
-                "workers": workers,
-                "stale": sorted(w for w, i in workers.items()
-                                if i["stale"])}
+        out = {"stale_after_s": self.stale_after,
+               "workers": workers,
+               "stale": sorted(w for w, i in workers.items()
+                               if i["stale"])}
+        shards = self.shard_block()
+        if shards:
+            out["shards"] = shards
+        return out
+
+    def shard_block(self) -> Dict[str, dict]:
+        """Per-shard rollup of the sharded-paramserver series workers ship
+        over OP_TELEMETRY (docs/PARALLELISM.md "Sharded parameter-server
+        fleet"): for each shard label, the max ``paramserver_shard_
+        staleness`` across workers (and the per-worker values — the
+        rebalance/dead-shard audit view), plus ``paramserver_wire_bytes_
+        total`` summed over ops/directions/workers. Empty when no worker
+        reports the series (a fleet without the sharded client)."""
+        with self._lock:
+            regs = {w: e.get("registry") or {}
+                    for w, e in self._workers.items()}
+        shards: Dict[str, dict] = {}
+
+        def entry(label: str) -> dict:
+            return shards.setdefault(label, {
+                "staleness_max": 0.0, "staleness": {},
+                "wire_bytes": {"tx": 0.0, "rx": 0.0}})
+
+        for worker, reg in regs.items():
+            fam = reg.get("paramserver_shard_staleness") or {}
+            for row in fam.get("children", []):
+                label = row.get("labels", {}).get("shard")
+                if label is None:
+                    continue
+                ent = entry(label)
+                value = float(row.get("value", 0.0))
+                ent["staleness"][worker] = value
+                ent["staleness_max"] = max(ent["staleness_max"], value)
+            fam = reg.get("paramserver_wire_bytes_total") or {}
+            for row in fam.get("children", []):
+                labels = row.get("labels", {})
+                label = labels.get("shard")
+                direction = labels.get("direction")
+                # client rows only: a worker co-hosting a shard node ships
+                # BOTH roles in one registry, and the server rows are the
+                # same bytes seen from the other end — summing both would
+                # double-count every frame
+                if label is None or direction not in ("tx", "rx") \
+                        or labels.get("role") != "client":
+                    continue
+                entry(label)["wire_bytes"][direction] += \
+                    float(row.get("value", 0.0))
+        return shards
 
     def render_prometheus(self) -> str:
         """The merged fleet scrape: every worker's shipped registry dump

@@ -156,6 +156,16 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
 
     storeUpdate = store_update
 
+    def reinject(self, dense_update: np.ndarray):
+        """Return un-deliverable quantized mass to the residual: a sharded
+        push that lost a shard server hands the dead shard's DECODED update
+        back here, so the next ``store_update`` re-encodes it — supra- and
+        sub-threshold mass alike is never lost to a down server (the same
+        never-lose-mass rule the residual already guarantees)."""
+        d = np.asarray(dense_update, np.float32)
+        self._residual = (d.copy() if self._residual is None
+                          else self._residual + d)
+
     def encoded_bytes(self) -> int:
         """Wire size of the last encoding (index + sign bytes)."""
         if not self.last_encoded:

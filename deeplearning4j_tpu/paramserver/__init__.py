@@ -5,21 +5,29 @@ threshold codec): a standalone fault-tolerant server node
 (:class:`ParameterServer`), a retry/backoff client with bounded-staleness
 pulls (:class:`ParameterServerClient`), the async TrainingMaster that rides
 them (:class:`ParameterServerTrainingMaster`), and listener-bus metrics
-(:class:`ParamServerMetricsListener`). See docs/PARALLELISM.md "Parameter
-server"."""
-from .server import (ParameterServer, OP_TELEMETRY, FLAG_TRACE,
-                     PROTO_VERSION)
+(:class:`ParamServerMetricsListener`), plus the sharded fleet layer — N
+real server nodes fronted by a per-shard fan-out client speaking the
+delta-compressed proto v3 wire (:class:`ShardedParameterServerGroup`,
+:class:`ShardedParameterServerClient`). See docs/PARALLELISM.md
+"Parameter server" and "Sharded parameter-server fleet"."""
+from .server import (ParameterServer, OP_TELEMETRY, OP_PULL_DELTA,
+                     FLAG_TRACE, PROTO_VERSION)
 from .client import (ParameterServerClient, ServerUnavailableError,
-                     ParameterServerError)
+                     ParameterServerError, Fanout)
+from .sharded import (ShardedParameterServerGroup,
+                      ShardedParameterServerClient, parse_addresses,
+                      shard_slice_length)
 from .training import (ParameterServerTrainingMaster, flatten_params,
                        set_params_from_flat)
 from .metrics import (ParamServerMetrics, ParamServerMetricsListener,
                       LatencyHistogram)
 
 __all__ = [
-    "ParameterServer", "OP_TELEMETRY", "FLAG_TRACE", "PROTO_VERSION",
-    "ParameterServerClient", "ServerUnavailableError",
-    "ParameterServerError", "ParameterServerTrainingMaster",
+    "ParameterServer", "OP_TELEMETRY", "OP_PULL_DELTA", "FLAG_TRACE",
+    "PROTO_VERSION", "ParameterServerClient", "ServerUnavailableError",
+    "ParameterServerError", "Fanout", "ShardedParameterServerGroup",
+    "ShardedParameterServerClient", "parse_addresses",
+    "shard_slice_length", "ParameterServerTrainingMaster",
     "flatten_params", "set_params_from_flat", "ParamServerMetrics",
     "ParamServerMetricsListener", "LatencyHistogram",
 ]

@@ -21,22 +21,26 @@ import os
 import random
 import socket
 import struct
+import threading
 import time
-from typing import Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..monitor import (get_flight_recorder, get_health, get_registry,
                        get_tracer)
+from ..parallel.accumulation import serialize_encoded
 from ..parallel.transport import send_frame, recv_frame
 from .metrics import ParamServerMetrics
 from .server import (OP_INIT, OP_SET, OP_PUSH, OP_PULL, OP_VERSION, OP_STATS,
-                     OP_TELEMETRY, FLAG_TRACE, ST_OK)
+                     OP_TELEMETRY, OP_PULL_DELTA, FLAG_TRACE, OP_MASK,
+                     OP_NAMES, ST_OK, DELTA_FRESH, DELTA_FRAMES, DELTA_FULL)
 
 log = logging.getLogger(__name__)
 
 __all__ = ["ParameterServerClient", "ServerUnavailableError",
-           "ParameterServerError"]
+           "ParameterServerError", "Fanout"]
 
 #: newest trace events shipped per telemetry report — a snapshot window,
 #: not the whole ring buffer (reports are meant to stay "compact")
@@ -54,6 +58,60 @@ class ParameterServerError(RuntimeError):
     mismatch, pull-before-init). Not retried — retrying can't fix it."""
 
 
+class Fanout:
+    """Tiny shared fan-out runner: execute a list of thunks concurrently
+    and return their results in submission order. THE one parallel-request
+    code path — :meth:`ParameterServerClient.pull_sharded` (per-shard pulls
+    against a single server, over its connection pool) and
+    :class:`~deeplearning4j_tpu.paramserver.sharded.
+    ShardedParameterServerClient` (per-shard-server fan-out) both ride it,
+    so the legacy and fleet paths cannot diverge.
+
+    An exception from any thunk re-raises after every thunk has resolved
+    (each is an independent request whose effect stands either way);
+    callers that need per-shard error-as-value semantics wrap their thunks
+    — see ``ShardedParameterServerClient._per_shard``. The first thunk
+    runs inline on the calling thread, so the 1-server/1-shard case pays
+    no thread overhead."""
+
+    def __init__(self, max_workers: int):
+        self.max_workers = max(1, int(max_workers))
+        self._lock = threading.Lock()
+        self._exec: Optional[ThreadPoolExecutor] = None
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._exec is None:
+                self._exec = ThreadPoolExecutor(
+                    max_workers=self.max_workers,
+                    thread_name_prefix="psfanout")
+            return self._exec
+
+    def run(self, thunks: Sequence[Callable]) -> List[object]:
+        def call(t: Callable):
+            try:
+                return False, t()
+            except Exception as e:
+                return True, e
+        # the FIRST thunk runs inline on the calling thread: it would only
+        # block on its future anyway, and the saved dispatch+wakeup is a
+        # measurable slice of a small delta round trip
+        futures = [self._executor().submit(call, t) for t in thunks[1:]]
+        results = [call(thunks[0])] + [f.result() for f in futures]
+        out: List[object] = []
+        for raised, value in results:
+            if raised:
+                raise value
+            out.append(value)
+        return out
+
+    def close(self):
+        with self._lock:
+            ex, self._exec = self._exec, None
+        if ex is not None:
+            ex.shutdown(wait=False)
+
+
 class ParameterServerClient:
     """One TCP connection to a :class:`~deeplearning4j_tpu.paramserver.
     server.ParameterServer`, lazily (re)established per request.
@@ -63,6 +121,11 @@ class ParameterServerClient:
     :class:`ServerUnavailableError`; backoff sleeps are
     ``backoff * 2^attempt`` (capped at ``backoff_max``) with ±``jitter``
     randomization so rejoining clients don't thundering-herd the server.
+    ``pool_size``: idle connections kept (>= 2 lets concurrent requests —
+    :meth:`pull_sharded`, the fan-out client — genuinely parallelize; extra
+    concurrent requests open temporary sockets that close on return).
+    ``shard``: which shard of a fleet this client talks to — metrics
+    labeling only (``paramserver_wire_bytes_total{shard=}``).
     """
 
     def __init__(self, address: str, staleness: int = 0,
@@ -70,7 +133,8 @@ class ParameterServerClient:
                  backoff_max: float = 2.0, jitter: float = 0.25,
                  timeout: float = 30.0,
                  metrics: Optional[ParamServerMetrics] = None,
-                 worker_id: Optional[str] = None, tracer=None):
+                 worker_id: Optional[str] = None, tracer=None,
+                 pool_size: int = 1, shard: Optional[int] = None):
         host, _, port = address.rpartition(":")
         self.host, self.port = host, int(port)
         self.address = address
@@ -80,6 +144,8 @@ class ParameterServerClient:
         self.backoff_max = float(backoff_max)
         self.jitter = float(jitter)
         self.timeout = float(timeout)
+        self.pool_size = max(1, int(pool_size))
+        self.shard_label = "0" if shard is None else str(shard)
         self.metrics = metrics or ParamServerMetrics()
         #: fleet identity this client reports telemetry under; spans land
         #: in ``tracer`` (default: the process-global one) so an in-process
@@ -88,29 +154,87 @@ class ParameterServerClient:
         self.tracer = tracer if tracer is not None else get_tracer()
         #: negotiated server protocol version — None until the first
         #: OP_STATS answer; 1 for pre-OP_TELEMETRY servers (no flag bits,
-        #: no telemetry), >= 2 to use the v2 extensions
+        #: no telemetry), >= 2 to use the v2 extensions, >= 3 for the
+        #: delta-pull wire
         self._proto: Optional[int] = None
-        self._sock: Optional[socket.socket] = None
+        self._pool: List[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._fan: Optional[Fanout] = None
         self._rand = random.Random()
 
     # ---------------------------------------------------------- connection
-    def _ensure_sock(self) -> socket.socket:
-        if self._sock is None:
-            s = socket.create_connection((self.host, self.port),
-                                         timeout=self.timeout)
-            self._sock = s
-        return self._sock
+    @property
+    def _sock(self) -> Optional[socket.socket]:
+        """The first idle pooled connection (None when none is open) —
+        kept for the fault-injection idiom ``client._sock.close()`` that
+        simulates a transient network blip."""
+        with self._pool_lock:
+            return self._pool[0] if self._pool else None
+
+    def _checkout(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        # connect OUTSIDE the lock (THR001): a slow connect must not stall
+        # the other pool users
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        try:
+            # delta frames and version checks are tiny — Nagle coalescing
+            # would add a delayed-ACK round to exactly the ops the sharded
+            # wire made small
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return s
+
+    def _checkin(self, s: socket.socket):
+        with self._pool_lock:
+            if len(self._pool) < self.pool_size:
+                self._pool.append(s)
+                return
+        try:
+            s.close()
+        except OSError:
+            pass
 
     def _drop_sock(self):
-        if self._sock is not None:
+        """Close every idle pooled connection (sockets checked out by
+        in-flight requests close themselves on their own error paths)."""
+        with self._pool_lock:
+            socks, self._pool = self._pool, []
+        for s in socks:
             try:
-                self._sock.close()
+                s.close()
             except OSError:
                 pass
-            self._sock = None
+
+    def _fanout(self) -> Fanout:
+        if self._fan is None:
+            self._fan = Fanout(max(self.pool_size, 2))
+        return self._fan
+
+    def _record_wire(self, op: int, n_tx: int, n_rx: int):
+        """Client half of ``paramserver_wire_bytes_total{op=,shard=,
+        direction=}`` — tx is the request frame, rx the response frame."""
+        name = OP_NAMES.get(op & OP_MASK)
+        if name is None:
+            return
+        reg = get_registry()
+        reg.counter("paramserver_wire_bytes_total",
+                    "bytes on the parameter-server wire", role="client",
+                    op=name, shard=self.shard_label,
+                    direction="tx").inc(n_tx)
+        reg.counter("paramserver_wire_bytes_total",
+                    "bytes on the parameter-server wire", role="client",
+                    op=name, shard=self.shard_label,
+                    direction="rx").inc(n_rx)
 
     def _request(self, op: int, payload: bytes = b"") -> bytes:
-        """One request/response round with reconnect-retry-backoff."""
+        """One request/response round with reconnect-retry-backoff.
+        Thread-safe: concurrent requests each check a connection out of the
+        pool (or open a temporary one), so per-shard parallel pulls and the
+        fan-out client can overlap rounds on one client."""
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
@@ -119,12 +243,16 @@ class ParameterServerClient:
                             self.backoff_max)
                 delay *= 1.0 + self.jitter * (2 * self._rand.random() - 1)
                 time.sleep(max(delay, 0.0))
+            s: Optional[socket.socket] = None
             try:
-                s = self._ensure_sock()
+                s = self._checkout()
                 send_frame(s, bytes([op]) + payload)
                 resp = recv_frame(s)
                 if resp is None or not resp:
                     raise ConnectionError("server closed the connection")
+                self._checkin(s)
+                s = None
+                self._record_wire(op, 1 + len(payload), len(resp))
                 if resp[0] != ST_OK:
                     raise ParameterServerError(
                         resp[1:].decode("utf-8", "replace"))
@@ -132,6 +260,13 @@ class ParameterServerClient:
                 return resp[1:]
             except (OSError, socket.timeout) as e:  # incl. ConnectionError
                 last = e
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                # the blip that killed this socket likely killed its pool
+                # siblings too — drop the idle ones so the retry reconnects
                 self._drop_sock()
         self.metrics.add("errors")
         err = ServerUnavailableError(
@@ -206,6 +341,76 @@ class ParameterServerClient:
 
     push = push_update
 
+    def push_encoded(self, encoded) -> Tuple[int, Optional[np.ndarray]]:
+        """Push a raw ``(idx, signs, threshold, n)`` encoding. Returns
+        ``(version, failed_mass)`` — always ``(v, None)`` here; the sharded
+        fan-out client shares this signature and uses the second slot to
+        hand un-deliverable shard mass back to the caller's accumulator."""
+        return self.push_update(serialize_encoded(encoded)), None
+
+    def pull_delta(self, since: int, slack: int = 0):
+        """Proto v3 delta pull: ONE round trip answering
+        ``(version, mode, body)`` —
+
+        - ``DELTA_FRESH``: ``body None`` — the server is within ``slack``
+          versions of ``since``; keep the local copy.
+        - ``DELTA_FRAMES``: ``body`` is the list of APPLIED update frames
+          for ``since+1..version`` in application order; replaying
+          ``p -= decode(frame)`` on the local copy at ``since``
+          reconstructs the server state bit-exactly.
+        - ``DELTA_FULL``: ``body`` is the full f32 value vector (journal
+          evicted / restart / SET barrier / caller ahead of the server).
+
+        Callers must negotiate proto >= 3 first (the sharded client does);
+        a v1/v2 server rejects the op as unknown."""
+        t0 = time.perf_counter()
+        with self.tracer.span("ps/pull_delta", cat="paramserver",
+                              since=int(since)) as ctx:
+            op, payload = self._traced(
+                OP_PULL_DELTA, struct.pack("<qi", int(since), int(slack)),
+                ctx)
+            out = self._request(op, payload)
+        version, mode = struct.unpack("<qB", out[:9])
+        body = out[9:]
+        if mode == DELTA_FRESH:
+            return version, mode, None
+        self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
+                                 len(body))
+        if mode == DELTA_FULL:
+            return version, mode, np.frombuffer(body, np.float32)
+        if mode != DELTA_FRAMES:
+            raise ParameterServerError(f"unknown delta mode {mode}")
+        (count,) = struct.unpack_from("<I", body)
+        frames, off = [], 4
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<I", body, off)
+            off += 4
+            frames.append(bytes(body[off:off + ln]))
+            off += ln
+        if off != len(body):
+            raise ParameterServerError(
+                f"delta body length mismatch ({off} parsed, "
+                f"{len(body)} received)")
+        return version, mode, frames
+
+    def pull_sharded(self, num_shards: Optional[int] = None
+                     ) -> Tuple[int, np.ndarray]:
+        """Pull every virtual shard of ONE server in PARALLEL over the
+        connection pool and reassemble the round-robin layout — the
+        single-server half of the fan-out code path (:class:`Fanout`).
+        Returns ``(version, vector)`` with ``version`` the max seen across
+        shards; like sequential per-shard pulls, concurrent pushes can
+        tear the snapshot across shard boundaries (the async-PS trade)."""
+        if num_shards is None:
+            num_shards = int(self.stats().get("num_shards", 1))
+        results = self._fanout().run(
+            [(lambda s=s: self.pull(shard=s)) for s in range(num_shards)])
+        n = sum(part.size for _, part in results)
+        vec = np.empty(n, np.float32)
+        for s, (_, part) in enumerate(results):
+            vec[s::num_shards] = part
+        return max(v for v, _ in results), vec
+
     def pull(self, shard: int = -1) -> Tuple[int, np.ndarray]:
         """Current parameters (``shard=-1``: full vector; ``shard=s``: the
         round-robin slice ``s::num_shards``), stamped with the server
@@ -268,6 +473,9 @@ class ParameterServerClient:
 
     def close(self):
         self._drop_sock()
+        if self._fan is not None:
+            self._fan.close()
+            self._fan = None
 
     def __enter__(self):
         return self

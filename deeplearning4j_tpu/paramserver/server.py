@@ -30,6 +30,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -37,14 +38,15 @@ import numpy as np
 from ..monitor import SpanContext, get_fleet, get_registry, get_tracer
 from ..parallel.transport import send_frame, recv_frame
 from ..parallel.accumulation import (deserialize_encoded, threshold_decode,
-                                     encode_residual)
+                                     encode_residual, serialize_encoded)
 from .metrics import ParamServerMetrics
 
 log = logging.getLogger(__name__)
 
 __all__ = ["ParameterServer", "OP_INIT", "OP_SET", "OP_PUSH", "OP_PULL",
-           "OP_VERSION", "OP_STATS", "OP_TELEMETRY", "FLAG_TRACE",
-           "OP_MASK", "PROTO_VERSION", "ST_OK", "ST_ERR"]
+           "OP_VERSION", "OP_STATS", "OP_TELEMETRY", "OP_PULL_DELTA",
+           "FLAG_TRACE", "OP_MASK", "PROTO_VERSION", "ST_OK", "ST_ERR",
+           "DELTA_FRESH", "DELTA_FRAMES", "DELTA_FULL"]
 
 # request = [op u8 | payload]; response = [status u8 | payload]
 OP_INIT = 1     # payload f32[n]; set params ONLY if uninitialized → [ver q | created u8]
@@ -54,6 +56,7 @@ OP_PULL = 4     # payload [shard i32] (-1 = full vector) → [ver q | shard i32 
 OP_VERSION = 5  # no payload → [ver q | n q]
 OP_STATS = 6    # no payload → JSON bytes
 OP_TELEMETRY = 7  # payload JSON {worker, registry, trace_events, ...} → JSON
+OP_PULL_DELTA = 8  # v3: payload [since q | slack i32] → [ver q | mode u8 | body]
 ST_OK = 0
 ST_ERR = 1
 
@@ -68,11 +71,32 @@ ST_ERR = 1
 # plain op bytes 1..6 — work against v2 servers unchanged.
 FLAG_TRACE = 0x80
 OP_MASK = 0x7F
-PROTO_VERSION = 2
+
+# --- proto v3 extension (sharded fleet / delta wire, docs/PARALLELISM.md
+# "Sharded parameter-server fleet") ---------------------------------------
+# OP_PULL_DELTA replaces "version round trip + full-vector pull" with ONE
+# round trip that ships only what changed. Request: [since q | slack i32]
+# (the version the caller's local copy reconstructs, and how many server
+# versions of lag it tolerates). Response: [ver q | mode u8 | body] where
+#   DELTA_FRESH   body empty        — ver - since <= slack, keep local copy
+#   DELTA_FRAMES  body = [count u32 | (len u32, frame)*count]
+#                 — the APPLIED update frames for versions since+1..ver, in
+#                 application order; replaying `p -= decode(frame)` on the
+#                 local copy reconstructs the server state BIT-EXACTLY
+#   DELTA_FULL    body = f32 values — the journal no longer reaches back to
+#                 `since` (eviction, restart, or a SET barrier), or the
+#                 caller is AHEAD of the server (restore from an older
+#                 snapshot): full resync
+# Clients only send OP_PULL_DELTA after OP_STATS advertises proto >= 3, so
+# v3 clients negotiate down against v1/v2 servers exactly like v2 did.
+DELTA_FRESH = 0
+DELTA_FRAMES = 1
+DELTA_FULL = 2
+PROTO_VERSION = 3
 
 OP_NAMES = {OP_INIT: "init", OP_SET: "set", OP_PUSH: "push",
             OP_PULL: "pull", OP_VERSION: "version", OP_STATS: "stats",
-            OP_TELEMETRY: "telemetry"}
+            OP_TELEMETRY: "telemetry", OP_PULL_DELTA: "pull_delta"}
 
 
 class ParameterServer:
@@ -89,11 +113,21 @@ class ParameterServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  num_shards: int = 1, threshold: float = 0.0,
-                 restore: Optional[tuple] = None, tracer=None, fleet=None):
+                 restore: Optional[tuple] = None, tracer=None, fleet=None,
+                 journal: int = 256, shard_label: str = "0"):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
         self.num_shards = int(num_shards)
         self.threshold = float(threshold)
+        #: which shard of a ShardedParameterServerGroup this node holds —
+        #: pure metrics/stats labeling, the storage layout doesn't change
+        self.shard_label = str(shard_label)
+        #: ring of the last `journal` APPLIED update frames (version,
+        #: wire bytes) behind OP_PULL_DELTA; 0 disables (delta pulls always
+        #: fall back to full). Cleared by SET/INIT (a full-state barrier no
+        #: frame replay can cross) and empty after a restore — spanning
+        #: pulls resync via DELTA_FULL once, then ride frames again.
+        self._journal: deque = deque(maxlen=max(int(journal), 0))
         self.metrics = ParamServerMetrics(role="server")
         #: where server-side child spans land (the merged fleet trace reads
         #: these) and where worker telemetry reports aggregate; both default
@@ -167,6 +201,7 @@ class ParameterServer:
                 raise ValueError(
                     f"pushed update length {n} != model length {self._n}")
             update = threshold_decode(idx, signs, thr, (n,))
+            applied_frame = bytes(payload)
             if self.threshold > 0.0:
                 # server-side residual accumulation: retain sub-threshold
                 # mass, apply only what crossed the threshold this round
@@ -174,9 +209,14 @@ class ParameterServer:
                      else update + self._residual)
                 (i2, s2), self._residual = encode_residual(g, self.threshold)
                 update = threshold_decode(i2, s2, self.threshold, (n,))
+                # the journal must hold what was APPLIED (post-residual) —
+                # replaying the raw pushed frame would skip the residual rule
+                applied_frame = serialize_encoded((i2, s2, self.threshold, n))
             for s in range(self.num_shards):
                 self._shards[s] -= update[s::self.num_shards]
             self._version += 1
+            if self._journal.maxlen:
+                self._journal.append((self._version, applied_frame))
             return self._version
 
     def _handle(self, op: int, payload: bytes) -> bytes:
@@ -187,6 +227,7 @@ class ParameterServer:
                 if created:
                     self._store(vec.copy())
                     self._version += 1
+                    self._journal.clear()
                 return struct.pack("<qB", self._version, int(created))
         if op == OP_SET:
             vec = np.frombuffer(payload, np.float32)
@@ -194,6 +235,9 @@ class ParameterServer:
                 self._store(vec.copy())
                 self._residual = None
                 self._version += 1
+                # a SET is a full-state barrier: no sequence of journaled
+                # push frames reconstructs across it
+                self._journal.clear()
                 return struct.pack("<q", self._version)
         if op == OP_PUSH:
             t0 = time.perf_counter()
@@ -217,6 +261,38 @@ class ParameterServer:
             self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
                                      len(data))
             return struct.pack("<qi", version, shard) + data
+        if op == OP_PULL_DELTA:
+            since, slack = struct.unpack("<qi", payload)
+            t0 = time.perf_counter()
+            with self._lock:
+                if self._shards is None:
+                    raise ValueError("pull before init: server holds no "
+                                     "params")
+                ver = self._version
+                if since > ver:
+                    # the caller is AHEAD of us (we restored from an older
+                    # snapshot): a frame replay can't rewind — force resync
+                    mode, body = DELTA_FULL, self._assemble().tobytes()
+                elif ver - since <= max(int(slack), 0):
+                    mode, body = DELTA_FRESH, b""
+                else:
+                    frames = [f for v, f in self._journal if v > since]
+                    if len(frames) == ver - since:
+                        # the journal covers since+1..ver contiguously
+                        # (only pushes append; SET/INIT clear), so the
+                        # caller replays exactly what we applied
+                        mode = DELTA_FRAMES
+                        parts = [struct.pack("<I", len(frames))]
+                        for f in frames:
+                            parts.append(struct.pack("<I", len(f)))
+                            parts.append(f)
+                        body = b"".join(parts)
+                    else:
+                        mode, body = DELTA_FULL, self._assemble().tobytes()
+            if mode != DELTA_FRESH:
+                self.metrics.record_pull((time.perf_counter() - t0) * 1e3,
+                                         len(body))
+            return struct.pack("<qB", ver, mode) + body
         if op == OP_VERSION:
             with self._lock:
                 return struct.pack("<qq", self._version, self._n)
@@ -226,6 +302,8 @@ class ParameterServer:
                 stats["version"] = self._version
                 stats["n"] = self._n
                 stats["num_shards"] = self.num_shards
+                stats["journal_len"] = len(self._journal)
+            stats["shard"] = self.shard_label
             # immutable after construction; lets clients detect
             # server-side residual merging (see training.py's
             # count_own_pushes drift warning)
@@ -265,6 +343,12 @@ class ParameterServer:
                 except OSError:
                     pass
                 return
+            try:
+                # small-response ops (version, delta-fresh) must not sit
+                # out a Nagle/delayed-ACK round
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             self._conns.append(s)
             threading.Thread(target=self._serve_conn, args=(s,),
                              daemon=True).start()
@@ -278,6 +362,23 @@ class ParameterServer:
         get_registry().counter("paramserver_requests_total",
                                "requests served by op", role="server",
                                op=name).inc()
+
+    def _record_wire(self, op: int, n_rx: int, n_tx: int):
+        """Per-op / per-shard wire accounting (``paramserver_wire_bytes_
+        total``): rx = the request frame, tx = the response frame — the
+        server half of the series the fan-out client also records."""
+        name = OP_NAMES.get(op)
+        if name is None:
+            return
+        reg = get_registry()
+        reg.counter("paramserver_wire_bytes_total",
+                    "bytes on the parameter-server wire", role="server",
+                    op=name, shard=self.shard_label,
+                    direction="rx").inc(n_rx)
+        reg.counter("paramserver_wire_bytes_total",
+                    "bytes on the parameter-server wire", role="server",
+                    op=name, shard=self.shard_label,
+                    direction="tx").inc(n_tx)
 
     def _serve_conn(self, s: socket.socket):
         try:
@@ -314,6 +415,7 @@ class ParameterServer:
                             out = self._handle(op, payload)
                     else:
                         out = self._handle(op, payload)
+                    self._record_wire(op, len(frame), 1 + len(out))
                     send_frame(s, bytes([ST_OK]) + out)
                 except Exception as e:  # malformed frame ≠ dead server: the
                     # client gets a typed error, the connection stays up

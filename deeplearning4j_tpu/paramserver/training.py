@@ -81,7 +81,7 @@ class ParameterServerTrainingMaster(TrainingMaster):
     """
 
     class Builder:
-        def __init__(self, server_address: str):
+        def __init__(self, server_address):
             self._address = server_address
             self._staleness = 0
             self._threshold = 1e-3
@@ -91,6 +91,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
             self._count_own_pushes = True
             self._worker_id = None
             self._telemetry_interval = 5.0
+            self._num_servers = None
+            self._delta_push = None
 
         def staleness(self, n):
             self._staleness = int(n)
@@ -132,6 +134,26 @@ class ParameterServerTrainingMaster(TrainingMaster):
 
         telemetryInterval = telemetry_interval
 
+        def num_servers(self, n: int):
+            """Expected shard-server fan-out width — a consistency check
+            against the configured address list (a fleet dial that silently
+            disagreed with the topology would mis-shard every push)."""
+            self._num_servers = int(n)
+            return self
+
+        numServers = num_servers
+
+        def delta_push(self, flag: bool = True):
+            """Proto v3 delta wire: per-shard sparse pushes + journal-replay
+            pulls (defaults ON for multi-server addresses, OFF for the
+            single-server legacy path; set explicitly to override either
+            way — True with one address rides the delta wire against a
+            single server over the same fan-out code path)."""
+            self._delta_push = bool(flag)
+            return self
+
+        deltaPush = delta_push
+
         def build(self):
             return ParameterServerTrainingMaster(
                 self._address, staleness=self._staleness,
@@ -140,14 +162,18 @@ class ParameterServerTrainingMaster(TrainingMaster):
                 max_retries=self._retries, backoff=self._backoff,
                 count_own_pushes=self._count_own_pushes,
                 worker_id=self._worker_id,
-                telemetry_interval=self._telemetry_interval)
+                telemetry_interval=self._telemetry_interval,
+                num_servers=self._num_servers,
+                delta_push=self._delta_push)
 
-    def __init__(self, server_address: str, staleness: int = 0,
+    def __init__(self, server_address, staleness: int = 0,
                  threshold: float = 1e-3, batch_size_per_worker: int = 32,
                  max_retries: int = 5, backoff: float = 0.05,
                  count_own_pushes: bool = True,
                  worker_id: Optional[str] = None,
                  telemetry_interval: float = 5.0,
+                 num_servers: Optional[int] = None,
+                 delta_push: Optional[bool] = None,
                  client: Optional[ParameterServerClient] = None):
         self.server_address = server_address
         self.staleness = int(staleness)
@@ -173,6 +199,13 @@ class ParameterServerTrainingMaster(TrainingMaster):
         #: never mid-epoch — join and leave still report)
         self.worker_id = worker_id
         self.telemetry_interval = telemetry_interval
+        #: sharded-fleet dials (docs/PARALLELISM.md "Sharded parameter-
+        #: server fleet"): ``server_address`` may name N servers
+        #: (comma-joined or a list — shard order IS the address order);
+        #: ``num_servers`` cross-checks that width; ``delta_push`` rides
+        #: the proto v3 delta wire (None = auto: on for multi-server)
+        self.num_servers = num_servers
+        self.delta_push = delta_push
         self.client = client
         self.accumulator = EncodedGradientsAccumulator(
             initial_threshold=threshold)
@@ -184,13 +217,49 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self._last_telemetry = 0.0
 
     # ------------------------------------------------------------ plumbing
-    def _ensure_client(self) -> ParameterServerClient:
+    def _ensure_client(self):
         if self.client is None:
-            self.client = ParameterServerClient(
-                self.server_address, staleness=self.staleness,
-                max_retries=self.max_retries, backoff=self.backoff,
-                worker_id=self.worker_id)
+            from .sharded import ShardedParameterServerClient, \
+                parse_addresses
+            addrs = parse_addresses(self.server_address)
+            if self.num_servers is not None \
+                    and self.num_servers != len(addrs):
+                raise ValueError(
+                    f"num_servers={self.num_servers} but {len(addrs)} "
+                    f"server address(es) configured: {addrs}")
+            delta = (self.delta_push if self.delta_push is not None
+                     else len(addrs) > 1)
+            if len(addrs) > 1 or self.delta_push:
+                self.client = ShardedParameterServerClient(
+                    addrs, staleness=self.staleness, delta=delta,
+                    max_retries=self.max_retries, backoff=self.backoff,
+                    worker_id=self.worker_id)
+            else:
+                self.client = ParameterServerClient(
+                    addrs[0], staleness=self.staleness,
+                    max_retries=self.max_retries, backoff=self.backoff,
+                    worker_id=self.worker_id)
         return self.client
+
+    def remap(self, addresses):
+        """Elastic membership (the rebalance runbook): rebind this master
+        to a new shard-server set between fits — after a
+        ``ShardedParameterServerGroup.scale_to`` or a fleet move. The next
+        ``fit``/``execute_training`` re-joins against the new layout
+        (``init_params`` → ``created=False`` → adopt the rebalanced
+        state); an attached sharded client remaps in place (flight event
+        ``client_remap``), a legacy client is rebuilt."""
+        from .sharded import parse_addresses
+        addrs = parse_addresses(addresses)
+        self.server_address = ",".join(addrs)
+        self.num_servers = None
+        if self.client is not None:
+            if hasattr(self.client, "remap"):
+                self.client.remap(addrs)
+            else:
+                self.client.close()
+                self.client = None
+        self.local_version = 0
 
     def _ship_telemetry(self, client: ParameterServerClient,
                         force: bool = False):
@@ -243,8 +312,13 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self._ensure_steps(net)
         acc = self.accumulator
 
+        stats0 = {}
+        if not self.count_own_pushes:   # the stats round trip is only
+            stats0 = client.stats()     # needed for this warning
+            if isinstance(stats0, list):  # sharded: one snapshot per shard
+                stats0 = next((s for s in stats0 if "threshold" in s), {})
         if not self.count_own_pushes \
-                and float(client.stats().get("threshold", 0.0)) > 0.0:
+                and float(stats0.get("threshold", 0.0)) > 0.0:
             # server-side residual merging withholds sub-threshold mass,
             # so the optimistic local apply differs from the server's
             # applied state by the residual — and with own pushes not
@@ -273,7 +347,9 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self.local_version = version
         fr.record(join_kind, worker=client.worker_id,
                   server=client.address, seeded=created,
-                  version=int(version))
+                  version=(list(map(int, version))
+                           if isinstance(version, (list, tuple))
+                           else int(version)))
         self._joined_once = True
         self._ship_telemetry(client, force=True)
 
@@ -289,29 +365,50 @@ class ParameterServerTrainingMaster(TrainingMaster):
                                       itc, net._next_rng(), f, l, None, None)
                 update = jax.tree_util.tree_map(np.asarray, update)
                 decoded_own = acc.store_update(update)
-                frame = acc.serialize_last()
                 # optimistic local apply: progress continues between pulls;
                 # the next adopted pull replaces it with the server's
                 # merged state
                 net.params = self._apply_step(
                     net.params,
                     jax.tree_util.tree_map(jnp.asarray, decoded_own))
-                pushed_version = client.push_update(frame)
-                if not self.count_own_pushes \
-                        and pushed_version == self.local_version + 1:
+                pushed_version, failed_mass = client.push_encoded(
+                    acc.last_encoded)
+                if failed_mass is not None:
+                    # a down shard server's quantized mass re-enters the
+                    # accumulator residual — re-encoded and re-pushed next
+                    # round instead of vanishing with the dead node
+                    acc.reinject(failed_mass)
+                if not self.count_own_pushes:
                     # contiguity guard: the returned version is the GLOBAL
-                    # counter, so it only provably covers just our own push
-                    # when it is exactly local+1. Adopt it then (the local
-                    # optimistic apply above already holds this update's
-                    # effect); any gap means other workers' pushes
-                    # interleaved — leave local_version alone so
-                    # pull_if_stale still sees them and the staleness=k
-                    # bound stays honest.
-                    self.local_version = pushed_version
+                    # counter (per shard server, for a fleet), so it only
+                    # provably covers just our own push when it is exactly
+                    # local+1. Adopt it then (the local optimistic apply
+                    # above already holds this update's effect); any gap
+                    # means other workers' pushes interleaved — leave
+                    # local_version alone so pull_if_stale still sees them
+                    # and the staleness=k bound stays honest.
+                    if isinstance(pushed_version, list):
+                        for j, pv in enumerate(pushed_version):
+                            if pv is not None \
+                                    and pv == self.local_version[j] + 1:
+                                self.local_version[j] = pv
+                    elif pushed_version == self.local_version + 1:
+                        self.local_version = pushed_version
                 fresh = client.pull_if_stale(self.local_version)
                 if fresh is not None:
-                    self.local_version, vec = fresh
-                    set_params_from_flat(net, vec)
+                    self.local_version, payload = fresh
+                    if isinstance(payload, dict):
+                        # sharded resync: scatter ONLY the refreshed
+                        # shards' slices; the fresh shards keep this
+                        # worker's optimistic local state (the per-shard
+                        # bounded-staleness contract)
+                        vec = flatten_params(net.params)
+                        n_srv = client.num_servers
+                        for j, values in payload.items():
+                            vec[j::n_srv] = values
+                        set_params_from_flat(net, vec)
+                    else:
+                        set_params_from_flat(net, payload)
                 net.score_ = loss
                 net.iteration_count += 1
                 steps += 1

@@ -17,8 +17,10 @@ TPU-native equivalent of reference ``deeplearning4j-play``
    counts/times/flops, device-memory gauges, step/ETL timing split
    (``?format=text`` for the terminal rendering)
  - ``/fleet``                — merged per-worker metrics (Prometheus text,
-   ``worker`` label; ``?format=json`` for the liveness table) aggregated
-   from ``OP_TELEMETRY`` reports on a paramserver-server process
+   ``worker`` label; ``?format=json`` for the liveness table, which
+   carries a per-shard rollup — staleness + wire bytes by shard — when
+   workers run the sharded paramserver client) aggregated from
+   ``OP_TELEMETRY`` reports on a paramserver-server process
  - ``/fleet/trace``          — whole-fleet Chrome trace, one ``pid`` row
    per process, propagated trace IDs intact
  - ``/events``               — the crash flight recorder's structured
@@ -203,7 +205,9 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/fleet":
             # merged per-worker registry view (OP_TELEMETRY reports landed
             # in the process-global FleetState): Prometheus text with a
-            # worker label, or the liveness table as JSON (?format=json)
+            # worker label, or the liveness table as JSON (?format=json —
+            # includes the per-shard staleness/wire-bytes block when the
+            # fleet runs the sharded paramserver client)
             fleet = get_fleet()
             if q.get("format", [""])[0] == "json":
                 self._json(fleet.liveness())

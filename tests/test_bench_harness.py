@@ -337,6 +337,25 @@ def test_driver_contract_deadline_self_exit(tmp_path):
     assert docs[-1]["stale"] is True and "deadline" in docs[-1]["error"]
 
 
+def test_paramserver_bench_cuts_wire_bytes(bench):
+    """Acceptance (PR 7): the paramserver bench must show the N-server
+    delta wire moving >= 2x fewer bytes per step than the 1-server
+    full-vector baseline, and latch the {steps/sec, wire bytes/step}
+    comparison for the --one record. (The steps/sec >= dense criterion is
+    latched by the real bench record — at the full 1M-param size; this
+    harness run is shrunk for test time, so only sanity-bound it here.)"""
+    value = bench.bench_paramserver(steps=12, n_in=128, hidden=256,
+                                    batch=16)
+    stats = bench.PARAMSERVER_STATS
+    assert value > 0
+    assert stats["num_servers"] == 3
+    assert stats["wire_reduction"] >= 2.0
+    assert stats["delta_wire_bytes_per_step"] < \
+        stats["dense_wire_bytes_per_step"]
+    assert stats["dense_steps_per_sec"] > 0
+    assert stats["speedup"] > 0.3
+
+
 def test_input_pipeline_bench_hides_etl(bench):
     """Acceptance (PR 6): the input-bound bench must show etl_ms reduced
     >= 5x with prefetch + device-put-ahead vs the synchronous path, and

@@ -346,6 +346,104 @@ def test_jitwatch_and_memory_series_flow_through_fleet():
         fleet.clear()
 
 
+def test_raw_socket_v3_pull_delta_roundtrip():
+    """Proto v3 wire pin, both directions on a raw socket: request
+    ``[op | since q | slack i32]``, response ``[status | ver q | mode u8 |
+    body]`` — FRESH (empty body), FRAMES (count-prefixed applied frames),
+    and FULL (raw f32) all framed exactly as documented."""
+    from deeplearning4j_tpu.paramserver.server import (
+        OP_PULL_DELTA, DELTA_FRESH, DELTA_FRAMES, DELTA_FULL)
+    from deeplearning4j_tpu.parallel.accumulation import serialize_encoded
+
+    vec = np.arange(8, dtype=np.float32)
+    frame = serialize_encoded((np.array([3], np.int32),
+                               np.array([1], np.int8), 0.5, 8))
+    with ParameterServer(port=0, journal=1) as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            send_frame(s, bytes([OP_SET]) + vec.tobytes())
+            (ver,) = struct.unpack("<q", recv_frame(s)[1:])
+
+            # in sync → FRESH, empty body
+            send_frame(s, bytes([OP_PULL_DELTA]) +
+                       struct.pack("<qi", ver, 0))
+            resp = recv_frame(s)
+            assert resp[0] == ST_OK
+            v, mode = struct.unpack("<qB", resp[1:10])
+            assert (v, mode, resp[10:]) == (ver, DELTA_FRESH, b"")
+
+            # one push behind → FRAMES carrying exactly the applied frame
+            send_frame(s, bytes([3]) + frame)          # OP_PUSH
+            recv_frame(s)
+            send_frame(s, bytes([OP_PULL_DELTA]) +
+                       struct.pack("<qi", ver, 0))
+            resp = recv_frame(s)
+            v, mode = struct.unpack("<qB", resp[1:10])
+            assert v == ver + 1 and mode == DELTA_FRAMES
+            (count,) = struct.unpack_from("<I", resp, 10)
+            (ln,) = struct.unpack_from("<I", resp, 14)
+            assert count == 1 and resp[18:18 + ln] == frame
+
+            # journal (maxlen=1) can't reach ver-2 → FULL raw f32 body
+            send_frame(s, bytes([3]) + frame)
+            recv_frame(s)
+            send_frame(s, bytes([OP_PULL_DELTA]) +
+                       struct.pack("<qi", ver, 0))
+            resp = recv_frame(s)
+            v, mode = struct.unpack("<qB", resp[1:10])
+            assert mode == DELTA_FULL
+            exp = vec.copy()
+            exp[3] -= 1.0                              # two applied pushes
+            np.testing.assert_array_equal(
+                np.frombuffer(resp[10:], np.float32), exp)
+        finally:
+            s.close()
+
+
+def test_sharded_wire_series_and_shard_block_flow_through_fleet():
+    """Sharded-fleet satellite pin: ``paramserver_wire_bytes_total{op=,
+    shard=,direction=}`` (both directions) and the
+    ``paramserver_shard_staleness{shard=}`` gauge are plain registry
+    series, so a sharded worker's wire accounting rides OP_TELEMETRY into
+    ``GET /fleet`` under its worker label — and ``/fleet?format=json``
+    rolls them up into the per-shard block."""
+    from deeplearning4j_tpu.paramserver import ShardedParameterServerGroup
+
+    fleet = get_fleet()
+    fleet.clear()
+    try:
+        with ShardedParameterServerGroup(3, fleet=fleet,
+                                         tracer=Tracer()) as group:
+            master = ParameterServerTrainingMaster(
+                group.address, staleness=0, backoff=0.01,
+                worker_id="wshard", telemetry_interval=0.0)
+            master.execute_training(_toy_net(seed=7),
+                                    ListDataSetIterator(_toy_batches(n=2)))
+            ui = UIServer(port=0)
+            ui.attach(InMemoryStatsStorage())
+            port = ui.start()
+            try:
+                text = _get(port, "/fleet")
+                doc = json.loads(_get(port, "/fleet?format=json"))
+            finally:
+                ui.stop()
+        for shard in ("0", "1", "2"):
+            for direction in ("tx", "rx"):
+                assert (f'paramserver_wire_bytes_total{{'
+                        f'direction="{direction}",op="push",role="client",'
+                        f'shard="{shard}",worker="wshard"}}') in text
+            assert (f'paramserver_shard_staleness{{role="client",'
+                    f'shard="{shard}",worker="wshard"}}') in text
+        # the /fleet JSON view carries the per-shard rollup block
+        assert set(doc["shards"]) == {"0", "1", "2"}
+        for shard in doc["shards"].values():
+            assert shard["wire_bytes"]["tx"] > 0
+            assert shard["wire_bytes"]["rx"] > 0
+            assert "wshard" in shard["staleness"]
+    finally:
+        fleet.clear()
+
+
 def test_input_pipeline_series_flow_through_fleet():
     """PR-6 satellite pin: the input-pipeline series (queue-depth gauge,
     wait histogram, byte/batch counters from ``datasets/prefetch.py``) are
