@@ -15,9 +15,10 @@ pre-existing lives in ``analysis/baseline.json`` (ratchet-only — the
 tier-1 run fails on any NEW finding).
 """
 from .linter import (Finding, Linter, load_baseline, load_baseline_reasons,
-                     save_baseline, DEFAULT_BASELINE_PATH, PACKAGE_ROOT)
+                     save_baseline, DEFAULT_BASELINE_PATH, PACKAGE_ROOT,
+                     REPO_ROOT)
 from .rules import all_rules, get_rule
 
 __all__ = ["Finding", "Linter", "load_baseline", "load_baseline_reasons",
            "save_baseline", "DEFAULT_BASELINE_PATH", "PACKAGE_ROOT",
-           "all_rules", "get_rule"]
+           "REPO_ROOT", "all_rules", "get_rule"]

@@ -214,19 +214,27 @@ class Linter:
         return finding.rule.upper() in ids
 
     # ------------------------------------------------------------- linting
+    @staticmethod
+    def _parse(source: str, path: str, rel: str, lines: Sequence[str]):
+        """(tree, None) or (None, SYN000 finding)."""
+        try:
+            return ast.parse(source, filename=path), None
+        except SyntaxError as e:
+            return None, Finding(
+                "SYN000", rel, int(e.lineno or 1),
+                int((e.offset or 1) - 1), f"syntax error: {e.msg}",
+                snippet=(lines[e.lineno - 1].strip()
+                         if e.lineno and e.lineno <= len(lines) else ""))
+
     def lint_source(self, source: str, path: str) -> List[Finding]:
-        """Lint one already-read source blob (unit of everything else)."""
+        """Lint one already-read source blob with the per-file rules.
+        Project rules (THR003/THR004) see a one-module horizon here; use
+        :meth:`run_sources` to lint a SET of sources as one project."""
         rel = self._relpath(path)
         lines = source.splitlines()
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            return [Finding("SYN000", rel, int(e.lineno or 1),
-                            int((e.offset or 1) - 1),
-                            f"syntax error: {e.msg}",
-                            snippet=(lines[e.lineno - 1].strip()
-                                     if e.lineno and
-                                     e.lineno <= len(lines) else ""))]
+        tree, syn = self._parse(source, path, rel, lines)
+        if syn is not None:
+            return [syn]
         out: List[Finding] = []
         for rule in self.rules:
             for f in rule.check(tree, lines, rel):
@@ -271,14 +279,62 @@ class Linter:
     def run(self, paths: Sequence[str],
             baseline: Optional[Dict[Tuple[str, str, str], int]] = None
             ) -> LintResult:
-        """Lint paths and partition findings against ``baseline``."""
+        """Lint paths and partition findings against ``baseline``. File
+        rules run per file; project rules (THR003/THR004) run ONCE over
+        every parseable module of the run — which is what makes their
+        interprocedural analysis see cross-file lock orders."""
+        blobs: List[Tuple[str, str, Optional[str]]] = []
+        for fp in self.iter_files(paths):
+            try:
+                with open(fp, "r", encoding="utf-8") as fh:
+                    blobs.append((fp, fh.read(), None))
+            except (OSError, UnicodeDecodeError) as e:
+                # one unreadable file must not kill the verdict for the
+                # rest of the tree — report it (always new → exit 1)
+                blobs.append((fp, "", f"cannot read file: {e}"))
+        return self._run_blobs(blobs, baseline)
+
+    def run_sources(self, sources: Dict[str, str],
+                    baseline: Optional[Dict[Tuple[str, str, str],
+                                            int]] = None) -> LintResult:
+        """Lint a dict of in-memory ``{path: source}`` blobs as ONE
+        project (fixtures for the interprocedural rules)."""
+        return self._run_blobs(
+            [(p, src, None) for p, src in sorted(sources.items())],
+            baseline)
+
+    def _run_blobs(self, blobs, baseline=None) -> LintResult:
+        from .lockgraph import ModuleSource
         res = LintResult()
         findings: List[Finding] = []
         checked: set = set()
-        for fp in self.iter_files(paths):
-            findings.extend(self.lint_file(fp))
-            checked.add(self._relpath(fp))
+        modules: List[ModuleSource] = []
+        line_map: Dict[str, Sequence[str]] = {}
+        file_rules = [r for r in self.rules if not r.project]
+        project_rules = [r for r in self.rules if r.project]
+        for fp, source, err in blobs:
+            rel = self._relpath(fp)
+            checked.add(rel)
             res.files_checked += 1
+            if err is not None:
+                findings.append(Finding("SYN000", rel, 1, 0, err))
+                continue
+            lines = source.splitlines()
+            tree, syn = self._parse(source, fp, rel, lines)
+            if syn is not None:
+                findings.append(syn)
+                continue
+            line_map[rel] = lines
+            modules.append(ModuleSource(rel, tree, lines))
+            for rule in file_rules:
+                for f in rule.check(tree, lines, rel):
+                    if not self._suppressed(f, lines):
+                        findings.append(f)
+        if project_rules and modules:
+            for rule in project_rules:
+                for f in rule.check_project(modules):
+                    if not self._suppressed(f, line_map.get(f.path, ())):
+                        findings.append(f)
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         remaining = dict(baseline or {})
         for f in findings:

@@ -1,0 +1,657 @@
+"""Interprocedural lock-graph analysis (THR003 / THR004 backend).
+
+THR001/THR002 see one function at a time; this module sees the package.
+It resolves every lock to a **stable identity**, follows calls made while
+a lock is held, and derives two whole-program artifacts:
+
+- the **acquisition graph**: an edge ``A -> B`` means some code path
+  acquires lock ``B`` while holding lock ``A`` (directly nested ``with``,
+  or through any resolvable call chain). A cycle in this graph is a
+  lock-order inversion — the schedule that deadlocks under contention —
+  reported as **THR003** with BOTH witness paths in the message.
+- **cross-function blocking**: a call made under a lock whose transitive
+  callee reaches a blocking primitive (the THR001 set: sleep, socket
+  I/O, the ``send_frame``/``recv_frame`` wire helpers, untimed
+  ``join``/queue ``get``) — reported as **THR004** at the call site,
+  with the full call path to the block. Direct in-region blocking stays
+  THR001's report; THR004 only fires across a function boundary, so the
+  two never double-report one line.
+
+Lock identities
+---------------
+- ``ClassName.attr`` for ``self.attr = threading.Lock()/RLock()/
+  Condition()`` (assigned in any method of the class), and
+- ``module.NAME`` for module-level globals,
+- the **string literal** passed to ``monitor.lockwatch``'s
+  ``make_lock("Name")`` / ``make_rlock`` / ``make_condition`` factories
+  when the lock is created through them — which is exactly the name the
+  runtime sanitizer labels its observed edges with, so
+  ``tests/test_lockwatch.py`` can require every runtime-observed edge to
+  be statically derivable from this graph.
+
+Call resolution (the JAX001 scope-resolution idea, widened to types):
+``self.m()`` resolves through the enclosing class and its (same-package)
+bases; bare ``f()`` to module functions and package-internal
+``from . import`` targets; ``obj.m()`` through parameter annotations
+(``def _pull(self, ep: _Epoch)``), local ``var = ClassName(...)`` /
+``var = factory()`` assignments where the factory has a class return
+annotation (``def get_registry() -> MetricsRegistry``). Unresolvable
+calls are skipped — this is a may-analysis used as an under-approximation
+for blocking/cycles and checked against runtime observation for recall.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .rules.threading_rules import _blocking_reason, _is_lock_expr
+from .rules import terminal_name
+
+__all__ = ["LockGraph", "LockGraphAnalyzer", "ModuleSource",
+           "analyze_package"]
+
+
+def analyze_package(root: Optional[str] = None) -> "LockGraph":
+    """Parse every .py under ``root`` (default: the installed package)
+    and build its lock graph — the static half of the runtime cross-check
+    in ``tests/test_lockwatch.py``."""
+    from .linter import Linter, PACKAGE_ROOT
+    linter = Linter(rules=[])
+    modules: List[ModuleSource] = []
+    for fp in Linter.iter_files([root or PACKAGE_ROOT]):
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=fp)
+        except (OSError, UnicodeDecodeError, SyntaxError):
+            continue
+        modules.append(ModuleSource(linter._relpath(fp), tree,
+                                    source.splitlines()))
+    return LockGraphAnalyzer(modules).build()
+
+#: monitor.lockwatch factory callees — first string arg IS the identity
+_LOCK_FACTORIES = {"make_lock", "make_rlock", "make_condition"}
+#: threading constructors that create a lock object
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+#: how deep the call-chain closure follows before giving up (cycles in
+#: the call graph are handled by memoization; the cap bounds pathology)
+_MAX_DEPTH = 8
+
+
+class ModuleSource:
+    """One parsed module handed to the analyzer."""
+
+    __slots__ = ("path", "tree", "lines", "modkey", "modbase")
+
+    def __init__(self, path: str, tree: ast.AST, lines: Sequence[str]):
+        self.path = path.replace(os.sep, "/")
+        self.tree = tree
+        self.lines = lines
+        # dotted module key without extension: "deeplearning4j_tpu.
+        # paramserver.server"; modbase is the stem used in global-lock ids
+        self.modkey = self.path[:-3].replace("/", ".") \
+            if self.path.endswith(".py") else self.path.replace("/", ".")
+        self.modbase = self.modkey.rsplit(".", 1)[-1]
+
+
+class _FuncInfo:
+    """Per-function facts: lock regions, direct acquisitions/blocking/
+    calls (same-thread walk: nested def/lambda bodies excluded — a
+    closure defined under a lock runs later)."""
+
+    __slots__ = ("key", "node", "mod", "classname", "regions",
+                 "acquires", "blocking", "calls", "display")
+
+    def __init__(self, key, node, mod, classname):
+        self.key = key                  # (modkey, classname|None, name)
+        self.node = node
+        self.mod = mod
+        self.classname = classname
+        self.display = (f"{classname}.{node.name}" if classname
+                        else node.name)
+        self.regions: List[tuple] = []  # (lockid, line, events)
+        self.acquires: List[tuple] = [] # (lockid, line)
+        self.blocking: List[tuple] = [] # (reason, line, callee)
+        self.calls: List[tuple] = []    # (callee_key, line, display)
+
+
+class LockGraph:
+    """The analysis result: edges, witnesses, cycles, THR004 chains."""
+
+    def __init__(self):
+        #: {(lockA, lockB): witness} — witness is a human-readable hop
+        #: list ending at lockB's acquisition
+        self.edges: Dict[Tuple[str, str], str] = {}
+        #: [(path, line, snippet-line, lockid, witness-pair)] per cycle
+        self.cycles: List[dict] = []
+        #: [(path, line, lockid, reason, chain)] blocking-under-lock
+        #: reached across a function boundary
+        self.blocking: List[dict] = []
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return set(self.edges)
+
+
+def _walk_same_thread(root: ast.AST, include_root_children=True):
+    """Walk skipping nested function/lambda bodies (separate execution)."""
+    stack = list(ast.iter_child_nodes(root)) if include_root_children \
+        else [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _ann_class(ann: Optional[ast.AST]) -> Optional[str]:
+    """Annotation expression -> class name (handles Optional["X"] not;
+    plain Name / Attribute / string constants only — the repo's idiom)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rsplit(".", 1)[-1]
+    name = terminal_name(ann)
+    return name
+
+
+class LockGraphAnalyzer:
+    """Build the package lock graph from parsed modules."""
+
+    def __init__(self, modules: Iterable[ModuleSource]):
+        self.modules = list(modules)
+        #: class name -> (modkey, ClassDef, [base names])
+        self.classes: Dict[str, Tuple[str, ast.ClassDef, List[str]]] = {}
+        #: (classname, attr) -> lock identity
+        self.attr_locks: Dict[Tuple[str, str], str] = {}
+        #: attr -> {classname} (unique-owner fallback resolution)
+        self.attr_owners: Dict[str, Set[str]] = {}
+        #: (modkey, global name) -> identity
+        self.global_locks: Dict[Tuple[str, str], str] = {}
+        #: (classname, attr) -> class name (``self.X = ClassName(...)`` /
+        #: annotated attr assignments) — lets ``self._fan.run()`` resolve
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        #: function index
+        self.funcs: Dict[tuple, _FuncInfo] = {}
+        #: (modkey, imported name) -> (modkey2, name2) package-internal
+        self.imports: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        #: function key -> class name it returns (return annotation)
+        self.returns: Dict[tuple, str] = {}
+        self._closure_memo: Dict[tuple, tuple] = {}
+        self._index()
+        self._summarize()
+
+    # ------------------------------------------------------------ indexing
+    def _index(self):
+        for mod in self.modules:
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    bases = [terminal_name(b) for b in node.bases]
+                    self.classes.setdefault(
+                        node.name,
+                        (mod.modkey, node, [b for b in bases if b]))
+                elif isinstance(node, (ast.ImportFrom,)):
+                    self._index_import(mod, node)
+                elif isinstance(node, ast.Assign):
+                    ident = self._lock_ctor_identity(node.value)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and ident is not None:
+                            self.global_locks[(mod.modkey, t.id)] = (
+                                ident if isinstance(ident, str)
+                                else f"{mod.modbase}.{t.id}")
+        # functions + self-attr lock definitions + return annotations
+        for mod in self.modules:
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._index_func(mod, None, node)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            self._index_func(mod, node.name, item)
+
+    def _index_import(self, mod: ModuleSource, node: ast.ImportFrom):
+        target = self._resolve_import_module(mod, node)
+        if target is None:
+            return
+        for a in node.names:
+            self.imports[(mod.modkey, a.asname or a.name)] = (target,
+                                                              a.name)
+
+    def _resolve_import_module(self, mod: ModuleSource,
+                               node: ast.ImportFrom) -> Optional[str]:
+        """Relative (and package-absolute) import -> target modkey, when
+        the target is one of the analyzed modules."""
+        if node.level == 0:
+            target = node.module or ""
+        else:
+            parts = mod.modkey.split(".")
+            # strip the module name itself plus (level-1) packages
+            base = parts[:-node.level]
+            target = ".".join(base + ((node.module or "").split(".")
+                                      if node.module else []))
+        known = {m.modkey for m in self.modules}
+        if target in known:
+            return target
+        # "from X import Y" where X is a package: Y may be a module —
+        # not needed for lock analysis; ignore
+        return None
+
+    def _lock_ctor_identity(self, value: ast.AST):
+        """Is ``value`` a lock construction? Returns the literal name for
+        factory calls, True for bare threading ctors, None otherwise."""
+        if not isinstance(value, ast.Call):
+            return None
+        callee = terminal_name(value.func)
+        if callee in _LOCK_FACTORIES:
+            if value.args and isinstance(value.args[0], ast.Constant) \
+                    and isinstance(value.args[0].value, str):
+                return value.args[0].value
+            return True
+        if callee in _LOCK_CTORS:
+            # threading.Lock() / Lock() / threading.Condition(...)
+            return True
+        return None
+
+    def _index_func(self, mod: ModuleSource, classname: Optional[str],
+                    node: ast.AST):
+        key = (mod.modkey, classname, node.name)
+        self.funcs[key] = _FuncInfo(key, node, mod, classname)
+        ret = _ann_class(getattr(node, "returns", None))
+        if ret and ret in self.classes or ret and classname == ret:
+            self.returns[key] = ret
+        # self-attr lock definitions + self-attr types
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign) or classname is None:
+                continue
+            if isinstance(stmt.value, ast.Call):
+                ctor = terminal_name(stmt.value.func)
+                if ctor in self.classes \
+                        and isinstance(stmt.value.func, ast.Name):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            self.attr_types.setdefault(
+                                (classname, t.attr), ctor)
+            ident = self._lock_ctor_identity(stmt.value)
+            if ident is None:
+                continue
+            for t in stmt.targets:
+                attr = None
+                tt = t
+                while isinstance(tt, ast.Subscript):
+                    tt = tt.value
+                if isinstance(tt, ast.Attribute) \
+                        and isinstance(tt.value, ast.Name) \
+                        and tt.value.id == "self":
+                    attr = tt.attr
+                if attr is None:
+                    continue
+                identity = (ident if isinstance(ident, str)
+                            else f"{classname}.{attr}")
+                self.attr_locks[(classname, attr)] = identity
+                self.attr_owners.setdefault(attr, set()).add(classname)
+
+    # --------------------------------------------------------- resolution
+    def _class_chain(self, classname: str) -> List[str]:
+        """classname + same-package ancestors (by name, cycle-safe)."""
+        out, stack, seen = [], [classname], set()
+        while stack:
+            c = stack.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            out.append(c)
+            stack.extend(self.classes[c][2])
+        return out
+
+    def _attr_lock_identity(self, classname: Optional[str],
+                            attr: str) -> Optional[str]:
+        if classname is not None:
+            for c in self._class_chain(classname):
+                ident = self.attr_locks.get((c, attr))
+                if ident is not None:
+                    return ident
+        owners = self.attr_owners.get(attr, set())
+        if len(owners) == 1:
+            return self.attr_locks[(next(iter(owners)), attr)]
+        return None
+
+    def _local_types(self, fn: _FuncInfo) -> Dict[str, str]:
+        """param annotations + simple ``var = ClassName(...)`` /
+        ``var = annotated_factory()`` assignments -> class names."""
+        types: Dict[str, str] = {}
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            c = _ann_class(a.annotation)
+            if c and c in self.classes:
+                types[a.arg] = c
+        for stmt in _walk_same_thread(fn.node):
+            value, targets = None, []
+            if isinstance(stmt, ast.Assign):
+                value, targets = stmt.value, stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                c = _ann_class(stmt.annotation)
+                if c and c in self.classes and isinstance(stmt.target,
+                                                          ast.Name):
+                    types[stmt.target.id] = c
+                continue
+            if value is None or not isinstance(value, ast.Call):
+                continue
+            cls = self._call_result_class(value, fn)
+            if cls is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    types[t.id] = cls
+        return types
+
+    def _call_result_class(self, call: ast.Call,
+                           fn: _FuncInfo) -> Optional[str]:
+        """Class constructed / returned by ``call`` (ctor or annotated
+        factory), else None."""
+        callee = terminal_name(call.func)
+        if callee in self.classes and isinstance(call.func, ast.Name):
+            return callee
+        key = self._resolve_call_key(call, fn, types=None)
+        if key is not None:
+            return self.returns.get(key)
+        return None
+
+    def _resolve_call_key(self, call: ast.Call, fn: _FuncInfo,
+                          types: Optional[Dict[str, str]]) -> Optional[tuple]:
+        f = call.func
+        modkey = fn.mod.modkey
+        if isinstance(f, ast.Name):
+            key = (modkey, None, f.id)
+            if key in self.funcs:
+                return key
+            imp = self.imports.get((modkey, f.id))
+            if imp is not None:
+                key = (imp[0], None, imp[1])
+                if key in self.funcs:
+                    return key
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base = f.value
+        # self.m() -> method through the class chain
+        if isinstance(base, ast.Name) and base.id == "self" \
+                and fn.classname is not None:
+            return self._method_key(fn.classname, f.attr)
+        # var.m() via local/param types
+        if isinstance(base, ast.Name) and types is not None:
+            cls = types.get(base.id)
+            if cls is not None:
+                return self._method_key(cls, f.attr)
+        # self.attr.m() via self-attr types (self._fan = Fanout(...))
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and fn.classname is not None:
+            for c in self._class_chain(fn.classname):
+                cls = self.attr_types.get((c, base.attr))
+                if cls is not None:
+                    return self._method_key(cls, f.attr)
+        # factory().m() via return annotations
+        if isinstance(base, ast.Call):
+            cls = self._call_result_class(base, fn)
+            if cls is not None:
+                return self._method_key(cls, f.attr)
+        return None
+
+    def _method_key(self, classname: str, method: str) -> Optional[tuple]:
+        for c in self._class_chain(classname):
+            modkey = self.classes[c][0]
+            key = (modkey, c, method)
+            if key in self.funcs:
+                return key
+        return None
+
+    def _resolve_lock(self, expr: ast.AST, fn: _FuncInfo,
+                      types: Dict[str, str]) -> Optional[str]:
+        """Lock identity of a with-item / acquire receiver, or None when
+        the expression is not recognizably a lock."""
+        node = expr
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            attr = node.attr
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                ident = self._attr_lock_identity(fn.classname, attr)
+            elif isinstance(base, ast.Name):
+                cls = types.get(base.id)
+                ident = self._attr_lock_identity(cls, attr)
+            else:
+                ident = self._attr_lock_identity(None, attr)
+            if ident is not None:
+                return ident
+            return f"?.{attr}" if _is_lock_expr(node) else None
+        if isinstance(node, ast.Name):
+            ident = self.global_locks.get((fn.mod.modkey, node.id))
+            if ident is not None:
+                return ident
+            imp = self.imports.get((fn.mod.modkey, node.id))
+            if imp is not None:
+                ident = self.global_locks.get(imp)
+                if ident is not None:
+                    return ident
+            return f"?.{node.id}" if _is_lock_expr(node) else None
+        return None
+
+    # --------------------------------------------------------- summaries
+    def _summarize(self):
+        for fn in self.funcs.values():
+            types = self._local_types(fn)
+            self._scan_fn(fn, types)
+
+    def _scan_fn(self, fn: _FuncInfo, types: Dict[str, str]):
+        # whole-body direct facts
+        for node in _walk_same_thread(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            lockid = self._acquire_lockid(node, fn, types)
+            if lockid is not None:
+                fn.acquires.append((lockid, node.lineno))
+                continue
+            reason = _blocking_reason(node)
+            if reason:
+                fn.blocking.append((reason, node.lineno,
+                                    terminal_name(node.func) or "?"))
+                continue
+            key = self._resolve_call_key(node, fn, types)
+            if key is not None and key != fn.key:
+                fn.calls.append((key, node.lineno,
+                                 self.funcs[key].display))
+        # with-lock regions (nested regions recorded independently; the
+        # same-thread walk of an outer region sees the inner acquisitions)
+        for node in _walk_same_thread(fn.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    continue        # context managers, not lock objects
+                lockid = self._resolve_lock(item.context_expr, fn, types)
+                if lockid is None:
+                    continue
+                events = self._region_events(node, item.context_expr, fn,
+                                             types)
+                fn.regions.append((lockid, node.lineno, events))
+        # with-acquires count into fn.acquires too (a caller holding L
+        # that calls us must see our with-regions as acquisitions)
+        for lockid, line, _ in fn.regions:
+            fn.acquires.append((lockid, line))
+
+    def _acquire_lockid(self, call: ast.Call, fn: _FuncInfo,
+                        types: Dict[str, str]) -> Optional[str]:
+        """``X.acquire(...)`` on a resolvable lock -> identity."""
+        if not isinstance(call.func, ast.Attribute) \
+                or call.func.attr != "acquire":
+            return None
+        return self._resolve_lock(call.func.value, fn, types)
+
+    def _region_events(self, region: ast.AST, lock_expr: ast.AST,
+                       fn: _FuncInfo, types: Dict[str, str]) -> List[tuple]:
+        """Events inside one with-lock region (same-thread walk of the
+        BODY; the with-items themselves are excluded)."""
+        events: List[tuple] = []
+        for stmt in region.body:
+            for node in _walk_same_thread(stmt, include_root_children=False):
+                if not isinstance(node, ast.Call):
+                    continue
+                lockid = self._acquire_lockid(node, fn, types)
+                if lockid is not None:
+                    events.append(("acquire", lockid, node.lineno))
+                    continue
+                # direct in-region blocking yields NO event: that line is
+                # THR001's single-function report, and the `continue` also
+                # keeps a resolvable blocking WRAPPER (streaming's
+                # _send_frame) from re-entering as a "call" — THR004 only
+                # fires across a function boundary the line can't show
+                if _blocking_reason(node):
+                    continue
+                key = self._resolve_call_key(node, fn, types)
+                if key is not None and key != fn.key:
+                    events.append(("call", key, node.lineno))
+        # nested with-locks inside the region body
+        for stmt in region.body:
+            for node in _walk_same_thread(stmt,
+                                          include_root_children=False):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        continue
+                    lockid = self._resolve_lock(item.context_expr, fn,
+                                                types)
+                    if lockid is not None:
+                        events.append(("acquire", lockid, node.lineno))
+        return events
+
+    # ----------------------------------------------------------- closures
+    def closure(self, key: tuple, _depth: int = 0,
+                _stack: Optional[frozenset] = None) -> tuple:
+        """Transitive effects of calling ``key``:
+        ``(acquires: {lockid: hops}, blocking: {(reason, line-desc):
+        hops})`` where hops is a tuple of "display (path:line)" strings
+        from the entry call to the effect."""
+        memo = self._closure_memo.get(key)
+        if memo is not None:
+            return memo
+        stack = _stack or frozenset()
+        if key in stack or _depth > _MAX_DEPTH:
+            return {}, {}
+        fn = self.funcs.get(key)
+        if fn is None:
+            return {}, {}
+        acq: Dict[str, tuple] = {}
+        blk: Dict[tuple, tuple] = {}
+        here = fn.mod.path
+        for lockid, line in fn.acquires:
+            acq.setdefault(lockid,
+                           (f"{fn.display} acquires {lockid} "
+                            f"({here}:{line})",))
+        for reason, line, callee in fn.blocking:
+            blk.setdefault((reason, callee),
+                           (f"{fn.display} calls {callee} [{reason}] "
+                            f"({here}:{line})",))
+        for callee_key, line, display in fn.calls:
+            sub_acq, sub_blk = self.closure(
+                callee_key, _depth + 1, stack | {key})
+            hop = f"{fn.display} -> {display} ({here}:{line})"
+            for lockid, hops in sub_acq.items():
+                acq.setdefault(lockid, (hop,) + hops)
+            for bkey, hops in sub_blk.items():
+                blk.setdefault(bkey, (hop,) + hops)
+        result = (acq, blk)
+        if _depth == 0:
+            self._closure_memo[key] = result
+        return result
+
+    # -------------------------------------------------------------- build
+    def build(self) -> LockGraph:
+        graph = LockGraph()
+        edge_meta: Dict[Tuple[str, str], dict] = {}
+        blocking: List[dict] = []
+        for fn in self.funcs.values():
+            here = fn.mod.path
+            for held, region_line, events in fn.regions:
+                for ev in events:
+                    if ev[0] == "acquire":
+                        _, lockid, line = ev
+                        if lockid == held:
+                            continue
+                        edge_meta.setdefault((held, lockid), {
+                            "path": here, "line": line,
+                            "witness": (f"{fn.display} holds {held} "
+                                        f"({here}:{region_line}) and "
+                                        f"acquires {lockid} "
+                                        f"({here}:{line})"),
+                        })
+                    elif ev[0] == "call":
+                        _, key, line = ev
+                        sub_acq, sub_blk = self.closure(key)
+                        hop = (f"{fn.display} holds {held} "
+                               f"({here}:{region_line}), calls "
+                               f"{self.funcs[key].display} "
+                               f"({here}:{line})")
+                        for lockid, hops in sub_acq.items():
+                            if lockid == held:
+                                continue
+                            edge_meta.setdefault((held, lockid), {
+                                "path": here, "line": line,
+                                "witness": " -> ".join((hop,) + hops),
+                            })
+                        for (reason, callee), hops in sub_blk.items():
+                            blocking.append({
+                                "path": here, "line": line,
+                                "lock": held, "reason": reason,
+                                "callee": callee,
+                                "chain": " -> ".join((hop,) + hops),
+                            })
+        graph.edges = {k: m["witness"] for k, m in edge_meta.items()}
+        graph.blocking = blocking
+        graph.cycles = self._find_cycles(edge_meta)
+        return graph
+
+    def _find_cycles(self, edge_meta: Dict[Tuple[str, str], dict]
+                     ) -> List[dict]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edge_meta:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles: Set[frozenset] = set()
+        out: List[dict] = []
+        for (a, b), meta in sorted(edge_meta.items()):
+            back = self._path(adj, b, a)
+            if back is None:
+                continue
+            nodes = frozenset([a, b] + back[:-1])
+            if nodes in seen_cycles:
+                continue
+            seen_cycles.add(nodes)
+            rev = " ; ".join(edge_meta[(x, y)]["witness"]
+                             for x, y in zip([b] + back, back))
+            out.append({"path": meta["path"], "line": meta["line"],
+                        "locks": sorted(nodes),
+                        "forward": meta["witness"], "reverse": rev})
+        return out
+
+    @staticmethod
+    def _path(adj: Dict[str, Set[str]], src: str,
+              dst: str) -> Optional[List[str]]:
+        stack: List[Tuple[str, List[str]]] = [(src, [])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None

@@ -21,13 +21,24 @@ _REGISTRY: Dict[str, Type["Rule"]] = {}
 
 
 class Rule:
-    """Base class for tpulint rules."""
+    """Base class for tpulint rules.
+
+    ``project = True`` marks a rule whose analysis spans files (the
+    interprocedural lock rules): the linter calls :meth:`check_project`
+    ONCE with every parsed module of the run instead of :meth:`check`
+    per file. Such rules still work through ``check`` for single-source
+    entry points, just with a one-module horizon.
+    """
     id: str = ""
     title: str = ""
     rationale: str = ""
+    project: bool = False
 
     def check(self, tree: ast.AST, lines: Sequence[str],
               path: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, modules) -> Iterator[Finding]:
         raise NotImplementedError
 
     # convenience for subclasses
@@ -57,7 +68,8 @@ def all_rules() -> Dict[str, Type[Rule]]:
     """Every registered rule, id-sorted. Importing the rule modules here
     (not at package import) keeps ``analysis.linter`` import-light and
     cycle-free."""
-    from . import exception_rules, jax_rules, threading_rules  # noqa: F401
+    from . import (exception_rules, jax_rules, lockgraph_rules,  # noqa: F401
+                   resource_rules, threading_rules)  # noqa: F401
     return dict(sorted(_REGISTRY.items()))
 
 

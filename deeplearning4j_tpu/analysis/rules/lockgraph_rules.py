@@ -1,0 +1,125 @@
+"""Interprocedural concurrency rules: lock-order cycles (THR003) and
+lock-held-across-blocking-call (THR004).
+
+Both are **project rules** (``project = True``): they run once over the
+whole file set of a lint run, on the package-wide acquisition graph the
+:mod:`~deeplearning4j_tpu.analysis.lockgraph` analyzer builds — because a
+lock-order inversion is, by construction, a property of two *different*
+code paths that no single-function scan can see. The runtime half of the
+pass is ``monitor/lockwatch.py``; ``tests/test_lockwatch.py`` pins that
+every lock-order edge the sanitizer observes at runtime is derivable by
+this analyzer (the static side is not allowed to be blind to real
+behavior).
+
+Caveat worth knowing when reading reports: a subset run (``lint
+--changed``, explicit paths) analyzes only the files given — call chains
+and cycle partners living outside the subset are invisible there. The
+tier-1 self-host guard always runs the whole package.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Set, Tuple
+
+from . import Rule, register, make_finding
+from ..lockgraph import LockGraph, LockGraphAnalyzer, ModuleSource
+
+
+#: one-slot cache so THR003 and THR004 running over the SAME module list
+#: (the linter passes one list object to every project rule) build the
+#: package-wide graph once, not once per rule; the strong reference to
+#: the module list keeps the identity check sound
+_LAST: list = [None, None]
+
+
+def _analyze(modules: Sequence[ModuleSource]) -> LockGraph:
+    if _LAST[0] is modules:
+        return _LAST[1]
+    graph = LockGraphAnalyzer(modules).build()
+    _LAST[0], _LAST[1] = modules, graph
+    return graph
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "THR003"
+    title = "lock-order inversion (cycle in the acquisition graph)"
+    project = True
+    rationale = (
+        "Two code paths acquiring the same locks in opposite orders "
+        "deadlock the moment they run concurrently — and 16 modules here "
+        "hold locks across the paramserver fleet, the prefetch pipeline "
+        "and the monitor stack, with Fanout executors interleaving them "
+        "freely. The analyzer resolves locks to stable identities "
+        "(ClassName.attr / module.GLOBAL / the lockwatch factory name), "
+        "follows calls made while a lock is held, and reports any cycle "
+        "with BOTH witness paths. Fix: pick one canonical order (document "
+        "it where the locks are created) and restructure the losing path "
+        "— usually by snapshotting under the first lock and calling out "
+        "after releasing it (docs/STATIC_ANALYSIS.md has the runbook).")
+
+    def check(self, tree, lines, path) -> Iterator:
+        # single-file entry (lint_source): analyze just this module —
+        # project runs use check_project with the whole file set
+        yield from self.check_project(
+            [ModuleSource(path, tree, lines)])
+
+    def check_project(self, modules: Sequence[ModuleSource]) -> Iterator:
+        graph = _analyze(modules)
+        lines_by_path = {m.path: m.lines for m in modules}
+        for cyc in graph.cycles:
+            lines = lines_by_path.get(cyc["path"], [])
+            node = _Anchor(cyc["line"])
+            yield make_finding(
+                self.id, node, lines, cyc["path"],
+                f"lock-order inversion between "
+                f"{' and '.join(cyc['locks'])}: path 1 [{cyc['forward']}] "
+                f"vs path 2 [{cyc['reverse']}] — these orders deadlock "
+                f"under contention; pick one canonical order and "
+                f"restructure the other path")
+
+
+@register
+class LockHeldAcrossBlockingCall(Rule):
+    id = "THR004"
+    title = "lock held across a blocking call in a called function"
+    project = True
+    rationale = (
+        "THR001 sees a sleep/socket/join under `with lock:` only when "
+        "both live in one function — but the hazard hides just as well "
+        "one call away: a helper that looks cheap at the call site "
+        "sends a frame or sleeps three frames down. This rule follows "
+        "every resolvable call made while a lock is held to the "
+        "blocking primitive it reaches, and reports the full chain. Fix "
+        "like THR001: snapshot under the lock, do the blocking work "
+        "after releasing it — or make the callee non-blocking.")
+
+    def check(self, tree, lines, path) -> Iterator:
+        yield from self.check_project(
+            [ModuleSource(path, tree, lines)])
+
+    def check_project(self, modules: Sequence[ModuleSource]) -> Iterator:
+        graph = _analyze(modules)
+        lines_by_path = {m.path: m.lines for m in modules}
+        seen: Set[Tuple[str, int, str, str]] = set()
+        for b in graph.blocking:
+            key = (b["path"], b["line"], b["lock"], b["reason"])
+            if key in seen:
+                continue
+            seen.add(key)
+            lines = lines_by_path.get(b["path"], [])
+            node = _Anchor(b["line"])
+            yield make_finding(
+                self.id, node, lines, b["path"],
+                f"call made while holding {b['lock']!r} reaches a "
+                f"blocking {b['reason']} through [{b['chain']}]; every "
+                f"thread touching that lock stalls for the full I/O "
+                f"latency — snapshot under the lock, call after "
+                f"releasing it")
+
+
+class _Anchor:
+    """Minimal node stand-in for make_finding (line-anchored findings)."""
+
+    def __init__(self, line: int, col: int = 0):
+        self.lineno = int(line)
+        self.col_offset = int(col)

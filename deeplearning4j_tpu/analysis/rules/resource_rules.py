@@ -1,0 +1,201 @@
+"""Resource hygiene: sockets / executors / servers without a close path.
+
+The threaded modules here own real OS resources — TCP sockets on the
+paramserver wire, ``ThreadPoolExecutor`` fan-out pools, accept-loop
+server sockets. A leaked one is quieter than a leaked thread (THR002):
+nothing hangs, the process just accumulates fds until a long training
+run hits EMFILE, or CI leaks ports between tests. RES001 demands that
+every creation site has a *visible* disposal story.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from . import Rule, register, terminal_name
+
+#: constructors that allocate an OS-level resource, and what closes them
+_SOCKET_CTORS = {"socket", "create_connection", "socketpair",
+                 "create_server"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SERVER_CTORS = {"HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                 "UDPServer", "ThreadingTCPServer", "ThreadingUDPServer"}
+#: receiver methods that count as disposal
+_DISPOSERS = {"close", "shutdown", "stop", "server_close", "terminate"}
+
+
+def _creation_kind(call: ast.Call) -> Optional[str]:
+    callee = terminal_name(call.func)
+    if callee in _EXECUTOR_CTORS:
+        return "executor"
+    if callee in _SERVER_CTORS:
+        return "server"
+    if callee in _SOCKET_CTORS:
+        # sockets are attribute calls (socket.socket, socket.create_
+        # connection) or bare imports of those names; 'socket' as a bare
+        # Name call only counts when the module imports it from socket
+        if isinstance(call.func, ast.Attribute):
+            base = terminal_name(call.func.value)
+            if base == "socket":
+                return "socket"
+            return None
+        return None    # bare socket()/create_connection(): too ambiguous
+    return None
+
+
+def _bound_target(call: ast.Call, parents) -> Tuple[Optional[str], bool]:
+    """(terminal name the resource is bound to, is_self_attr). None when
+    the creation is unbound (an expression/argument) — unjoinable."""
+    parent = parents.get(call)
+    targets: List[ast.AST] = []
+    if isinstance(parent, ast.Assign) and parent.value is call:
+        targets = parent.targets
+    elif isinstance(parent, (ast.AnnAssign, ast.AugAssign,
+                             ast.NamedExpr)) and parent.value is call:
+        targets = [parent.target]
+    for t in targets:
+        tt = t
+        while isinstance(tt, ast.Subscript):
+            tt = tt.value
+        if isinstance(tt, ast.Attribute):
+            return tt.attr, True
+        if isinstance(tt, ast.Name):
+            return tt.id, False
+    return None, False
+
+
+@register
+class LeakedResource(Rule):
+    id = "RES001"
+    title = "socket/executor/server created without a close path"
+    rationale = (
+        "A socket, ThreadPoolExecutor, or server object with no "
+        "with-block, close(), shutdown(), or stop() on any path leaks an "
+        "OS resource per call — fds under the paramserver's reconnect "
+        "loops, threads under a forgotten executor — until a long run "
+        "dies on EMFILE with no hint where. Create it in a `with`, or "
+        "bind it somewhere a close path provably reaches (locals: same "
+        "function; self attributes/globals: anywhere in the module). "
+        "Ownership that genuinely transfers out (a factory returning a "
+        "live socket into a pool) is a deliberate pattern: pragma the "
+        "line and name the closer (the pool-checkout idiom in "
+        "paramserver/client.py is the exemplar).")
+
+    def check(self, tree, lines, path) -> Iterator:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        # module-wide disposal evidence: receiver terminal names of
+        # close()/shutdown()/stop() calls, plus with-items
+        disposed_module: Set[str] = set()
+        withitems: Set[ast.Call] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DISPOSERS:
+                n = terminal_name(node.func.value)
+                if n:
+                    disposed_module.add(n)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        withitems.add(item.context_expr)
+        # one alias hop: `for s in self._peers.values(): s.close()` and
+        # the exception-safe swap `ex, self._exec = self._exec, None` +
+        # `ex.shutdown()` both dispose the ATTRIBUTE through a local name
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                src = node.iter
+                if isinstance(src, ast.Call):
+                    src = src.func
+                if isinstance(src, ast.Attribute) \
+                        and src.attr in ("values", "items", "keys"):
+                    src = src.value        # the container, not the view
+                container = terminal_name(src)
+                tgt = terminal_name(node.target)
+                if container and tgt and tgt in disposed_module:
+                    disposed_module.add(container)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+                pairs = []
+                if isinstance(t, ast.Tuple) and isinstance(v, ast.Tuple) \
+                        and len(t.elts) == len(v.elts):
+                    pairs = list(zip(t.elts, v.elts))
+                else:
+                    pairs = [(t, v)]
+                for te, ve in pairs:
+                    tn, vn = terminal_name(te), terminal_name(ve)
+                    if tn and vn and tn in disposed_module:
+                        disposed_module.add(vn)
+
+        # per-function disposal evidence for LOCAL names: a local `s` in
+        # one function is not the `s` of another
+        func_of: Dict[ast.AST, ast.AST] = {}
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for node in ast.walk(fn):
+                    func_of.setdefault(node, fn)
+        disposed_local: Dict[ast.AST, Set[str]] = {}
+        #: per function: local name -> attr names it was stored into
+        #: (`self._peers[q] = s` hands ownership to the instance; the
+        #: attr's module-wide close path then covers the local)
+        stored_into: Dict[ast.AST, Dict[str, Set[str]]] = {}
+        for node in ast.walk(tree):
+            fn = func_of.get(node)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DISPOSERS:
+                n = terminal_name(node.func.value)
+                if n and fn is not None:
+                    disposed_local.setdefault(fn, set()).add(n)
+            elif isinstance(node, ast.Assign) and fn is not None \
+                    and isinstance(node.value, ast.Name):
+                for t in node.targets:
+                    tt = t
+                    while isinstance(tt, ast.Subscript):
+                        tt = tt.value
+                    if isinstance(tt, ast.Attribute):
+                        stored_into.setdefault(fn, {}).setdefault(
+                            node.value.id, set()).add(tt.attr)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _creation_kind(node)
+            if kind is None:
+                continue
+            if node in withitems:
+                continue                      # `with ctor() as x:` closes
+            if isinstance(parents.get(node), ast.Return):
+                # `return socket.create_connection(...)`: a pure factory —
+                # ownership transfers whole to the caller by construction
+                continue
+            bound, is_attr = _bound_target(node, parents)
+            if bound is None:
+                yield self.finding(
+                    node, lines, path,
+                    f"{kind} created but never bound — nothing can ever "
+                    f"close it; bind it and close/shutdown it, or use a "
+                    f"with-block")
+                continue
+            if is_attr:
+                ok = bound in disposed_module
+            else:
+                fn = func_of.get(node)
+                ok = bound in disposed_local.get(fn, set())
+                if not ok:
+                    attrs = stored_into.get(fn, {}).get(bound, set())
+                    ok = any(a in disposed_module for a in attrs)
+            if ok:
+                continue
+            where = ("no close()/shutdown() on it anywhere in this "
+                     "module" if is_attr else
+                     "no close()/shutdown() on it in this function")
+            yield self.finding(
+                node, lines, path,
+                f"{kind} bound to {bound!r} but {where}; close it on "
+                f"every path (with-block / try-finally), or — if "
+                f"ownership transfers out — pragma this line naming who "
+                f"closes it")

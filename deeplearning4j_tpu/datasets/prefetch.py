@@ -52,6 +52,7 @@ import numpy as np
 from .dataset import DataSet, DataSetIterator, MultiDataSet
 from .iterators import AsyncDataSetIterator
 from ..monitor import get_registry
+from ..monitor.lockwatch import make_condition, make_lock
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +92,7 @@ class _Epoch:
 
     def __init__(self, source):
         self.source = source
-        self.cond = threading.Condition()   # guards buf/emit_seq/end_seq
+        self.cond = make_condition("_Epoch.cond")  # guards buf/emit_seq/end_seq
         self.buf = {}                       # seq -> item | _Raise
         self.next_seq = 0
         self.emit_seq = 0
@@ -142,7 +143,7 @@ class PrefetchIterator:
         # iterator-level, NOT per-epoch: a stale worker still blocked
         # inside next(source) after a timed-out join keeps excluding the
         # next epoch's workers from the shared non-thread-safe base
-        self._pull_lock = threading.Lock()
+        self._pull_lock = make_lock("PrefetchIterator._pull_lock")
         self._ep: Optional[_Epoch] = None
         self._handles = None
 

@@ -142,7 +142,8 @@ class StreamingBroker:
         # LAST publisher of a topic closes — one departing publisher must not
         # end the stream for a topic others are still feeding
         self._pubs: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        from ..monitor.lockwatch import make_lock
+        self._lock = make_lock("StreamingBroker._lock")
         self._running = True
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -165,7 +166,8 @@ class StreamingBroker:
         if mode == "SUB":
             with self._lock:
                 self._subs.setdefault(topic, []).append(s)
-                self._send_locks[s] = threading.Lock()
+                from ..monitor.lockwatch import make_lock
+                self._send_locks[s] = make_lock("StreamingBroker._send_locks")
             return  # frames are pushed by publishers; socket stays open
         with self._lock:
             self._pubs[topic] = self._pubs.get(topic, 0) + 1

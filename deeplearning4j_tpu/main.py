@@ -299,25 +299,67 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def _changed_files(root: str) -> list:
+    """Repo-relative ``git diff``-touched .py files (working tree vs HEAD,
+    plus untracked), absolutized — the ``lint --changed`` scope."""
+    import os
+    import subprocess
+    out: list = []
+    # --relative: diff prints toplevel-relative paths by default, which
+    # silently drop every match when this repo is nested inside an outer
+    # git repository; ls-files --others is already cwd-relative
+    for argv in (["git", "diff", "--name-only", "--relative", "HEAD",
+                  "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            text = subprocess.run(
+                argv, cwd=root, check=True, capture_output=True,
+                text=True, timeout=30).stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            raise SystemExit(f"lint --changed needs a git checkout: {e}")
+        for line in text.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                ap = os.path.join(root, line)
+                if os.path.exists(ap) and ap not in out:
+                    out.append(ap)
+    return out
+
+
 def cmd_lint(args) -> int:
     """tpulint (docs/STATIC_ANALYSIS.md): AST-check the package (or the
     given paths) for this stack's hazard classes — host-sync barriers in
     jitted code (JAX001), PRNG key reuse (JAX002), blocking calls under a
-    lock (THR001), leaked threads (THR002), silent broad excepts (EXC001).
-    Exit 0 iff no finding outside the baseline; deterministic output."""
+    lock (THR001), leaked threads (THR002), lock-order inversions and
+    cross-function blocking-under-lock on the interprocedural lock graph
+    (THR003/THR004), silent broad excepts (EXC001), leaked
+    sockets/executors/servers (RES001). Exit 0 iff no finding outside the
+    baseline; deterministic output. ``--changed`` scopes the run to
+    git-touched files for fast pre-commit checks (note: the
+    interprocedural rules then only see those files — the tier-1 guard
+    always runs the whole package)."""
     import json as _json
     import os
     from .analysis import (Linter, load_baseline, load_baseline_reasons,
                            save_baseline, DEFAULT_BASELINE_PATH,
-                           PACKAGE_ROOT)
+                           PACKAGE_ROOT, REPO_ROOT)
 
-    if args.write_baseline and (args.paths or args.select):
+    if args.write_baseline and (args.paths or args.select or args.changed):
         # a ratchet reset is inherently whole-package: a subset rewrite
         # would silently delete grandfathered entries for files/rules the
         # run never examined
         raise SystemExit("--write-baseline requires a full default run "
-                         "(no explicit paths, no --select)")
-    paths = args.paths or [PACKAGE_ROOT]
+                         "(no explicit paths, no --select, no --changed)")
+    if args.changed:
+        if args.paths:
+            raise SystemExit("--changed and explicit paths are mutually "
+                             "exclusive")
+        paths = _changed_files(REPO_ROOT)
+        if not paths:
+            print("tpulint: no changed python files")
+            return 0
+    else:
+        paths = args.paths or [PACKAGE_ROOT]
     rules = ([r.strip() for r in args.select.split(",") if r.strip()]
              if args.select else None)
     try:
@@ -401,6 +443,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="report every finding, baselined or not")
     li.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule ids to run (default: all)")
+    li.add_argument("--changed", action="store_true",
+                    help="lint only git-diff-touched .py files (working "
+                         "tree vs HEAD, plus untracked) — the fast "
+                         "pre-commit scope; interprocedural rules see "
+                         "only those files")
     li.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from the current findings "
                          "(ratchet reset — review the diff!)")

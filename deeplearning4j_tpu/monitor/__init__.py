@@ -34,6 +34,8 @@ import os
 
 import contextlib
 
+from .lockwatch import (InstrumentedLock, LockWatch, get_lockwatch,
+                        make_lock, make_rlock, make_condition)
 from .registry import (MetricsRegistry, LatencyHistogram, Counter, Gauge,
                        Histogram, get_registry, render_prometheus_dump)
 from .tracer import SpanContext, Tracer, get_tracer
@@ -55,7 +57,8 @@ __all__ = [
     "merge_traces", "MonitoredJit", "JitRegistry", "monitored_jit",
     "get_jit_registry", "sample_device_memory",
     "maybe_sample_device_memory", "profile_report",
-    "render_profile_text",
+    "render_profile_text", "InstrumentedLock", "LockWatch",
+    "get_lockwatch", "make_lock", "make_rlock", "make_condition",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 

@@ -64,7 +64,8 @@ class FleetState:
 
     def __init__(self, stale_after: float = DEFAULT_STALE_AFTER):
         self.stale_after = float(stale_after)
-        self._lock = threading.Lock()
+        from .lockwatch import make_lock
+        self._lock = make_lock("FleetState._lock")
         self._workers: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- feeding

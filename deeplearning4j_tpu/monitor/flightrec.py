@@ -53,7 +53,8 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 4096,
                  dump_dir: Optional[str] = None):
-        self._lock = threading.Lock()
+        from .lockwatch import make_lock
+        self._lock = make_lock("FlightRecorder._lock")
         self._events = deque(maxlen=int(capacity))
         self._seq = 0
         self.dropped = 0

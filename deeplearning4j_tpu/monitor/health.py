@@ -49,7 +49,8 @@ class HealthState:
     time so a stalled process reports a growing age, not a stale one."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from .lockwatch import make_lock
+        self._lock = make_lock("HealthState._lock")
         self.reset()
 
     def reset(self):

@@ -163,7 +163,8 @@ class JitRegistry:
     to apply its action."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from .lockwatch import make_lock
+        self._lock = make_lock("JitRegistry._lock")
         self._stats: Dict[str, _FnStats] = {}
         self._pending_storms: List[Dict[str, Any]] = []
 
@@ -294,8 +295,9 @@ class MonitoredJit:
         self._fn = fn
         self.name = name or getattr(fn, "__qualname__",
                                     getattr(fn, "__name__", "jit_fn"))
+        from .lockwatch import make_lock
         self._jit = jax.jit(fn, **jit_kwargs)
-        self._lock = threading.Lock()
+        self._lock = make_lock("MonitoredJit._lock")
         self.calls = 0
         self.compiles = 0
         self.compile_seconds = 0.0
@@ -476,7 +478,11 @@ def _ensure_cost_executor():
             # before the executor's join — pending captures are cancelled
             # and exit waits only for the one in-flight compile
             from concurrent.futures import ThreadPoolExecutor
-            _COST_EXECUTOR = ThreadPoolExecutor(
+            # never shutdown() explicitly BY DESIGN: concurrent.futures
+            # joins this worker at interpreter exit, and the canceller
+            # registered below trims the queue first — see the comment
+            # block above (a daemon thread here SIGABRTs mid-compile)
+            _COST_EXECUTOR = ThreadPoolExecutor(  # tpulint: disable=RES001
                 max_workers=1, thread_name_prefix="jitwatch-cost")
             try:
                 threading._register_atexit(_cancel_pending_captures)
@@ -668,7 +674,17 @@ def profile_report() -> Dict[str, Any]:
             "etl_ms": summary("training_etl_ms"),
         },
         "pipeline": _pipeline_block(snap),
+        "locks": _locks_block(),
     }
+
+
+def _locks_block() -> Dict[str, Any]:
+    """Lock-contention table (monitor/lockwatch.py): per instrumented lock
+    the acquisition count and exact wait/held mean/max, plus the observed
+    inversion count. Empty unless lockwatch is enabled
+    (``DL4J_TPU_LOCKWATCH=1``) and instrumented locks actually ran."""
+    from .lockwatch import contention_table
+    return contention_table()
 
 
 def _pipeline_block(snap) -> Dict[str, Any]:
@@ -762,4 +778,22 @@ def render_profile_text(report: Dict[str, Any]) -> str:
             lines.append(f"etl_fraction={pipe['etl_fraction']} "
                          f"(etl {pipe.get('etl_ms_total')} ms / step "
                          f"{pipe.get('step_ms_total')} ms)")
+    locks = report.get("locks") or {}
+    if locks:
+        lines.append("")
+        lines.append("# locks (lockwatch contention)")
+        inv = locks.get("_inversions", {}).get("count")
+        if inv:
+            lines.append(f"  !! {inv} lock-order inversion(s) observed — "
+                         f"see the flight recorder")
+        lines.append(f"{'lock':<40} {'acq':>8} {'wait_mean_s':>12} "
+                     f"{'wait_max_s':>11} {'held_mean_s':>12} "
+                     f"{'held_max_s':>11}")
+        for name, r in locks.items():
+            if name == "_inversions":
+                continue
+            lines.append(
+                f"{name:<40} {r['acquisitions']:>8} "
+                f"{r['wait_s_mean']:>12} {r['wait_s_max']:>11} "
+                f"{r['held_s_mean']:>12} {r['held_s_max']:>11}")
     return "\n".join(lines) + "\n"

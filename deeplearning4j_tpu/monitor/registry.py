@@ -197,6 +197,13 @@ class MetricsRegistry:
     """
 
     def __init__(self):
+        # PLAIN lock by necessity, never lockwatch-instrumented: the
+        # registry is lockwatch's own data plane — recording any lock's
+        # first acquisition creates its metric children THROUGH this
+        # lock, so instrumenting it here re-enters a non-reentrant lock
+        # (observed as a hard deadlock on the first monitored_jit call
+        # under DL4J_TPU_LOCKWATCH=1). Its regions are tiny dict ops,
+        # THR001-clean by construction.
         self._lock = threading.Lock()
         self._families: Dict[str, _Family] = {}
 

@@ -86,7 +86,8 @@ class Tracer:
     """
 
     def __init__(self, capacity: int = 8192):
-        self._lock = threading.Lock()
+        from .lockwatch import make_lock
+        self._lock = make_lock("Tracer._lock")
         self._events = deque(maxlen=int(capacity))
         self._t0 = time.perf_counter()
         self._local = threading.local()     # per-thread span-context stack

@@ -79,7 +79,8 @@ class ParallelInference:
         self.queue_limit = queue_limit
         self.flush_after_ms = float(flush_after_ms)
         self._jit_fwd = None
-        self._lock = threading.Lock()
+        from ..monitor.lockwatch import make_lock
+        self._lock = make_lock("ParallelInference._lock")
         self._pending: List = []  # (features, future)
         self._flush_timer = None
 

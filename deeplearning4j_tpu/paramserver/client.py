@@ -32,6 +32,7 @@ from ..monitor import (get_flight_recorder, get_health, get_registry,
                        get_tracer)
 from ..parallel.accumulation import serialize_encoded
 from ..parallel.transport import send_frame, recv_frame
+from ..monitor.lockwatch import make_lock
 from .metrics import ParamServerMetrics
 from .server import (OP_INIT, OP_SET, OP_PUSH, OP_PULL, OP_VERSION, OP_STATS,
                      OP_TELEMETRY, OP_PULL_DELTA, FLAG_TRACE, OP_MASK,
@@ -76,7 +77,7 @@ class Fanout:
 
     def __init__(self, max_workers: int):
         self.max_workers = max(1, int(max_workers))
-        self._lock = threading.Lock()
+        self._lock = make_lock("Fanout._lock")
         self._exec: Optional[ThreadPoolExecutor] = None
 
     def _executor(self) -> ThreadPoolExecutor:
@@ -158,7 +159,7 @@ class ParameterServerClient:
         #: delta-pull wire
         self._proto: Optional[int] = None
         self._pool: List[socket.socket] = []
-        self._pool_lock = threading.Lock()
+        self._pool_lock = make_lock("ParameterServerClient._pool_lock")
         self._fan: Optional[Fanout] = None
         self._rand = random.Random()
 
@@ -176,8 +177,11 @@ class ParameterServerClient:
             if self._pool:
                 return self._pool.pop()
         # connect OUTSIDE the lock (THR001): a slow connect must not stall
-        # the other pool users
-        s = socket.create_connection((self.host, self.port),
+        # the other pool users. Ownership transfers to the caller (the
+        # pool-checkout idiom): _checkin pools or closes it, and every
+        # _request error path closes its checked-out socket — RES001's
+        # documented transfer-out exemplar.
+        s = socket.create_connection((self.host, self.port),  # tpulint: disable=RES001
                                      timeout=self.timeout)
         try:
             # delta frames and version checks are tiny — Nagle coalescing

@@ -20,6 +20,7 @@ import logging
 import threading
 from typing import Dict, List
 
+from ..monitor.lockwatch import make_lock
 from ..monitor.registry import LatencyHistogram, get_registry
 from ..optimize.listeners import TrainingListener
 
@@ -61,7 +62,7 @@ class ParamServerMetrics:
         self._reg_pull = reg.histogram(
             "paramserver_pull_ms", "pull round-trip latency", role=self.role)
         # per-instance exact mirror (the snapshot()/OP_STATS view)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ParamServerMetrics._lock")
         self.counters: Dict[str, int] = {k: 0 for k in COUNTERS}
         self.push_latency = LatencyHistogram()
         self.pull_latency = LatencyHistogram()

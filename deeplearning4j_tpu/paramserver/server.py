@@ -39,6 +39,7 @@ from ..monitor import SpanContext, get_fleet, get_registry, get_tracer
 from ..parallel.transport import send_frame, recv_frame
 from ..parallel.accumulation import (deserialize_encoded, threshold_decode,
                                      encode_residual, serialize_encoded)
+from ..monitor.lockwatch import make_lock
 from .metrics import ParamServerMetrics
 
 log = logging.getLogger(__name__)
@@ -135,9 +136,9 @@ class ParameterServer:
         self.tracer = tracer if tracer is not None else get_tracer()
         self.fleet = fleet if fleet is not None else get_fleet()
         self._t_start = time.time()
-        self._op_lock = threading.Lock()
+        self._op_lock = make_lock("ParameterServer._op_lock")
         self._op_counts = {name: 0 for name in OP_NAMES.values()}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ParameterServer._lock")
         self._shards: Optional[List[np.ndarray]] = None
         self._n = 0
         self._version = 0

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..monitor import get_flight_recorder, get_registry
+from ..monitor.lockwatch import make_lock
 from ..parallel.accumulation import (deserialize_encoded, serialize_encoded,
                                      threshold_decode)
 from .client import (Fanout, ParameterServerClient, ParameterServerError,
@@ -289,7 +290,7 @@ class ShardedParameterServerClient:
         self.worker_id = self.clients[0].worker_id
         self.tracer = self.clients[0].tracer
         self._fan = Fanout(min(2 * self.num_servers, 16))
-        self._state_lock = threading.Lock()
+        self._state_lock = make_lock("ShardedParameterServerClient._state_lock")
         self._shadow: List[Optional[np.ndarray]] = [None] * self.num_servers
         #: per-shard version of the shadow (the server state the client
         #: can reconstruct) — distinct from the MASTER's local_version,

@@ -139,8 +139,9 @@ class InMemoryStatsStorage(StatsStorage):
     """Reference ``ui/storage/InMemoryStatsStorage``."""
 
     def __init__(self):
+        from ..monitor.lockwatch import make_lock
         self._updates: Dict[str, List[StatsReport]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("InMemoryStatsStorage._lock")
 
     def put_update(self, report: StatsReport):
         with self._lock:
@@ -164,8 +165,9 @@ class FileStatsStorage(StatsStorage):
     durability contract: every update is persisted and reloadable)."""
 
     def __init__(self, path: str):
+        from ..monitor.lockwatch import make_lock
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = make_lock("FileStatsStorage._lock")
         self._fh = open(path, "a", encoding="utf-8")
 
     def put_update(self, report: StatsReport):
@@ -204,8 +206,9 @@ class SqliteStatsStorage(StatsStorage):
     """Reference ``ui/storage/sqlite/J7FileStatsStorage`` counterpart."""
 
     def __init__(self, path: str):
+        from ..monitor.lockwatch import make_lock
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = make_lock("SqliteStatsStorage._lock")
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS updates (session_id TEXT, "

@@ -18,7 +18,7 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
 RULE_IDS = {"JAX001", "JAX002", "JAX003", "THR001", "THR002",
-            "EXC001"}
+            "THR003", "THR004", "RES001", "EXC001"}
 
 
 # default fixture path lives under tests/ so the JAX003 bare-jit rule

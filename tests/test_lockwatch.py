@@ -1,0 +1,314 @@
+"""Runtime lock sanitizer (monitor/lockwatch.py) + the static↔runtime
+cross-check.
+
+The acceptance scenarios from the concurrency-correctness pass:
+
+- a deliberately inverted two-lock fixture is caught by THR003
+  **statically** AND by lockwatch **at runtime** (flight event + health
+  problem) — the two halves agree on the hazard;
+- the sharded-paramserver and prefetch suites run under lockwatch with
+  **zero inversion events**, and every runtime-observed acquisition edge
+  is **statically derivable** by ``analysis/lockgraph.py`` (the analyzer
+  is not allowed to be blind to real behavior).
+"""
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import Linter
+from deeplearning4j_tpu.monitor import (get_flight_recorder, get_health,
+                                        get_registry)
+from deeplearning4j_tpu.monitor import lockwatch
+
+
+@pytest.fixture
+def watch():
+    """Enable lockwatch for the test, restore and clear afterwards."""
+    prev = lockwatch.enabled()
+    lockwatch.set_enabled(True)
+    w = lockwatch.get_lockwatch()
+    w.clear()
+    try:
+        yield w
+    finally:
+        lockwatch.set_enabled(prev)
+        w.clear()
+
+
+def _events_since(n0):
+    return [e for e in get_flight_recorder().events()][n0:]
+
+
+# ------------------------------------------------------------------ units
+def test_factory_returns_plain_primitives_when_disabled():
+    assert not lockwatch.enabled()
+    lk = lockwatch.make_lock("X.l")
+    assert not isinstance(lk, lockwatch.InstrumentedLock)
+    assert isinstance(lockwatch.make_rlock("X.r"),
+                      type(threading.RLock()))
+    assert isinstance(lockwatch.make_condition("X.c"),
+                      threading.Condition)
+
+
+def test_instrumented_lock_metrics_and_contention_table(watch):
+    lk = lockwatch.make_lock("Unit.alpha")
+    assert isinstance(lk, lockwatch.InstrumentedLock)
+    for _ in range(3):
+        with lk:
+            pass
+    assert lk.acquire(blocking=False)
+    lk.release()
+    table = watch.contention_table()
+    assert table["Unit.alpha"]["acquisitions"] == 4
+    assert table["Unit.alpha"]["held_s_max"] >= 0.0
+    # registry series (ride OP_TELEMETRY into /fleet like every series)
+    dump = get_registry().dump()
+    rows = dump["lock_acquisitions_total"]["children"]
+    assert any(r["labels"] == {"lock": "Unit.alpha"} and r["value"] == 4
+               for r in rows)
+    assert "lock_wait_seconds" in dump and "lock_held_seconds" in dump
+
+
+def test_rlock_reentrancy_counts_once_on_the_stack(watch):
+    r = lockwatch.make_rlock("Unit.re")
+    with r:
+        with r:               # reentrant: no self-edge, no double entry
+            pass
+    assert watch.observed_edges() == set()
+    assert watch.contention_table()["Unit.re"]["acquisitions"] == 2
+
+
+def test_order_edges_and_no_inversion_on_consistent_order(watch):
+    a = lockwatch.make_lock("Unit.a")
+    b = lockwatch.make_lock("Unit.b")
+    for _ in range(2):
+        with a:
+            with b:
+                pass
+    assert watch.observed_edges() == {("Unit.a", "Unit.b")}
+    assert watch.inversions() == []
+
+
+def test_condition_wait_releases_the_tracked_hold(watch):
+    cond = lockwatch.make_condition("Unit.cond")
+    other = lockwatch.make_lock("Unit.other")
+    hits = []
+
+    def waiter():
+        with cond:
+            hits.append("waiting")
+            cond.wait(2.0)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    for _ in range(100):
+        if hits:
+            break
+        time.sleep(0.01)
+    # while the waiter is parked inside wait() the lock is RELEASED —
+    # another thread can take it immediately (and no held-time builds up)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5)
+    assert hits == ["waiting", "woke"]
+    # a lock acquired while holding the condition's lock shows the edge
+    with cond:
+        with other:
+            pass
+    assert ("Unit.cond", "Unit.other") in watch.observed_edges()
+
+
+def test_hold_time_threshold_fires_flight_event(watch, monkeypatch):
+    monkeypatch.setattr(lockwatch, "HOLD_THRESHOLD_S", 0.05)
+    n0 = len(get_flight_recorder().events())
+    lk = lockwatch.make_lock("Unit.slow")
+    with lk:
+        time.sleep(0.08)
+    events = [e for e in _events_since(n0)
+              if e["event"] == "lock_hold_exceeded"]
+    assert len(events) == 1
+    assert events[0]["lock"] == "Unit.slow"
+    assert events[0]["held_s"] > 0.05
+    assert watch.hold_events()
+    assert any("lock_hold" in p for p in
+               get_health().snapshot()["problems"])
+
+
+def test_profile_report_carries_the_contention_table(watch):
+    from deeplearning4j_tpu.monitor import (profile_report,
+                                            render_profile_text)
+    lk = lockwatch.make_lock("Unit.profiled")
+    with lk:
+        pass
+    rep = profile_report()
+    assert rep["locks"]["Unit.profiled"]["acquisitions"] == 1
+    text = render_profile_text(rep)
+    assert "# locks (lockwatch contention)" in text
+    assert "Unit.profiled" in text
+
+
+# -------------------------------------- the inverted two-lock acceptance
+_INVERTED_SRC = """
+    import threading
+
+    class Inverted:
+        def __init__(self, mk=threading.Lock):
+            self._first_lock = mk("Inverted._first_lock")
+            self._second_lock = mk("Inverted._second_lock")
+
+        def forward(self):
+            with self._first_lock:
+                self._grab_second()
+
+        def _grab_second(self):
+            with self._second_lock:
+                pass
+
+        def backward(self):
+            with self._second_lock:
+                with self._first_lock:
+                    pass
+"""
+
+
+def test_inverted_fixture_caught_statically_and_at_runtime(watch):
+    """THE two-halves acceptance: the same source is flagged by THR003
+    on the lock graph AND trips lockwatch when it actually runs."""
+    src = textwrap.dedent(_INVERTED_SRC).replace(
+        "mk=threading.Lock", "mk=None")     # static: plain threading form
+    static_src = textwrap.dedent("""
+        import threading
+
+        class Inverted:
+            def __init__(self):
+                self._first_lock = threading.Lock()
+                self._second_lock = threading.Lock()
+
+            def forward(self):
+                with self._first_lock:
+                    self._grab_second()
+
+            def _grab_second(self):
+                with self._second_lock:
+                    pass
+
+            def backward(self):
+                with self._second_lock:
+                    with self._first_lock:
+                        pass
+        """)
+    fs = Linter(rules=["THR003"]).run_sources(
+        {"pkg/inverted.py": static_src}).new
+    assert [f.rule for f in fs] == ["THR003"]
+    assert "Inverted._first_lock" in fs[0].message
+    assert src  # (the runtime twin below uses the factory directly)
+
+    # runtime twin: same shape, instrumented locks, actually executed —
+    # sequentially, so it can never deadlock, yet the ORDER graph still
+    # exposes the inversion
+    ns: dict = {}
+    exec(textwrap.dedent(_INVERTED_SRC), ns)
+    n0 = len(get_flight_recorder().events())
+    obj = ns["Inverted"](mk=lockwatch.make_lock)
+    obj.forward()
+    obj.backward()
+    inv = watch.inversions()
+    assert len(inv) == 1
+    assert set(inv[0]["locks"]) == {"Inverted._first_lock",
+                                    "Inverted._second_lock"}
+    assert inv[0]["path_forward"] and inv[0]["path_reverse"]
+    flight = [e for e in _events_since(n0)
+              if e["event"] == "lock_order_inversion"]
+    assert len(flight) == 1
+    assert sorted(flight[0]["locks"]) == sorted(inv[0]["locks"])
+    assert any("lock_order_inversion" in p
+               for p in get_health().snapshot()["problems"])
+    # same cycle observed again: fires once, not per acquisition
+    obj.forward()
+    obj.backward()
+    assert len(watch.inversions()) == 1
+
+
+# ----------------------- the suites under lockwatch + static cross-check
+def _sharded_flows():
+    """The sharded-paramserver suite's core flows: seed, split pushes,
+    delta pulls, kill/restart, elastic rebalance + remap."""
+    from deeplearning4j_tpu.paramserver import (
+        ShardedParameterServerClient, ShardedParameterServerGroup)
+    rng = np.random.default_rng(5)
+    vec = rng.normal(size=91).astype(np.float32)
+    with ShardedParameterServerGroup(3) as group:
+        with ShardedParameterServerClient(group.addresses, max_retries=2,
+                                          backoff=0.01,
+                                          down_backoff=0.05) as c:
+            c.init_params(vec)
+            idx = np.array([0, 3, 4, 9, 33], np.int32)
+            signs = np.array([1, -1, 1, 1, -1], np.int8)
+            c.push_encoded((idx, signs, 0.5, vec.size))
+            c.pull()
+            c.pull_if_stale([0, 0, 0])
+            # fault injection: kill node 1, ops degrade per shard, restart
+            port, snap = group.kill(1)
+            c.push_encoded((idx, signs, 0.5, vec.size))
+            group.restart(1, snap)
+            c.pull()
+            # elastic: rebalance 3 -> 2 and remap the client
+            addrs = group.scale_to(2)
+            c.remap(addrs)
+            c.pull()
+            c.stats()
+
+
+def _prefetch_flows():
+    """The prefetch suite's core flows: multi-worker ordered epochs,
+    reset mid-epoch, worker-exception delivery, device-put-ahead."""
+    from deeplearning4j_tpu import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.datasets.prefetch import (
+        PrefetchDataSetIterator, PrefetchIterator)
+    data = [DataSet(np.full((1, 3), i, np.float32),
+                    np.eye(2, dtype=np.float32)[[i % 2]])
+            for i in range(30)]
+    pf = PrefetchDataSetIterator(ListDataSetIterator(data), workers=3)
+    try:
+        for _ in range(2):
+            got = [float(ds.features[0, 0]) for ds in pf]
+            assert got == [float(i) for i in range(30)]
+        # reset mid-epoch (stale-worker path)
+        it = iter(pf)
+        next(it)
+        pf.reset()
+        next(iter(pf))
+    finally:
+        pf.shutdown()
+    # raw iterator with a transform + a worker exception in order
+    boom = PrefetchIterator(iter(range(20)), workers=2,
+                            transform=lambda x: 1 // (x - 15) and x or x)
+    with pytest.raises(ZeroDivisionError):
+        list(boom)
+    boom.shutdown()
+
+
+def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
+    """Tier-1 pin: the sharded-paramserver + prefetch flows under
+    lockwatch produce ZERO lock-order inversions, and every observed
+    edge is derivable by the static analyzer."""
+    _sharded_flows()
+    _prefetch_flows()
+    assert watch.inversions() == [], watch.inversions()
+
+    observed = watch.observed_edges()
+    # the pipeline's one real nesting must actually have been observed —
+    # otherwise this cross-check proves nothing
+    assert ("PrefetchIterator._pull_lock", "_Epoch.cond") in observed
+
+    from deeplearning4j_tpu.analysis.lockgraph import analyze_package
+    static = analyze_package().edge_set()
+    unexplained = observed - static
+    assert not unexplained, (
+        f"runtime-observed lock edges the static analyzer cannot derive "
+        f"(lockgraph.py resolution gap): {sorted(unexplained)}; "
+        f"witnesses: { {e: watch.edge_witnesses()[e] for e in unexplained} }")

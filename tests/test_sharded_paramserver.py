@@ -419,8 +419,12 @@ def test_worker_surge_2x_mid_training_neither_halts_nor_corrupts():
                 worker_id=f"surge-{wid}", telemetry_interval=None)
             net = _toy_net(seed=seed)
             first_wave_started.set()
+            # n=16: enough async quantized steps that the learned-state
+            # probe below clears its margin on every scheduler
+            # interleaving — n=6 left it within noise of random init
+            # (flaky under load, ~1-in-3 on a busy CI box)
             master.execute_training(
-                net, ListDataSetIterator(_toy_batches(n=6, seed=seed)))
+                net, ListDataSetIterator(_toy_batches(n=16, seed=seed)))
         except Exception as e:  # noqa: BLE001 - surfaced via errors below
             errors.append((wid, e))
 
