@@ -367,6 +367,123 @@ def bench_input_pipeline(batch=256, n_batches=32, delay_ms=25.0, workers=8):
     return ips_pre
 
 
+#: latched by bench_serving_latency; embedded in its --one record so the
+#: BENCH trajectory starts tracking tail latency (p50/p99 vs offered QPS)
+#: alongside img/s
+SERVING_STATS = {}
+
+
+def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
+                          n_in=64, hidden=128, classes=10,
+                          buckets=(1, 2, 4, 8, 16, 32), linger_ms=3.0,
+                          max_queue_examples=64, pool_workers=64):
+    """Serving-tier tail latency (serving/ — docs/SERVING.md): an
+    OPEN-LOOP load generator drives ``POST /v1/models/<name>/predict``
+    on an in-process :class:`InferenceServer` at fixed offered QPS —
+    requests fire on schedule whether or not earlier ones returned, so
+    queueing delay shows up as tail latency instead of silently throttling
+    the generator (closed-loop coordination would hide saturation).
+    Sweeps ``qps_points``; per point latches {offered_qps, achieved_qps,
+    p50_ms, p99_ms, reject_rate, mean_batch_size} into ``SERVING_STATS``.
+    Headline value: achieved QPS at the highest offered point."""
+    from concurrent.futures import ThreadPoolExecutor
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, Sgd,
+                                    InferenceServer, ModelRegistry)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.monitor import get_registry
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden))
+            .layer(OutputLayer(n_in=hidden, n_out=classes,
+                               activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    registry = ModelRegistry()
+    # warmup=True pre-compiles every bucket signature OUTSIDE the timed
+    # sweep: serving cold-start is the compile-cache item's problem; this
+    # config measures steady-state scheduling + forward latency
+    registry.register("bench", net, batch_buckets=buckets,
+                      linger_ms=linger_ms,
+                      max_queue_examples=max_queue_examples,
+                      default_deadline_ms=5000.0,
+                      input_shape=(n_in,), warmup=True)
+    _hb()
+    srv = InferenceServer(registry)
+    port = srv.start(port=0)
+    url = f"http://127.0.0.1:{port}/v1/models/bench/predict"
+    payload = json.dumps(
+        {"inputs": np.random.default_rng(0)
+         .normal(size=(1, n_in)).astype(np.float32).tolist()}).encode()
+    batch_hist = get_registry().histogram("serving_batch_size",
+                                          "", model="bench")
+
+    def fire(out, lock):
+        t0 = time.perf_counter()
+        try:
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            code = 200
+        except urllib.error.HTTPError as e:
+            e.close()
+            code = e.code
+        except OSError:
+            code = -1
+        with lock:
+            out.append((code, (time.perf_counter() - t0) * 1e3))
+
+    def drive(offered):
+        out, lock = [], threading.Lock()
+        n = int(offered * duration_s)
+        period = 1.0 / offered
+        with ThreadPoolExecutor(max_workers=pool_workers) as pool:
+            _, b_total0, b_n0 = batch_hist.state()
+            t_start = time.perf_counter()
+            for i in range(n):
+                target = t_start + i * period
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                pool.submit(fire, out, lock)
+        wall = time.perf_counter() - t_start
+        _, b_total1, b_n1 = batch_hist.state()
+        _hb()
+        lat_ok = sorted(l for c, l in out if c == 200)
+        rejects = sum(1 for c, _ in out if c == 429)
+        flushes = max(b_n1 - b_n0, 1)
+
+        def pct(q):
+            return lat_ok[min(int(q * (len(lat_ok) - 1)),
+                              len(lat_ok) - 1)] if lat_ok else None
+        return {
+            "offered_qps": offered,
+            "sent": n,
+            "achieved_qps": round(len(lat_ok) / wall, 1),
+            "p50_ms": round(pct(0.50), 2) if lat_ok else None,
+            "p99_ms": round(pct(0.99), 2) if lat_ok else None,
+            "reject_rate": round(rejects / max(n, 1), 4),
+            "mean_batch_size": round((b_total1 - b_total0) / flushes, 2),
+        }
+
+    try:
+        points = [drive(q) for q in qps_points]
+    finally:
+        srv.stop()
+    SERVING_STATS.update({
+        "buckets": list(buckets), "linger_ms": linger_ms,
+        "max_queue_examples": max_queue_examples,
+        "duration_s": duration_s, "points": points,
+    })
+    return points[-1]["achieved_qps"] or 0.0
+
+
 #: latched by bench_paramserver; embedded in its --one record so the BENCH
 #: trajectory carries the 1-server-full-vector vs N-server-delta wire and
 #: throughput comparison, not just the headline number
@@ -589,6 +706,7 @@ ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
     ("input_pipeline_images_per_sec", "images/sec", bench_input_pipeline),
     ("paramserver_steps_per_sec", "steps/sec", bench_paramserver),
+    ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -1028,7 +1146,10 @@ def main():
                           "input_pipeline": INPUT_PIPELINE_STATS or None,
                           # 1-server-dense vs N-server-delta comparison —
                           # populated only by the paramserver config
-                          "paramserver": PARAMSERVER_STATS or None}))
+                          "paramserver": PARAMSERVER_STATS or None,
+                          # offered-QPS sweep (p50/p99/reject/batch-size) —
+                          # populated only by the serving_latency config
+                          "serving": SERVING_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

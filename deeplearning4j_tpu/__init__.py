@@ -26,3 +26,5 @@ from .datasets.normalizers import (NormalizerStandardize, NormalizerMinMaxScaler
 from .utils.model_serializer import ModelSerializer
 from .nn.transferlearning import (TransferLearning, FineTuneConfiguration,
                                   TransferLearningHelper)
+from .serving import (InferenceServer, ModelRegistry, ContinuousBatcher,
+                      OverloadedError, DeadlineExceededError)

@@ -45,10 +45,14 @@ import numpy as np
 
 from .dataset import DataSet, DataSetIterator, MultiDataSet
 
-__all__ = ["ShapeBucketingDataSetIterator"]
+__all__ = ["ShapeBucketingDataSetIterator", "validate_buckets", "bucket_for"]
 
 
-def _buckets(values: Sequence[int], kind: str):
+def validate_buckets(values: Sequence[int], kind: str = "batch"):
+    """Normalize a bucket spec: sorted unique positive ints, loud on junk.
+    Shared with the serving tier (``serving/batcher.py``), which buckets
+    request batches by the same rules this iterator buckets dataset
+    batches."""
     out = sorted({int(v) for v in values})
     if not out or out[0] < 1:
         raise ValueError(f"{kind} buckets must be positive ints, got "
@@ -56,13 +60,20 @@ def _buckets(values: Sequence[int], kind: str):
     return out
 
 
-def _bucket_for(buckets, n: int, kind: str) -> int:
+def bucket_for(buckets, n: int, kind: str = "batch") -> int:
+    """Smallest bucket admitting ``n``; oversize is rejected loudly (the
+    caller must configure a bucket that fits, not silently truncate)."""
     for b in buckets:
         if b >= n:
             return b
     raise ValueError(
         f"{kind} size {n} exceeds the largest configured bucket "
         f"{buckets[-1]} — add a bucket >= {n} (buckets: {buckets})")
+
+
+# intra-module shorthands (the public names are the API)
+_buckets = validate_buckets
+_bucket_for = bucket_for
 
 
 def _pad_axis0(arr: np.ndarray, b: int, t: Optional[int] = None):

@@ -674,6 +674,7 @@ def profile_report() -> Dict[str, Any]:
             "etl_ms": summary("training_etl_ms"),
         },
         "pipeline": _pipeline_block(snap),
+        "serving": _serving_block(snap),
         "locks": _locks_block(),
     }
 
@@ -685,6 +686,45 @@ def _locks_block() -> Dict[str, Any]:
     (``DL4J_TPU_LOCKWATCH=1``) and instrumented locks actually ran."""
     from .lockwatch import contention_table
     return contention_table()
+
+
+def _serving_block(snap) -> Dict[str, Any]:
+    """Per-model serving anatomy (serving/ tier, docs/SERVING.md): request
+    outcomes, latency summary (p50/p95/p99/max — the serving histograms
+    are ms-valued, so bucket quantiles are honest here), trailing-window
+    QPS, batch-size distribution (mean real examples per flush — how well
+    continuous batching is coalescing), and current queue depth. Built
+    purely from the registry snapshot, so the block also renders for a
+    remote dump. Empty dict until serving traffic flows."""
+    per: Dict[str, Dict[str, Any]] = {}
+
+    def row(model):
+        return per.setdefault(model, {})
+
+    for r in snap.get("serving_requests_total", []):
+        m = r["labels"].get("model", "?")
+        row(m).setdefault("requests", {})[
+            r["labels"].get("outcome", "?")] = r.get("value")
+    for r in snap.get("serving_request_latency_ms", []):
+        m = r["labels"].get("model", "?")
+        if r.get("summary"):
+            row(m)["latency_ms"] = r["summary"]
+    for r in snap.get("serving_batch_size", []):
+        m = r["labels"].get("model", "?")
+        s = r.get("summary")
+        if s:
+            # the histogram stores EXAMPLE COUNTS in its value slots, so
+            # mean/max/n are exact; its ms-geometry bucket quantiles are
+            # not meaningful for counts and are dropped (the
+            # input_wait_seconds precedent, datasets/prefetch.py)
+            row(m)["batch_examples"] = {"mean": round(s["mean_ms"], 2),
+                                        "max": s["max_ms"],
+                                        "n": int(s["n"])}
+    for fam, key in (("serving_queue_depth", "queue_depth"),
+                     ("serving_qps", "qps")):
+        for r in snap.get(fam, []):
+            row(r["labels"].get("model", "?"))[key] = r.get("value")
+    return per
 
 
 def _pipeline_block(snap) -> Dict[str, Any]:
@@ -778,6 +818,27 @@ def render_profile_text(report: Dict[str, Any]) -> str:
             lines.append(f"etl_fraction={pipe['etl_fraction']} "
                          f"(etl {pipe.get('etl_ms_total')} ms / step "
                          f"{pipe.get('step_ms_total')} ms)")
+    serving = report.get("serving") or {}
+    if serving:
+        lines.append("")
+        lines.append("# serving (per hosted model)")
+        lines.append(f"{'model':<20} {'ok':>8} {'rej':>6} {'dl':>5} "
+                     f"{'err':>5} {'qps':>7} {'p50_ms':>8} {'p99_ms':>8} "
+                     f"{'batch':>6} {'queue':>6}")
+        for name, r in sorted(serving.items()):
+            req = r.get("requests", {})
+            lat = r.get("latency_ms") or {}
+            bat = r.get("batch_examples") or {}
+            lines.append(
+                f"{name:<20} {int(req.get('ok', 0)):>8} "
+                f"{int(req.get('rejected', 0)):>6} "
+                f"{int(req.get('deadline', 0)):>5} "
+                f"{int(req.get('error', 0)):>5} "
+                f"{round(r.get('qps', 0.0), 1):>7} "
+                f"{round(lat.get('p50_ms', 0.0), 2):>8} "
+                f"{round(lat.get('p99_ms', 0.0), 2):>8} "
+                f"{round(bat.get('mean', 0.0), 1):>6} "
+                f"{int(r.get('queue_depth', 0) or 0):>6}")
     locks = report.get("locks") or {}
     if locks:
         lines.append("")

@@ -77,6 +77,9 @@ class LatencyHistogram:
         return {"mean_ms": self.total_ms / self.n,
                 "p50_ms": self.quantile(0.50),
                 "p95_ms": self.quantile(0.95),
+                # tail latency is the serving tier's SLO currency
+                # (docs/SERVING.md); bucket-edge resolution like p50/p95
+                "p99_ms": self.quantile(0.99),
                 "max_ms": self.max_ms, "n": float(self.n)}
 
 

@@ -6,12 +6,21 @@ TPU-native equivalent of reference ``ParallelInference.java:32``
 replicas fed by observer threads, ONE jitted forward with the batch dim sharded
 over the mesh serves every device; BATCHED mode keeps the reference's
 accumulate-then-flush behavior for many small concurrent requests.
+
+The BATCHED scheduling (accumulate, flush on batch/queue limits, max-linger
+timeout so a lone request is never stranded, graceful drain on ``close``)
+is delegated to the serving tier's
+:class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher` — one
+scheduler implementation for both this API and the HTTP front door
+(docs/SERVING.md). The previous ad-hoc per-batch ``threading.Timer``
+linger is gone: a single scheduler thread owns flush timing, so
+concurrent fills and timer callbacks can no longer race each other into
+duplicate jit-wrapper construction.
 """
 from __future__ import annotations
 
-import threading
 from concurrent.futures import Future
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 import jax
@@ -34,6 +43,7 @@ class ParallelInference:
             self._batch_limit = 64
             self._queue_limit = 64
             self._workers = None
+            self._flush_after_ms = 10.0
 
         def inference_mode(self, mode):
             self._mode = mode
@@ -57,11 +67,20 @@ class ParallelInference:
             self._workers = int(n)
             return self
 
+        def flush_after_ms(self, ms):
+            """Max-linger for a partial batch (reference
+            ``BatchedInferenceObservable`` drains whatever is queued)."""
+            self._flush_after_ms = float(ms)
+            return self
+
+        flushAfterMs = flush_after_ms
+
         def build(self):
             return ParallelInference(self._net, mode=self._mode,
                                      batch_limit=self._batch_limit,
                                      queue_limit=self._queue_limit,
-                                     workers=self._workers)
+                                     workers=self._workers,
+                                     flush_after_ms=self._flush_after_ms)
 
     def __init__(self, net, mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 64, queue_limit: int = 64,
@@ -81,26 +100,29 @@ class ParallelInference:
         self._jit_fwd = None
         from ..monitor.lockwatch import make_lock
         self._lock = make_lock("ParallelInference._lock")
-        self._pending: List = []  # (features, future)
-        self._flush_timer = None
+        self._batcher = None      # lazy: built on the first BATCHED submit
 
     # ------------------------------------------------------------------
     def _forward(self, x):
         """Sharded forward: pad the batch to a device multiple, run one SPMD
         forward, strip padding."""
         net = self.net
-        if self._jit_fwd is None:
-            def fwd(params, states, f):
-                f = net._adapt_input(f)
-                y, _, _ = net._apply_layers(params, states, f, None, False, None)
-                return y
-            repl = replicated(self.mesh)
-            data = batch_sharded(self.mesh)
-            self._jit_fwd = monitored_jit(
-                fwd, name="inference/fwd",
-                in_shardings=(repl, repl, data), out_shardings=data)
-            net.params = jax.device_put(net.params, repl)
-            net.states = jax.device_put(net.states, repl)
+        with self._lock:
+            # under the lock: output() callers and the batching scheduler
+            # may race the first forward — exactly one builds the wrapper
+            if self._jit_fwd is None:
+                def fwd(params, states, f):
+                    f = net._adapt_input(f)
+                    y, _, _ = net._apply_layers(params, states, f, None,
+                                                False, None)
+                    return y
+                repl = replicated(self.mesh)
+                data = batch_sharded(self.mesh)
+                self._jit_fwd = monitored_jit(
+                    fwd, name="inference/fwd",
+                    in_shardings=(repl, repl, data), out_shardings=data)
+                net.params = jax.device_put(net.params, repl)
+                net.states = jax.device_put(net.states, repl)
         b = x.shape[0]
         pad = (-b) % self.n_devices
         if pad:
@@ -119,54 +141,50 @@ class ParallelInference:
         return self._forward(x)
 
     # ----------------------------------------------------- async batched path
-    def submit(self, x) -> Future:
-        """Queue a request; BATCHED mode flushes when ``batch_limit`` examples
-        accumulate, or after ``flush_after_ms`` so a lone partial batch never
-        starves (reference BatchedInferenceObservable drains whatever is
-        queued)."""
-        x = np.asarray(x, np.float32)
-        fut: Future = Future()
+    def _ensure_batcher(self):
         with self._lock:
-            self._pending.append((x, fut))
-            total = sum(arr.shape[0] for arr, _ in self._pending)
-            if (self.mode != InferenceMode.BATCHED
-                    or total >= self.batch_limit
-                    or len(self._pending) >= self.queue_limit):
-                pending, self._pending = self._pending, []
-                self._cancel_timer_locked()
-            else:
-                pending = None
-                if self._flush_timer is None:
-                    self._flush_timer = threading.Timer(
-                        self.flush_after_ms / 1e3, self.flush)
-                    self._flush_timer.daemon = True
-                    self._flush_timer.start()
-        if pending:
-            self._run_batch(pending)
-        return fut
+            if self._batcher is None:
+                from ..serving.batcher import ContinuousBatcher
+                # queue_policy="flush": hitting batch_limit examples or
+                # queue_limit requests forces a flush (the reference
+                # semantics) rather than rejecting — admission control
+                # with 429s is the HTTP tier's job, not this API's
+                self._batcher = ContinuousBatcher(
+                    self._forward, name="parallel-inference",
+                    max_batch=self.batch_limit,
+                    max_queue_examples=None,
+                    max_queue_requests=self.queue_limit,
+                    linger_ms=self.flush_after_ms,
+                    queue_policy="flush")
+            return self._batcher
 
-    def _cancel_timer_locked(self):
-        if self._flush_timer is not None:
-            self._flush_timer.cancel()
-            self._flush_timer = None
+    def submit(self, x) -> Future:
+        """Queue a request; BATCHED mode flushes when ``batch_limit``
+        examples or ``queue_limit`` requests accumulate, or after
+        ``flush_after_ms`` so a lone partial batch is never stranded
+        (reference BatchedInferenceObservable drains whatever is queued).
+        Scheduling runs on the shared continuous-batching scheduler
+        (``serving/batcher.py``)."""
+        x = np.asarray(x, np.float32)
+        if self.mode != InferenceMode.BATCHED:
+            fut: Future = Future()
+            try:
+                fut.set_result(self._forward(x))
+            except Exception as e:
+                fut.set_exception(e)
+            return fut
+        return self._ensure_batcher().submit(x)
 
     def flush(self):
-        with self._lock:
-            pending, self._pending = self._pending, []
-            self._cancel_timer_locked()
-        if pending:
-            self._run_batch(pending)
+        """Force everything queued to run now; returns once the queue is
+        drained (a direct ``output`` call relies on that ordering)."""
+        if self._batcher is not None:
+            self._batcher.flush(wait=True)
 
-    def _run_batch(self, pending):
-        xs = np.concatenate([p for p, _ in pending], axis=0)
-        try:
-            ys = self._forward(xs)
-            pos = 0
-            for x, fut in pending:
-                n = x.shape[0]
-                fut.set_result(ys[pos:pos + n])
-                pos += n
-        except Exception as e:
-            for _, fut in pending:
-                if not fut.done():
-                    fut.set_exception(e)
+    def close(self, drain: bool = True):
+        """Stop the batching scheduler; ``drain=True`` serves every
+        already-submitted request first."""
+        with self._lock:
+            batcher, self._batcher = self._batcher, None
+        if batcher is not None:
+            batcher.close(drain=drain)

@@ -1,0 +1,32 @@
+"""Production inference serving tier (docs/SERVING.md).
+
+The "millions of users" path the ROADMAP names: the reference's
+``ParallelInference.java`` observer threads reborn as a continuous-
+batching scheduler behind an HTTP front door.
+
+- :class:`ContinuousBatcher` — coalesces concurrent single-example
+  requests into shape-bucketed padded batches (one jitted forward per
+  flush, a CLOSED jit-signature set under any request-size churn —
+  jitwatch-enforced), with per-request deadlines, a max-linger bound so
+  a lone request is never stranded, and bounded-queue admission control
+  (typed :class:`OverloadedError` / :class:`DeadlineExceededError`).
+- :class:`ModelRegistry` / :class:`ServedModel` — multi-model hosting:
+  zoo models and ``keras/`` imports side by side, each with its own
+  batcher, queue caps, and per-model latency/QPS/batch-size series in
+  the monitor registry (the ``serving`` block on ``GET /profile``).
+- :class:`InferenceServer` — the HTTP/JSON front door
+  (``POST /v1/models/<name>/predict``, ``GET /v1/models``, plus the
+  monitor scrape endpoints), mapping the typed errors onto 429/504 and
+  draining gracefully on ``stop()`` so no accepted request is dropped.
+
+``ParallelInference`` (``parallel/inference.py``) delegates its BATCHED
+accumulate-then-flush path to the same scheduler.
+"""
+from .batcher import (ContinuousBatcher, DeadlineExceededError,
+                      ModelNotFoundError, OverloadedError)
+from .registry import ModelRegistry, ServedModel, DEFAULT_BATCH_BUCKETS
+from .server import InferenceServer
+
+__all__ = ["ContinuousBatcher", "ModelRegistry", "ServedModel",
+           "InferenceServer", "OverloadedError", "DeadlineExceededError",
+           "ModelNotFoundError", "DEFAULT_BATCH_BUCKETS"]

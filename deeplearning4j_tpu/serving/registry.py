@@ -1,0 +1,216 @@
+"""Multi-model hosting: named models, each behind its own batcher.
+
+The reference serves one net per ``ParallelInference`` instance; a
+production front door hosts MANY — zoo models and ``keras/`` imports side
+by side — so the registry maps ``name -> ServedModel``, where each entry
+owns its own :class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher`
+(independent queues, buckets, deadlines) while sharing one optional
+``max_in_flight`` semaphore so N models cannot pile N concurrent forwards
+onto one device. Per-model latency/QPS/batch-size/queue-depth series land
+in the monitor registry under a ``model`` label and roll up into the
+``serving`` block of ``GET /profile`` (docs/OBSERVABILITY.md).
+
+Anything with an ``output(features)`` method serves: ``MultiLayerNetwork``,
+``ComputationGraph``, a ``keras.model_import`` product, or a test stub.
+Zoo models may be passed un-initialized (``ZooModel`` instances are
+``init()``-ed on registration).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..monitor.lockwatch import make_lock
+from .batcher import ContinuousBatcher, ModelNotFoundError
+
+__all__ = ["ServedModel", "ModelRegistry"]
+
+#: default batch buckets: powers of two up to a modest serving batch —
+#: small enough that a lone request pads little, closed enough that the
+#: jit cache stays warm under any request-size churn
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+class ServedModel:
+    """One hosted model: the net, its batcher, and its serving config."""
+
+    def __init__(self, name: str, model, *,
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                 time_buckets: Optional[Sequence[int]] = None,
+                 max_queue_examples: int = 256,
+                 linger_ms: float = 5.0,
+                 default_deadline_ms: Optional[float] = 2000.0,
+                 input_shape: Optional[Sequence[int]] = None,
+                 warmup: bool = False,
+                 in_flight: Optional[threading.Semaphore] = None):
+        if hasattr(model, "conf") and not hasattr(model, "output"):
+            model = model.init()          # a ZooModel, not yet built
+        if not callable(getattr(model, "output", None)):
+            raise TypeError(
+                f"model {name!r} has no callable output(features) — pass "
+                f"an initialized network (MultiLayerNetwork, "
+                f"ComputationGraph, keras import) or a ZooModel")
+        self.name = name
+        self.model = model
+        self.input_shape = (tuple(int(d) for d in input_shape)
+                            if input_shape is not None else None)
+        self.batcher = ContinuousBatcher(
+            self._forward, name=name,
+            batch_buckets=batch_buckets, time_buckets=time_buckets,
+            max_queue_examples=max_queue_examples, linger_ms=linger_ms,
+            default_deadline_ms=default_deadline_ms,
+            queue_policy="reject", in_flight=in_flight,
+            metrics_label=name)
+        if warmup:
+            self.warm()
+
+    def warm(self):
+        """Pre-compile every bucket signature (synchronously, on the
+        registering thread): after this, request-size churn NEVER
+        compiles — the whole closed signature set is already in the jit
+        cache, so serving cold-start is paid at registration, not on the
+        first unlucky requests. Requires ``input_shape`` (the per-example
+        trailing shape, e.g. ``(784,)`` or ``(T, features)``).
+
+        Note the jitwatch interplay: warming ``>= DL4J_TPU_RETRACE_
+        THRESHOLD`` (default 3) buckets back-to-back is, to the
+        per-instance storm detector, indistinguishable from churn — it
+        logs one storm during warmup. Size the bucket set below the
+        threshold, or raise the threshold for serving processes; steady
+        state is storm-free either way (docs/SERVING.md)."""
+        if self.input_shape is None:
+            raise ValueError(
+                f"model {self.name!r}: warmup needs input_shape= (the "
+                f"per-example trailing shape) at registration")
+        b = self.batcher
+        shapes = [(n,) + self.input_shape for n in (b._bb or [b.max_batch])]
+        for shape in shapes:
+            if b._tb is not None and len(shape) >= 3:
+                # one variant per (batch, time) bucket, through the same
+                # masked path real sequence requests take
+                for tt in b._tb:
+                    xs = np.zeros((shape[0], tt) + shape[2:], np.float32)
+                    self._forward(xs, np.ones((shape[0], tt), np.float32))
+            else:
+                self._forward(np.zeros(shape, np.float32))
+        return self
+
+    def _forward(self, xs, mask=None):
+        # the scheduler thread is the only caller, so the model's lazy
+        # jit-wrapper construction needs no extra locking here
+        y = self.model.output(xs) if mask is None \
+            else self.model.output(xs, mask=mask)
+        return np.asarray(y)
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+        return self.batcher.submit(x, deadline_ms=deadline_ms)
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                timeout: float = 60.0):
+        """Synchronous convenience: submit + wait for the result rows."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        b = self.batcher
+        return {
+            "name": self.name,
+            "model": type(self.model).__name__,
+            "queue_depth": b.queue_depth(),
+            "batch_buckets": list(b._bb) if b._bb else None,
+            "time_buckets": list(b._tb) if b._tb else None,
+            "max_queue_examples": b.max_queue_examples,
+            "linger_ms": b.linger_ms,
+            "default_deadline_ms": b.default_deadline_ms,
+        }
+
+    def close(self, drain: bool = True, timeout: float = 30.0):
+        self.batcher.close(drain=drain, timeout=timeout)
+
+
+class ModelRegistry:
+    """Thread-safe name → :class:`ServedModel` table.
+
+    ``max_in_flight`` bounds CONCURRENT forwards across all hosted models
+    (each model's scheduler acquires the shared semaphore around its
+    flush); per-model queue caps bound each model's backlog. The lock
+    covers only the name map — request traffic never runs under it, so
+    registering model B cannot stall model A's flushes.
+    """
+
+    def __init__(self, max_in_flight: Optional[int] = None):
+        self._lock = make_lock("ModelRegistry._lock")
+        self._models: Dict[str, ServedModel] = {}
+        self._reserved: set = set()
+        self._in_flight = (threading.BoundedSemaphore(int(max_in_flight))
+                           if max_in_flight else None)
+
+    def register(self, name: str, model, **config) -> ServedModel:
+        """Host ``model`` under ``name`` (see :class:`ServedModel` for the
+        per-model config dials). Re-using a live name raises — unregister
+        (which drains) first, so in-flight requests are never orphaned.
+        The name is reserved BEFORE the ServedModel is built: a duplicate
+        fails fast instead of paying warmup compiles and a scheduler
+        thread just to tear them down again; construction itself runs
+        outside the registry lock (warmup can take seconds and must not
+        block lookups)."""
+        with self._lock:
+            if name in self._models or name in self._reserved:
+                raise ValueError(f"model {name!r} already registered — "
+                                 f"unregister it first")
+            self._reserved.add(name)
+        try:
+            served = ServedModel(name, model, in_flight=self._in_flight,
+                                 **config)
+            with self._lock:
+                self._models[name] = served
+        finally:
+            with self._lock:
+                self._reserved.discard(name)
+        return served
+
+    def unregister(self, name: str, drain: bool = True):
+        with self._lock:
+            served = self._models.pop(name, None)
+        if served is None:
+            raise ModelNotFoundError(name)
+        served.close(drain=drain)
+
+    def get(self, name: str) -> ServedModel:
+        with self._lock:
+            served = self._models.get(name)
+        if served is None:
+            raise ModelNotFoundError(name)
+        return served
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def list_models(self) -> List[Dict[str, Any]]:
+        """Stats rows for ``GET /v1/models`` (stable name order)."""
+        with self._lock:
+            models = sorted(self._models.items())
+        return [m.stats() for _, m in models]
+
+    def submit(self, name: str, x,
+               deadline_ms: Optional[float] = None) -> Future:
+        return self.get(name).submit(x, deadline_ms=deadline_ms)
+
+    def predict(self, name: str, x, deadline_ms: Optional[float] = None,
+                timeout: float = 60.0):
+        return self.get(name).predict(x, deadline_ms=deadline_ms,
+                                      timeout=timeout)
+
+    def close_all(self, drain: bool = True, timeout: float = 30.0):
+        """Graceful shutdown: stop admission on every model, serve what
+        was accepted (``drain=True``), join every scheduler. Closing
+        happens OUTSIDE the registry lock (a drain can take a while and
+        must not block lookups, nor create a lock-order edge onto the
+        batcher's condition)."""
+        with self._lock:
+            models, self._models = list(self._models.values()), {}
+        for m in models:
+            m.close(drain=drain, timeout=timeout)

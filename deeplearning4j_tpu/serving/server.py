@@ -1,0 +1,177 @@
+"""HTTP/JSON inference front door for the serving tier.
+
+Sibling of the training UI server (``ui/server.py`` — same stdlib
+``ThreadingHTTPServer``, same :class:`~deeplearning4j_tpu.ui.server.
+JsonRequestHandler` plumbing and POST Content-Length cap), serving:
+
+- ``POST /v1/models/<name>/predict`` — body ``{"inputs": [[...], ...],
+  "deadline_ms": optional}``; responds ``{"model", "outputs",
+  "latency_ms"}``. Typed failures map onto HTTP: unknown model → 404,
+  malformed body/shape → 400, :class:`OverloadedError` (queue at
+  capacity / draining) → **429** with a ``Retry-After`` hint,
+  :class:`DeadlineExceededError` → **504**, anything else → 500.
+- ``GET /v1/models`` — hosted-model listing with queue depth and config.
+- ``GET /v1/models/<name>`` — one model's row.
+- ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` — the monitor
+  endpoints re-exposed here so a serving replica is scrapeable without a
+  training UI attached; ``/profile`` carries the per-model ``serving``
+  block (p50/p99 latency, QPS, batch-size distribution, queue depth).
+
+Each handler thread blocks on its request's Future while the model's
+batching scheduler coalesces concurrent requests into one padded
+forward — the HTTP layer adds no batching logic of its own.
+``stop(drain=True)`` is the graceful path: stop accepting, drain every
+model's queue (no accepted request is dropped), then close the socket.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..ui.server import JsonRequestHandler
+from .batcher import (DeadlineExceededError, ModelNotFoundError,
+                      OverloadedError)
+from .registry import ModelRegistry
+
+__all__ = ["InferenceServer"]
+
+
+class _ServingHandler(JsonRequestHandler):
+    registry: ModelRegistry = None     # bound by the server factory
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):
+        url = urlparse(self.path)
+        if self._monitor_get(url, parse_qs(url.query)):
+            return                     # shared /metrics /healthz /profile
+        parts = [p for p in url.path.split("/") if p]
+        if parts == ["v1", "models"]:
+            self._json({"models": self.registry.list_models()})
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "models"]:
+            try:
+                self._json(self.registry.get(parts[2]).stats())
+            except ModelNotFoundError:
+                self._json({"error": f"model {parts[2]!r} not found",
+                            "models": self.registry.names()}, 404)
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if not (len(parts) == 4 and parts[:2] == ["v1", "models"]
+                and parts[3] == "predict"):
+            self._json({"error": "not found"}, 404)
+            return
+        body = self._post_body()
+        if body is None:
+            return
+        name = parts[2]
+        try:
+            doc = json.loads(body)
+            inputs = np.asarray(doc["inputs"], np.float32)
+            deadline_ms = doc.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)   # non-numeric → 400 here,
+                if deadline_ms <= 0:               # not a 500 at submit
+                    raise ValueError("deadline_ms must be > 0")
+            if inputs.ndim < 1 or inputs.shape[0] < 1:
+                raise ValueError("inputs must be a non-empty [b, ...] "
+                                 "array")
+        except (KeyError, TypeError, ValueError) as e:
+            self._json({"error": f"bad request body: {e}"}, 400)
+            return
+        t0 = time.perf_counter()
+        try:
+            fut = self.registry.submit(name, inputs,
+                                       deadline_ms=deadline_ms)
+            # generous transport-level backstop — per-request shedding is
+            # the batcher's deadline, not this timeout
+            out = fut.result(timeout=max(
+                60.0, (deadline_ms or 0.0) / 1e3 + 30.0))
+        except ModelNotFoundError:
+            self._json({"error": f"model {name!r} not found",
+                        "models": self.registry.names()}, 404)
+            return
+        except ValueError as e:            # oversize request, bad shape
+            self._json({"error": str(e)}, 400)
+            return
+        except OverloadedError as e:
+            self._json({"error": str(e)}, 429,
+                       headers={"Retry-After": "1"})
+            return
+        except DeadlineExceededError as e:
+            self._json({"error": str(e)}, 504)
+            return
+        except Exception as e:             # model blew up: the caller
+            self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+            return
+        self._json({"model": name, "outputs": np.asarray(out).tolist(),
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3,
+                                        3)})
+
+
+class InferenceServer:
+    """The serving front door: a :class:`ModelRegistry` behind HTTP.
+
+    ``InferenceServer().start(port=0)`` returns the bound port; bind is
+    loopback by default (the endpoints are unauthenticated — widen to
+    ``"0.0.0.0"`` only on a trusted network, exactly like ``UIServer``).
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 port: int = 8500, host: str = "127.0.0.1"):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.port = port
+        self.host = host
+        self._httpd = None
+        self._thread = None
+
+    def register(self, name: str, model, **config):
+        """Convenience passthrough to the registry."""
+        return self.registry.register(name, model, **config)
+
+    def start(self, port: Optional[int] = None,
+              host: Optional[str] = None) -> int:
+        if self._httpd is not None:
+            return self.port
+        if port is not None:
+            self.port = port
+        if host is not None:
+            self.host = host
+        handler = type("BoundServingHandler", (_ServingHandler,),
+                       {"registry": self.registry})
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="inference-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Graceful shutdown: stop the accept loop first (no NEW requests
+        land), then drain every model's batcher so every ACCEPTED request
+        resolves — handler threads blocked on their futures finish writing
+        their responses — and finally close the listening socket."""
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self.registry.close_all(drain=drain, timeout=timeout)
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

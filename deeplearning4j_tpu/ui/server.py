@@ -148,59 +148,98 @@ refresh();
 </script></body></html>"""
 
 
-class _Handler(BaseHTTPRequestHandler):
-    storage: StatsStorage = None  # set by server factory
-    tsne_data = None              # latest uploaded t-SNE coords/labels
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared JSON-over-HTTP handler plumbing: quiet logging, ``_json``
+    responses with correct Content-Length, extra response headers, and
+    bounded POST-body reads (the ``MAX_POST_BYTES`` 413 cap — refuse
+    BEFORE reading, so an abusive body never enters memory). The training
+    UI handler below and the serving tier's front door
+    (``serving/server.py``) both build on this, so the two servers cannot
+    drift on framing or limits."""
 
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def _json(self, obj, code=200, default=None):
+    def _json(self, obj, code=200, default=None, headers=None):
         payload = json.dumps(obj, default=default).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
+
+    def _post_body(self, max_bytes: int = None):
+        """Read and decode the POST body, or send the matching 400/413
+        error and return None — callers just bail on None."""
+        limit = MAX_POST_BYTES if max_bytes is None else max_bytes
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            self._json({"error": "bad Content-Length"}, 400)
+            return None
+        if length < 0:
+            # rfile.read(-1) would block until the client closes the socket
+            self._json({"error": "bad Content-Length"}, 400)
+            return None
+        if length > limit:
+            # refuse before reading: the body never enters memory
+            self._json({"error": f"body of {length} bytes exceeds the "
+                        f"{limit}-byte limit"}, 413)
+            return None
+        return self.rfile.read(length).decode("utf-8")
+
+    def _text(self, text: str, content_type: str, code: int = 200):
+        payload = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _monitor_get(self, url, q) -> bool:
+        """Serve the process-monitor endpoints every server shares —
+        ``/metrics``, ``/healthz``, ``/profile`` — so the training UI and
+        the serving front door cannot drift on routing, status-code
+        mapping, or framing. Returns True when the path was handled."""
+        if url.path == "/metrics":
+            # Prometheus scrape of the process-global monitor registry.
+            # Device-memory gauges are sampled scrape-time (pull-model
+            # freshness; a no-op on backends without memory stats)
+            sample_device_memory()
+            self._text(get_registry().render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return True
+        if url.path == "/healthz":
+            snap = get_health().snapshot()
+            self._json(snap, 200 if snap["healthy"] else 503)
+            return True
+        if url.path == "/profile":
+            # step-anatomy report (docs/OBSERVABILITY.md "Compilation &
+            # memory"): per-fn jit compile/call/cost table + device-memory
+            # gauges + step/ETL split + the serving block, one view
+            rep = profile_report()
+            if q.get("format", [""])[0] == "text":
+                self._text(render_profile_text(rep),
+                           "text/plain; charset=utf-8")
+            else:
+                self._json(rep)
+            return True
+        return False
+
+
+class _Handler(JsonRequestHandler):
+    storage: StatsStorage = None  # set by server factory
+    tsne_data = None              # latest uploaded t-SNE coords/labels
 
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
-        if url.path == "/metrics":
-            # Prometheus scrape of the process-global monitor registry.
-            # Device-memory gauges are sampled scrape-time (pull-model
-            # freshness; a no-op on backends without memory stats).
-            sample_device_memory()
-            payload = get_registry().render_prometheus().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
-        if url.path == "/healthz":
-            snap = get_health().snapshot()
-            self._json(snap, 200 if snap["healthy"] else 503)
+        if self._monitor_get(url, q):    # /metrics /healthz /profile
             return
         if url.path == "/trace":
             self._json(get_tracer().export())
-            return
-        if url.path == "/profile":
-            # step-anatomy report (docs/OBSERVABILITY.md "Compilation &
-            # memory"): per-fn jit compile/call/cost table + device-memory
-            # gauges + the step/ETL timing split, one view
-            rep = profile_report()
-            if q.get("format", [""])[0] == "text":
-                payload = render_profile_text(rep).encode("utf-8")
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; charset=utf-8")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-                return
-            self._json(rep)
             return
         if url.path == "/fleet":
             # merged per-worker registry view (OP_TELEMETRY reports landed
@@ -212,13 +251,8 @@ class _Handler(BaseHTTPRequestHandler):
             if q.get("format", [""])[0] == "json":
                 self._json(fleet.liveness())
                 return
-            payload = fleet.render_prometheus().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+            self._text(fleet.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
             return
         if url.path == "/fleet/trace":
             # whole-fleet Chrome trace: every worker's shipped spans plus
@@ -294,21 +328,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urlparse(self.path).path
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-        except (TypeError, ValueError):
-            self._json({"error": "bad Content-Length"}, 400)
+        body = self._post_body()
+        if body is None:
             return
-        if length < 0:
-            # rfile.read(-1) would block until the client closes the socket
-            self._json({"error": "bad Content-Length"}, 400)
-            return
-        if length > MAX_POST_BYTES:
-            # refuse before reading: the body never enters memory
-            self._json({"error": f"body of {length} bytes exceeds the "
-                        f"{MAX_POST_BYTES}-byte limit"}, 413)
-            return
-        body = self.rfile.read(length).decode("utf-8")
         if path == "/remote":
             try:
                 self.storage.put_update(StatsReport.from_json(body))

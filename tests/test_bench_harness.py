@@ -356,6 +356,24 @@ def test_paramserver_bench_cuts_wire_bytes(bench):
     assert stats["speedup"] > 0.3
 
 
+def test_serving_latency_bench_reports_tail_at_two_qps_points(bench):
+    """Acceptance (ISSUE 9): the open-loop load generator drives the
+    HTTP endpoint at two offered-QPS points and latches
+    {p50_ms, p99_ms, achieved_qps, reject_rate, mean_batch_size} per
+    point into the --one record's serving block."""
+    value = bench.bench_serving_latency(qps_points=(30.0, 90.0),
+                                        duration_s=1.0, pool_workers=16)
+    stats = bench.SERVING_STATS
+    assert value > 0
+    assert [p["offered_qps"] for p in stats["points"]] == [30.0, 90.0]
+    for p in stats["points"]:
+        assert p["sent"] > 0 and p["achieved_qps"] > 0
+        assert 0.0 < p["p50_ms"] <= p["p99_ms"]
+        assert 0.0 <= p["reject_rate"] <= 1.0
+        assert p["mean_batch_size"] >= 1.0
+    assert stats["buckets"] == [1, 2, 4, 8, 16, 32]
+
+
 def test_input_pipeline_bench_hides_etl(bench):
     """Acceptance (PR 6): the input-bound bench must show etl_ms reduced
     >= 5x with prefetch + device-put-ahead vs the synchronous path, and

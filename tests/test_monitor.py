@@ -436,7 +436,7 @@ def test_paramserver_metrics_snapshot_shape_unchanged():
     assert snap["counters"]["pushes"] == 1
     assert snap["counters"]["pull_bytes"] == 400
     assert snap["counters"]["retries"] == 1
-    assert {"mean_ms", "p50_ms", "p95_ms", "max_ms",
+    assert {"mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms",
             "n"} == set(snap["push_latency"])
     # per-instance isolation: a second facade starts from zero even though
     # both mirror into the same shared registry role

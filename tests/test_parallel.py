@@ -1,6 +1,8 @@
 """Parallelism tests on the virtual 8-device CPU mesh (reference test family:
 ``ParallelWrapperMainTest``, ``SharedTrainingAccumulationFunctionTest`` —
 SURVEY.md §4 items 5/6)."""
+import time
+
 import numpy as np
 import pytest
 import jax
@@ -545,13 +547,58 @@ def test_tensor_parallel_step_matches_replicated():
 
 
 def test_parallel_inference_partial_batch_timer_flush():
-    # a lone partial batch must flush via the timer, not hang (review finding)
+    # a lone partial batch must flush via the max-linger, not hang
+    # (review finding; the linger now lives on the shared serving
+    # scheduler rather than an ad-hoc per-batch Timer)
     net = _net()
     pi = ParallelInference(net, mode=InferenceMode.BATCHED, batch_limit=1000,
                            flush_after_ms=50)
     fut = pi.submit(_data(4).features)
     out = fut.result(timeout=30)
     assert out.shape == (4, 4)
+    pi.close()
+
+
+def test_parallel_inference_lone_request_never_stranded():
+    """Regression (ISSUE 9 satellite): a single sub-batch_limit request
+    must resolve within the linger bound with NO further submits, NO
+    explicit flush, and NO direct output() call — stranding here is the
+    exact failure the max-linger exists to prevent. Also pins the
+    Builder's flush_after_ms dial and close(drain=True) semantics."""
+    net = _net()
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(1000).queue_limit(1000)
+          .flush_after_ms(25).build())
+    assert pi.flush_after_ms == 25.0
+    t0 = time.perf_counter()
+    out = pi.submit(_data(2).features).result(timeout=30)
+    assert out.shape == (2, 4)
+    # generous wall-clock bound: linger (25ms) + forward + slack — the
+    # point is "resolves without a second submit", not exact timing
+    assert time.perf_counter() - t0 < 20.0
+
+    # close(drain=True): already-queued requests still complete
+    futs = [pi.submit(_data(1, seed=i).features) for i in range(3)]
+    pi.close(drain=True)
+    assert all(f.result(timeout=5).shape == (1, 4) for f in futs)
+    # after close, a new submit transparently restarts the scheduler
+    assert pi.submit(_data(1).features).result(timeout=30).shape == (1, 4)
+    pi.close()
+
+
+def test_parallel_inference_oversize_submit_still_served():
+    # review finding: a request LARGER than batch_limit must flush as its
+    # own batch (the original accept-and-flush semantics), not be rejected
+    net = _net()
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+    big = _data(24).features                  # 3x the batch limit
+    out = pi.submit(big).result(timeout=30)
+    assert out.shape == (24, 4)
+    np.testing.assert_allclose(out, np.asarray(net.output(big)),
+                               rtol=1e-5, atol=1e-6)
+    pi.close()
 
 
 def test_tp_updater_state_shards_with_param():
