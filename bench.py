@@ -394,7 +394,9 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
                                     MultiLayerNetwork, Sgd,
                                     InferenceServer, ModelRegistry)
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.monitor import get_registry
+    from deeplearning4j_tpu.monitor import (AlertEngine, MetricsHistory,
+                                            default_serving_rules,
+                                            get_registry)
 
     conf = (NeuralNetConfiguration.builder().seed(7)
             .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
@@ -419,8 +421,23 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
     payload = json.dumps(
         {"inputs": np.random.default_rng(0)
          .normal(size=(1, n_in)).astype(np.float32).tolist()}).encode()
-    batch_hist = get_registry().histogram("serving_batch_size",
+    batch_hist = get_registry().histogram("serving_batch_examples",
                                           "", model="bench")
+    # SLO watch (monitor/alerts.py): the default serving rule pack over a
+    # fast-sampling history ring; each offered-QPS point latches which
+    # rules were FIRING when the point ended — and the LOWEST point must
+    # end alert-free (a healthy server at trivial load with alerts firing
+    # means the bench or the rules are broken)
+    history = MetricsHistory(capacity=256, interval_s=0.25)
+    engine = AlertEngine(history=history)
+    engine.add(*default_serving_rules(
+        model="bench", windows=(2.0, 4.0), p99_target_ms=250.0,
+        queue_cap=max_queue_examples, for_seconds=0.0))
+    # for_seconds=0: the sweep points are seconds long — the production
+    # hold-down would mask every breach, and alerts_fired at the high
+    # points is part of the latched record
+    rule_names = [r.name for r in engine.rules()]
+    history.start()
 
     def fire(out, lock):
         t0 = time.perf_counter()
@@ -462,6 +479,7 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
         def pct(q):
             return lat_ok[min(int(q * (len(lat_ok) - 1)),
                               len(lat_ok) - 1)] if lat_ok else None
+        engine.evaluate(strict=False)
         return {
             "offered_qps": offered,
             "sent": n,
@@ -470,16 +488,27 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
             "p99_ms": round(pct(0.99), 2) if lat_ok else None,
             "reject_rate": round(rejects / max(n, 1), 4),
             "mean_batch_size": round((b_total1 - b_total0) / flushes, 2),
+            "alerts_fired": engine.firing(),
         }
 
     try:
         points = [drive(q) for q in qps_points]
     finally:
         srv.stop()
+        history.stop()
+        # rules legitimately FIRING at a high-QPS point must not leave
+        # alerts_firing{rule=}=1 squatting in the process-global registry
+        # for the rest of the run — clear() records the closing edges
+        engine.clear()
+    assert not points[0]["alerts_fired"], (
+        f"SLO rules FIRING at the lowest offered-QPS point "
+        f"({qps_points[0]} qps): {points[0]['alerts_fired']} — a healthy "
+        f"server at trivial load must be alert-free")
     SERVING_STATS.update({
         "buckets": list(buckets), "linger_ms": linger_ms,
         "max_queue_examples": max_queue_examples,
         "duration_s": duration_s, "points": points,
+        "alert_rules": rule_names,
     })
     return points[-1]["achieved_qps"] or 0.0
 

@@ -157,7 +157,7 @@ class PrefetchIterator:
                           "training loop"),
                 reg.histogram("input_wait_seconds",
                               "blocking wait for the next batch in the "
-                              "input pipeline (seconds)"),
+                              "input pipeline (seconds)", unit="s"),
                 reg.counter("input_batches_total",
                             "batches served by the input pipeline"),
             )

@@ -203,7 +203,10 @@ def cmd_monitor(args) -> int:
     (``/fleet``); ``--events`` prints the flight recorder's structured
     event log as JSONL; ``--profile`` prints the step-anatomy report
     (per-fn jit compiles/times/flops + device memory + step/ETL split,
-    ``/profile`` remotely)."""
+    ``/profile`` remotely); ``--alerts`` prints the alert engine's rule
+    states (``/alerts`` remotely — docs/OBSERVABILITY.md "Alerting &
+    SLOs"); ``--history`` prints the metric-history ring meta
+    (``/history`` remotely)."""
     import json
     import urllib.error
     import urllib.request
@@ -236,6 +239,44 @@ def cmd_monitor(args) -> int:
                 print(json.dumps(rep, indent=2))
             else:
                 print(render_profile_text(rep), end="")
+        return 0
+
+    if args.alerts:
+        # alert-rule states: one line per rule in text mode, the full
+        # /alerts JSON with --format json; exit 0 either way (the alert
+        # is the GAUGE's job — a monitoring dump must stay scriptable)
+        if base:
+            doc = json.loads(_fetch(base, "/alerts"))
+        else:
+            from .monitor import get_alert_engine
+            engine = get_alert_engine()
+            engine.evaluate(strict=False)
+            doc = engine.snapshot()
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            rows = doc.get("alerts", [])
+            if not rows:
+                print("# no alert rules registered")
+            for r in rows:
+                print(f"{r['state']:<8} {r['rule']:<36} "
+                      f"value={r.get('value')} {r.get('detail', '')}"
+                      + (f" exemplar={r['exemplar_trace_id']}"
+                         if r.get("exemplar_trace_id") else ""))
+            if doc.get("firing"):
+                print(f"# FIRING: {', '.join(doc['firing'])}")
+        return 0
+
+    if args.history:
+        # metric-history ring meta (the per-series view is the HTTP
+        # endpoint's ?metric= job — a terminal wants the shape, not
+        # thousands of points)
+        if base:
+            doc = json.loads(_fetch(base, "/history"))
+        else:
+            from .monitor import get_history
+            doc = get_history().describe()
+        print(json.dumps(doc, indent=2))
         return 0
 
     if args.events:
@@ -333,7 +374,8 @@ def cmd_lint(args) -> int:
     lock (THR001), leaked threads (THR002), lock-order inversions and
     cross-function blocking-under-lock on the interprocedural lock graph
     (THR003/THR004), silent broad excepts (EXC001), leaked
-    sockets/executors/servers (RES001). Exit 0 iff no finding outside the
+    sockets/executors/servers (RES001), metric-name unit-suffix
+    violations (MON001). Exit 0 iff no finding outside the
     baseline; deterministic output. ``--changed`` scopes the run to
     git-touched files for fast pre-commit checks (note: the
     interprocedural rules then only see those files — the tier-1 guard
@@ -427,6 +469,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="step-anatomy report: per-fn jit compile counts/"
                         "seconds/flops, device-memory gauges, step/ETL "
                         "timing split (text, or JSON with --format json)")
+    m.add_argument("--alerts", action="store_true",
+                   help="alert-rule states (OK/PENDING/FIRING) from the "
+                        "SLO engine — one line per rule, or the /alerts "
+                        "JSON with --format json")
+    m.add_argument("--history", action="store_true",
+                   help="metric-history ring meta (/history): sampler "
+                        "interval, capacity, sample count, family names")
     m.set_defaults(fn=cmd_monitor)
     li = sub.add_parser("lint",
                         help="tpulint: AST static analysis for JAX/"

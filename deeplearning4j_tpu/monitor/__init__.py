@@ -20,6 +20,14 @@ across (``ParamServerMetrics``, ``PerformanceListener``/
 - :func:`get_fleet` — per-worker telemetry shipped over the paramserver's
   ``OP_TELEMETRY``: the merged ``GET /fleet`` scrape, the merged
   multi-``pid`` Chrome trace, and worker staleness for ``/healthz``.
+- :func:`get_history` — the bounded ring of timestamped registry
+  snapshots behind ``GET /history`` and the ``trends`` block of
+  ``/profile`` (opt-in background sampler; windowed rate/delta/quantile
+  readers).
+- :func:`get_alert_engine` — declarative threshold / burn-rate SLO rules
+  evaluated over the history: OK→PENDING→FIRING with hold-down,
+  ``alert_firing``/``alert_resolved`` flight events,
+  ``alerts_firing{rule=}`` gauge, ``GET /alerts``.
 
 The fit loops, transport channel, parameter-server client/server, and
 async dataset iterator are pre-instrumented against these globals. The
@@ -38,11 +46,17 @@ from .lockwatch import (InstrumentedLock, LockWatch, get_lockwatch,
                         make_lock, make_rlock, make_condition)
 from .registry import (MetricsRegistry, LatencyHistogram, Counter, Gauge,
                        Histogram, get_registry, render_prometheus_dump)
-from .tracer import SpanContext, Tracer, get_tracer
+from .tracer import SpanContext, Tracer, get_tracer, new_context
 from .health import (HealthState, get_health, TrainingHealthListener,
                      TrainingHealthError)
 from .flightrec import FlightRecorder, get_flight_recorder
 from .fleet import FleetState, get_fleet, merge_traces
+from .history import MetricsHistory, get_history
+from .alerts import (AlertEngine, AlertError, AlertRule, BurnRateRule,
+                     FleetStalenessRule, HealthRule, ThresholdRule,
+                     default_fleet_rules, default_rules,
+                     default_serving_rules, default_training_rules,
+                     get_alert_engine)
 from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
                        get_jit_registry, sample_device_memory,
                        maybe_sample_device_memory, profile_report,
@@ -51,7 +65,7 @@ from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
 __all__ = [
     "MetricsRegistry", "LatencyHistogram", "Counter", "Gauge", "Histogram",
     "get_registry", "render_prometheus_dump", "SpanContext", "Tracer",
-    "get_tracer", "HealthState", "get_health",
+    "get_tracer", "new_context", "HealthState", "get_health",
     "TrainingHealthListener", "TrainingHealthError",
     "FlightRecorder", "get_flight_recorder", "FleetState", "get_fleet",
     "merge_traces", "MonitoredJit", "JitRegistry", "monitored_jit",
@@ -59,6 +73,11 @@ __all__ = [
     "maybe_sample_device_memory", "profile_report",
     "render_profile_text", "InstrumentedLock", "LockWatch",
     "get_lockwatch", "make_lock", "make_rlock", "make_condition",
+    "MetricsHistory", "get_history", "AlertEngine", "AlertError",
+    "AlertRule", "ThresholdRule", "BurnRateRule", "HealthRule",
+    "FleetStalenessRule", "get_alert_engine", "default_rules",
+    "default_serving_rules", "default_training_rules",
+    "default_fleet_rules",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 
@@ -88,7 +107,7 @@ def step_span(iteration: int):
     gauges (throttled, AFTER the span ends so the sampling cost never
     inflates the step duration) — the step boundary is where
     donation/sharding decisions have just landed, so
-    ``device_memory_bytes_in_use`` tracks the working set step-by-step
+    ``device_memory_in_use_bytes`` tracks the working set step-by-step
     (docs/OBSERVABILITY.md "Compilation & memory")."""
     try:
         with get_tracer().span("step", cat="train",

@@ -1,0 +1,685 @@
+"""Declarative alert rules over metric history: SLOs that act.
+
+The seeing half of the observability stack (PRs 2-9) ends at endpoints a
+human must poll; this module is the acting half. Rules are evaluated over
+:class:`~deeplearning4j_tpu.monitor.history.MetricsHistory` windows and
+run a three-state machine per rule::
+
+    OK --breach--> PENDING --breach held for_seconds--> FIRING
+    FIRING --breach clears--> OK (resolved)
+
+- **PENDING** is the hold-down: a single bad sample (one slow scrape, one
+  transient queue spike) never pages — the breach must persist for the
+  rule's ``for_seconds`` before it fires.
+- **FIRING** is edge-triggered: ONE ``alert_firing`` flight-recorder
+  event, one health problem (``kind="alert"`` — lands on ``/healthz``
+  like every watchdog), and ``alerts_firing{rule=}`` set to 1. Resolution
+  mirrors it (``alert_resolved`` event, gauge back to 0).
+- A firing latency alert carries an **exemplar trace id** — the worst
+  recent sample's trace latched by the serving latency histogram
+  (``LatencyHistogram.record(..., exemplar=)``) — so the responder jumps
+  from the alert straight to the offending request on ``GET /trace``
+  instead of guessing from an aggregate.
+
+Rule types:
+
+- :class:`ThresholdRule` — one metric, one comparison: current value,
+  windowed rate, windowed max, or windowed quantile vs a threshold.
+- :class:`BurnRateRule` — multi-window SLO burn (the SRE playbook):
+  *availability* (1 − bad/total must stay ≥ the SLO target; the error
+  budget burn rate must exceed ``burn_factor`` on BOTH the short and the
+  long window to breach — short confirms it is still happening, long
+  confirms it is not noise) and *latency* (windowed p99 over target on
+  both windows).
+- :class:`HealthRule` — training stall/divergence/NaN read from the
+  existing :func:`~deeplearning4j_tpu.monitor.health.get_health` state
+  (the watchdog already classifies; this turns its problems into
+  stateful, resolvable alerts).
+- :class:`FleetStalenessRule` — workers stale on the fleet table.
+
+``action`` mirrors the TrainingHealthListener contract: ``"warn"``
+(default) records the problem, ``"halt"`` additionally requests the
+graceful training stop via ``HealthState.record_halt``, ``"raise"``
+raises :class:`AlertError` out of a *synchronous* ``evaluate`` (the
+sampler thread and the HTTP endpoints evaluate with ``strict=False``,
+which downgrades raise to warn — an alert must never kill the sampler).
+
+``default_serving_rules`` / ``default_training_rules`` /
+``default_fleet_rules`` are the shipped rule packs; nothing is installed
+by default (tier-1 suites run with zero rules and therefore zero alerts).
+See docs/OBSERVABILITY.md "Alerting & SLOs".
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .lockwatch import make_lock
+from .history import MetricsHistory, get_history
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AlertError", "AlertRule", "ThresholdRule", "BurnRateRule",
+           "HealthRule", "FleetStalenessRule", "AlertEngine",
+           "get_alert_engine", "default_serving_rules",
+           "default_training_rules", "default_fleet_rules",
+           "default_rules"]
+
+OK, PENDING, FIRING = "OK", "PENDING", "FIRING"
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+class AlertError(RuntimeError):
+    """Raised by a strict ``AlertEngine.evaluate`` when a rule with
+    ``action="raise"`` fires. ``rule`` names the offender."""
+
+    def __init__(self, rule: str, message: str):
+        super().__init__(message)
+        self.rule = rule
+
+
+class AlertRule:
+    """One named rule: subclasses implement :meth:`check`; the engine owns
+    the OK/PENDING/FIRING state machine, hold-down, and event fan-out."""
+
+    ACTIONS = ("warn", "raise", "halt")
+
+    def __init__(self, name: str, *, for_seconds: float = 0.0,
+                 severity: str = "page", action: str = "warn",
+                 description: str = ""):
+        if action not in self.ACTIONS:
+            raise ValueError(f"action must be one of {self.ACTIONS}, "
+                             f"got {action!r}")
+        self.name = str(name)
+        self.for_seconds = float(for_seconds)
+        self.severity = str(severity)
+        self.action = action
+        self.description = description
+        # state machine (engine-owned, engine-lock-guarded)
+        self.state = OK
+        self.pending_since: Optional[float] = None
+        self.firing_since: Optional[float] = None
+        self.fired_count = 0
+        self.last_value: Optional[float] = None
+        self.last_detail: str = ""
+        self.last_exemplar: Optional[str] = None
+
+    def check(self, history: MetricsHistory, now: float
+              ) -> Tuple[bool, Optional[float], str, Optional[str]]:
+        """(breached, observed value, human detail, exemplar trace id)."""
+        raise NotImplementedError
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.name,
+            "state": self.state,
+            "severity": self.severity,
+            "action": self.action,
+            "for_seconds": self.for_seconds,
+            "description": self.description,
+            "pending_since": self.pending_since,
+            "firing_since": self.firing_since,
+            "fired_count": self.fired_count,
+            "value": self.last_value,
+            "detail": self.last_detail,
+            "exemplar_trace_id": self.last_exemplar,
+        }
+
+
+class ThresholdRule(AlertRule):
+    """``mode``: ``"value"`` (newest sample), ``"rate"`` (counter
+    increase/s over ``window_s``), ``"max"`` (gauge max over the window),
+    or ``"quantile"`` (windowed histogram quantile ``q``, in the family's
+    unit). A metric with no data does not breach — absence of traffic is
+    not an incident for a threshold rule."""
+
+    def __init__(self, name: str, metric: str, *, threshold: float,
+                 op: str = ">", mode: str = "value", window_s: float = 60.0,
+                 q: float = 0.99, labels: Optional[Dict[str, str]] = None,
+                 agg: str = "sum", **kw):
+        super().__init__(name, **kw)
+        if op not in _OPS:
+            raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+        if mode not in ("value", "rate", "max", "quantile"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if agg not in ("sum", "max"):
+            raise ValueError(f"agg must be sum|max, got {agg!r}")
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.op = op
+        self.mode = mode
+        self.window_s = float(window_s)
+        self.q = float(q)
+        self.labels = dict(labels) if labels else None
+        #: child aggregation for value/max modes: "sum" across matching
+        #: children, or "max" (worst single child — the right reading
+        #: when the threshold is a PER-child cap, e.g. queue depth vs
+        #: one model's admission cap)
+        self.agg = agg
+
+    def _observe(self, history: MetricsHistory, now: float
+                 ) -> Optional[float]:
+        if self.mode == "value":
+            return history.current(self.metric, self.labels, agg=self.agg)
+        if self.mode == "rate":
+            # rate normalizes by the ACTUAL sample span, so it stays
+            # honest on a young ring — no coverage guard needed
+            return history.rate(self.metric, self.window_s, self.labels,
+                                now=now)
+        if not history.covers(self.window_s, now=now):
+            # max/quantile over an uncovered window would silently
+            # describe a shorter span — the same dishonesty the
+            # burn-rate windows guard against
+            return None
+        if self.mode == "max":
+            return history.max_over(self.metric, self.window_s, self.labels,
+                                    now=now, agg=self.agg)
+        return history.quantile_over(self.metric, self.q, self.window_s,
+                                     self.labels, now=now)
+
+    def check(self, history, now):
+        v = self._observe(history, now)
+        if v is None:
+            return False, None, f"{self.metric}: no data", None
+        breached = _OPS[self.op](v, self.threshold)
+        what = {"value": self.metric,
+                "rate": f"rate({self.metric})/s",
+                "max": f"max_{self.window_s:g}s({self.metric})",
+                "quantile": f"p{int(self.q * 100)}({self.metric})"}[self.mode]
+        return breached, v, (f"{what} = {v:.6g} "
+                             f"{self.op} {self.threshold:g}"), None
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window SLO burn rate.
+
+    ``kind="availability"``: availability = 1 − bad/total over a window
+    (``bad_labels`` rows of ``total_metric`` — the serving default counts
+    ``outcome`` in ``error``/``deadline``, the 5xx outcomes). Burn rate =
+    (bad/total) / (1 − slo); breach when burn > ``burn_factor`` on BOTH
+    windows. With the defaults (slo 0.999, factor 14.4, 60s/300s) a full
+    outage fires in ~one minute while a 0.1% error trickle never does —
+    exactly the SRE multiwindow table.
+
+    ``kind="latency"``: windowed p-``q`` of ``latency_metric`` over
+    ``target_ms`` on BOTH windows; the exemplar is the worst latched
+    trace id of the latency histogram (requests route it via the serving
+    batcher)."""
+
+    def __init__(self, name: str, *, kind: str = "availability",
+                 slo: float = 0.999, burn_factor: float = 14.4,
+                 windows: Sequence[float] = (60.0, 300.0),
+                 total_metric: str = "serving_requests_total",
+                 total_labels: Optional[Dict[str, str]] = None,
+                 bad_labels: Optional[Sequence[Dict[str, str]]] = None,
+                 latency_metric: str = "serving_request_latency_ms",
+                 latency_labels: Optional[Dict[str, str]] = None,
+                 target_ms: float = 250.0, q: float = 0.99,
+                 min_requests: float = 1.0, **kw):
+        super().__init__(name, **kw)
+        if kind not in ("availability", "latency"):
+            raise ValueError(f"kind must be availability|latency, "
+                             f"got {kind!r}")
+        self.kind = kind
+        self.slo = float(slo)
+        self.burn_factor = float(burn_factor)
+        self.windows = tuple(float(w) for w in windows)
+        self.total_metric = total_metric
+        self.total_labels = dict(total_labels) if total_labels else None
+        self.bad_labels = ([dict(b) for b in bad_labels] if bad_labels
+                           else [{"outcome": "error"},
+                                 {"outcome": "deadline"}])
+        self.latency_metric = latency_metric
+        self.latency_labels = dict(latency_labels) if latency_labels \
+            else None
+        self.target_ms = float(target_ms)
+        self.q = float(q)
+        self.min_requests = float(min_requests)
+
+    def _bad_delta(self, history, window, now) -> float:
+        total = 0.0
+        for bl in self.bad_labels:
+            labels = dict(self.total_labels or {})
+            labels.update(bl)
+            d = history.delta(self.total_metric, window, labels, now=now)
+            if d:
+                total += d
+        return total
+
+    def _availability(self, history, now):
+        budget = max(1.0 - self.slo, 1e-9)
+        burns = []
+        for w in self.windows:
+            if not history.covers(w, now=now):
+                # a ring younger than the window would make the long
+                # window equal to the short one — the multiwindow
+                # protection must not degenerate to a single window
+                return False, None, (f"history does not cover the "
+                                     f"{w:g}s window yet"), None
+            total = history.delta(self.total_metric, w, self.total_labels,
+                                  now=now)
+            if total is None or total < self.min_requests:
+                return False, None, (f"error budget: <{self.min_requests:g} "
+                                     f"requests in {w:g}s window"), None
+            ratio = self._bad_delta(history, w, now) / max(total, 1.0)
+            burns.append(ratio / budget)
+        breached = all(b > self.burn_factor for b in burns)
+        detail = (f"error-budget burn "
+                  + "/".join(f"{b:.1f}x@{w:g}s"
+                             for b, w in zip(burns, self.windows))
+                  + f" vs {self.burn_factor:g}x (slo {self.slo})")
+        return breached, max(burns), detail, None
+
+    def _latency(self, history, now):
+        ps = []
+        for w in self.windows:
+            if not history.covers(w, now=now):
+                return False, None, (f"history does not cover the "
+                                     f"{w:g}s window yet"), None
+            p = history.quantile_over(self.latency_metric, self.q, w,
+                                      self.latency_labels, now=now)
+            if p is None:
+                return False, None, (f"p{int(self.q * 100)}: no samples in "
+                                     f"{w:g}s window"), None
+            ps.append(p)
+        breached = all(p > self.target_ms for p in ps)
+        exemplar = None
+        if breached:
+            exemplar = self._worst_trace()
+        detail = (f"p{int(self.q * 100)} "
+                  + "/".join(f"{p:.1f}ms@{w:g}s"
+                             for p, w in zip(ps, self.windows))
+                  + f" vs target {self.target_ms:g}ms")
+        return breached, max(ps), detail, exemplar
+
+    def _worst_trace(self) -> Optional[str]:
+        """Worst latched exemplar across the latency histogram's matching
+        children — read from the LIVE registry (exemplars are local, not
+        part of the history dumps)."""
+        from .registry import get_registry
+        reg = get_registry()
+        dump = reg.dump().get(self.latency_metric)
+        if not dump:
+            return None
+        from .history import _match
+        worst = None
+        for row in dump.get("children", []):
+            labels = row.get("labels", {})
+            if not _match(labels, self.latency_labels):
+                continue
+            child = reg.histogram(self.latency_metric, **labels)
+            ex = child.worst_exemplar()
+            if ex and (worst is None or ex["value"] > worst["value"]):
+                worst = ex
+        return worst["exemplar"] if worst else None
+
+    def check(self, history, now):
+        return (self._availability(history, now) if self.kind ==
+                "availability" else self._latency(history, now))
+
+
+class HealthRule(AlertRule):
+    """Training health as a stateful alert. ``kind="stall"`` breaches when
+    iterations have happened but the last one is older than
+    ``stall_after_s``; ``kind="problem"`` breaches while a
+    ``health_problem`` flight-recorder event whose kind matches
+    ``problem_kinds`` (divergence / nan / retrace — the watchdog already
+    classified it) was recorded within the trailing ``within_s``. Flight
+    events carry timestamps, so the alert RESOLVES once the problems age
+    out — the health snapshot's 8-slot problem ring is append-only for
+    the process lifetime (and shared with every other problem source), so
+    reading it directly would either never resolve or resolve spuriously
+    on eviction."""
+
+    def __init__(self, name: str, *, kind: str = "stall",
+                 stall_after_s: float = 120.0,
+                 problem_kinds: Sequence[str] = ("nan", "divergence"),
+                 within_s: float = 300.0, **kw):
+        super().__init__(name, **kw)
+        if kind not in ("stall", "problem"):
+            raise ValueError(f"kind must be stall|problem, got {kind!r}")
+        self.kind = kind
+        self.stall_after_s = float(stall_after_s)
+        self.problem_kinds = tuple(problem_kinds)
+        self.within_s = float(within_s)
+
+    def check(self, history, now):
+        if self.kind == "stall":
+            from .health import get_health
+            snap = get_health().snapshot()
+            age = snap.get("last_iteration_age_s")
+            if age is None:
+                return False, None, "no training iterations yet", None
+            return (age > self.stall_after_s, age,
+                    f"last iteration {age:.1f}s ago "
+                    f"(stall_after={self.stall_after_s:g}s)", None)
+        from .flightrec import get_flight_recorder
+        hits = [e for e in get_flight_recorder().events()
+                if e.get("event") == "health_problem"
+                and e.get("kind") in self.problem_kinds
+                and now - e.get("t", 0.0) <= self.within_s]
+        return (bool(hits), float(len(hits)),
+                (f"{hits[-1].get('kind')}: {hits[-1].get('message')}"
+                 if hits else
+                 f"no {'/'.join(self.problem_kinds)} problems in the "
+                 f"last {self.within_s:g}s"), None)
+
+
+class FleetStalenessRule(AlertRule):
+    """Workers stale on the fleet table (no OP_TELEMETRY report within the
+    fleet's staleness horizon) — only meaningful on the process where
+    reports land (the paramserver server)."""
+
+    def __init__(self, name: str, *, min_stale: int = 1, **kw):
+        super().__init__(name, **kw)
+        self.min_stale = int(min_stale)
+
+    def check(self, history, now):
+        from .fleet import get_fleet
+        live = get_fleet().liveness()
+        stale = live.get("stale", [])
+        if not live.get("workers"):
+            return False, None, "no fleet workers reporting", None
+        return (len(stale) >= self.min_stale, float(len(stale)),
+                f"stale workers: {sorted(stale)}" if stale
+                else "all workers fresh", None)
+
+
+class AlertEngine:
+    """Holds rules, drives their state machines, fans out events.
+
+    One engine per process (:func:`get_alert_engine`), sharing the global
+    :class:`MetricsHistory`. ``attach()`` registers the engine on the
+    history sampler so every tick evaluates; the ``/alerts`` endpoints
+    additionally evaluate at request time so a snapshot is never staler
+    than the scrape that asked for it."""
+
+    def __init__(self, history: Optional[MetricsHistory] = None):
+        self._lock = make_lock("AlertEngine._lock")
+        # serializes whole evaluation passes INCLUDING their event
+        # fan-out, and remove()/clear()'s closing edges: without it a
+        # sampler-tick evaluate and a request-time /alerts evaluate (or a
+        # concurrent remove) could emit alert_resolved before the queued
+        # alert_firing, stranding the gauge at 1 with no owner. Ordered
+        # strictly before _lock; never held while a rule fires an
+        # exception into the caller (release happens in the finally).
+        self._eval_lock = make_lock("AlertEngine._eval_lock")
+        self._history = history
+        self._rules: Dict[str, AlertRule] = {}
+        self._attached = False
+        self.last_evaluated: Optional[float] = None
+
+    @property
+    def history(self) -> MetricsHistory:
+        return self._history if self._history is not None else get_history()
+
+    # ------------------------------------------------------------- rules
+    def add(self, *rules: AlertRule) -> "AlertEngine":
+        with self._lock:
+            for r in rules:
+                if r.name in self._rules:
+                    raise ValueError(f"alert rule {r.name!r} already "
+                                     f"registered")
+                self._rules[r.name] = r
+        return self
+
+    @staticmethod
+    def _resolve_dangling(name: str):
+        """A FIRING rule leaving the engine (remove/clear) must not leave
+        an unmatched ``alert_firing`` edge: zero the gauge AND record the
+        closing ``alert_resolved`` so flight-stream consumers that pair
+        the edges never see a forever-firing ghost."""
+        AlertEngine._gauge(name).set(0.0)
+        from .flightrec import get_flight_recorder
+        get_flight_recorder().record("alert_resolved", rule=name,
+                                     detail="rule removed from engine")
+
+    def remove(self, name: str):
+        with self._eval_lock:      # never interleave with an in-flight
+            with self._lock:       # evaluation's transition fan-out
+                rule = self._rules.pop(name, None)
+                was_firing = rule is not None and rule.state == FIRING
+            if was_firing:
+                self._resolve_dangling(name)
+
+    def rules(self) -> List[AlertRule]:
+        with self._lock:
+            return [self._rules[n] for n in sorted(self._rules)]
+
+    def clear(self):
+        with self._eval_lock:
+            with self._lock:
+                rules, self._rules = list(self._rules.values()), {}
+                firing = [r.name for r in rules if r.state == FIRING]
+            for name in firing:
+                self._resolve_dangling(name)
+
+    def attach(self) -> "AlertEngine":
+        """Evaluate on every history sampler tick (idempotent)."""
+        with self._lock:
+            if self._attached:
+                return self
+            self._attached = True
+        self.history.add_listener(lambda _h: self.evaluate(strict=False))
+        return self
+
+    # --------------------------------------------------------- evaluation
+    @staticmethod
+    def _gauge(name: str):
+        from .registry import get_registry
+        return get_registry().gauge(
+            "alerts_firing", "alert rules currently FIRING (1) by rule",
+            rule=name)
+
+    def evaluate(self, now: Optional[float] = None,
+                 strict: bool = True) -> List[Dict[str, Any]]:
+        """One evaluation pass over every rule; returns the snapshot rows.
+        ``strict=False`` (sampler/endpoints) downgrades ``action="raise"``
+        to a warning — background evaluation must never throw."""
+        now = float(now) if now is not None else time.time()
+        history = self.history
+        with self._eval_lock:
+            return self._evaluate_locked(now, history, strict)
+
+    def _evaluate_locked(self, now: float, history: MetricsHistory,
+                         strict: bool) -> List[Dict[str, Any]]:
+        transitions: List[Tuple[AlertRule, str]] = []
+        with self._lock:
+            rules = list(self._rules.values())
+            self.last_evaluated = now
+        raise_after: Optional[AlertError] = None
+        for rule in rules:
+            try:
+                breached, value, detail, exemplar = rule.check(history, now)
+            except Exception:
+                log.exception("alert rule %r check failed", rule.name)
+                continue
+            with self._lock:
+                if self._rules.get(rule.name) is not rule:
+                    # removed (or replaced) while its check ran: firing
+                    # now would strand the gauge/health problem with no
+                    # registered owner to ever resolve them
+                    continue
+                rule.last_value = value
+                rule.last_detail = detail
+                if exemplar is not None:
+                    rule.last_exemplar = exemplar
+                if breached:
+                    if rule.state == OK:
+                        rule.state = PENDING
+                        rule.pending_since = now
+                    if (rule.state == PENDING
+                            and now - rule.pending_since
+                            >= rule.for_seconds):
+                        rule.state = FIRING
+                        rule.firing_since = now
+                        rule.fired_count += 1
+                        transitions.append((rule, "alert_firing"))
+                else:
+                    if rule.state == FIRING:
+                        transitions.append((rule, "alert_resolved"))
+                    if rule.state != OK:
+                        rule.state = OK
+                        rule.pending_since = None
+                        rule.firing_since = None
+                        # the exemplar belongs to THIS incident: a later
+                        # firing with no fresh exemplar must not surface
+                        # a trace id from hours ago that no longer
+                        # resolves (EXEMPLAR_TTL_S's point, end to end)
+                        rule.last_exemplar = None
+        for rule, event in transitions:
+            err = self._fire(rule, event)
+            if err is not None and raise_after is None:
+                raise_after = err
+        if strict and raise_after is not None:
+            raise raise_after
+        return self.snapshot()["alerts"]
+
+    def _fire(self, rule: AlertRule, event: str) -> Optional[AlertError]:
+        """Event fan-out OUTSIDE the engine lock (flight recorder, health
+        and registry each take their own locks — holding ours across them
+        would hand THR004 a real finding)."""
+        from .flightrec import get_flight_recorder
+        firing = event == "alert_firing"
+        self._gauge(rule.name).set(1.0 if firing else 0.0)
+        get_flight_recorder().record(
+            event, rule=rule.name, severity=rule.severity,
+            value=rule.last_value, detail=rule.last_detail,
+            exemplar_trace_id=rule.last_exemplar if firing else None)
+        if not firing:
+            log.info("alert resolved: %s (%s)", rule.name, rule.last_detail)
+            return None
+        msg = (f"alert {rule.name} FIRING: {rule.last_detail}"
+               + (f" — exemplar trace {rule.last_exemplar}"
+                  if rule.last_exemplar else ""))
+        log.warning("%s", msg)
+        from .health import get_health
+        get_health().record_problem("alert", msg)
+        if rule.action == "halt":
+            get_health().record_halt(msg)
+        elif rule.action == "raise":
+            return AlertError(rule.name, msg)
+        return None
+
+    # ------------------------------------------------------------ reading
+    def firing(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, r in self._rules.items()
+                          if r.state == FIRING)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /alerts`` payload (always HTTP 200 — an alerting
+        endpoint that 503s while alerting would blind the prober exactly
+        when it matters)."""
+        with self._lock:
+            rows = [self._rules[n].to_dict() for n in sorted(self._rules)]
+            evaluated = self.last_evaluated
+        return {"alerts": rows,
+                "firing": [r["rule"] for r in rows
+                           if r["state"] == FIRING],
+                "pending": [r["rule"] for r in rows
+                            if r["state"] == PENDING],
+                "evaluated_at": evaluated}
+
+
+# ------------------------------------------------------- default rule packs
+#: default hold-down for the shipped rule packs: a breach must persist
+#: this long before paging, so one transient sample (a queue blip, a
+#: single slow scrape) never fires — the state-machine invariant the
+#: module docstring promises. Pass for_seconds=0.0 for instant-fire
+#: (tests, demos).
+DEFAULT_FOR_SECONDS = 30.0
+
+
+def default_serving_rules(model: Optional[str] = None, *,
+                          slo: float = 0.999, burn_factor: float = 14.4,
+                          windows: Sequence[float] = (60.0, 300.0),
+                          p99_target_ms: float = 250.0,
+                          queue_cap: int = 256,
+                          queue_frac: float = 0.8,
+                          reject_rate_per_s: float = 1.0,
+                          for_seconds: float = DEFAULT_FOR_SECONDS
+                          ) -> List[AlertRule]:
+    """The serving pack: error-budget burn, p99 breach, queue saturation,
+    reject rate. ``model=None`` aggregates across hosted models."""
+    labels = {"model": model} if model else None
+    suffix = f"/{model}" if model else ""
+    return [
+        BurnRateRule(f"serving_error_burn{suffix}", kind="availability",
+                     slo=slo, burn_factor=burn_factor, windows=windows,
+                     total_labels=labels, for_seconds=for_seconds,
+                     description="5xx error-budget burn on both windows"),
+        BurnRateRule(f"serving_p99_breach{suffix}", kind="latency",
+                     target_ms=p99_target_ms, windows=windows,
+                     latency_labels=labels, for_seconds=for_seconds,
+                     description="windowed p99 over target on both windows"),
+        ThresholdRule(f"serving_queue_saturation{suffix}",
+                      "serving_queue_examples", labels=labels,
+                      threshold=queue_frac * queue_cap, op=">=",
+                      mode="value", agg="max", for_seconds=for_seconds,
+                      severity="ticket",
+                      description="a batcher queue near its admission cap "
+                                  "(queued EXAMPLES vs max_queue_examples "
+                                  "— same unit as admission; worst single "
+                                  "model, the cap is per-model)"),
+        ThresholdRule(f"serving_reject_rate{suffix}",
+                      "serving_requests_total",
+                      labels={**(labels or {}), "outcome": "rejected"},
+                      threshold=reject_rate_per_s, op=">", mode="rate",
+                      window_s=windows[0], for_seconds=for_seconds,
+                      severity="ticket",
+                      description="sustained admission rejects (429s)"),
+    ]
+
+
+def default_training_rules(stall_after_s: float = 120.0,
+                           for_seconds: float = DEFAULT_FOR_SECONDS
+                           ) -> List[AlertRule]:
+    return [
+        HealthRule("training_stall", kind="stall",
+                   stall_after_s=stall_after_s, for_seconds=for_seconds,
+                   description="training iterations stopped arriving"),
+        HealthRule("training_divergence", kind="problem",
+                   problem_kinds=("nan", "divergence"),
+                   for_seconds=for_seconds,
+                   description="watchdog NaN/divergence problems present"),
+    ]
+
+
+def default_fleet_rules(for_seconds: float = DEFAULT_FOR_SECONDS
+                        ) -> List[AlertRule]:
+    return [
+        FleetStalenessRule("fleet_worker_stale", for_seconds=for_seconds,
+                           severity="ticket",
+                           description="worker missed its telemetry "
+                                       "interval on /fleet"),
+    ]
+
+
+def default_rules(*, stall_after_s: float = 120.0,
+                  for_seconds: float = DEFAULT_FOR_SECONDS,
+                  **serving_kw) -> List[AlertRule]:
+    """Every shipped pack (serving aggregated across models + training +
+    fleet) — the one-call setup for a monitored process. ``for_seconds``
+    and ``stall_after_s`` apply across packs; the remaining keywords go
+    to :func:`default_serving_rules`."""
+    return (default_serving_rules(for_seconds=for_seconds, **serving_kw)
+            + default_training_rules(stall_after_s=stall_after_s,
+                                     for_seconds=for_seconds)
+            + default_fleet_rules(for_seconds=for_seconds))
+
+
+#: the process-global engine the endpoints/CLI serve — empty (no rules,
+#: nothing evaluating) until someone adds rules and attaches/evaluates
+_ENGINE = AlertEngine()
+
+
+def get_alert_engine() -> AlertEngine:
+    return _ENGINE

@@ -26,7 +26,7 @@ both first-class monitor citizens:
   docs/OBSERVABILITY.md "Compilation & memory"). ``TrainingHealthListener``
   drains :meth:`JitRegistry.drain_storms` per iteration to apply its
   warn/raise/halt action.
-- :func:`sample_device_memory` — ``device_memory_bytes_in_use{device=}``
+- :func:`sample_device_memory` — ``device_memory_in_use_bytes{device=}``
   / ``device_memory_peak_bytes{device=}`` / ``device_live_buffers``
   gauges, sampled on every ``/metrics`` scrape and at step-span close,
   degrading gracefully on backends without memory stats (CPU's
@@ -245,14 +245,23 @@ class JitRegistry:
         variants, flops, bytes_accessed, peak_memory_bytes, ...}} — the
         jit block of the step-anatomy report."""
         from .registry import get_registry
-        reg = get_registry()
+        # read through the snapshot, never through handle lookups: a
+        # /profile scrape must not materialize empty children for fns
+        # that never ran (the lazy-handles principle, _metric_handles)
+        snap = get_registry().snapshot()
+
+        def fn_row(metric, name):
+            for r in snap.get(metric, []):
+                if r["labels"].get("fn") == name:
+                    return r
+            return None
+
         with self._lock:
             stats = list(self._stats.items())
         out: Dict[str, Dict[str, Any]] = {}
         for name, st in sorted(stats):
-            calls = int(reg.counter("jit_calls_total",
-                                    "calls into monitored jit functions",
-                                    fn=name).value)
+            calls_row = fn_row("jit_calls_total", name)
+            calls = int(calls_row["value"]) if calls_row else 0
             row: Dict[str, Any] = {
                 "calls": calls,
                 "compiles": st.compiles,
@@ -262,6 +271,14 @@ class JitRegistry:
                 "variants": len(st.variants),
                 "storms": st.storms,
             }
+            cs_row = fn_row("jit_compile_seconds", name)
+            cs = cs_row.get("summary") if cs_row else None
+            if cs:
+                # honest per-fn compile-latency quantiles: the histogram
+                # rides the unit="s" bucket geometry (sub-100ms compiles
+                # no longer collapse into one bucket)
+                row["compile_s"] = {k: round(v, 4)
+                                    for k, v in cs.items()}
             if st.last_cost:
                 row.update(st.last_cost)
             if st.last_delta:
@@ -325,7 +342,7 @@ class MonitoredJit:
                 reg.histogram("jit_compile_seconds",
                               "wall-clock seconds per jit compilation "
                               "(trace+compile, first-call latency)",
-                              fn=self.name),
+                              unit="s", fn=self.name),
             )
         return self._handles
 
@@ -597,7 +614,7 @@ def sample_device_memory(registry=None) -> Dict[str, Any]:
             row = out["devices"].setdefault(dev, {})
             in_use = stats.get("bytes_in_use")
             if in_use is not None:
-                reg.gauge("device_memory_bytes_in_use",
+                reg.gauge("device_memory_in_use_bytes",
                           "device bytes currently allocated",
                           device=dev).set(float(in_use))
                 row["bytes_in_use"] = int(in_use)
@@ -676,6 +693,73 @@ def profile_report() -> Dict[str, Any]:
         "pipeline": _pipeline_block(snap),
         "serving": _serving_block(snap),
         "locks": _locks_block(),
+        "trends": _trends_block(),
+    }
+
+
+#: the trends block's comparison horizons (seconds): "now vs 1m vs 5m"
+_TREND_WINDOWS = (60.0, 300.0)
+
+
+def _trends_block() -> Dict[str, Any]:
+    """Now-vs-1m-vs-5m movement of the load-bearing series, read from the
+    metric history ring (monitor/history.py). Empty until the history
+    sampler has at least two samples — the block answers "is it getting
+    WORSE", which a single snapshot cannot. Gauges compare the current
+    value against the value at each horizon; counters report the delta
+    over each horizon; latency reports the WINDOWED p99 (bucket-count
+    deltas — only the samples inside the window); memory peak reports the
+    windowed max."""
+    from .history import get_history
+    hist = get_history()
+    if len(hist) < 2:
+        return {}
+
+    def tol(w):
+        # honesty guard: a value only counts as "w seconds ago" when a
+        # sample landed within a quarter-window (or a couple of sampler
+        # intervals) of that horizon — a 15s-old ring must answer the
+        # 5m question with None, never with a 15s-old value mislabeled
+        return max(w * 0.25, 2 * hist.interval_s)
+
+    def covers(w):
+        # windowed math only when the window is actually covered (the
+        # shared MetricsHistory.covers guard — the alert engine applies
+        # the same one to its burn-rate windows)
+        return hist.covers(w, tolerance_s=tol(w))
+
+    def ago(metric, w):
+        at = hist.at_age(w, tolerance_s=tol(w))
+        return hist.value_of(at[1], metric) if at else None
+
+    def gauge_row(metric):
+        row = {"now": hist.current(metric)}
+        for w in _TREND_WINDOWS:
+            row[f"{w:g}s_ago"] = ago(metric, w)
+        return row
+
+    def delta_row(metric):
+        row = {"total": hist.current(metric)}
+        for w in _TREND_WINDOWS:
+            row[f"{w:g}s_delta"] = (hist.delta(metric, w)
+                                    if covers(w) else None)
+        return row
+
+    p99 = {}
+    for w in _TREND_WINDOWS:
+        p99[f"{w:g}s_p99_ms"] = (hist.quantile_over(
+            "serving_request_latency_ms", 0.99, w) if covers(w) else None)
+    peak = {"now": hist.current("device_memory_peak_bytes")}
+    for w in _TREND_WINDOWS:
+        peak[f"{w:g}s_max"] = (hist.max_over("device_memory_peak_bytes", w)
+                               if covers(w) else None)
+    return {
+        "window_s": list(_TREND_WINDOWS),
+        "serving_qps": gauge_row("serving_qps"),
+        "serving_p99_ms": p99,
+        "serving_queue_depth": gauge_row("serving_queue_depth"),
+        "jit_compiles": delta_row("jit_compiles_total"),
+        "device_memory_peak_bytes": peak,
     }
 
 
@@ -709,14 +793,13 @@ def _serving_block(snap) -> Dict[str, Any]:
         m = r["labels"].get("model", "?")
         if r.get("summary"):
             row(m)["latency_ms"] = r["summary"]
-    for r in snap.get("serving_batch_size", []):
+    for r in snap.get("serving_batch_examples", []):
         m = r["labels"].get("model", "?")
         s = r.get("summary")
         if s:
             # the histogram stores EXAMPLE COUNTS in its value slots, so
-            # mean/max/n are exact; its ms-geometry bucket quantiles are
-            # not meaningful for counts and are dropped (the
-            # input_wait_seconds precedent, datasets/prefetch.py)
+            # mean/max/n are exact; its bucket quantiles are not
+            # meaningful for counts and are dropped
             row(m)["batch_examples"] = {"mean": round(s["mean_ms"], 2),
                                         "max": s["max_ms"],
                                         "n": int(s["n"])}
@@ -732,18 +815,19 @@ def _pipeline_block(snap) -> Dict[str, Any]:
     residual blocking wait, bytes fed, and the compute/ETL overlap split —
     ``etl_fraction`` near 0 means prefetch+put-ahead hid the ETL behind
     device compute; near 1 means the accelerator starves on input."""
-    # wait stats: mean/max/n only — all EXACT on LatencyHistogram. Its
-    # bucket quantiles assume ms-scale samples (first edge 0.1 units), so
-    # for a seconds-valued series every sub-100ms pop collapses into
-    # bucket 0 and p50/p95 would degenerate to the worst stall observed
+    # input_wait_seconds rides the unit="s" bucket geometry (PR 10), so
+    # its p50/p95 are honest bucket quantiles now — the PR-6 exact-only
+    # workaround (mean/max) is superseded
     w = _snap_summary(snap, "input_wait_seconds")
     out: Dict[str, Any] = {
         "queue_depth": _snap_value(snap, "input_queue_depth"),
         "batches": _snap_value(snap, "input_batches_total"),
         "bytes_total": _snap_value(snap, "input_bytes_total"),
         "wait_seconds": (None if not w else
-                         {"mean_s": round(w["mean_ms"], 6),
-                          "max_s": round(w["max_ms"], 6),
+                         {"mean_s": round(w["mean_s"], 6),
+                          "p50_s": round(w["p50_s"], 6),
+                          "p95_s": round(w["p95_s"], 6),
+                          "max_s": round(w["max_s"], 6),
                           "n": int(w["n"])}),
     }
     etl = _snap_summary(snap, "training_etl_ms")
@@ -813,6 +897,8 @@ def render_profile_text(report: Dict[str, Any]) -> str:
         w = pipe.get("wait_seconds")
         if w:
             lines.append(f"wait_s: mean={w.get('mean_s'):.4f} "
+                         f"p50={w.get('p50_s', 0.0):.4f} "
+                         f"p95={w.get('p95_s', 0.0):.4f} "
                          f"max={w.get('max_s'):.4f} n={int(w.get('n', 0))}")
         if pipe.get("etl_fraction") is not None:
             lines.append(f"etl_fraction={pipe['etl_fraction']} "
@@ -857,4 +943,15 @@ def render_profile_text(report: Dict[str, Any]) -> str:
                 f"{name:<40} {r['acquisitions']:>8} "
                 f"{r['wait_s_mean']:>12} {r['wait_s_max']:>11} "
                 f"{r['held_s_mean']:>12} {r['held_s_max']:>11}")
+    trends = report.get("trends") or {}
+    if trends:
+        lines.append("")
+        lines.append("# trends (now vs 1m/5m — monitor/history.py)")
+        for key, row in trends.items():
+            if key == "window_s":
+                continue
+            cells = " ".join(
+                f"{k}={round(v, 3) if isinstance(v, float) else v}"
+                for k, v in row.items())
+            lines.append(f"{key}: {cells}")
     return "\n".join(lines) + "\n"

@@ -25,10 +25,10 @@ becomes an instrumented wrapper that records, per acquisition:
   lock for the duration of the wait, so parked waiters never count.
 - **metrics**: ``lock_acquisitions_total{lock=}``,
   ``lock_wait_seconds{lock=}`` and ``lock_held_seconds{lock=}`` in the
-  monitor registry (seconds-valued histograms follow the
-  ``jit_compile_seconds`` convention: read mean/max, not bucket
-  quantiles), rolled into the ``locks`` contention table of
-  ``GET /profile`` (docs/OBSERVABILITY.md "Lockwatch").
+  monitor registry (seconds-valued histograms on the ``unit="s"`` bucket
+  geometry, so their quantiles are honest), rolled into the ``locks``
+  contention table of ``GET /profile`` (docs/OBSERVABILITY.md
+  "Lockwatch").
 
 When disabled (the default), the factory returns plain ``threading``
 primitives — zero overhead, byte-identical behavior. Lock *names* are the
@@ -151,10 +151,10 @@ class LockWatch:
                          lock=name),
              reg.histogram("lock_wait_seconds",
                            "blocking wait to acquire an instrumented "
-                           "lock (seconds)", lock=name),
+                           "lock (seconds)", unit="s", lock=name),
              reg.histogram("lock_held_seconds",
                            "time an instrumented lock stayed held "
-                           "(seconds)", lock=name))
+                           "(seconds)", unit="s", lock=name))
         with self._lock:
             self._handles.setdefault(name, h)
         return h
@@ -352,6 +352,7 @@ class LockWatch:
             stats = {n: (s.n, s.wait_total, s.wait_max, s.held_total,
                          s.held_max) for n, s in self._stats.items()}
             inv = len(self._inversions)
+            handles_by_name = dict(self._handles)
         out: Dict[str, Dict[str, Any]] = {}
         for name in sorted(stats):
             n, wt, wm, ht, hm = stats[name]
@@ -362,6 +363,13 @@ class LockWatch:
                 "held_s_mean": round(ht / n, 6) if n else 0.0,
                 "held_s_max": round(hm, 6),
             }
+            # honest bucket quantiles from the unit="s" registry
+            # histogram (mean/max above stay exact from _LockStats)
+            handles = handles_by_name.get(name)
+            if handles is not None:
+                ws = handles[1].summary()
+                if ws:
+                    out[name]["wait_s_p95"] = round(ws["p95_s"], 6)
         if out and inv:
             # surfaced at the table level so a renderer can't miss it
             out["_inversions"] = {"count": inv}

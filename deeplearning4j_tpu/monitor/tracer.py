@@ -37,7 +37,7 @@ import time
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional
 
-__all__ = ["SpanContext", "Tracer", "get_tracer"]
+__all__ = ["SpanContext", "Tracer", "get_tracer", "new_context"]
 
 
 class SpanContext(NamedTuple):
@@ -52,6 +52,14 @@ class SpanContext(NamedTuple):
 def _new_id() -> int:
     # 63 bits: fits JSON/JS number precision limits and struct "<Q"
     return random.getrandbits(63) | 1       # never 0 (0 = "no parent")
+
+
+def new_context() -> SpanContext:
+    """A fresh root :class:`SpanContext` — for subsystems that mint a
+    trace identity per unit of work without opening a thread-bound span
+    (the serving batcher stamps one per request at submit time so the
+    queue-wait and flush spans recorded later can join it)."""
+    return SpanContext(_new_id(), _new_id(), 0)
 
 
 def _trace_annotation():
@@ -142,15 +150,18 @@ class Tracer:
             self._append(ev)
 
     def record_complete(self, name: str, start: float, dur: float,
-                        cat: str = "host", **args):
+                        cat: str = "host",
+                        parent: Optional[SpanContext] = None, **args):
         """Record an ALREADY-timed span after the fact — for events only
         detectable at their end (e.g. a jit compile, recognized by the
         cache-size delta once the call returns). ``start`` is the
         ``perf_counter`` value at the event's start, ``dur`` seconds. The
-        span is parented under the innermost OPEN span on this thread (a
-        compile detected mid-step nests under the step span) but does not
-        touch the context stack itself."""
-        up = self.current_span()
+        span is parented under ``parent`` when given (the serving batcher
+        parents a request's queue-wait span under the REQUEST's context,
+        not the scheduler thread's), else under the innermost OPEN span on
+        this thread (a compile detected mid-step nests under the step
+        span); either way it does not touch the context stack itself."""
+        up = parent if parent is not None else self.current_span()
         ctx = SpanContext(up.trace_id if up else _new_id(), _new_id(),
                           up.span_id if up else 0)
         ev = {"name": name, "cat": cat, "ph": "X",

@@ -16,8 +16,17 @@ batching scheduler behind an HTTP front door.
   the monitor registry (the ``serving`` block on ``GET /profile``).
 - :class:`InferenceServer` — the HTTP/JSON front door
   (``POST /v1/models/<name>/predict``, ``GET /v1/models``, plus the
-  monitor scrape endpoints), mapping the typed errors onto 429/504 and
-  draining gracefully on ``stop()`` so no accepted request is dropped.
+  monitor scrape endpoints incl. ``/alerts`` and ``/history``), mapping
+  the typed errors onto 429/504 and draining gracefully on ``stop()`` so
+  no accepted request is dropped.
+
+Every request is **request-scope traced**: the front door joins the
+caller's ``X-DL4J-Trace`` header (:data:`TRACE_HEADER` — the proto-v2
+``SpanContext`` ids in hex) or mints a fresh trace, the batcher records
+a ``serving/queue_wait`` span linked to the shared ``serving/flush``
+span, and the latency histogram latches the trace id of the worst recent
+samples as **exemplars** — a firing p99 alert (monitor/alerts.py) names
+a trace resolvable against ``GET /trace``.
 
 ``ParallelInference`` (``parallel/inference.py``) delegates its BATCHED
 accumulate-then-flush path to the same scheduler.
@@ -25,8 +34,9 @@ accumulate-then-flush path to the same scheduler.
 from .batcher import (ContinuousBatcher, DeadlineExceededError,
                       ModelNotFoundError, OverloadedError)
 from .registry import ModelRegistry, ServedModel, DEFAULT_BATCH_BUCKETS
-from .server import InferenceServer
+from .server import InferenceServer, TRACE_HEADER, parse_trace_header
 
 __all__ = ["ContinuousBatcher", "ModelRegistry", "ServedModel",
            "InferenceServer", "OverloadedError", "DeadlineExceededError",
-           "ModelNotFoundError", "DEFAULT_BATCH_BUCKETS"]
+           "ModelNotFoundError", "DEFAULT_BATCH_BUCKETS", "TRACE_HEADER",
+           "parse_trace_header"]

@@ -95,19 +95,22 @@ class ModelNotFoundError(KeyError):
 
 
 class _Request:
-    __slots__ = ("x", "mask", "fut", "key", "n", "t_enq", "deadline",
-                 "orig_t", "padded_t")
+    __slots__ = ("x", "mask", "fut", "key", "n", "t_enq", "t_perf",
+                 "deadline", "orig_t", "padded_t", "ctx")
 
-    def __init__(self, x, mask, key, t_enq, deadline, orig_t, padded_t):
+    def __init__(self, x, mask, key, t_enq, deadline, orig_t, padded_t,
+                 ctx=None):
         self.x = x
         self.mask = mask
         self.fut: Future = Future()
         self.key = key
         self.n = int(x.shape[0])
         self.t_enq = t_enq
+        self.t_perf = time.perf_counter()   # tracer timebase for spans
         self.deadline = deadline      # monotonic seconds, or None
         self.orig_t = orig_t          # pre-padding time steps, or None
         self.padded_t = padded_t      # time bucket the input was padded to
+        self.ctx = ctx                # SpanContext (serving mode), or None
 
 
 class ContinuousBatcher:
@@ -185,12 +188,18 @@ class ContinuousBatcher:
                     "request latency, submit to result (queue + batch "
                     "assembly + forward)", model=self._label),
                 "batch": reg.histogram(
-                    "serving_batch_size",
+                    "serving_batch_examples",
                     "real (pre-padding) examples per flushed batch",
                     model=self._label),
                 "depth": reg.gauge(
                     "serving_queue_depth",
                     "requests currently queued for batching",
+                    model=self._label),
+                "depth_ex": reg.gauge(
+                    "serving_queue_examples",
+                    "examples currently queued for batching — the unit "
+                    "the admission cap (max_queue_examples) is in, so "
+                    "saturation alerts compare like with like",
                     model=self._label),
                 "qps": reg.gauge(
                     "serving_qps",
@@ -208,36 +217,70 @@ class ContinuousBatcher:
             "inference requests by outcome (ok/rejected/deadline/error)",
             model=self._label, outcome=outcome).inc(n)
 
-    def _note_done(self, outcome: str, latency_ms: Optional[float] = None):
+    def _note_done(self, outcome: str, latency_ms: Optional[float] = None,
+                   exemplar: Optional[str] = None):
         h = self._metric_handles()
         self._count(outcome)
         if h is None:
             return
         if latency_ms is not None:
-            h["latency"].observe(latency_ms)
+            # the exemplar (the request's trace id) rides the worst-bucket
+            # latch, so a firing p99 alert can name a concrete trace
+            h["latency"].observe(latency_ms, exemplar=exemplar)
         now = time.monotonic()
-        # trailing-window QPS: bookkeeping under the cond (the scheduler
-        # thread is the only completer, submitters never touch this)
+        # trailing-window QPS: scheduler-thread-only bookkeeping (the
+        # scheduler is the only completer, submitters never touch this)
         self._done_times.append(now)
+        self._trim_done(now, h)
+
+    def _trim_done(self, now: float, h) -> bool:
+        """Drop completions older than the window and refresh the qps
+        gauge — the ONE implementation behind both the completion path
+        and the idle decay (they must never disagree on the gauge).
+        Returns True when anything aged out."""
         cut = now - self._qps_window
+        changed = False
         while self._done_times and self._done_times[0] < cut:
             self._done_times.pop(0)
-        h["qps"].set(len(self._done_times) / self._qps_window)
+            changed = True
+        if h is not None:
+            h["qps"].set(len(self._done_times) / self._qps_window)
+        return changed
+
+    def _decay_qps(self, now: float):
+        """Scheduler-driven staleness fix: the trailing-window gauge is
+        otherwise only written by completion bookkeeping, so after traffic
+        stops it would report the last value FOREVER. The idle scheduler
+        wakes as completions age out of the window (see
+        ``_wait_timeout_locked``) and walks the gauge down to zero."""
+        if not self._done_times:
+            return
+        self._trim_done(now, self._metric_handles())
 
     def _set_depth(self):
         h = self._metric_handles()
         if h is not None:
             h["depth"].set(len(self._queue))
+            h["depth_ex"].set(self._queued_examples)
 
     # -------------------------------------------------------------- submit
-    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
         """Queue a request; returns a Future resolving to the result rows
         for exactly the submitted examples (padding never leaks out).
 
         ``x``: ``[b, ...]`` features (``b >= 1``). Raises
         :class:`OverloadedError` when the queue is at capacity (policy
         ``"reject"``) or the batcher is closed; ``ValueError`` when ``b``
-        exceeds the largest bucket (configure a bucket that fits)."""
+        exceeds the largest bucket (configure a bucket that fits).
+
+        ``trace_ctx``: the request's :class:`SpanContext` (the HTTP front
+        door forwards the caller's ``X-DL4J-Trace`` header, or its own
+        ``http/predict`` span). Serving-labeled batchers mint a fresh
+        context when none is given, so EVERY request owns a trace id —
+        the scheduler records a ``serving/queue_wait`` span under it
+        (linked to the shared ``serving/flush`` span) and latches it as
+        the latency histogram's exemplar."""
         x = np.asarray(x)
         if x.dtype.kind == "f" and x.dtype != np.float32:
             x = x.astype(np.float32)
@@ -271,9 +314,15 @@ class ContinuousBatcher:
         now = time.monotonic()
         dl_ms = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
+        ctx = trace_ctx
+        if ctx is None and self._label is not None:
+            # serving mode: every request gets a trace identity even when
+            # the caller brought none (direct registry.submit callers)
+            from ..monitor.tracer import new_context
+            ctx = new_context()
         req = _Request(x, mask, key, now,
                        now + dl_ms / 1e3 if dl_ms is not None else None,
-                       orig_t, padded_t)
+                       orig_t, padded_t, ctx=ctx)
         with self._cond:
             if self._closed:
                 self._count("rejected")
@@ -320,8 +369,14 @@ class ContinuousBatcher:
 
     def _wait_timeout_locked(self, now: float) -> Optional[float]:
         """Sleep until the oldest request's linger expires or the nearest
-        deadline passes, whichever is sooner (None = park until notified)."""
+        deadline passes, whichever is sooner. With an empty queue but a
+        non-empty qps window, wake when the oldest completion ages out so
+        ``_decay_qps`` can walk the gauge down (None = park until
+        notified)."""
         if not self._queue:
+            if self._done_times:
+                return max(self._done_times[0] + self._qps_window - now,
+                           0.0) + 0.05
             return None
         t = self._queue[0].t_enq + self.linger_ms / 1e3
         for r in self._queue:
@@ -372,6 +427,13 @@ class ContinuousBatcher:
                 now = time.monotonic()
                 while not self._ripe_locked(now):
                     if self._closed and not self._queue:
+                        # the gauge must not outlive the scheduler: a
+                        # closed model frozen at its last nonzero qps
+                        # would report a dead model as serving forever
+                        self._done_times.clear()
+                        h = self._metric_handles()
+                        if h is not None:
+                            h["qps"].set(0.0)
                         return
                     if self._force and not self._queue:
                         self._force = False    # stale flush() of an idle
@@ -379,6 +441,9 @@ class ContinuousBatcher:
                                                # next request's linger
                     self._cond.wait(self._wait_timeout_locked(now))
                     now = time.monotonic()
+                    # idle ticks double as the qps-gauge decay driver
+                    # (only this thread touches _done_times)
+                    self._decay_qps(now)
                 expired, batch = self._take_locked(now)
                 self._running = bool(batch)
             try:
@@ -423,22 +488,51 @@ class ContinuousBatcher:
                 pos += r.n
         return xs, mask, total
 
+    def _forward_batch(self, xs, mask):
+        if self._in_flight is not None:
+            self._in_flight.acquire()
+        try:
+            return self._forward(xs) if mask is None \
+                else self._forward(xs, mask)
+        finally:
+            if self._in_flight is not None:
+                self._in_flight.release()
+
     def _run_batch(self, batch: List[_Request]):
         try:
             xs, mask, total = self._assemble(batch)
-            if self._in_flight is not None:
-                self._in_flight.acquire()
-            try:
-                ys = self._forward(xs) if mask is None \
-                    else self._forward(xs, mask)
-            finally:
-                if self._in_flight is not None:
-                    self._in_flight.release()
+            flush_start = time.perf_counter()
+            if self._label is not None:
+                # request-scoped tracing (docs/OBSERVABILITY.md): ONE
+                # shared serving/flush span on the scheduler thread —
+                # compiles inside the forward nest under it — and each
+                # request's queue-wait span below links to it, so p99
+                # decomposes into queue vs compute vs compile per trace
+                from ..monitor.tracer import get_tracer
+                with get_tracer().span(
+                        "serving/flush", cat="serving", model=self.name,
+                        examples=int(total), padded=int(xs.shape[0]),
+                        requests=len(batch)) as flush_ctx:
+                    ys = self._forward_batch(xs, mask)
+            else:
+                flush_ctx = None
+                ys = self._forward_batch(xs, mask)
             ys = np.asarray(ys)
             h = self._metric_handles()
             if h is not None:
                 h["batch"].observe(float(total))
             done = time.monotonic()
+            if flush_ctx is not None:
+                from ..monitor.tracer import get_tracer
+                tracer = get_tracer()
+                for r in batch:
+                    if r.ctx is None:
+                        continue
+                    tracer.record_complete(
+                        "serving/queue_wait", r.t_perf,
+                        max(flush_start - r.t_perf, 0.0), cat="serving",
+                        parent=r.ctx, model=self.name,
+                        flush_span_id=f"{flush_ctx.span_id:x}")
             pos = 0
             for r in batch:
                 yr = ys[pos:pos + r.n]
@@ -449,7 +543,10 @@ class ContinuousBatcher:
                     # time dim): strip the time padding from the result too
                     yr = yr[:, :r.orig_t]
                 if _complete(r.fut, yr):
-                    self._note_done("ok", (done - r.t_enq) * 1e3)
+                    self._note_done(
+                        "ok", (done - r.t_enq) * 1e3,
+                        exemplar=(f"{r.ctx.trace_id:x}" if r.ctx is not None
+                                  else None))
         except Exception as e:
             for r in batch:
                 if not r.fut.done() and _complete(r.fut, exc=e):

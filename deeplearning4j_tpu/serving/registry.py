@@ -45,6 +45,7 @@ class ServedModel:
                  default_deadline_ms: Optional[float] = 2000.0,
                  input_shape: Optional[Sequence[int]] = None,
                  warmup: bool = False,
+                 qps_window_s: float = 10.0,
                  in_flight: Optional[threading.Semaphore] = None):
         if hasattr(model, "conf") and not hasattr(model, "output"):
             model = model.init()          # a ZooModel, not yet built
@@ -63,7 +64,7 @@ class ServedModel:
             max_queue_examples=max_queue_examples, linger_ms=linger_ms,
             default_deadline_ms=default_deadline_ms,
             queue_policy="reject", in_flight=in_flight,
-            metrics_label=name)
+            metrics_label=name, qps_window_s=qps_window_s)
         if warmup:
             self.warm()
 
@@ -105,13 +106,16 @@ class ServedModel:
             else self.model.output(xs, mask=mask)
         return np.asarray(y)
 
-    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
-        return self.batcher.submit(x, deadline_ms=deadline_ms)
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
+        return self.batcher.submit(x, deadline_ms=deadline_ms,
+                                   trace_ctx=trace_ctx)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
-                timeout: float = 60.0):
+                timeout: float = 60.0, trace_ctx=None):
         """Synchronous convenience: submit + wait for the result rows."""
-        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(x, deadline_ms=deadline_ms,
+                           trace_ctx=trace_ctx).result(timeout)
 
     def stats(self) -> Dict[str, Any]:
         b = self.batcher
@@ -195,14 +199,15 @@ class ModelRegistry:
             models = sorted(self._models.items())
         return [m.stats() for _, m in models]
 
-    def submit(self, name: str, x,
-               deadline_ms: Optional[float] = None) -> Future:
-        return self.get(name).submit(x, deadline_ms=deadline_ms)
+    def submit(self, name: str, x, deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
+        return self.get(name).submit(x, deadline_ms=deadline_ms,
+                                     trace_ctx=trace_ctx)
 
     def predict(self, name: str, x, deadline_ms: Optional[float] = None,
-                timeout: float = 60.0):
+                timeout: float = 60.0, trace_ctx=None):
         return self.get(name).predict(x, deadline_ms=deadline_ms,
-                                      timeout=timeout)
+                                      timeout=timeout, trace_ctx=trace_ctx)
 
     def close_all(self, drain: bool = True, timeout: float = 30.0):
         """Graceful shutdown: stop admission on every model, serve what

@@ -12,10 +12,17 @@ JsonRequestHandler` plumbing and POST Content-Length cap), serving:
   :class:`DeadlineExceededError` → **504**, anything else → 500.
 - ``GET /v1/models`` — hosted-model listing with queue depth and config.
 - ``GET /v1/models/<name>`` — one model's row.
-- ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` — the monitor
-  endpoints re-exposed here so a serving replica is scrapeable without a
+- ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` /
+  ``GET /alerts`` / ``GET /history`` — the monitor endpoints re-exposed
+  here so a serving replica is scrapeable (and alertable) without a
   training UI attached; ``/profile`` carries the per-model ``serving``
   block (p50/p99 latency, QPS, batch-size distribution, queue depth).
+
+Requests are request-scope traced: the ``X-DL4J-Trace`` header
+(``<trace hex>:<span hex>``, the proto-v2 ``SpanContext`` ids) joins the
+caller's trace, responses carry the request's ``trace_id``, and the
+worst recent latencies latch their trace ids as histogram exemplars for
+the alert engine.
 
 Each handler thread blocks on its request's Future while the model's
 batching scheduler coalesces concurrent requests into one padded
@@ -34,12 +41,35 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..monitor.tracer import SpanContext, get_tracer
 from ..ui.server import JsonRequestHandler
 from .batcher import (DeadlineExceededError, ModelNotFoundError,
                       OverloadedError)
 from .registry import ModelRegistry
 
-__all__ = ["InferenceServer"]
+__all__ = ["InferenceServer", "TRACE_HEADER", "parse_trace_header"]
+
+#: request trace-context header: ``<trace_id hex>:<span_id hex>`` — the
+#: same 64-bit ids the paramserver proto v2 FLAG_TRACE frame carries
+#: (``struct "<QQ"`` there, hex here), so one trace id follows a request
+#: across HTTP serving and paramserver hops alike
+TRACE_HEADER = "X-DL4J-Trace"
+
+
+def parse_trace_header(value: Optional[str]) -> Optional[SpanContext]:
+    """``"<trace hex>:<span hex>"`` → :class:`SpanContext` (None on a
+    missing/malformed header — a bad trace header must never fail the
+    request it decorates)."""
+    if not value:
+        return None
+    try:
+        tid_s, _, sid_s = value.partition(":")
+        tid, sid = int(tid_s, 16), int(sid_s, 16)
+        if not (0 < tid < 1 << 64 and 0 < sid < 1 << 64):
+            return None
+        return SpanContext(tid, sid)
+    except ValueError:
+        return None
 
 
 class _ServingHandler(JsonRequestHandler):
@@ -88,13 +118,23 @@ class _ServingHandler(JsonRequestHandler):
             self._json({"error": f"bad request body: {e}"}, 400)
             return
         t0 = time.perf_counter()
+        # request-scoped trace: join the caller's context when the
+        # X-DL4J-Trace header carries one, else the span mints a fresh
+        # trace — either way the batcher stamps the request with THIS
+        # span's context, so /trace shows http/predict → queue_wait →
+        # (linked) serving/flush as one causal chain per request
+        remote = parse_trace_header(self.headers.get(TRACE_HEADER))
+        ctx = None
         try:
-            fut = self.registry.submit(name, inputs,
-                                       deadline_ms=deadline_ms)
-            # generous transport-level backstop — per-request shedding is
-            # the batcher's deadline, not this timeout
-            out = fut.result(timeout=max(
-                60.0, (deadline_ms or 0.0) / 1e3 + 30.0))
+            with get_tracer().span("http/predict", cat="serving",
+                                   parent=remote, model=name) as ctx:
+                fut = self.registry.submit(name, inputs,
+                                           deadline_ms=deadline_ms,
+                                           trace_ctx=ctx)
+                # generous transport-level backstop — per-request shedding
+                # is the batcher's deadline, not this timeout
+                out = fut.result(timeout=max(
+                    60.0, (deadline_ms or 0.0) / 1e3 + 30.0))
         except ModelNotFoundError:
             self._json({"error": f"model {name!r} not found",
                         "models": self.registry.names()}, 404)
@@ -114,7 +154,8 @@ class _ServingHandler(JsonRequestHandler):
             return
         self._json({"model": name, "outputs": np.asarray(out).tolist(),
                     "latency_ms": round((time.perf_counter() - t0) * 1e3,
-                                        3)})
+                                        3),
+                    "trace_id": f"{ctx.trace_id:x}"})
 
 
 class InferenceServer:

@@ -14,8 +14,14 @@ TPU-native equivalent of reference ``deeplearning4j-play``
  - ``/trace``                — Chrome trace-event JSON from the monitor's
    span :class:`~deeplearning4j_tpu.monitor.Tracer` (open in Perfetto)
  - ``/profile``              — step-anatomy report: per-fn jit compile
-   counts/times/flops, device-memory gauges, step/ETL timing split
-   (``?format=text`` for the terminal rendering)
+   counts/times/flops, device-memory gauges, step/ETL timing split, and
+   a ``trends`` block (now vs 1m/5m once the history sampler runs;
+   ``?format=text`` for the terminal rendering)
+ - ``/alerts``               — alert-rule states (OK/PENDING/FIRING) from
+   the :mod:`~deeplearning4j_tpu.monitor.alerts` engine, evaluated at
+   request time; always HTTP 200
+ - ``/history``              — the metric-history ring: meta by default,
+   ``?metric=<name>[&seconds=N]`` for one series
  - ``/fleet``                — merged per-worker metrics (Prometheus text,
    ``worker`` label; ``?format=json`` for the liveness table, which
    carries a per-shard rollup — staleness + wire bytes by shard — when
@@ -200,9 +206,10 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
     def _monitor_get(self, url, q) -> bool:
         """Serve the process-monitor endpoints every server shares —
-        ``/metrics``, ``/healthz``, ``/profile`` — so the training UI and
-        the serving front door cannot drift on routing, status-code
-        mapping, or framing. Returns True when the path was handled."""
+        ``/metrics``, ``/healthz``, ``/profile``, ``/alerts``,
+        ``/history`` — so the training UI and the serving front door
+        cannot drift on routing, status-code mapping, or framing. Returns
+        True when the path was handled."""
         if url.path == "/metrics":
             # Prometheus scrape of the process-global monitor registry.
             # Device-memory gauges are sampled scrape-time (pull-model
@@ -225,6 +232,33 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
                            "text/plain; charset=utf-8")
             else:
                 self._json(rep)
+            return True
+        if url.path == "/alerts":
+            # alert-rule states (monitor/alerts.py): evaluated at request
+            # time so the snapshot is never staler than the scrape, and
+            # ALWAYS HTTP 200 — an alerting endpoint that 503s while
+            # alerting would blind the prober exactly when it matters
+            from ..monitor.alerts import get_alert_engine
+            engine = get_alert_engine()
+            engine.evaluate(strict=False)
+            self._json(engine.snapshot())
+            return True
+        if url.path == "/history":
+            # metric-history ring (monitor/history.py): ring meta by
+            # default; ?metric=<name>[&seconds=N] for one time series
+            from ..monitor.history import get_history
+            hist = get_history()
+            metric = q.get("metric", [None])[0]
+            if metric:
+                seconds = q.get("seconds", [None])[0]
+                try:
+                    seconds = float(seconds) if seconds else None
+                except ValueError:
+                    self._json({"error": "seconds must be a number"}, 400)
+                    return True
+                self._json(hist.series(metric, seconds=seconds))
+            else:
+                self._json(hist.describe())
             return True
         return False
 

@@ -1,0 +1,668 @@
+"""SLO & alerting engine (ISSUE 10 — docs/OBSERVABILITY.md "Metric
+history" / "Alerting & SLOs").
+
+Acceptance: an injected serving fault (slow/erroring model) drives the
+latency/error-burn SLO rules OK→PENDING→FIRING with an ``alert_firing``
+flight event, the ``alerts_firing`` gauge, and 503-free ``/alerts`` JSON
+carrying an exemplar trace id that resolves against ``/trace``; after the
+fault clears the rule resolves. Plus: history ring bounds + rate/window/
+quantile math, the alert state machine (hold-down, fire-once, resolve),
+``/history`` + ``/alerts`` endpoints on BOTH servers, the ``trends``
+block on ``/profile``, and the ``serving_qps`` decay fix.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor import (AlertEngine, AlertError,
+                                        BurnRateRule, FleetStalenessRule,
+                                        HealthRule, MetricsHistory,
+                                        MetricsRegistry, ThresholdRule,
+                                        default_fleet_rules, default_rules,
+                                        default_serving_rules,
+                                        default_training_rules,
+                                        get_alert_engine,
+                                        get_flight_recorder, get_health,
+                                        get_history, get_registry,
+                                        get_tracer, profile_report)
+from deeplearning4j_tpu.serving import InferenceServer, TRACE_HEADER
+
+
+@pytest.fixture(autouse=True)
+def _clean_alert_state():
+    """Engine/history/flight state is process-global — isolate tests."""
+    get_alert_engine().clear()
+    get_history().clear()
+    get_flight_recorder().clear()
+    get_health().reset()
+    yield
+    get_alert_engine().clear()
+    get_history().clear()
+    get_flight_recorder().clear()
+    get_health().reset()
+
+
+def _events(kind):
+    return [e for e in get_flight_recorder().events()
+            if e.get("event") == kind]
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read().decode("utf-8"))
+
+
+# ------------------------------------------------------------ history ring
+class TestMetricsHistory:
+    def test_ring_bounds_and_eviction(self):
+        reg = MetricsRegistry()
+        reg.counter("hist_probe_total").inc()
+        h = MetricsHistory(capacity=4, registry=reg)
+        for i in range(10):
+            h.sample(now=1000.0 + i)
+        assert len(h) == 4
+        ts = [t for t, _ in h.samples()]
+        assert ts == [1006.0, 1007.0, 1008.0, 1009.0]   # newest 4 win
+
+    def test_rate_delta_and_window_math(self):
+        reg = MetricsRegistry()
+        c = reg.counter("ticks_total", role="a")
+        g = reg.gauge("depth")
+        h = MetricsHistory(capacity=32, registry=reg)
+        t0 = 2000.0
+        for i in range(6):
+            c.inc(5)
+            g.set(float(i))
+            h.sample(now=t0 + i)
+        # window: only the last 3 samples
+        assert len(h.window(2.5, now=t0 + 5)) == 3
+        # delta/rate over the full ring: 5/s
+        assert h.delta("ticks_total", 100.0, now=t0 + 5) == 25.0
+        assert h.rate("ticks_total", 100.0, now=t0 + 5) \
+            == pytest.approx(5.0)
+        # labeled subset match
+        assert h.rate("ticks_total", 100.0, {"role": "a"}, now=t0 + 5) \
+            == pytest.approx(5.0)
+        assert h.delta("ticks_total", 100.0, {"role": "b"}, now=t0 + 5) \
+            is None
+        # gauges: current + max over window + at_age
+        assert h.current("depth") == 5.0
+        assert h.max_over("depth", 100.0, now=t0 + 5) == 5.0
+        assert h.at_age(3.0, now=t0 + 5)[1]["depth"]["children"][0][
+            "value"] == 2.0
+        # too-short windows return None, never crash
+        assert h.rate("ticks_total", 0.5, now=t0 + 5) is None
+        assert h.rate("missing_total", 100.0, now=t0 + 5) is None
+
+    def test_windowed_quantile_uses_only_in_window_samples(self):
+        """The honest-p99 property the latency SLO rule rides: bucket
+        deltas mean old slow samples age out of the window."""
+        reg = MetricsRegistry()
+        lat = reg.histogram("lat_ms", "x")
+        h = MetricsHistory(capacity=32, registry=reg)
+        t0 = 3000.0
+        h.sample(now=t0)
+        for _ in range(50):
+            lat.observe(400.0)               # slow era
+        h.sample(now=t0 + 1)
+        slow = h.quantile_over("lat_ms", 0.99, 10.0, now=t0 + 1)
+        assert slow and slow >= 400.0
+        h.sample(now=t0 + 30)
+        for _ in range(200):
+            lat.observe(1.0)                 # fast era
+        h.sample(now=t0 + 31)
+        fast = h.quantile_over("lat_ms", 0.99, 10.0, now=t0 + 31)
+        assert fast is not None and fast < 10.0, fast
+        # empty window -> None (no samples recorded inside it)
+        h.sample(now=t0 + 60)
+        h.sample(now=t0 + 61)
+        assert h.quantile_over("lat_ms", 0.99, 5.0, now=t0 + 61) is None
+
+    def test_sampler_thread_and_listener(self):
+        reg = MetricsRegistry()
+        reg.counter("sampled_total").inc()
+        h = MetricsHistory(capacity=16, registry=reg, interval_s=0.05)
+        seen = []
+        h.add_listener(lambda hist: seen.append(len(hist)))
+        h.start()
+        try:
+            deadline = time.monotonic() + 5
+            while len(h) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(h) >= 3
+            assert h.running()
+        finally:
+            h.stop()
+        assert not h.running()
+        assert seen and seen[-1] >= 1        # listener rode the ticks
+
+
+# ------------------------------------------------------- state machine
+class TestAlertStateMachine:
+    def _hist(self):
+        reg = MetricsRegistry()
+        return reg, MetricsHistory(capacity=64, registry=reg)
+
+    def test_threshold_pending_holddown_fire_once_and_resolve(self):
+        reg, hist = self._hist()
+        depth = reg.gauge("q_depth")
+        eng = AlertEngine(history=hist)
+        eng.add(ThresholdRule("deep_queue", "q_depth", threshold=10.0,
+                              for_seconds=5.0))
+        t0 = 5000.0
+        depth.set(3.0)
+        hist.sample(now=t0)
+        eng.evaluate(now=t0)
+        assert eng.rules()[0].state == "OK"
+        # breach starts: PENDING, not FIRING (hold-down)
+        depth.set(50.0)
+        hist.sample(now=t0 + 1)
+        eng.evaluate(now=t0 + 1)
+        assert eng.rules()[0].state == "PENDING"
+        assert _events("alert_firing") == []
+        assert eng.firing() == []
+        # still breaching after for_seconds: FIRING, exactly one event
+        hist.sample(now=t0 + 7)
+        eng.evaluate(now=t0 + 7)
+        eng.evaluate(now=t0 + 8)             # steady FIRING re-evaluation
+        assert eng.rules()[0].state == "FIRING"
+        assert len(_events("alert_firing")) == 1          # fire-once
+        assert get_registry().gauge("alerts_firing",
+                                    rule="deep_queue").value == 1.0
+        assert any("alert:" in p for p in
+                   get_health().snapshot()["problems"])
+        # breach clears: resolved, exactly one resolve event, gauge 0
+        depth.set(1.0)
+        hist.sample(now=t0 + 9)
+        eng.evaluate(now=t0 + 9)
+        eng.evaluate(now=t0 + 10)
+        assert eng.rules()[0].state == "OK"
+        assert len(_events("alert_resolved")) == 1
+        assert get_registry().gauge("alerts_firing",
+                                    rule="deep_queue").value == 0.0
+        assert eng.rules()[0].fired_count == 1
+
+    def test_remove_firing_rule_resolves_gauge_and_flight_edge(self):
+        """Review finding: removing/clearing a FIRING rule must zero the
+        gauge AND record the closing alert_resolved — a consumer pairing
+        flight edges must never see a forever-firing ghost."""
+        reg, hist = self._hist()
+        reg.gauge("rm_probe").set(9.0)
+        hist.sample(now=5500.0)
+        eng = AlertEngine(history=hist)
+        eng.add(ThresholdRule("rm_me", "rm_probe", threshold=1.0))
+        eng.evaluate(now=5500.0)
+        assert eng.firing() == ["rm_me"]
+        eng.remove("rm_me")
+        assert get_registry().gauge("alerts_firing",
+                                    rule="rm_me").value == 0.0
+        resolved = [e for e in _events("alert_resolved")
+                    if e["rule"] == "rm_me"]
+        assert resolved and "removed" in resolved[-1]["detail"]
+
+    def test_pending_that_recovers_never_fires(self):
+        reg, hist = self._hist()
+        depth = reg.gauge("q2_depth")
+        eng = AlertEngine(history=hist)
+        eng.add(ThresholdRule("blip", "q2_depth", threshold=10.0,
+                              for_seconds=30.0))
+        t0 = 6000.0
+        depth.set(99.0)
+        hist.sample(now=t0)
+        eng.evaluate(now=t0)
+        assert eng.rules()[0].state == "PENDING"
+        depth.set(0.0)
+        hist.sample(now=t0 + 1)
+        eng.evaluate(now=t0 + 1)
+        assert eng.rules()[0].state == "OK"
+        assert _events("alert_firing") == []
+        assert _events("alert_resolved") == []
+
+    def test_burn_rate_availability_multiwindow(self):
+        """Error burn must exceed the factor on BOTH windows: a burst
+        that only pollutes the short window does not page."""
+        reg, hist = self._hist()
+        ok = reg.counter("serving_requests_total", model="m", outcome="ok")
+        err = reg.counter("serving_requests_total", model="m",
+                          outcome="error")
+        eng = AlertEngine(history=hist)
+        eng.add(BurnRateRule("burn", kind="availability", slo=0.9,
+                             burn_factor=2.0, windows=(10.0, 40.0),
+                             total_labels={"model": "m"}))
+        t0 = 7000.0
+        # long healthy history: 500 ok over 36s (9s cadence keeps BOTH
+        # windows coverage-satisfied at the burst evaluate below)
+        for i in range(5):
+            ok.inc(100)
+            hist.sample(now=t0 + i * 9)
+        # short burst of errors: 50% errors in the short window only —
+        # the long window dilutes it under 2x the 10% budget
+        err.inc(30)
+        ok.inc(30)
+        hist.sample(now=t0 + 45)
+        eng.evaluate(now=t0 + 45)
+        assert eng.rules()[0].state == "OK", eng.rules()[0].last_detail
+        assert "burn" in eng.rules()[0].last_detail   # evaluated, not
+        #                                               coverage-skipped
+        # sustained outage: errors dominate both windows
+        for i in range(5):
+            err.inc(100)
+            hist.sample(now=t0 + 50 + i * 10)
+        eng.evaluate(now=t0 + 90)
+        assert eng.rules()[0].state == "FIRING", eng.rules()[0].last_detail
+
+    def test_burn_rate_requires_window_coverage(self):
+        """Review finding: a ring younger than the long window must not
+        page — otherwise the 5m window silently equals the short one and
+        the multiwindow protection degenerates to a single window."""
+        reg, hist = self._hist()
+        err = reg.counter("serving_requests_total", model="y",
+                          outcome="error")
+        lat = reg.histogram("serving_request_latency_ms", model="y")
+        eng = AlertEngine(history=hist)
+        eng.add(BurnRateRule("young_burn", kind="availability", slo=0.9,
+                             burn_factor=2.0, windows=(10.0, 40.0),
+                             total_labels={"model": "y"}),
+                BurnRateRule("young_p99", kind="latency", target_ms=10.0,
+                             windows=(10.0, 40.0),
+                             latency_labels={"model": "y"}))
+        t0 = 7500.0
+        # 100% errors and terrible p99 — but only 5s of history
+        err.inc(50)
+        lat.observe(500.0)
+        hist.sample(now=t0)
+        err.inc(50)
+        lat.observe(500.0)
+        hist.sample(now=t0 + 5)
+        eng.evaluate(now=t0 + 5)
+        assert eng.firing() == []
+        for r in eng.rules():
+            assert "does not cover" in r.last_detail, r.last_detail
+        # ThresholdRule's windowed max/quantile modes honor the same
+        # guard (review finding): a 5s-old ring must not page a 40s rule
+        eng2 = AlertEngine(history=hist)
+        eng2.add(ThresholdRule("young_q", "serving_request_latency_ms",
+                               labels={"model": "y"}, threshold=10.0,
+                               mode="quantile", window_s=40.0))
+        eng2.evaluate(now=t0 + 5)
+        assert eng2.firing() == []
+
+    def test_exemplar_ttl_evicts_stale_worst(self):
+        """Review finding: a cold-start burst must not squat the exemplar
+        latch forever — expired entries give way so a later breach
+        surfaces a CURRENT trace id, not one the tracer ring evicted."""
+        from deeplearning4j_tpu.monitor.registry import LatencyHistogram
+        h = LatencyHistogram(exemplar_ttl_s=0.05)
+        h.record(5000.0, exemplar="coldstart")      # huge, but old soon
+        time.sleep(0.1)
+        h.record(300.0, exemplar="breach")
+        worst = h.worst_exemplar()
+        assert worst and worst["exemplar"] == "breach"
+        # fully idle past the TTL: nothing recent to surface
+        time.sleep(0.1)
+        assert h.worst_exemplar() is None
+
+    def test_action_raise_and_halt(self):
+        reg, hist = self._hist()
+        g = reg.gauge("boom")
+        eng = AlertEngine(history=hist)
+        eng.add(ThresholdRule("boom_raise", "boom", threshold=1.0,
+                              action="raise"))
+        g.set(5.0)
+        hist.sample(now=8000.0)
+        with pytest.raises(AlertError) as ei:
+            eng.evaluate(now=8000.0)
+        assert ei.value.rule == "boom_raise"
+        # strict=False (the sampler/endpoint path) downgrades to warn
+        eng2 = AlertEngine(history=hist)
+        eng2.add(ThresholdRule("boom2", "boom", threshold=1.0,
+                               action="raise"))
+        eng2.evaluate(now=8001.0, strict=False)
+        assert eng2.rules()[0].state == "FIRING"
+        # halt requests the graceful training stop
+        eng3 = AlertEngine(history=hist)
+        eng3.add(ThresholdRule("boom_halt", "boom", threshold=1.0,
+                               action="halt"))
+        eng3.evaluate(now=8002.0, strict=False)
+        assert get_health().snapshot()["halted"] is not None
+
+    def test_health_and_fleet_rules_and_default_packs(self):
+        reg, hist = self._hist()
+        eng = AlertEngine(history=hist)
+        # for_seconds=0: this test exercises pack SHAPE, not hold-down
+        eng.add(*default_training_rules(stall_after_s=1e6,
+                                        for_seconds=0.0))
+        eng.add(*default_fleet_rules(for_seconds=0.0))
+        hist.sample(now=9000.0)
+        eng.evaluate(now=9000.0)
+        assert eng.firing() == []            # healthy process: all OK
+        get_health().record_problem("divergence", "score exploded")
+        eng.evaluate(now=9001.0)
+        assert "training_divergence" in eng.firing()
+        # review finding: problem rules read timestamped flight events,
+        # so they RESOLVE once the problems age out of within_s — the
+        # health snapshot's append-only 8-slot ring never would
+        aged = AlertEngine(history=hist)
+        aged.add(HealthRule("aged_div", kind="problem", within_s=5.0))
+        aged.evaluate(now=time.time())
+        assert aged.firing() == ["aged_div"]
+        aged.evaluate(now=time.time() + 60.0)
+        assert aged.firing() == []
+        # duplicate names refused; default_rules compose all three packs
+        with pytest.raises(ValueError):
+            eng.add(HealthRule("training_stall"))
+        names = [r.name for r in default_rules()]
+        assert "serving_error_burn" in names
+        assert "training_stall" in names and "fleet_worker_stale" in names
+        assert len(names) == len(set(names))
+        # review findings: the shipped packs default to a REAL hold-down
+        # ("one bad sample never pages"), and default_rules' shared knobs
+        # reach every pack, not just serving
+        assert all(r.for_seconds > 0 for r in default_rules())
+        tuned = default_rules(for_seconds=7.0, stall_after_s=300.0,
+                              p99_target_ms=100.0)
+        assert all(r.for_seconds == 7.0 for r in tuned)
+        stall = next(r for r in tuned if r.name == "training_stall")
+        assert stall.stall_after_s == 300.0
+        # review finding: the queue-saturation rule must compare in the
+        # ADMISSION cap's unit (queued examples, worst single model) —
+        # serving_queue_depth counts requests, a different unit
+        qrule = next(r for r in default_serving_rules()
+                     if "queue_saturation" in r.name)
+        assert qrule.metric == "serving_queue_examples"
+        assert qrule.agg == "max"
+        # rule constructor validation
+        with pytest.raises(ValueError):
+            ThresholdRule("bad", "m", threshold=1.0, op="~")
+        with pytest.raises(ValueError):
+            BurnRateRule("bad", kind="nope")
+        with pytest.raises(ValueError):
+            FleetStalenessRule("bad", action="explode")
+
+
+# --------------------------------------------------- serving fault e2e
+class FaultableModel:
+    """Serving stub with an injectable fault: slow, erroring, or clean."""
+
+    def __init__(self):
+        self.delay_s = 0.0
+        self.fail = False
+
+    def output(self, x, mask=None):
+        if self.fail:
+            raise RuntimeError("injected model fault")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        x = np.asarray(x)
+        return np.full((x.shape[0], 2), 1.0, np.float32)
+
+
+def _post(url, doc, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+class TestServingFaultEndToEnd:
+    def test_latency_and_error_burn_fire_and_resolve(self):
+        """THE acceptance: injected serving fault → OK→PENDING→FIRING
+        with flight event + gauge + 503-free /alerts JSON carrying an
+        exemplar trace id that resolves against /trace; recovery
+        resolves."""
+        model = FaultableModel()
+        srv = InferenceServer()
+        srv.register("sla", model, batch_buckets=(1, 2, 4), linger_ms=0.5,
+                     qps_window_s=1.0)
+        port = srv.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        url = f"{base}/v1/models/sla/predict"
+        engine = get_alert_engine()          # the endpoint serves THIS one
+        hist = get_history()
+        windows = (1.5, 3.0)
+        engine.add(
+            BurnRateRule("e2e_p99", kind="latency", target_ms=40.0,
+                         windows=windows, latency_labels={"model": "sla"},
+                         for_seconds=0.2),
+            BurnRateRule("e2e_burn", kind="availability", slo=0.9,
+                         burn_factor=2.0, windows=windows,
+                         total_labels={"model": "sla"}, min_requests=2.0,
+                         for_seconds=0.0))
+
+        def drive(n, sleep=0.0):
+            for _ in range(n):
+                _post(url, {"inputs": [[1.0, 2.0]]})
+                if sleep:
+                    time.sleep(sleep)
+            hist.sample()
+
+        try:
+            # healthy baseline: fast requests, everything OK
+            hist.sample()
+            drive(6)
+            engine.evaluate(strict=False)
+            assert engine.firing() == []
+
+            # inject a SUSTAINED fault: slow forwards plus a model error
+            # per round, and keep driving until the windows are covered,
+            # the hold-down elapses, and both rules fire
+            model.delay_s = 0.12
+            p99_states = set()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                model.fail = True
+                drive(1)                      # one 500 per round
+                model.fail = False
+                drive(3)                      # three slow 200s per round
+                engine.evaluate(strict=False)
+                p99_states.add({r.name: r.state for r in
+                                engine.rules()}["e2e_p99"])
+                if {"e2e_p99", "e2e_burn"} <= set(engine.firing()):
+                    break
+                time.sleep(0.1)
+            firing = engine.firing()
+            assert "e2e_p99" in firing, [
+                (r.name, r.state, r.last_detail) for r in engine.rules()]
+            assert "e2e_burn" in firing
+            # the hold-down was honored: the p99 rule passed through
+            # PENDING on its way to FIRING (for_seconds=0.2)
+            assert "PENDING" in p99_states, p99_states
+
+            # flight event + gauge + health problem
+            fired = _events("alert_firing")
+            assert {e["rule"] for e in fired} >= {"e2e_p99", "e2e_burn"}
+            assert get_registry().gauge("alerts_firing",
+                                        rule="e2e_p99").value == 1.0
+
+            # 503-free /alerts JSON with a resolvable exemplar trace id
+            status, doc = _get_json(f"{base}/alerts")
+            assert status == 200
+            rows = {r["rule"]: r for r in doc["alerts"]}
+            assert rows["e2e_p99"]["state"] == "FIRING"
+            exemplar = rows["e2e_p99"]["exemplar_trace_id"]
+            assert exemplar
+            trace_ids = {e["args"].get("trace_id")
+                         for e in get_tracer().events()}
+            assert exemplar in trace_ids     # resolves against /trace
+
+            # fault clears: fast traffic, slow samples age out of both
+            # windows, the rules resolve
+            model.delay_s = 0.0
+            deadline = time.monotonic() + 15
+            while engine.firing() and time.monotonic() < deadline:
+                drive(4)
+                time.sleep(0.3)
+                engine.evaluate(strict=False)
+            assert engine.firing() == [], [
+                (r.name, r.state, r.last_detail) for r in engine.rules()]
+            resolved = _events("alert_resolved")
+            assert {e["rule"] for e in resolved} >= {"e2e_p99", "e2e_burn"}
+            assert get_registry().gauge("alerts_firing",
+                                        rule="e2e_p99").value == 0.0
+            # the exemplar belonged to the resolved incident — a future
+            # firing must not inherit it (review finding)
+            p99 = {r.name: r for r in engine.rules()}["e2e_p99"]
+            assert p99.last_exemplar is None
+        finally:
+            srv.stop()
+
+    def test_exemplar_trace_roundtrip_with_caller_header(self):
+        """A caller-supplied X-DL4J-Trace id survives: response echoes it,
+        the worst-bucket exemplar latches it, and /trace carries the
+        queue-wait + flush spans under the same trace id."""
+        model = FaultableModel()
+        model.delay_s = 0.03
+        srv = InferenceServer()
+        srv.register("tr", model, batch_buckets=(1,), linger_ms=0.0)
+        port = srv.start(port=0)
+        try:
+            code, doc = _post(
+                f"http://127.0.0.1:{port}/v1/models/tr/predict",
+                {"inputs": [[1.0, 2.0]]},
+                headers={TRACE_HEADER: "feedc0de:1234"})
+            assert code == 200 and doc["trace_id"] == "feedc0de"
+            ex = get_registry().histogram(
+                "serving_request_latency_ms",
+                model="tr").worst_exemplar()
+            assert ex and ex["exemplar"] == "feedc0de"
+            evs = [e for e in get_tracer().events()
+                   if e["args"].get("trace_id") == "feedc0de"]
+            names = {e["name"] for e in evs}
+            assert {"http/predict", "serving/queue_wait"} <= names
+            qw = next(e for e in evs if e["name"] == "serving/queue_wait")
+            flush_ids = {f'{e["args"]["span_id"]}'
+                         for e in get_tracer().events()
+                         if e["name"] == "serving/flush"}
+            assert qw["args"]["flush_span_id"] in flush_ids   # linked
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------- endpoints & trends
+class TestEndpointsAndTrends:
+    def test_history_and_alerts_endpoints_on_ui_server(self):
+        from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+        get_registry().counter("endpoint_probe_total").inc(3)
+        get_history().sample()
+        get_alert_engine().add(
+            ThresholdRule("ep_probe", "endpoint_probe_total",
+                          threshold=1.0, mode="value"))
+        srv = UIServer(port=0)
+        srv.attach(InMemoryStatsStorage())
+        port = srv.start()
+        try:
+            status, doc = _get_json(f"http://127.0.0.1:{port}/history")
+            assert status == 200
+            assert doc["samples"] >= 1
+            assert "endpoint_probe_total" in doc["metrics"]
+            status, doc = _get_json(
+                f"http://127.0.0.1:{port}/history"
+                f"?metric=endpoint_probe_total&seconds=600")
+            assert status == 200 and doc["type"] == "counter"
+            assert doc["points"] and doc["points"][-1]["value"] >= 3.0
+            # /alerts evaluates at request time: the threshold rule over
+            # the already-sampled counter comes back FIRING, HTTP 200
+            status, doc = _get_json(f"http://127.0.0.1:{port}/alerts")
+            assert status == 200
+            assert doc["firing"] == ["ep_probe"]
+        finally:
+            srv.stop()
+
+    def test_profile_trends_block(self):
+        reg = get_registry()
+        qps = reg.gauge("serving_qps", model="trend")
+        hist = get_history()
+        qps.set(100.0)
+        hist.sample(now=time.time() - 60.0)   # "a minute ago"
+        qps.set(25.0)
+        hist.sample()
+        rep = profile_report()
+        tr = rep["trends"]
+        assert tr["window_s"] == [60.0, 300.0]
+        # the trends block sums across models, and other suites may have
+        # left serving_qps children behind — pin the MOVEMENT (the same
+        # foreign children appear in both dumps, so they cancel)
+        assert (tr["serving_qps"]["60s_ago"] - tr["serving_qps"]["now"]
+                == pytest.approx(75.0))
+        # honesty guard (review finding): the ring covers ~1 minute, so
+        # the 5-minute horizon must answer None, never a young value
+        # silently mislabeled as 5-minutes-old
+        assert tr["serving_qps"]["300s_ago"] is None
+        assert tr["jit_compiles"]["300s_delta"] is None
+        from deeplearning4j_tpu.monitor import render_profile_text
+        text = render_profile_text(rep)
+        assert "# trends" in text and "serving_qps" in text
+
+    def test_trends_empty_without_history(self):
+        assert profile_report()["trends"] == {}
+
+    def test_history_series_histogram_points(self):
+        reg = get_registry()
+        reg.histogram("trend_lat_ms", "x").observe(5.0)
+        hist = get_history()
+        hist.sample()
+        doc = hist.series("trend_lat_ms")
+        assert doc["type"] == "histogram" and doc["unit"] == "ms"
+        assert doc["points"][-1]["count"] >= 1
+
+
+# --------------------------------------------------- unit-aware buckets
+class TestUnitAwareHistograms:
+    def test_seconds_geometry_quantiles_and_render(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("probe_wait_seconds", "x", unit="s")
+        for _ in range(95):
+            h.observe(0.004)                 # 4 ms
+        for _ in range(5):
+            h.observe(2.0)                   # rare 2 s stall
+        s = h.summary()
+        assert s["p50_s"] < 0.05             # honest: NOT saturated at the
+        assert s["p95_s"] < 0.05             # first bucket edge
+        assert s["p99_s"] >= 1.0
+        text = reg.render_prometheus()
+        # le= edges are in seconds (sub-ms first edges), not ms
+        assert 'probe_wait_seconds_bucket{le="0.0002"}' in text
+        # dump carries the unit so a fleet re-render keeps the geometry
+        assert reg.dump()["probe_wait_seconds"]["unit"] == "s"
+
+    def test_unit_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("dual_seconds", unit="s")
+        with pytest.raises(ValueError, match="unit"):
+            reg.histogram("dual_seconds", unit="ms")
+        # unit=None adopts the family's
+        assert reg.histogram("dual_seconds").unit == "s"
+
+    def test_reader_before_creator_does_not_pin_the_unit(self):
+        """Review finding: a read-path lookup (state()/summary() peeking)
+        must not freeze a family at ms geometry — the first EXPLICIT
+        unit claims it, re-gearing the reader's still-empty handle."""
+        reg = MetricsRegistry()
+        reader = reg.histogram("late_wait_seconds")     # no unit claimed
+        assert reader.state() == ([0] * 24, 0.0, 0)
+        creator = reg.histogram("late_wait_seconds", unit="s")
+        assert creator is reader and reader.unit == "s"  # handle re-geared
+        reader.observe(0.004)
+        assert reader.summary()["p50_s"] < 0.05          # s geometry
+        # recording BEFORE the claim is a real error at the recorder
+        reg.histogram("tainted_seconds").observe(3.0)
+        with pytest.raises(ValueError, match="recorded samples"):
+            reg.histogram("tainted_seconds", unit="s")
+
+    def test_migrated_series_use_seconds_geometry(self):
+        """The three PR-6/8 seconds series ride unit="s" now."""
+        reg = get_registry()
+        import deeplearning4j_tpu.monitor.jitwatch  # noqa: F401
+        for name in ("jit_compile_seconds", "lock_wait_seconds",
+                     "input_wait_seconds"):
+            assert reg.histogram(name, unit="s") is not None  # no conflict

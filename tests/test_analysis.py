@@ -18,7 +18,7 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
 RULE_IDS = {"JAX001", "JAX002", "JAX003", "THR001", "THR002",
-            "THR003", "THR004", "RES001", "EXC001"}
+            "THR003", "THR004", "RES001", "EXC001", "MON001"}
 
 
 # default fixture path lives under tests/ so the JAX003 bare-jit rule
@@ -639,3 +639,43 @@ def test_jax001_follows_monitored_jit_wrapped_defs():
             return monitored_jit(step, name="mln/step")
         """, rules=["JAX001"])
     assert rule_ids(fs) == ["JAX001"]
+
+
+# ---------------------------------------------------------------- MON001
+def test_mon001_metric_name_unit_suffix_convention():
+    """ISSUE 10: counters end _total, gauges must not, histograms carry a
+    unit suffix, _seconds histograms pass unit="s", and unit tokens sit
+    at the END of the name (or right before a counter's _total)."""
+    bad = lint_src("""
+        reg.counter("requests")
+        reg.gauge("stuff_total")
+        reg.histogram("lat")
+        reg.histogram("wait_seconds")
+        reg.gauge("device_memory_bytes_in_use")
+        """, rules=["MON001"])
+    assert rule_ids(bad) == ["MON001"] * 5
+
+    clean = lint_src("""
+        reg.counter("requests_total")
+        reg.counter("wire_bytes_total")
+        reg.counter(f"paramserver_{k}_total")
+        reg.gauge("queue_depth")
+        reg.histogram("lat_ms", op="push")
+        reg.histogram("wait_seconds", unit="s")
+        reg.histogram("frame_bytes")
+        reg.histogram("batch_examples")
+        reg.histogram(f"shard_lat_{suffix}")
+        """, rules=["MON001"])
+    assert clean == []
+
+    # dynamic names and non-registry callees are out of scope
+    assert lint_src("""
+        reg.counter(name)
+        counter("oops")
+        somedict.histogram()
+        """, rules=["MON001"]) == []
+
+    # pragma suppression rides the shared machinery
+    assert lint_src("""
+        reg.counter("legacy")  # tpulint: disable=MON001
+        """, rules=["MON001"]) == []

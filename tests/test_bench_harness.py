@@ -371,7 +371,13 @@ def test_serving_latency_bench_reports_tail_at_two_qps_points(bench):
         assert 0.0 < p["p50_ms"] <= p["p99_ms"]
         assert 0.0 <= p["reject_rate"] <= 1.0
         assert p["mean_batch_size"] >= 1.0
+        # ISSUE 10: every point latches which SLO rules were FIRING
+        assert isinstance(p["alerts_fired"], list)
+    # the bench's own contract: only the LOWEST point must be alert-free
+    # (a loaded CI box may legitimately trip p99 at the high point)
+    assert stats["points"][0]["alerts_fired"] == []
     assert stats["buckets"] == [1, 2, 4, 8, 16, 32]
+    assert "serving_p99_breach/bench" in stats["alert_rules"]
 
 
 def test_input_pipeline_bench_hides_etl(bench):

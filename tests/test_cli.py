@@ -300,6 +300,56 @@ def test_monitor_profile_subcommand_smoke(capsys):
         srv_ui.stop()
 
 
+def test_monitor_alerts_and_history_subcommand_smoke(capsys):
+    """`monitor --alerts` / `--history`: the SLO-engine and history-ring
+    views, local and over --url, text and JSON."""
+    from deeplearning4j_tpu.monitor import (ThresholdRule, get_alert_engine,
+                                            get_history, get_registry)
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    engine = get_alert_engine()
+    engine.clear()
+    hist = get_history()
+    hist.clear()
+    get_registry().counter("cli_alert_probe_total").inc(5)
+    hist.sample()
+    engine.add(ThresholdRule("cli_probe", "cli_alert_probe_total",
+                             threshold=1.0))
+    try:
+        assert main(["monitor", "--alerts"]) == 0
+        out = capsys.readouterr().out
+        assert "cli_probe" in out and "FIRING" in out
+
+        assert main(["monitor", "--alerts", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["firing"] == ["cli_probe"]
+        rows = {r["rule"]: r for r in doc["alerts"]}
+        assert rows["cli_probe"]["state"] == "FIRING"
+
+        assert main(["monitor", "--history"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["samples"] >= 1
+        assert "cli_alert_probe_total" in doc["metrics"]
+
+        srv_ui = UIServer(port=0)
+        srv_ui.attach(InMemoryStatsStorage())
+        port = srv_ui.start()
+        try:
+            assert main(["monitor", "--alerts", "--url",
+                         f"127.0.0.1:{port}", "--format", "json"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["firing"] == ["cli_probe"]
+            assert main(["monitor", "--history", "--url",
+                         f"127.0.0.1:{port}"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["samples"] >= 1
+        finally:
+            srv_ui.stop()
+    finally:
+        engine.clear()
+        hist.clear()
+
+
 def test_lint_subcommand_smoke(tmp_path, capsys):
     """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over the
     shipped package (self-hosting against analysis/baseline.json), emits

@@ -682,18 +682,23 @@ class TestBucketingClosesJitSignatures:
         net.fit(it)                                # must not crash the step
         assert np.isfinite(float(net.score_))
 
-    def test_pipeline_wait_stats_are_exact_not_bucket_quantiles(self):
-        """Regression (review finding): the /profile wait block reports
-        the exact mean/max, not LatencyHistogram bucket quantiles that
-        collapse sub-100ms (seconds-valued) samples into one bucket."""
+    def test_pipeline_wait_stats_honest_quantiles_on_seconds_geometry(self):
+        """ISSUE 10 supersedes the PR-6 exact-only workaround: with
+        ``input_wait_seconds`` on the ``unit="s"`` bucket geometry the
+        /profile wait block reports HONEST p50/p95 — 99 sub-100µs pops
+        plus one 150 ms stall must yield a sub-millisecond median, not
+        the one stall the old ms-geometry buckets degenerated to."""
         from deeplearning4j_tpu.monitor import get_registry
         from deeplearning4j_tpu.monitor.jitwatch import _pipeline_block
         reg = get_registry()
-        h = reg.histogram("input_wait_seconds")
+        h = reg.histogram("input_wait_seconds", unit="s")
         for _ in range(99):
             h.observe(50e-6)
         h.observe(0.15)                            # one transient stall
         w = _pipeline_block(reg.snapshot())["wait_seconds"]
         assert w["max_s"] == pytest.approx(0.15)
-        assert w["mean_s"] < 0.01                  # NOT the 0.15 the bucket
-        assert "p95_ms" not in w                   # quantiles would report
+        assert w["mean_s"] < 0.01
+        assert w["p50_s"] < 1e-3                   # honest: the median is
+        assert w["p95_s"] < 1e-3                   # the fast path, not the
+        assert "p95_ms" not in w                   # worst stall; keys are
+                                                   # unit-suffixed _s

@@ -407,6 +407,61 @@ def test_time_buckets_pad_mask_and_slice_back():
         b.close()
 
 
+def test_queue_examples_gauge_tracks_admission_unit():
+    """Review finding: serving_queue_depth counts REQUESTS while the
+    admission cap is in EXAMPLES — the serving_queue_examples gauge
+    carries the cap's unit so saturation alerts compare like with like."""
+    model = StubModel(delay_s=0.1)
+    b = ContinuousBatcher(model.output, name="qex", batch_buckets=(2,),
+                          linger_ms=0.0, max_queue_examples=64,
+                          metrics_label="qex")
+    try:
+        f1 = b.submit(np.ones((2, 2), np.float32))   # occupies the
+        time.sleep(0.03)                             # scheduler
+        f2 = b.submit(np.ones((2, 2), np.float32))
+        f3 = b.submit(np.ones((2, 2), np.float32))
+        g = get_registry().gauge("serving_queue_examples", model="qex")
+        assert g.value == 4.0          # 2 queued requests x 2 examples
+        for f in (f1, f2, f3):
+            f.result(timeout=10)
+    finally:
+        b.close()
+    assert g.value == 0.0              # drained queue reads empty
+
+
+def test_serving_qps_decays_to_zero_after_traffic_stops():
+    """ISSUE 10 satellite: the trailing-window serving_qps gauge was only
+    written by completion bookkeeping, so after traffic stopped it
+    reported the last value forever. The idle scheduler now wakes as
+    completions age out of the window and walks the gauge to zero."""
+    registry = ModelRegistry()
+    registry.register("qps", StubModel(), batch_buckets=(1, 2),
+                      linger_ms=0.5, qps_window_s=0.4)
+    try:
+        for _ in range(4):
+            registry.predict("qps", np.ones((1, 2), np.float32))
+        qps = get_registry().gauge("serving_qps", model="qps")
+        assert qps.value > 0.0
+        deadline = time.monotonic() + 5
+        while qps.value > 0.0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert qps.value == 0.0, qps.value
+    finally:
+        registry.close_all()
+
+    # review finding: closing a model right after traffic must not freeze
+    # the gauge at its last nonzero value (the scheduler zeroes it on the
+    # way out, before the idle decay ticks ever run)
+    registry = ModelRegistry()
+    registry.register("qps2", StubModel(), batch_buckets=(1,),
+                      linger_ms=0.0, qps_window_s=60.0)
+    for _ in range(3):
+        registry.predict("qps2", np.ones((1, 2), np.float32))
+    assert get_registry().gauge("serving_qps", model="qps2").value > 0.0
+    registry.close_all()
+    assert get_registry().gauge("serving_qps", model="qps2").value == 0.0
+
+
 # ----------------------------------------------------- /profile rollup
 def test_profile_serving_block_shape_and_text_render():
     registry = ModelRegistry()
