@@ -376,7 +376,8 @@ SERVING_STATS = {}
 def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
                           n_in=64, hidden=128, classes=10,
                           buckets=(1, 2, 4, 8, 16, 32), linger_ms=3.0,
-                          max_queue_examples=64, pool_workers=64):
+                          max_queue_examples=64, pool_workers=64,
+                          variants=True, zipf_pool=24, zipf_s=1.3):
     """Serving-tier tail latency (serving/ — docs/SERVING.md): an
     OPEN-LOOP load generator drives ``POST /v1/models/<name>/predict``
     on an in-process :class:`InferenceServer` at fixed offered QPS —
@@ -385,7 +386,15 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
     the generator (closed-loop coordination would hide saturation).
     Sweeps ``qps_points``; per point latches {offered_qps, achieved_qps,
     p50_ms, p99_ms, reject_rate, mean_batch_size} into ``SERVING_STATS``.
-    Headline value: achieved QPS at the highest offered point."""
+
+    ``variants=True`` (ISSUE 11) additionally re-drives the SAME offered-
+    QPS points against the data-plane configurations {f32-nocache (the
+    main sweep), bf16, bf16+cache under a ZIPFIAN request mix} and
+    latches a ``variants`` sub-block — {p50_ms, p99_ms, achieved_qps,
+    cache_hit_rate, mean_batch_size} per point per variant — so the
+    BENCH trajectory carries the precision/cache before-after, not just
+    the headline. Headline value: main-sweep achieved QPS at the highest
+    offered point."""
     from concurrent.futures import ThreadPoolExecutor
     import urllib.error
     import urllib.request
@@ -398,52 +407,43 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
                                             default_serving_rules,
                                             get_registry)
 
-    conf = (NeuralNetConfiguration.builder().seed(7)
-            .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
-            .layer(DenseLayer(n_in=n_in, n_out=hidden))
-            .layer(OutputLayer(n_in=hidden, n_out=classes,
-                               activation="softmax", loss="mcxent"))
-            .build())
-    net = MultiLayerNetwork(conf).init()
-    registry = ModelRegistry()
-    # warmup=True pre-compiles every bucket signature OUTSIDE the timed
-    # sweep: serving cold-start is the compile-cache item's problem; this
-    # config measures steady-state scheduling + forward latency
-    registry.register("bench", net, batch_buckets=buckets,
-                      linger_ms=linger_ms,
-                      max_queue_examples=max_queue_examples,
-                      default_deadline_ms=5000.0,
-                      input_shape=(n_in,), warmup=True)
-    _hb()
-    srv = InferenceServer(registry)
-    port = srv.start(port=0)
-    url = f"http://127.0.0.1:{port}/v1/models/bench/predict"
-    payload = json.dumps(
-        {"inputs": np.random.default_rng(0)
-         .normal(size=(1, n_in)).astype(np.float32).tolist()}).encode()
-    batch_hist = get_registry().histogram("serving_batch_examples",
-                                          "", model="bench")
-    # SLO watch (monitor/alerts.py): the default serving rule pack over a
-    # fast-sampling history ring; each offered-QPS point latches which
-    # rules were FIRING when the point ended — and the LOWEST point must
-    # end alert-free (a healthy server at trivial load with alerts firing
-    # means the bench or the rules are broken)
-    history = MetricsHistory(capacity=256, interval_s=0.25)
-    engine = AlertEngine(history=history)
-    engine.add(*default_serving_rules(
-        model="bench", windows=(2.0, 4.0), p99_target_ms=250.0,
-        queue_cap=max_queue_examples, for_seconds=0.0))
-    # for_seconds=0: the sweep points are seconds long — the production
-    # hold-down would mask every breach, and alerts_fired at the high
-    # points is part of the latched record
-    rule_names = [r.name for r in engine.rules()]
-    history.start()
+    def make_server(model_name, precision="f32", cache_size=None):
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden))
+                .layer(OutputLayer(n_in=hidden, n_out=classes,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        registry = ModelRegistry()
+        # warmup=True pre-compiles every bucket signature (in the serving
+        # precision) OUTSIDE the timed sweep: serving cold-start is the
+        # compile-cache item's problem; this config measures steady-state
+        # scheduling + forward latency
+        registry.register(model_name, net, batch_buckets=buckets,
+                          linger_ms=linger_ms,
+                          max_queue_examples=max_queue_examples,
+                          default_deadline_ms=5000.0,
+                          input_shape=(n_in,), warmup=True,
+                          precision=precision, cache_size=cache_size)
+        _hb()
+        srv = InferenceServer(registry)
+        port = srv.start(port=0)
+        return srv, f"http://127.0.0.1:{port}/v1/models/{model_name}/predict"
 
-    def fire(out, lock):
+    rng = np.random.default_rng(0)
+    # one fixed payload for the nocache sweeps (the pre-ISSUE-11 shape),
+    # a pool of distinct payloads for the Zipfian cache variant — the
+    # "millions of users" mix where a hot head dominates
+    payloads = [json.dumps(
+        {"inputs": rng.normal(size=(1, n_in)).astype(np.float32).tolist()}
+    ).encode() for _ in range(zipf_pool)]
+
+    def fire(url, data, out, lock):
         t0 = time.perf_counter()
         try:
             req = urllib.request.Request(
-                url, data=payload,
+                url, data=data,
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=30) as resp:
                 resp.read()
@@ -456,10 +456,15 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
         with lock:
             out.append((code, (time.perf_counter() - t0) * 1e3))
 
-    def drive(offered):
+    def drive(offered, url, model_name, pick_payload, engine=None,
+              cache_counters=None):
+        batch_hist = get_registry().histogram("serving_batch_examples",
+                                              "", model=model_name)
         out, lock = [], threading.Lock()
         n = int(offered * duration_s)
         period = 1.0 / offered
+        c0 = ([c.value for c in cache_counters]
+              if cache_counters else None)
         with ThreadPoolExecutor(max_workers=pool_workers) as pool:
             _, b_total0, b_n0 = batch_hist.state()
             t_start = time.perf_counter()
@@ -468,7 +473,7 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
                 delay = target - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
-                pool.submit(fire, out, lock)
+                pool.submit(fire, url, pick_payload(i), out, lock)
         wall = time.perf_counter() - t_start
         _, b_total1, b_n1 = batch_hist.state()
         _hb()
@@ -479,8 +484,13 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
         def pct(q):
             return lat_ok[min(int(q * (len(lat_ok) - 1)),
                               len(lat_ok) - 1)] if lat_ok else None
-        engine.evaluate(strict=False)
-        return {
+        hit_rate = None
+        if cache_counters:
+            hits = cache_counters[0].value - c0[0]
+            misses = cache_counters[1].value - c0[1]
+            if hits + misses:
+                hit_rate = round(hits / (hits + misses), 4)
+        point = {
             "offered_qps": offered,
             "sent": n,
             "achieved_qps": round(len(lat_ok) / wall, 1),
@@ -488,11 +498,33 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
             "p99_ms": round(pct(0.99), 2) if lat_ok else None,
             "reject_rate": round(rejects / max(n, 1), 4),
             "mean_batch_size": round((b_total1 - b_total0) / flushes, 2),
-            "alerts_fired": engine.firing(),
+            "cache_hit_rate": hit_rate,
         }
+        if engine is not None:
+            engine.evaluate(strict=False)
+            point["alerts_fired"] = engine.firing()
+        return point
 
+    # ---- main sweep: f32, no cache, fixed payload, SLO rules watching.
+    # The default serving rule pack over a fast-sampling history ring;
+    # each offered-QPS point latches which rules were FIRING when the
+    # point ended — and the LOWEST point must end alert-free (a healthy
+    # server at trivial load with alerts firing means the bench or the
+    # rules are broken)
+    srv, url = make_server("bench")
+    history = MetricsHistory(capacity=256, interval_s=0.25)
+    engine = AlertEngine(history=history)
+    engine.add(*default_serving_rules(
+        model="bench", windows=(2.0, 4.0), p99_target_ms=250.0,
+        queue_cap=max_queue_examples, for_seconds=0.0))
+    # for_seconds=0: the sweep points are seconds long — the production
+    # hold-down would mask every breach, and alerts_fired at the high
+    # points is part of the latched record
+    rule_names = [r.name for r in engine.rules()]
+    history.start()
     try:
-        points = [drive(q) for q in qps_points]
+        points = [drive(q, url, "bench", lambda i: payloads[0],
+                        engine=engine) for q in qps_points]
     finally:
         srv.stop()
         history.stop()
@@ -510,6 +542,56 @@ def bench_serving_latency(qps_points=(50.0, 250.0), duration_s=4.0,
         "duration_s": duration_s, "points": points,
         "alert_rules": rule_names,
     })
+
+    if variants:
+        # ---- data-plane variants at the SAME offered-QPS points.
+        # f32-nocache re-uses the main sweep's points verbatim (same
+        # harness, same payload) so the comparison costs one sweep, not
+        # two; bf16 and bf16+cache each get a fresh net + server so
+        # precision flips and cache state never leak across variants.
+        recorded = [{"variant": "f32-nocache", "precision": "f32",
+                     "cache_size": None, "zipfian": False,
+                     "points": points, "cache_hit_rate": None}]
+        zrng = np.random.default_rng(1)
+        zipf_idx = [int((zrng.zipf(zipf_s) - 1) % zipf_pool)
+                    for _ in range(int(max(qps_points) * duration_s) + 1)]
+        for variant, cache_size, zipfian in (
+                ("bf16", None, False),
+                ("bf16-cache", zipf_pool, True)):
+            model_name = f"bench_{variant.replace('-', '_')}"
+            srv, url = make_server(model_name, precision="bf16",
+                                   cache_size=cache_size)
+            counters = None
+            if cache_size:
+                counters = (
+                    get_registry().counter("serving_cache_hits_total",
+                                           model=model_name),
+                    get_registry().counter("serving_cache_misses_total",
+                                           model=model_name))
+            pick = ((lambda i: payloads[zipf_idx[i]]) if zipfian
+                    else (lambda i: payloads[0]))
+            # the registry is process-global and the model name fixed:
+            # the overall rate must diff against THIS sweep's start like
+            # the per-point rate does, or a re-run in the same process
+            # reports a blended stale figure
+            base = [c.value for c in counters] if counters else None
+            try:
+                vpoints = [drive(q, url, model_name, pick,
+                                 cache_counters=counters)
+                           for q in qps_points]
+            finally:
+                srv.stop()
+            overall = None
+            if counters:
+                hits, misses = (c.value - b0
+                                for c, b0 in zip(counters, base))
+                if hits + misses:
+                    overall = round(hits / (hits + misses), 4)
+            recorded.append({"variant": variant, "precision": "bf16",
+                             "cache_size": cache_size, "zipfian": zipfian,
+                             "points": vpoints,
+                             "cache_hit_rate": overall})
+        SERVING_STATS["variants"] = recorded
     return points[-1]["achieved_qps"] or 0.0
 
 
@@ -968,6 +1050,21 @@ _FINAL = {
 _CHILDREN = set()
 
 
+def _backend_stale() -> bool:
+    """Whether a measurement taken NOW would be off-harness: True unless
+    the process is talking to a real TPU backend (tpu/axon). The ``--one``
+    record carries this as its ``stale`` field so the trajectory tooling
+    can filter CPU-fallback / smoke-test numbers automatically — the
+    r03–r05 tunnel-outage replays were only flagged in prose, and prose
+    does not filter. (The parent's BASELINE.json replay headlines carry
+    their own ``stale: true`` via :func:`_headline_doc`.)"""
+    try:
+        import jax
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:   # unreachable backend = nothing fresh to trust
+        return True
+
+
 def _monitor_snapshot():
     """The measuring process's monitor-registry snapshot (step/ETL
     histograms, transport bytes, …), embedded in each emitted record so
@@ -1168,6 +1265,10 @@ def main():
                 sys.exit(3)
             _write_partial(base_doc, {name: value})
         print(json.dumps({"one": name, "value": value,
+                          # backend-reachability provenance: False only
+                          # when this number was measured on real TPU
+                          # hardware (see _backend_stale)
+                          "stale": _backend_stale(),
                           "monitor": _monitor_snapshot(),
                           "jitwatch": _jitwatch_snapshot(),
                           # prefetch-off/on ETL comparison — populated only

@@ -23,6 +23,12 @@ convention the package settled on:
 - **unit tokens sit at the END of the name** (or directly before
   ``_total``, the Prometheus counter spelling ``*_bytes_total``):
   ``device_memory_in_use_bytes``, never ``device_memory_bytes_in_use``.
+- **hit/miss series are monotonic event counts** (names whose stem ends
+  ``_hits``/``_misses`` — ISSUE 11's ``serving_cache_*`` response-cache
+  series, any future cache): they must be counters ending ``_total`` — a
+  gauge or histogram spelling would break the hit-rate math every
+  consumer (the /profile ``cache`` column, the bench's
+  ``cache_hit_rate``) derives from windowed counter deltas.
 
 The rule fires on direct registry-handle creations — ``X.counter("name",
 ...)`` / ``X.gauge`` / ``X.histogram`` with a literal (or
@@ -72,6 +78,23 @@ def _unit_kwarg(call: ast.Call) -> Optional[str]:
         if kw.arg == "unit" and isinstance(kw.value, ast.Constant):
             return kw.value.value
     return None
+
+
+def _hits_misses_stem(name: str) -> bool:
+    """Whether the name is a hit/miss EVENT COUNT: its token sequence
+    ends with ``hits``/``misses``, optionally followed by unit tokens
+    and/or a final ``total``. Such series must be ``_total`` counters —
+    hit-rate math everywhere derives from monotonic counter deltas.
+    (``cache_hit_latency_ms`` — singular, mid-name — is not one.)"""
+    tokens = name.split("_")
+    if tokens and tokens[-1].endswith("*"):
+        # trailing "*" = dynamic f-string suffix, unknowable statically
+        # (the counter branch's same escape — the suffix may well be
+        # "total" at runtime, the paramserver_{k}_total idiom)
+        return False
+    while tokens and (tokens[-1] in _UNIT_TOKENS or tokens[-1] == "total"):
+        tokens.pop()
+    return bool(tokens) and tokens[-1] in ("hits", "misses")
 
 
 def _misplaced_unit(name: str) -> Optional[str]:
@@ -126,6 +149,16 @@ class MetricNameUnitSuffix(Rule):
             return (f"{kind} {name!r} buries the unit token {tok!r} "
                     f"mid-name — units go at the end "
                     f"(…_{tok}, or …_{tok}_total for a counter)")
+        if _hits_misses_stem(name):
+            # hit/miss series (response cache, any future cache) are
+            # monotonic events by definition — any non-counter spelling
+            # silently breaks every hit-rate consumer downstream
+            if kind != "counter" or not name.endswith("_total"):
+                return (f"{kind} {name!r}: hit/miss series must be "
+                        f"counters ending '_total' (e.g. "
+                        f"serving_cache_hits_total / "
+                        f"serving_cache_misses_total) — hit-rate math "
+                        f"needs monotonic counter deltas")
         if kind == "counter":
             if not name.endswith("_total") and not name.endswith("*"):
                 return (f"counter {name!r} must end '_total' (the name "

@@ -807,6 +807,28 @@ def _serving_block(snap) -> Dict[str, Any]:
                      ("serving_qps", "qps")):
         for r in snap.get(fam, []):
             row(r["labels"].get("model", "?"))[key] = r.get("value")
+    for fam, key in (("serving_pad_ms", "pad_ms"),
+                     ("serving_transfer_ms", "transfer_ms")):
+        # the ISSUE-11 flush-time split: batch assembly vs host<->device
+        # movement, per flush — read next to latency_ms to see how much
+        # of the tail is data plane rather than compute
+        for r in snap.get(fam, []):
+            if r.get("summary"):
+                row(r["labels"].get("model", "?"))[key] = {
+                    "mean": round(r["summary"]["mean_ms"], 4),
+                    "p99": r["summary"]["p99_ms"],
+                    "n": int(r["summary"]["n"])}
+    hits: Dict[str, float] = {}
+    misses: Dict[str, float] = {}
+    for fam, acc in (("serving_cache_hits_total", hits),
+                     ("serving_cache_misses_total", misses)):
+        for r in snap.get(fam, []):
+            acc[r["labels"].get("model", "?")] = r.get("value") or 0.0
+    for m in set(hits) | set(misses):
+        h, miss = hits.get(m, 0.0), misses.get(m, 0.0)
+        row(m)["cache"] = {
+            "hits": int(h), "misses": int(miss),
+            "hit_rate": (round(h / (h + miss), 4) if h + miss else None)}
     return per
 
 
@@ -910,11 +932,14 @@ def render_profile_text(report: Dict[str, Any]) -> str:
         lines.append("# serving (per hosted model)")
         lines.append(f"{'model':<20} {'ok':>8} {'rej':>6} {'dl':>5} "
                      f"{'err':>5} {'qps':>7} {'p50_ms':>8} {'p99_ms':>8} "
-                     f"{'batch':>6} {'queue':>6}")
+                     f"{'batch':>6} {'queue':>6} {'cache':>6} "
+                     f"{'pad_ms':>7} {'xfer_ms':>8}")
         for name, r in sorted(serving.items()):
             req = r.get("requests", {})
             lat = r.get("latency_ms") or {}
             bat = r.get("batch_examples") or {}
+            cache = r.get("cache") or {}
+            rate = cache.get("hit_rate")
             lines.append(
                 f"{name:<20} {int(req.get('ok', 0)):>8} "
                 f"{int(req.get('rejected', 0)):>6} "
@@ -924,7 +949,10 @@ def render_profile_text(report: Dict[str, Any]) -> str:
                 f"{round(lat.get('p50_ms', 0.0), 2):>8} "
                 f"{round(lat.get('p99_ms', 0.0), 2):>8} "
                 f"{round(bat.get('mean', 0.0), 1):>6} "
-                f"{int(r.get('queue_depth', 0) or 0):>6}")
+                f"{int(r.get('queue_depth', 0) or 0):>6} "
+                f"{rate if rate is not None else '-':>6} "
+                f"{(r.get('pad_ms') or {}).get('mean', '-'):>7} "
+                f"{(r.get('transfer_ms') or {}).get('mean', '-'):>8}")
     locks = report.get("locks") or {}
     if locks:
         lines.append("")

@@ -149,13 +149,17 @@ class ParallelInference:
                 # queue_limit requests forces a flush (the reference
                 # semantics) rather than rejecting — admission control
                 # with 429s is the HTTP tier's job, not this API's
+                # device_path=False: _forward pads to the DEVICE MULTIPLE
+                # and device_puts with the mesh's batch sharding itself —
+                # the batcher's single-device resident path would only
+                # add a host round-trip in front of that
                 self._batcher = ContinuousBatcher(
                     self._forward, name="parallel-inference",
                     max_batch=self.batch_limit,
                     max_queue_examples=None,
                     max_queue_requests=self.queue_limit,
                     linger_ms=self.flush_after_ms,
-                    queue_policy="flush")
+                    queue_policy="flush", device_path=False)
             return self._batcher
 
     def submit(self, x) -> Future:

@@ -14,6 +14,16 @@ batching scheduler behind an HTTP front door.
   zoo models and ``keras/`` imports side by side, each with its own
   batcher, queue caps, and per-model latency/QPS/batch-size series in
   the monitor registry (the ``serving`` block on ``GET /profile``).
+  Per-model data-plane dials (ISSUE 11, docs/SERVING.md "Data-plane
+  tuning"): ``precision="bf16"`` serves the forward in bfloat16 (f32
+  responses, its own closed jit-signature set, half the wire bytes) and
+  ``cache_size=`` puts a content-addressed response LRU in front of the
+  queue — a hit skips queue and flush entirely. The flush path itself
+  is device-resident: one h2d transfer of the real examples, on-device
+  padding into a donation-recycled bucket buffer, on-device slicing,
+  one d2h transfer (``serving/pad``/``serving/transfer`` spans +
+  ``serving_pad_ms``/``serving_transfer_ms`` histograms prove the
+  split).
 - :class:`InferenceServer` — the HTTP/JSON front door
   (``POST /v1/models/<name>/predict``, ``GET /v1/models``, plus the
   monitor scrape endpoints incl. ``/alerts`` and ``/history``), mapping

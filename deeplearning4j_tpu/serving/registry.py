@@ -24,9 +24,55 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..monitor.lockwatch import make_lock
-from .batcher import ContinuousBatcher, ModelNotFoundError
+from .batcher import (ContinuousBatcher, ModelNotFoundError, PRECISIONS,
+                      serving_dtype)
 
 __all__ = ["ServedModel", "ModelRegistry"]
+
+
+def _flip_compute_dtype(model, dtype_name: str) -> bool:
+    """Switch a framework net's layer compute policy to ``dtype_name``
+    (the mixed-precision policy ``nn/layers/base.py`` documents: params
+    stay f32 masters, activations and MXU compute flow in the low
+    precision). NOTE: this mutates the NET OBJECT — registration takes
+    ownership of the model's compute policy, so a net that is still
+    training elsewhere (or hosted by a second registry entry) computes
+    in the new dtype too. Share a net across serving and training only
+    at precision="f32", or register a copy (docs/SERVING.md). Anything
+    without ``impls`` (duck-typed models) is left alone — those only see
+    the low-precision INPUTS the batcher casts. Returns True when at
+    least one layer flipped."""
+    impls = getattr(model, "impls", None)
+    if impls is None:
+        return False          # duck model — and keeps jax-free fleets
+    import jax.numpy as jnp   # (device_path=False) importing lazily
+    dt = jnp.dtype(dtype_name)
+    flipped = False
+    stack = list(impls.values() if isinstance(impls, dict) else impls)
+    while stack:
+        impl = stack.pop()
+        if impl is None:
+            continue
+        inner = getattr(impl, "inner", None)   # wrapper impls (Frozen,
+        if inner is not None:                  # Bidirectional, ...)
+            stack.append(inner)
+        if hasattr(impl, "compute_dtype") \
+                and jnp.dtype(impl.compute_dtype) != dt:
+            impl.compute_dtype = dt
+            impl.out_dtype = (dt if dt.itemsize < 4
+                              else getattr(impl, "dtype", dt))
+            flipped = True
+    if not flipped:
+        return False       # already at the target precision: a no-op
+        # re-registration (the common f32-on-f32 case) must not discard
+        # valid compiled traces below
+    gc = getattr(model, "gc", None)
+    if gc is not None and hasattr(gc, "compute_dtype"):
+        gc.compute_dtype = str(dt)     # keep config honest for serde/stats
+    cache = getattr(model, "_jit_output", None)
+    if isinstance(cache, dict):
+        cache.clear()      # any pre-flip traces compiled the OLD dtype —
+    return True            # they must not serve under the new contract
 
 #: default batch buckets: powers of two up to a modest serving batch —
 #: small enough that a lone request pads little, closed enough that the
@@ -35,7 +81,16 @@ DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
 class ServedModel:
-    """One hosted model: the net, its batcher, and its serving config."""
+    """One hosted model: the net, its batcher, and its serving config.
+
+    ``precision="bf16"`` serves this model in bfloat16 (docs/SERVING.md
+    "Data-plane tuning"): framework nets have their layer compute policy
+    flipped at registration (f32 params, bf16 activations/MXU compute),
+    the batcher casts inputs to bf16 at submit — so h2d/d2h wire bytes
+    halve and the bf16 dtype keys its OWN closed jit-signature set — and
+    responses come back f32. Duck-typed models simply receive bf16
+    inputs. ``cache_size`` (examples) enables the content-addressed
+    response cache in front of the queue."""
 
     def __init__(self, name: str, model, *,
                  batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
@@ -46,7 +101,10 @@ class ServedModel:
                  input_shape: Optional[Sequence[int]] = None,
                  warmup: bool = False,
                  qps_window_s: float = 10.0,
-                 in_flight: Optional[threading.Semaphore] = None):
+                 in_flight: Optional[threading.Semaphore] = None,
+                 precision: str = "f32",
+                 cache_size: Optional[int] = None,
+                 device_path: Optional[bool] = None):
         if hasattr(model, "conf") and not hasattr(model, "output"):
             model = model.init()          # a ZooModel, not yet built
         if not callable(getattr(model, "output", None)):
@@ -54,17 +112,39 @@ class ServedModel:
                 f"model {name!r} has no callable output(features) — pass "
                 f"an initialized network (MultiLayerNetwork, "
                 f"ComputationGraph, keras import) or a ZooModel")
+        if precision not in PRECISIONS:
+            raise ValueError(f"model {name!r}: precision must be one of "
+                             f"{PRECISIONS}, got {precision!r}")
         self.name = name
         self.model = model
+        self.precision = precision
+        # enforce the declared precision in BOTH directions: registering
+        # f32 flips a previously-bf16-served net back (and clears its jit
+        # cache), so stats()['precision'] can never disagree with what
+        # the layers actually compute in — the flip is a property of the
+        # registration, not a one-way ratchet on the net
+        _flip_compute_dtype(model,
+                            "bfloat16" if precision == "bf16"
+                            else "float32")
         self.input_shape = (tuple(int(d) for d in input_shape)
                             if input_shape is not None else None)
+        if device_path is None:
+            # framework nets (layer impls) compute on device — stage
+            # their batches there. Duck-typed models compute wherever
+            # they please, usually host numpy: auto-staging would ADD
+            # the h2d+d2h round trip the device path exists to remove
+            # (and hand an in-place-mutating forward an immutable
+            # jax.Array) — they opt in with device_path=True
+            device_path = hasattr(model, "impls")
         self.batcher = ContinuousBatcher(
             self._forward, name=name,
             batch_buckets=batch_buckets, time_buckets=time_buckets,
             max_queue_examples=max_queue_examples, linger_ms=linger_ms,
             default_deadline_ms=default_deadline_ms,
             queue_policy="reject", in_flight=in_flight,
-            metrics_label=name, qps_window_s=qps_window_s)
+            metrics_label=name, qps_window_s=qps_window_s,
+            precision=precision, cache_size=cache_size,
+            device_path=device_path)
         if warmup:
             self.warm()
 
@@ -87,24 +167,39 @@ class ServedModel:
                 f"model {self.name!r}: warmup needs input_shape= (the "
                 f"per-example trailing shape) at registration")
         b = self.batcher
+        # warm in the SERVING dtype: precision is part of the jit
+        # signature, so an f32 warmup of a bf16 model would pre-compile
+        # the wrong variants and the first real requests would retrace
+        dt = serving_dtype(self.precision)
         shapes = [(n,) + self.input_shape for n in (b._bb or [b.max_batch])]
         for shape in shapes:
             if b._tb is not None and len(shape) >= 3:
                 # one variant per (batch, time) bucket, through the same
                 # masked path real sequence requests take
                 for tt in b._tb:
-                    xs = np.zeros((shape[0], tt) + shape[2:], np.float32)
+                    xs = np.zeros((shape[0], tt) + shape[2:], dt)
                     self._forward(xs, np.ones((shape[0], tt), np.float32))
             else:
-                self._forward(np.zeros(shape, np.float32))
+                self._forward(np.zeros(shape, dt))
+        # data-plane warm-in (ISSUE 11): the device pad program
+        # specializes per (real rows, bucket) pair — pre-compile those
+        # too, so no live flush ever pays a pad compile
+        if b._tb is not None and len(self.input_shape) >= 2:
+            for tt in b._tb:
+                b.warm_pads((tt,) + self.input_shape[1:], masked=True)
+        else:
+            b.warm_pads(self.input_shape)
         return self
 
     def _forward(self, xs, mask=None):
         # the scheduler thread is the only caller, so the model's lazy
-        # jit-wrapper construction needs no extra locking here
-        y = self.model.output(xs) if mask is None \
+        # jit-wrapper construction needs no extra locking here. The raw
+        # (possibly device-resident) output is returned — the batcher
+        # slices the padding off ON DEVICE and does the one host
+        # transfer itself (the old np.asarray here was the d2h round-trip
+        # the ISSUE-11 data-plane pass removed)
+        return self.model.output(xs) if mask is None \
             else self.model.output(xs, mask=mask)
-        return np.asarray(y)
 
     def submit(self, x, deadline_ms: Optional[float] = None,
                trace_ctx=None) -> Future:
@@ -128,6 +223,9 @@ class ServedModel:
             "max_queue_examples": b.max_queue_examples,
             "linger_ms": b.linger_ms,
             "default_deadline_ms": b.default_deadline_ms,
+            "precision": self.precision,
+            "cache_size": b.cache_size,
+            "cache": b.cache_stats(),
         }
 
     def close(self, drain: bool = True, timeout: float = 30.0):

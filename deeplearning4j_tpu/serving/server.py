@@ -10,7 +10,9 @@ JsonRequestHandler` plumbing and POST Content-Length cap), serving:
   malformed body/shape → 400, :class:`OverloadedError` (queue at
   capacity / draining) → **429** with a ``Retry-After`` hint,
   :class:`DeadlineExceededError` → **504**, anything else → 500.
-- ``GET /v1/models`` — hosted-model listing with queue depth and config.
+- ``GET /v1/models`` — hosted-model listing with queue depth and config
+  (since ISSUE 11 each row also carries the model's serving ``precision``
+  and response-cache occupancy — docs/SERVING.md "Data-plane tuning").
 - ``GET /v1/models/<name>`` — one model's row.
 - ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` /
   ``GET /alerts`` / ``GET /history`` — the monitor endpoints re-exposed

@@ -679,3 +679,24 @@ def test_mon001_metric_name_unit_suffix_convention():
     assert lint_src("""
         reg.counter("legacy")  # tpulint: disable=MON001
         """, rules=["MON001"]) == []
+
+
+def test_mon001_serving_cache_series_must_be_counters():
+    """ISSUE 11 satellite: the response-cache hit/miss series are
+    monotonic events — any non-counter spelling (or a counter without
+    _total) breaks the hit-rate math /profile and the bench derive from
+    counter deltas."""
+    bad = lint_src("""
+        reg.gauge("serving_cache_hits")
+        reg.histogram("serving_cache_misses_ms")
+        reg.counter("serving_cache_hits")
+        """, rules=["MON001"])
+    assert rule_ids(bad) == ["MON001"] * 3
+    assert all("serving_cache" in f.message for f in bad)
+
+    assert lint_src("""
+        reg.counter("serving_cache_hits_total", model=name)
+        reg.counter("serving_cache_misses_total", model=name)
+        reg.gauge("serving_cache_examples")
+        reg.counter(f"shard_cache_hits_{suffix}")
+        """, rules=["MON001"]) == []

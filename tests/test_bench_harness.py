@@ -360,7 +360,9 @@ def test_serving_latency_bench_reports_tail_at_two_qps_points(bench):
     """Acceptance (ISSUE 9): the open-loop load generator drives the
     HTTP endpoint at two offered-QPS points and latches
     {p50_ms, p99_ms, achieved_qps, reject_rate, mean_batch_size} per
-    point into the --one record's serving block."""
+    point into the --one record's serving block. ISSUE 11: the same run
+    latches a ``variants`` sub-block comparing {f32-nocache, bf16,
+    bf16+cache (Zipfian mix)} at the SAME offered-QPS points."""
     value = bench.bench_serving_latency(qps_points=(30.0, 90.0),
                                         duration_s=1.0, pool_workers=16)
     stats = bench.SERVING_STATS
@@ -378,6 +380,47 @@ def test_serving_latency_bench_reports_tail_at_two_qps_points(bench):
     assert stats["points"][0]["alerts_fired"] == []
     assert stats["buckets"] == [1, 2, 4, 8, 16, 32]
     assert "serving_p99_breach/bench" in stats["alert_rules"]
+
+    # ---- ISSUE 11 variants sub-block: shape pinned, same QPS points
+    variants = stats["variants"]
+    assert [v["variant"] for v in variants] == ["f32-nocache", "bf16",
+                                                "bf16-cache"]
+    for v in variants:
+        assert v["precision"] in ("f32", "bf16")
+        assert [p["offered_qps"] for p in v["points"]] == [30.0, 90.0]
+        for p in v["points"]:
+            for key in ("p50_ms", "p99_ms", "achieved_qps",
+                        "cache_hit_rate", "mean_batch_size"):
+                assert key in p, (v["variant"], key)
+            assert p["achieved_qps"] > 0
+            assert 0.0 < p["p50_ms"] <= p["p99_ms"]
+    # f32-nocache IS the main sweep (one harness, one comparison basis)
+    assert variants[0]["points"] is stats["points"]
+    assert variants[0]["cache_hit_rate"] is None
+    assert variants[1]["cache_size"] is None        # bf16, no cache
+    # the Zipfian mix over a pool smaller than the cache must hit >0.5 —
+    # every distinct payload misses at most once across the whole sweep
+    assert variants[2]["zipfian"] is True
+    assert variants[2]["cache_hit_rate"] > 0.5
+    assert variants[2]["points"][-1]["cache_hit_rate"] > 0.5
+
+
+def test_backend_stale_field_sources_from_backend(bench, monkeypatch):
+    """ISSUE 11 BENCH hygiene: --one records carry stale: true/false from
+    backend reachability, so trajectory tooling can filter replayed /
+    off-harness measurements without reading prose."""
+    import types
+
+    fake = types.SimpleNamespace(default_backend=lambda: "tpu")
+    monkeypatch.setitem(sys.modules, "jax", fake)
+    assert bench._backend_stale() is False
+    fake.default_backend = lambda: "cpu"
+    assert bench._backend_stale() is True
+
+    def boom():
+        raise RuntimeError("wedged tunnel")
+    fake.default_backend = boom
+    assert bench._backend_stale() is True
 
 
 def test_input_pipeline_bench_hides_etl(bench):

@@ -527,6 +527,330 @@ def test_serving_locks_clean_under_lockwatch_and_statically_derivable():
         watch.clear()
 
 
+# ------------------------------------------- data plane: bf16 precision
+def test_bf16_tolerance_and_closed_compile_set_per_precision():
+    """ISSUE 11: a model served with precision="bf16" answers within the
+    documented atol (5e-2, docs/SERVING.md) of an f32 twin, returns f32
+    responses, and — precision being part of the jit signature — compiles
+    exactly len(buckets) variants for the ONE precision actually served,
+    with zero retrace storms. An f32-served sibling independently owns
+    its own len(buckets) compile set."""
+    bf_net, f32_net, ref = _net(seed=21), _net(seed=21), _net(seed=21)
+    registry = ModelRegistry()
+    registry.register("bf16m", bf_net, batch_buckets=(2, 4), linger_ms=1.0,
+                      input_shape=(6,), warmup=True, precision="bf16")
+    registry.register("f32m", f32_net, batch_buckets=(2, 4), linger_ms=1.0,
+                      input_shape=(6,), warmup=True)
+    rng = np.random.default_rng(3)
+    served = []
+    for i in range(6):
+        x = rng.normal(size=(int(rng.integers(1, 5)), 6)).astype(np.float32)
+        served.append((x, registry.predict("bf16m", x),
+                       registry.predict("f32m", x)))
+    # the serving pins FIRST (the twin's unbatched churning forwards
+    # below would trip ITS storm detector — exactly the failure serving
+    # buckets close): closed compile set PER PRECISION — warmup
+    # pre-compiled both buckets in each model's serving dtype, churn
+    # added nothing
+    assert bf_net._jit_output[(False, False)].compiles == 2
+    assert f32_net._jit_output[(False, False)].compiles == 2
+    assert _storm_events() == []
+    # the bf16 flip reached the layer compute policy (framework nets)
+    assert str(bf_net.impls[0].compute_dtype) == "bfloat16"
+    assert str(f32_net.impls[0].compute_dtype) == "float32"
+    registry.close_all()
+    # review finding: the flip must be a property of the REGISTRATION,
+    # not a one-way ratchet — re-registering the same net as f32 restores
+    # f32 compute (and bit-equality with the twin)
+    registry2 = ModelRegistry()
+    registry2.register("back", bf_net, batch_buckets=(2, 4), linger_ms=1.0,
+                       input_shape=(6,), warmup=True)
+    assert str(bf_net.impls[0].compute_dtype) == "float32"
+    for x, y_bf, y_f32 in served:
+        y_ref = np.asarray(ref.output(x))
+        assert y_bf.dtype == np.float32          # f32 out, always
+        np.testing.assert_allclose(y_bf, y_ref, atol=5e-2)
+        # f32 sibling unchanged: still bit-identical to the twin
+        np.testing.assert_array_equal(y_f32, y_ref)
+        np.testing.assert_array_equal(registry2.predict("back", x), y_ref)
+    registry2.close_all()
+
+    with pytest.raises(ValueError):
+        ModelRegistry().register("bad", StubModel(), precision="f16")
+
+
+# --------------------------------------------- data plane: response cache
+def test_response_cache_bit_equality_and_hit_skips_queue():
+    """ISSUE 11: a cache hit returns rows BIT-identical to the freshly
+    computed response, without queueing (no serving/queue_wait span, no
+    flush, no forward call) — and the hit/miss counters move."""
+    from deeplearning4j_tpu.monitor.tracer import get_tracer
+    model = StubModel()
+    registry = ModelRegistry()
+    registry.register("cached", model, batch_buckets=(1, 2), linger_ms=0.0,
+                      cache_size=8)
+    reg = get_registry()
+    hits = reg.counter("serving_cache_hits_total", model="cached")
+    misses = reg.counter("serving_cache_misses_total", model="cached")
+    h0, m0 = hits.value, misses.value
+    x = np.random.default_rng(5).normal(size=(2, 3)).astype(np.float32)
+    fresh = registry.predict("cached", x)
+    assert misses.value == m0 + 1 and hits.value == h0
+    n_forwards = len(model.calls)
+
+    tracer = get_tracer()
+    tracer.clear()
+    fut = registry.submit("cached", x)
+    assert fut.done()                  # resolved AT submit — queue skipped
+    cached = fut.result(0)
+    assert cached.tobytes() == fresh.tobytes()          # strict bit-equality
+    assert cached.shape == fresh.shape and cached.dtype == fresh.dtype
+    assert hits.value == h0 + 1
+    assert len(model.calls) == n_forwards               # no forward ran
+    names = {e["name"] for e in tracer.events()}
+    assert "serving/queue_wait" not in names            # queue-wait absent
+    assert "serving/flush" not in names
+    # review finding: hits are completions — the trailing-QPS gauge must
+    # count them, or a cache-heavy model reads as idle
+    assert reg.gauge("serving_qps", model="cached").value > 0.0
+    assert reg.counter("serving_requests_total", model="cached",
+                       outcome="ok").value >= 2
+
+    # cached masters are mutation-proof: a caller scribbling on its copy
+    # must not corrupt later hits
+    cached[:] = -1.0
+    again = registry.submit("cached", x).result(5)
+    assert again.tobytes() == fresh.tobytes()
+    # a different input is a genuine miss
+    registry.predict("cached", x + 1.0)
+    assert misses.value == m0 + 2
+    stats = registry.get("cached").stats()
+    assert stats["cache_size"] == 8 and stats["precision"] == "f32"
+    assert stats["cache"]["entries"] == 2
+    registry.close_all()
+
+
+def test_cache_miss_owns_bytes_against_linger_window_mutation():
+    """Review finding: the cache key is hashed at submit, the value
+    computed at flush — without owning the bytes on a miss, a caller
+    mutating its array in the linger window would plant a poisoned entry
+    under the ORIGINAL bytes' hash for every other caller. The miss path
+    copies, so the mutation can't even reach the flush."""
+    model = StubModel()
+    b = ContinuousBatcher(model.output, name="poison", batch_buckets=(1,),
+                          linger_ms=200.0, cache_size=8)
+    try:
+        x = np.ones((1, 3), np.float32)
+        fut = b.submit(x)                     # miss: enqueued + hashed
+        x *= 5.0                              # contract violation, mid-linger
+        b.flush(wait=True)
+        poisoned = fut.result(5)
+        # the flush computed from the OWNED copy of the original bytes
+        expected = model.output(np.ones((1, 3), np.float32))
+        np.testing.assert_array_equal(poisoned, expected)
+        # a pristine caller of the original bytes hits the honest entry
+        hit = b.submit(np.ones((1, 3), np.float32))
+        assert hit.done()
+        np.testing.assert_array_equal(hit.result(0), expected)
+    finally:
+        b.close()
+
+
+def test_qps_decays_after_cache_hit_only_traffic():
+    """Review finding: cache hits complete on submitter threads while the
+    scheduler may be parked with wait(None) — the hit path must wake it,
+    or the qps gauge stays frozen at its last value forever after
+    hit-only traffic stops (the ISSUE-10 staleness bug, reborn)."""
+    registry = ModelRegistry()
+    registry.register("hitqps", StubModel(), batch_buckets=(1, 2),
+                      linger_ms=0.5, qps_window_s=0.4, cache_size=16)
+    try:
+        x = np.ones((1, 2), np.float32)
+        registry.predict("hitqps", x)          # miss: computes + caches
+        time.sleep(0.6)                        # flush completion ages out;
+        qps = get_registry().gauge("serving_qps", model="hitqps")
+        for _ in range(3):                     # scheduler parks (no queue)
+            assert registry.predict("hitqps", x) is not None   # pure hits
+        assert qps.value > 0.0                 # hits counted in the window
+        deadline = time.monotonic() + 5
+        while qps.value > 0.0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert qps.value == 0.0, qps.value     # idle decay still ran
+    finally:
+        registry.close_all()
+    assert get_registry().gauge("serving_qps", model="hitqps").value == 0.0
+
+
+def test_cache_lru_evicts_by_examples():
+    b = ContinuousBatcher(StubModel(delay_s=0.05).output, name="lru",
+                          batch_buckets=(2,), linger_ms=0.0, cache_size=4)
+    try:
+        xs = [np.full((2, 3), float(i), np.float32) for i in range(3)]
+        for x in xs:
+            b.submit(x).result(5)
+        # capacity 4 examples, entries are 2 examples each -> the oldest
+        # entry aged out; the two newest are resident
+        assert b.cache_stats() == {"entries": 2, "examples": 4}
+        assert not b.submit(xs[0]).done()       # evicted -> real request
+    finally:
+        b.close()
+
+
+# --------------------------------- data plane: device residency/donation
+def test_device_resident_flush_donation_safety_and_zero_padding():
+    """ISSUE 11: the flush pads on device into a donation-recycled
+    bucket buffer. Safety contract pinned here: the donated buffer is
+    only ever OVERWRITTEN (padding rows are zeros on every flush, even
+    though the recycled buffer held the previous flush's data) and the
+    batcher never touches the donated handle again (a fresh handle is
+    stored per flush, so backends that truly donate can invalidate the
+    old one freely)."""
+    model = StubModel()
+    b = ContinuousBatcher(model.output, name="dev", batch_buckets=(4,),
+                          linger_ms=0.0, device_path=True)
+    try:
+        b.submit(np.full((2, 3), 7.0, np.float32)).result(5)
+        (buf_key, buf1), = list(b._dev_bufs.items())
+        b.submit(np.full((3, 3), 9.0, np.float32)).result(5)
+        buf2 = b._dev_bufs[buf_key]
+        assert buf2 is not buf1          # handle replaced, old one dead
+        # the forward saw a DEVICE array both times, padded to the bucket
+        assert model.calls[0][0] == (4, 3) and model.calls[1][0] == (4, 3)
+        # padding rows are zero DESPITE the recycled buffer having held
+        # the previous flush's 7.0 rows — overwrite-only, never read
+        np.testing.assert_array_equal(np.asarray(buf2)[:3], 9.0)
+        np.testing.assert_array_equal(np.asarray(buf2)[3:], 0.0)
+    finally:
+        b.close()
+    assert b._dev_bufs == {}             # device residency released
+
+
+def test_warmup_precompiles_pad_programs():
+    """Review finding: the device-pad jit specializes per (real rows,
+    bucket) pair — warmup must pre-drive those programs so no live flush
+    pays a pad compile inside a request. Pinned structurally: after
+    register(warmup=True) the batcher holds a recycled pad buffer per
+    bucket for the serving trailing shape/dtype."""
+    registry = ModelRegistry()
+    registry.register("padwarm", _net(seed=4), batch_buckets=(2, 4),
+                      linger_ms=1.0, input_shape=(6,), warmup=True)
+    b = registry.get("padwarm").batcher
+    try:
+        key = ((6,), "float32", False)
+        assert set(b._dev_bufs) == {(key, 2), (key, 4)}
+    finally:
+        registry.close_all()
+
+
+def test_cache_hit_path_respects_closed_admission():
+    """Review finding: a closed (draining) batcher must not keep
+    answering cached inputs while rejecting uncached ones — admission
+    after close() is uniform (OverloadedError for both)."""
+    b = ContinuousBatcher(StubModel().output, name="closedhit",
+                          batch_buckets=(1,), linger_ms=0.0, cache_size=8)
+    try:
+        x = np.ones((1, 3), np.float32)
+        b.submit(x).result(5)              # cached
+        with b._cond:
+            b._closed = True      # the drain window: closed, cache still
+        assert b.cache_stats()["entries"] == 1     # populated (close()
+        with pytest.raises(OverloadedError):       # hasn't cleared yet)
+            b.submit(x)                    # hit in cache, still rejected
+        with pytest.raises(OverloadedError):
+            b.submit(x + 1.0)              # uncached: same outcome
+    finally:
+        with b._cond:
+            b._closed = False
+        b.close()
+
+
+class _TypeSpy:
+    """Records the concrete array type the forward receives."""
+
+    def __init__(self):
+        self.types = []
+
+    def output(self, x, mask=None):
+        self.types.append(type(x))
+        return np.zeros((np.asarray(x).shape[0], 2), np.float32)
+
+
+def test_forward_receives_device_resident_batch():
+    import jax
+    spy = _TypeSpy()
+    b = ContinuousBatcher(spy.output, name="devtype", batch_buckets=(2,),
+                          linger_ms=0.0, device_path=True)
+    try:
+        b.submit(np.ones((1, 3), np.float32)).result(5)
+        assert issubclass(spy.types[0], jax.Array), spy.types
+    finally:
+        b.close()
+
+    # review finding: a DIRECTLY-constructed batcher defaults to the
+    # host path — a pre-existing numpy forward must keep receiving the
+    # mutable ndarrays it always got (the registry opts framework nets
+    # into the device path; ServedModel test below)
+    spy2 = _TypeSpy()
+    b = ContinuousBatcher(spy2.output, name="hosttype", batch_buckets=(2,),
+                          linger_ms=0.0)
+    try:
+        b.submit(np.ones((1, 3), np.float32)).result(5)
+        assert spy2.types[0] is np.ndarray
+    finally:
+        b.close()
+
+    # a registry-registered framework net rides the device path (its
+    # forward is jax-backed by construction)
+    registry = ModelRegistry()
+    registry.register("devnet", _net(seed=5), batch_buckets=(2,),
+                      linger_ms=0.5, input_shape=(6,), warmup=True)
+    try:
+        assert registry.get("devnet").batcher._use_device() is True
+        registry.predict("devnet", np.ones((1, 6), np.float32))
+    finally:
+        registry.close_all()
+
+
+# ------------------------------------- data plane: submit no-copy contract
+def test_submit_does_not_copy_conforming_ndarray():
+    """ISSUE 11 satellite: a preexisting ndarray whose dtype already
+    conforms is enqueued AS-IS — the old per-submit asarray+cast copy is
+    gone. Non-conforming dtypes still convert (the one allowed copy)."""
+    b = ContinuousBatcher(StubModel().output, name="nocopy",
+                          batch_buckets=(4,), linger_ms=10_000.0)
+    try:
+        x = np.ones((1, 3), np.float32)
+        fut = b.submit(x)
+        with b._cond:
+            assert b._queue[0].x is x          # the SAME object, no copy
+        x64 = np.ones((1, 3), np.float64)
+        b.submit(x64)
+        with b._cond:
+            assert b._queue[1].x is not x64
+            assert b._queue[1].x.dtype == np.float32
+        b.flush(wait=True)
+        assert fut.result(5).shape == (1, 2)
+    finally:
+        b.close()
+
+    # bf16 precision: the conforming dtype IS bfloat16
+    import ml_dtypes
+    b = ContinuousBatcher(StubModel().output, name="nocopy16",
+                          batch_buckets=(4,), linger_ms=10_000.0,
+                          precision="bf16")
+    try:
+        xb = np.ones((1, 3), ml_dtypes.bfloat16)
+        b.submit(xb)
+        with b._cond:
+            assert b._queue[0].x is xb
+        xf = np.ones((1, 3), np.float32)
+        b.submit(xf)
+        with b._cond:
+            assert b._queue[1].x.dtype == np.dtype(ml_dtypes.bfloat16)
+    finally:
+        b.close()
+
+
 # ------------------------------------------------------- public surface
 def test_package_root_exports_with_docstrings():
     import deeplearning4j_tpu as pkg
