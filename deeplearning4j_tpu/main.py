@@ -340,6 +340,59 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def cmd_cache(args) -> int:
+    """Compile-once fleet operations (PERF.md "Compile-once fleet";
+    ``deeplearning4j_tpu/compilecache/``):
+
+    - ``--stats`` (the default): census of the cache directory — jax
+      compile-cache entries, AOT warmup artifacts, total bytes, and this
+      process's persistent hit/miss counts.
+    - ``--gc``: evict AOT artifacts whose fingerprint no longer matches
+      the RUNNING jax/backend (plus unreadable ones). DRY-RUN by default
+      — the report lists what would go; ``--apply`` deletes. jax's own
+      opaque cache entries are never touched (their key already encodes
+      the toolchain version).
+    - ``--export``: build a content-addressed AOT warmup artifact from a
+      model file: ``cache --export --model-path m.zip --input-shape 784
+      --out artifacts/`` (plus ``--buckets``/``--precision``/``--name``).
+      Load it on a cold replica with ``register(...,
+      warmup_artifact=path)``.
+
+    The directory defaults to ``--dir``, else the active
+    ``DL4J_TPU_COMPILE_CACHE_DIR``.
+    """
+    import json
+    from .compilecache import cache_stats, gc_cache
+
+    if args.export:
+        if not (args.model_path and args.input_shape and args.out):
+            raise SystemExit("cache --export needs --model-path, "
+                             "--input-shape and --out")
+        from .utils.model_guesser import ModelGuesser
+        from .serving.registry import ServedModel
+        net = ModelGuesser.load_model_guess(args.model_path)
+        shape = tuple(int(d) for d in args.input_shape.split(",")
+                      if d.strip())
+        kw = {}
+        if args.buckets:
+            kw["batch_buckets"] = tuple(int(b) for b in
+                                        args.buckets.split(",") if b.strip())
+        served = ServedModel(args.name, net, input_shape=shape,
+                             precision=args.precision, **kw)
+        try:
+            path = served.export_warmup(args.out)
+        finally:
+            served.close(drain=False)
+        print(path)
+        return 0
+    if args.gc:
+        report = gc_cache(args.dir, dry_run=not args.apply)
+        print(json.dumps(report, indent=2))
+        return 0
+    print(json.dumps(cache_stats(args.dir), indent=2))
+    return 0
+
+
 def _changed_files(root: str) -> list:
     """Repo-relative ``git diff``-touched .py files (working tree vs HEAD,
     plus untracked), absolutized — the ``lint --changed`` scope."""
@@ -477,6 +530,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metric-history ring meta (/history): sampler "
                         "interval, capacity, sample count, family names")
     m.set_defaults(fn=cmd_monitor)
+    c = sub.add_parser("cache",
+                       help="compile-once fleet: persistent XLA compile "
+                            "cache stats/GC + AOT warmup-artifact export "
+                            "(PERF.md 'Compile-once fleet')")
+    c.add_argument("--dir", default=None, metavar="PATH",
+                   help="cache directory (default: the active "
+                        "DL4J_TPU_COMPILE_CACHE_DIR)")
+    c.add_argument("--stats", action="store_true",
+                   help="directory census: entries, artifacts, bytes "
+                        "(the default action)")
+    c.add_argument("--gc", action="store_true",
+                   help="evict AOT artifacts whose fingerprint no longer "
+                        "matches the running jax/backend — DRY-RUN unless "
+                        "--apply")
+    c.add_argument("--apply", action="store_true",
+                   help="with --gc: actually delete the evictable "
+                        "artifacts")
+    c.add_argument("--export", action="store_true",
+                   help="export an AOT warmup artifact from a model file "
+                        "(needs --model-path, --input-shape, --out)")
+    c.add_argument("--model-path", default=None,
+                   help="model to export: DL4J zip, Keras .h5, or config "
+                        "JSON")
+    c.add_argument("--name", default="model",
+                   help="served-model name recorded in the artifact")
+    c.add_argument("--input-shape", default=None, metavar="D0[,D1...]",
+                   help="per-example trailing shape, e.g. 784 or 50,16")
+    c.add_argument("--buckets", default=None, metavar="B0[,B1...]",
+                   help="batch buckets (default: the serving default set)")
+    c.add_argument("--precision", choices=("f32", "bf16"), default="f32")
+    c.add_argument("--out", default=None, metavar="PATH",
+                   help="artifact output: a directory (content-addressed "
+                        "name) or an exact file path")
+    c.set_defaults(fn=cmd_cache)
     li = sub.add_parser("lint",
                         help="tpulint: AST static analysis for JAX/"
                              "concurrency/exception hazards "

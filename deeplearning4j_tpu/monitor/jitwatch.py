@@ -52,6 +52,10 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..compilecache.cache import (claim_persistent_hit as _cc_claim_hit,
+                                  enabled as _cc_enabled,
+                                  hits_count as _cc_hits_count)
+
 log = logging.getLogger(__name__)
 
 __all__ = ["monitored_jit", "MonitoredJit", "JitRegistry",
@@ -144,7 +148,7 @@ class _FnStats:
     """Per-NAME aggregate (instances of the same named fn pool here)."""
 
     __slots__ = ("name", "compiles", "compile_seconds", "variants",
-                 "last_cost", "last_delta", "storms")
+                 "last_cost", "last_delta", "storms", "persistent_hits")
 
     def __init__(self, name: str):
         self.name = name
@@ -154,6 +158,11 @@ class _FnStats:
         self.last_cost: Optional[Dict[str, float]] = None
         self.last_delta: Optional[str] = None
         self.storms = 0
+        #: compiles of this fn that the persistent on-disk cache served
+        #: (compilecache/ — still in-process jit-cache misses, but disk
+        #: reads rather than XLA work; the hit/miss split keeps the
+        #: bimodal jit_compile_seconds distribution honest)
+        self.persistent_hits = 0
 
 
 class JitRegistry:
@@ -176,12 +185,14 @@ class JitRegistry:
             return st
 
     def note_compile(self, name: str, seconds: float, sig_key: str,
-                     delta: str):
+                     delta: str, persistent_hit: bool = False):
         st = self.stats(name)
         with self._lock:
             st.compiles += 1
             st.compile_seconds += seconds
             st.last_delta = delta
+            if persistent_hit:
+                st.persistent_hits += 1
             var = st.variants.setdefault(sig_key, {"compiles": 0})
             var["compiles"] += 1
             var["compile_seconds"] = round(
@@ -270,6 +281,12 @@ class JitRegistry:
                 "compile_seconds": round(st.compile_seconds, 4),
                 "variants": len(st.variants),
                 "storms": st.storms,
+                # the hit/miss split (compilecache/): of `compiles`, how
+                # many were disk-cache hits vs true XLA compiles — a
+                # fleet warm from a shared cache dir shows compiles ==
+                # persistent_cache_hits and near-zero compile_seconds
+                "persistent_cache_hits": st.persistent_hits,
+                "true_compiles": st.compiles - st.persistent_hits,
             }
             cs_row = fn_row("jit_compile_seconds", name)
             cs = cs_row.get("summary") if cs_row else None
@@ -323,6 +340,12 @@ class MonitoredJit:
         self._seen_cache_size = 0         # compiles claimed so far
         self._compile_times = deque(maxlen=max(RETRACE_THRESHOLD, 8))
         self._handles = None
+        self._phit_handle = None      # jit_persistent_cache_hits_total —
+                                      # created on the FIRST disk hit only
+                                      # (lazy-handles principle: processes
+                                      # without the cache never materialize
+                                      # the series)
+        self._lowerings: Dict[Any, Any] = {}   # cached_lowering memo
         self._has_cache_size = hasattr(self._jit, "_cache_size")
         functools.update_wrapper(self, fn, updated=())
 
@@ -351,6 +374,12 @@ class MonitoredJit:
         calls_c.inc()
         with self._lock:
             self.calls += 1
+        # persistent-cache attribution window (compilecache/): snapshot
+        # the disk-hit counter before the call so a detected compile can
+        # be classified hit-vs-miss precisely. Both reads are lock-free
+        # (flag + GIL-atomic int) — cache on or off, the hot path takes
+        # no lock for this
+        phits0 = _cc_hits_count() if _cc_enabled() else None
         t0 = time.perf_counter()
         out = self._jit(*args, **kwargs)
         dur = time.perf_counter() - t0
@@ -376,8 +405,10 @@ class MonitoredJit:
                 self._seen_sigs.add(key)
         if compiled:
             try:
+                phit = (phits0 is not None
+                        and _cc_claim_hit(phits0))
                 self._record_compile(args, kwargs, t0, dur, sig,
-                                     compiles_c, hist)
+                                     compiles_c, hist, phit)
             except Exception as e:
                 # observability must never fail the training step it
                 # observes — degrade to the bare counters
@@ -392,11 +423,23 @@ class MonitoredJit:
             log.debug("jitwatch: signature of %s failed: %r", self.name, e)
             return None
 
-    def _record_compile(self, args, kwargs, t0, dur, sig, compiles_c, hist):
+    def _record_compile(self, args, kwargs, t0, dur, sig, compiles_c, hist,
+                        phit: bool = False):
         if sig is None:
             sig = self._safe_signature(args, kwargs)
         compiles_c.inc()
         hist.observe(dur)          # seconds (the metric name carries units)
+        if phit:
+            # this "compile" was a persistent-cache disk read, not XLA
+            # work (claimed in __call__ against the pre-call hit window)
+            if self._phit_handle is None:
+                from .registry import get_registry
+                self._phit_handle = get_registry().counter(
+                    "jit_persistent_cache_hits_total",
+                    "jit compiles served from the persistent on-disk "
+                    "compile cache (disk reads, not XLA compiles)",
+                    fn=self.name)
+            self._phit_handle.inc()
         delta = _sig_delta(self._last_sig, sig) if sig else "unknown"
         now = time.time()
         with self._lock:
@@ -419,7 +462,8 @@ class MonitoredJit:
                                      signature_delta=delta)
         sig_key = ";".join(f"{k}={v}" for k, v in sig[0]) if sig else "?"
         reg = get_jit_registry()
-        reg.note_compile(self.name, dur, sig_key, delta)
+        reg.note_compile(self.name, dur, sig_key, delta,
+                         persistent_hit=phit)
         if _COST_CAPTURE:
             _submit_cost_capture(self._jit, self.name, sig_key,
                                  args, kwargs)
@@ -430,6 +474,31 @@ class MonitoredJit:
     def lower(self, *args, **kwargs):
         """AOT lowering passthrough (``utils.profiling.step_cost`` seam)."""
         return self._jit.lower(*args, **kwargs)
+
+    def cached_lowering(self, *args, **kwargs):
+        """:meth:`lower`, memoized by abstract argument signature.
+
+        ``jax.jit.lower`` re-TRACES on every call even when the same
+        signature's executable is already compiled — fine for a one-off
+        export, wasteful for repeated cost analysis over the same shapes
+        (``utils.profiling.step_cost`` used to pay a full second trace
+        per call). Bounded memo (the signature set of any analysis
+        caller is tiny); falls through to a live lower when the
+        signature cannot be computed."""
+        sig = self._safe_signature(args, kwargs)
+        key = sig[0] if sig else None
+        if key is not None:
+            with self._lock:
+                got = self._lowerings.get(key)
+            if got is not None:
+                return got
+        lowered = self._jit.lower(*args, **kwargs)
+        if key is not None:
+            with self._lock:
+                self._lowerings[key] = lowered
+                while len(self._lowerings) > 16:   # bounded, oldest out
+                    self._lowerings.pop(next(iter(self._lowerings)))
+        return lowered
 
     @property
     def cache_miss_ratio(self) -> Optional[float]:
@@ -547,7 +616,14 @@ def _submit_cost_capture(jitted, name: str, sig_key: str, args, kwargs):
 
 def _capture_cost_task(jitted, name, sig_key, a_args, a_kwargs):
     try:
-        _capture_cost_now(jitted, name, sig_key, a_args, a_kwargs)
+        # the abstract re-lower below re-compiles the variant that just
+        # compiled — with the persistent cache on, that is a guaranteed
+        # disk hit, and it must not enter the hit-attribution pool (a
+        # FOREGROUND compile racing this worker would claim it and read
+        # as a disk hit it never had) — compilecache.suppress_events
+        from ..compilecache.cache import suppress_events
+        with suppress_events():
+            _capture_cost_now(jitted, name, sig_key, a_args, a_kwargs)
     except Exception as e:
         log.debug("jitwatch: cost capture for %s failed: %r", name, e)
 
@@ -870,15 +946,18 @@ def render_profile_text(report: Dict[str, Any]) -> str:
     lines = ["# jit (per named function)"]
     jit = report.get("jit") or {}
     if jit:
+        # disk = persistent_cache_hits (compilecache/): of `compiles`,
+        # how many were on-disk cache reads rather than true XLA work
         lines.append(f"{'fn':<28} {'calls':>8} {'compiles':>8} "
-                     f"{'miss':>7} {'compile_s':>10} {'gflops':>10} "
-                     f"{'peak_mb':>8}")
+                     f"{'disk':>6} {'miss':>7} {'compile_s':>10} "
+                     f"{'gflops':>10} {'peak_mb':>8}")
         for name, r in jit.items():
             miss = r.get("cache_miss_ratio")
             flops = r.get("flops")
             peak = r.get("peak_memory_bytes")
             lines.append(
                 f"{name:<28} {r['calls']:>8} {r['compiles']:>8} "
+                f"{r.get('persistent_cache_hits', 0):>6} "
                 f"{miss if miss is not None else '-':>7} "
                 f"{r['compile_seconds']:>10} "
                 f"{round(flops / 1e9, 3) if flops else '-':>10} "

@@ -273,6 +273,13 @@ class ShardedParameterServerClient:
                  worker_id: Optional[str] = None, tracer=None,
                  down_backoff: float = 1.0,
                  metrics: Optional[ParamServerMetrics] = None):
+        # compile-once fleet seam (compilecache/): constructing this
+        # client is what a worker does on join, REJOIN after a death, and
+        # remap after scale_to — exactly the moments its next fit would
+        # recompile. With DL4J_TPU_COMPILE_CACHE_DIR shared fleet-wide
+        # those become disk hits; no-op when the dial is unset
+        from ..compilecache.cache import maybe_enable
+        maybe_enable()
         self.addresses = parse_addresses(addresses)
         self.address = ",".join(self.addresses)
         self.staleness = int(staleness)

@@ -308,6 +308,14 @@ class ParameterServerTrainingMaster(TrainingMaster):
     def execute_training(self, net, iterator):
         import jax.numpy as jnp
 
+        # compile-once fleet (compilecache/, PERF.md): a joining or
+        # REJOINING worker is about to (re)compile its update/apply steps
+        # — with DL4J_TPU_COMPILE_CACHE_DIR exported fleet-wide, every
+        # worker after the first turns those compiles into disk hits, so
+        # elastic churn (scale_to, die/rejoin) stops paying a recompile
+        # storm. No-op when the dial is unset (the tier-1 default)
+        from ..compilecache.cache import maybe_enable
+        maybe_enable()
         client = self._ensure_client()
         self._ensure_steps(net)
         acc = self.accumulator

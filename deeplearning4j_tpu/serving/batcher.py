@@ -831,6 +831,30 @@ class ContinuousBatcher:
         self._dev_bufs[(key, padded)] = out
         return out
 
+    def compile_signatures(self, input_shape: Sequence[int]
+                           ) -> List[Tuple[Tuple[int, ...], str, bool]]:
+        """The CLOSED forward compile set this batcher will ever request
+        for a model with per-example trailing shape ``input_shape``:
+        ``[(batch_shape, dtype, masked), ...]`` — one entry per batch
+        bucket (× time bucket for sequence models), in the serving
+        dtype. This enumeration is the single source of truth shared by
+        ``ServedModel.warm()`` (pre-compile each signature live) and the
+        AOT warmup-artifact exporter (``compilecache/artifacts.py`` —
+        serialize each signature's compiled executable), so an artifact
+        can never silently cover a different set than warm() compiles."""
+        shape = tuple(int(d) for d in input_shape)
+        dt = str(np.dtype(self._in_dtype))
+        out: List[Tuple[Tuple[int, ...], str, bool]] = []
+        for n in (self._bb or [self.max_batch]):
+            if self._tb is not None and len(shape) >= 2:
+                # one variant per (batch, time) bucket, masked — mask
+                # presence is part of the jit signature (module docstring)
+                for tt in self._tb:
+                    out.append(((n, tt) + shape[1:], dt, True))
+            else:
+                out.append(((n,) + shape, dt, False))
+        return out
+
     def warm_pads(self, trailing: Sequence[int], masked: bool = False):
         """Pre-compile the device-pad programs for every (real rows,
         bucket) pair with this trailing shape — warm()'s cold-start-

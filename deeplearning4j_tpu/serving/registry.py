@@ -104,7 +104,14 @@ class ServedModel:
                  in_flight: Optional[threading.Semaphore] = None,
                  precision: str = "f32",
                  cache_size: Optional[int] = None,
-                 device_path: Optional[bool] = None):
+                 device_path: Optional[bool] = None,
+                 warmup_artifact: Optional[str] = None):
+        # the compile-once fleet dial (compilecache/): a serving replica
+        # about to pay warmup compiles is exactly the process that wants
+        # the shared persistent cache — a no-op unless
+        # DL4J_TPU_COMPILE_CACHE_DIR is exported (tier-1 default: off)
+        from ..compilecache.cache import maybe_enable
+        maybe_enable()
         if hasattr(model, "conf") and not hasattr(model, "output"):
             model = model.init()          # a ZooModel, not yet built
         if not callable(getattr(model, "output", None)):
@@ -136,6 +143,10 @@ class ServedModel:
             # (and hand an in-place-mutating forward an immutable
             # jax.Array) — they opt in with device_path=True
             device_path = hasattr(model, "impls")
+        #: AOT forward table (compilecache/artifacts.py): signature key →
+        #: deserialized executable. Populated only by a successful
+        #: ``warm(artifact=)``; empty = every forward rides model.output
+        self._aot: Dict[Any, Any] = {}
         self.batcher = ContinuousBatcher(
             self._forward, name=name,
             batch_buckets=batch_buckets, time_buckets=time_buckets,
@@ -145,10 +156,12 @@ class ServedModel:
             metrics_label=name, qps_window_s=qps_window_s,
             precision=precision, cache_size=cache_size,
             device_path=device_path)
-        if warmup:
+        if warmup_artifact is not None:
+            self.warm(artifact=warmup_artifact)
+        elif warmup:
             self.warm()
 
-    def warm(self):
+    def warm(self, artifact: Optional[str] = None):
         """Pre-compile every bucket signature (synchronously, on the
         registering thread): after this, request-size churn NEVER
         compiles — the whole closed signature set is already in the jit
@@ -156,40 +169,90 @@ class ServedModel:
         first unlucky requests. Requires ``input_shape`` (the per-example
         trailing shape, e.g. ``(784,)`` or ``(T, features)``).
 
-        Note the jitwatch interplay: warming ``>= DL4J_TPU_RETRACE_
-        THRESHOLD`` (default 3) buckets back-to-back is, to the
+        ``artifact=`` (compile-once fleet, PERF.md): load an AOT warmup
+        artifact instead — the closed compile set deserialized from disk,
+        ZERO compiles. The artifact's fingerprint (jax+backend version),
+        topology hash, precision and bucket set must all match; ANY
+        mismatch or corruption falls back LOUDLY to the live warmup below
+        (``compile_cache_miss`` flight event naming the reason), never a
+        crash. A successful load adopts the artifact's ``input_shape``
+        when none was configured.
+
+        Note the jitwatch interplay (live path): warming ``>= DL4J_TPU_
+        RETRACE_THRESHOLD`` (default 3) buckets back-to-back is, to the
         per-instance storm detector, indistinguishable from churn — it
         logs one storm during warmup. Size the bucket set below the
         threshold, or raise the threshold for serving processes; steady
-        state is storm-free either way (docs/SERVING.md)."""
+        state is storm-free either way (docs/SERVING.md). With the
+        persistent compile cache enabled (``DL4J_TPU_COMPILE_CACHE_DIR``)
+        the live warmup's compiles become disk hits on every process
+        after the first — watch ``jit_persistent_cache_hits_total``."""
+        b = self.batcher
+        fallback = False
+        if artifact is not None:
+            from ..compilecache.artifacts import try_install
+            if try_install(self, artifact):
+                self._warm_pads()
+                return self
+            # loud fallback: the compile_cache_miss flight event already
+            # landed — pay the live compiles below instead
+            fallback = True
         if self.input_shape is None:
+            if fallback:
+                # a loader-only replica (no input_shape configured — the
+                # artifact was going to supply it) whose artifact was
+                # rejected CANNOT live-warm, and the never-a-crash
+                # contract of warm(artifact=) holds: start cold, let the
+                # first requests pay the compiles the artifact would
+                # have covered (the miss flight event already names why)
+                import logging
+                logging.getLogger(__name__).warning(
+                    "model %r: rejected warmup artifact and no "
+                    "input_shape configured — starting COLD (first "
+                    "requests will compile)", self.name)
+                return self
             raise ValueError(
                 f"model {self.name!r}: warmup needs input_shape= (the "
                 f"per-example trailing shape) at registration")
-        b = self.batcher
         # warm in the SERVING dtype: precision is part of the jit
         # signature, so an f32 warmup of a bf16 model would pre-compile
-        # the wrong variants and the first real requests would retrace
+        # the wrong variants and the first real requests would retrace.
+        # compile_signatures is the same enumeration the AOT exporter
+        # serializes — warm() and artifacts cover the identical set
         dt = serving_dtype(self.precision)
-        shapes = [(n,) + self.input_shape for n in (b._bb or [b.max_batch])]
-        for shape in shapes:
-            if b._tb is not None and len(shape) >= 3:
-                # one variant per (batch, time) bucket, through the same
-                # masked path real sequence requests take
-                for tt in b._tb:
-                    xs = np.zeros((shape[0], tt) + shape[2:], dt)
-                    self._forward(xs, np.ones((shape[0], tt), np.float32))
+        for shape, _, masked in b.compile_signatures(self.input_shape):
+            xs = np.zeros(shape, dt)
+            if masked:
+                # through the same masked path real sequence requests take
+                self._forward(xs, np.ones((shape[0], shape[1]), np.float32))
             else:
-                self._forward(np.zeros(shape, dt))
+                self._forward(xs)
+        self._warm_pads()
+        return self
+
+    def _warm_pads(self):
         # data-plane warm-in (ISSUE 11): the device pad program
         # specializes per (real rows, bucket) pair — pre-compile those
-        # too, so no live flush ever pays a pad compile
+        # too, so no live flush ever pays a pad compile. Pad programs are
+        # NOT part of the AOT artifact (trivial compiles; the persistent
+        # cache covers them when enabled), so both warm paths run this
+        b = self.batcher
+        if self.input_shape is None:
+            return
         if b._tb is not None and len(self.input_shape) >= 2:
             for tt in b._tb:
                 b.warm_pads((tt,) + self.input_shape[1:], masked=True)
         else:
             b.warm_pads(self.input_shape)
-        return self
+
+    def export_warmup(self, out: str) -> str:
+        """Serialize this model's closed compile set into a content-
+        addressed AOT warmup artifact (``compilecache/artifacts.py``) at
+        ``out`` (directory → content-addressed name, else exact path).
+        Returns the written path; load it on a cold replica with
+        ``warm(artifact=path)`` / ``register(..., warmup_artifact=)``."""
+        from ..compilecache.artifacts import export_warmup_artifact
+        return export_warmup_artifact(self, out)
 
     def _forward(self, xs, mask=None):
         # the scheduler thread is the only caller, so the model's lazy
@@ -198,6 +261,17 @@ class ServedModel:
         # slices the padding off ON DEVICE and does the one host
         # transfer itself (the old np.asarray here was the d2h round-trip
         # the ISSUE-11 data-plane pass removed)
+        if self._aot:
+            fn = self._aot.get((tuple(int(d) for d in xs.shape),
+                                str(xs.dtype), mask is not None))
+            if fn is not None:
+                # AOT executable from warm(artifact=): the same XLA
+                # program a live compile would produce, run against the
+                # CURRENT params/states — bit-identical results, zero
+                # compiles. Signatures outside the artifact (impossible
+                # for bucket-conforming traffic — the batcher pads to
+                # the same closed set) fall through to the live path
+                return fn(self.model.params, self.model.states, xs, mask)
         return self.model.output(xs) if mask is None \
             else self.model.output(xs, mask=mask)
 
@@ -226,6 +300,7 @@ class ServedModel:
             "precision": self.precision,
             "cache_size": b.cache_size,
             "cache": b.cache_stats(),
+            "aot_signatures": len(self._aot),
         }
 
     def close(self, drain: bool = True, timeout: float = 30.0):

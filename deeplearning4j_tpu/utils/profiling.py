@@ -144,10 +144,27 @@ class StepTimerListener(TrainingListener):
                 "n": float(arr.size)}
 
 
+#: attribute name for per-net step_cost state: ONE jitwatch wrapper per
+#: net (the wrapper's cached_lowering memoizes the trace by abstract
+#: signature) plus the finished cost dicts per shape key. Stored ON the
+#: net object — its lifetime IS the net's (a module-level
+#: WeakKeyDictionary would never evict here: the wrapper's step closure
+#: captures the net, so the value would strongly reference its own key).
+#: Repeated step_cost(net, ds) with the same shapes therefore pays ZERO
+#: re-trace and ZERO re-compile — the pre-fix code built a fresh wrapper
+#: every call, so even an already-compiled step paid a full second trace
+#: per cost query.
+_STEP_COST_ATTR = "_step_cost_state"
+
+
 def step_cost(net, ds) -> Dict[str, Any]:
     """XLA cost analysis of the container's compiled train step on this
     DataSet's shapes: {'flops', 'bytes_accessed', ...} plus derived
-    per-example numbers. Works for MultiLayerNetwork and ComputationGraph."""
+    per-example numbers. Works for MultiLayerNetwork and ComputationGraph.
+    Memoized per (net, shapes) — see ``_STEP_COST_ATTR``; with the
+    persistent compile cache enabled (``DL4J_TPU_COMPILE_CACHE_DIR``,
+    ``compilecache/``) even the first call's ``.compile()`` rides the
+    disk cache."""
     import jax
     import jax.numpy as jnp
 
@@ -166,15 +183,30 @@ def step_cost(net, ds) -> Dict[str, Any]:
         labels = tuple(jnp.asarray(x) for x in ds.labels)
         batch = int(ds.features[0].shape[0])
 
-    raw = net._raw_step(False)  # both containers take with_rnn_state
-    from ..monitor.jitwatch import monitored_jit
-    lowered = monitored_jit(raw, name="profiling/step_cost").lower(
-        net.params, net.states, net.updater_state,
-        jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
-        feats, labels, None, None)
-    from ..compat import cost_analysis
+    state = getattr(net, _STEP_COST_ATTR, None)
+    if state is None:
+        from ..monitor.jitwatch import monitored_jit
+        # both containers take with_rnn_state
+        state = {"wrapper": monitored_jit(net._raw_step(False),
+                                          name="profiling/step_cost"),
+                 "costs": {}}
+        setattr(net, _STEP_COST_ATTR, state)
 
-    ca = cost_analysis(lowered.compile())
+    def leaf_key(tree):
+        return tuple((tuple(x.shape), str(x.dtype))
+                     for x in jax.tree_util.tree_leaves(tree))
+
+    key = (leaf_key(feats), leaf_key(labels))
+    cached = state["costs"].get(key)
+    if cached is None:
+        lowered = state["wrapper"].cached_lowering(
+            net.params, net.states, net.updater_state,
+            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+            feats, labels, None, None)
+        from ..compat import cost_analysis
+        cached = state["costs"][key] = dict(cost_analysis(
+            lowered.compile()))
+    ca = cached
     flops = float(ca.get("flops", 0.0))
     by = float(ca.get("bytes accessed", 0.0))
     return {"flops": flops, "bytes_accessed": by, "batch": batch,

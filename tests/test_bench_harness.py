@@ -364,7 +364,8 @@ def test_serving_latency_bench_reports_tail_at_two_qps_points(bench):
     latches a ``variants`` sub-block comparing {f32-nocache, bf16,
     bf16+cache (Zipfian mix)} at the SAME offered-QPS points."""
     value = bench.bench_serving_latency(qps_points=(30.0, 90.0),
-                                        duration_s=1.0, pool_workers=16)
+                                        duration_s=1.0, pool_workers=16,
+                                        cold_start=False)
     stats = bench.SERVING_STATS
     assert value > 0
     assert [p["offered_qps"] for p in stats["points"]] == [30.0, 90.0]
@@ -435,3 +436,47 @@ def test_input_pipeline_bench_hides_etl(bench):
     assert stats["etl_reduction"] >= 5.0
     assert 0.0 < stats["overlap_ratio"] <= 1.0
     assert stats["prefetch_images_per_sec"] > stats["sync_images_per_sec"]
+
+
+def test_cold_start_block_cold_vs_warm_cache_dir(bench):
+    """ISSUE 12: the serving bench's cold-start mode runs the warmup in
+    a child process twice against one shared compile-cache dir and
+    latches {cold_compile_s, warm_compile_s, speedup} — the block the
+    --one record embeds as ``cold_start``. Warm must not exceed cold
+    (its compiles are disk reads), and the warm child's persistent-hit
+    count equals its compile count (every warmup compile was a hit)."""
+    stats = bench._measure_cold_start(n_in=32, hidden=96, classes=10,
+                                      buckets=(1, 2, 4))
+    assert stats is bench.COLD_START_STATS       # the --one latch
+    for key in ("cold_compile_s", "warm_compile_s", "speedup",
+                "cold_persistent_hits", "warm_persistent_hits",
+                "compiles", "buckets"):
+        assert key in stats, key
+    assert stats["buckets"] == [1, 2, 4]
+    assert stats["compiles"] == 3                # one per bucket
+    assert stats["cold_persistent_hits"] == 0    # fresh dir: all misses
+    assert stats["warm_persistent_hits"] == 3    # all disk hits
+    assert stats["cold_compile_s"] > 0
+    assert stats["warm_compile_s"] <= stats["cold_compile_s"]
+    assert stats["speedup"] >= 1.0
+
+
+def test_cold_start_child_hang_costs_only_the_garnish(bench, monkeypatch):
+    """A hung or unspawnable cold-start child must return None (the
+    --one record simply omits the cold_start block) — never raise into
+    the serving sweep that already measured its points."""
+    import subprocess as sp
+
+    def hang(*a, **k):
+        raise sp.TimeoutExpired(["python"], 600)
+
+    monkeypatch.setattr(sp, "run", hang)
+    bench.COLD_START_STATS.clear()
+    assert bench._measure_cold_start() is None
+    assert bench.COLD_START_STATS == {}
+
+    def unspawnable(*a, **k):
+        raise OSError("no fds left")
+
+    monkeypatch.setattr(sp, "run", unspawnable)
+    assert bench._measure_cold_start() is None

@@ -373,3 +373,40 @@ def test_lint_subcommand_smoke(tmp_path, capsys):
     # rule selection: a THR-only run ignores the EXC001 violation
     assert main(["lint", str(bad), "--select", "THR001,THR002"]) == 0
     capsys.readouterr()
+
+
+def test_cache_subcommand_smoke(tmp_path, capsys):
+    """`cache` (PERF.md "Compile-once fleet"): --stats census, --export
+    builds a content-addressed AOT artifact from a model file, --gc
+    dry-runs by default and only deletes with --apply."""
+    model = tmp_path / "m.zip"
+    _write_model(model, n_in=16, n_hidden=8, n_out=4)
+
+    # empty-dir stats: JSON shape stable, exit 0
+    assert main(["cache", "--stats", "--dir", str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["dir"] == str(tmp_path) and doc["artifacts"] == 0
+    assert "process" in doc and "bytes" in doc
+
+    # export: artifact lands content-addressed in the dir
+    assert main(["cache", "--export", "--model-path", str(model),
+                 "--input-shape", "16", "--buckets", "1,2",
+                 "--out", str(tmp_path)]) == 0
+    path = capsys.readouterr().out.strip()
+    assert path.endswith(".dl4jaot") and os.path.exists(path)
+    assert main(["cache", "--stats", "--dir", str(tmp_path)]) == 0
+    assert json.loads(capsys.readouterr().out)["artifacts"] == 1
+
+    # gc: the fresh artifact survives a dry-run AND an --apply
+    assert main(["cache", "--gc", "--dir", str(tmp_path)]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["dry_run"] is True and rep["kept"] == 1
+    assert rep["evicted"] == []
+    assert main(["cache", "--gc", "--dir", str(tmp_path),
+                 "--apply"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["dry_run"] is False and os.path.exists(path)
+
+    # --export without its required args fails loudly
+    with pytest.raises(SystemExit):
+        main(["cache", "--export", "--model-path", str(model)])
