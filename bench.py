@@ -802,6 +802,154 @@ def bench_paramserver(steps=32, n_in=1024, hidden=1024, classes=10,
     return sps_delta
 
 
+PARALLEL_MEMORY_STATS = {}
+
+#: child source for the too-few-devices fallback: re-run the grid on a
+#: virtual 8-device CPU mesh in a fresh interpreter (set_cpu_devices must
+#: beat backend init — impossible in the already-initialized parent).
+#: Same pattern as _COLD_START_SRC. argv: steps n_in hidden classes batch
+#: model_extent bench_path
+_PM_CHILD_SRC = """
+import importlib.util, json, sys
+sys.path.insert(0, __import__('os').path.dirname(sys.argv[7]))
+from deeplearning4j_tpu.compat import set_cpu_devices
+# size the virtual mesh from the requested model extent, or the child
+# would re-fail the parent's device check and recurse another child
+set_cpu_devices(max(8, 2 * int(sys.argv[6])))
+spec = importlib.util.spec_from_file_location('bench_pm_child', sys.argv[7])
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+mod.bench_parallel_memory(*[int(a) for a in sys.argv[1:7]])
+print(json.dumps(mod.PARALLEL_MEMORY_STATS))
+"""
+
+
+def bench_parallel_memory(steps=8, n_in=256, hidden=1024, classes=16,
+                          batch=64, model_extent=2):
+    """Unified-mesh memory/throughput grid (parallel/mesh.py substrate):
+    the same Adam fit under {replicated, ws (ZeRO-1 optimizer-state
+    sharding), fsdp (ZeRO-3 sharded storage)} × {1-D data mesh, 2-D
+    data × model mesh with megatron TP rules}. Latches per cell
+    {steps_per_sec, state_bytes_per_device (EXACT: params+updater bytes
+    resident on device 0 — the quantity ZeRO divides), bytes_in_use /
+    peak_bytes (backend memory stats; None on statless backends like the
+    CPU harness — peak is process-cumulative, read it only for the cell
+    that interests you in a dedicated run)} into
+    ``PARALLEL_MEMORY_STATS`` for the ``--one`` record's
+    ``parallel_memory`` block. Headline value: fsdp-on-2-D steps/sec —
+    the composed topology the substrate exists for."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, DataSet,
+                                    ListDataSetIterator, Adam)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.monitor.jitwatch import sample_device_memory
+    import jax
+
+    if len(jax.devices()) < 2 * model_extent:
+        # single-chip harness (TPU v5 lite0 / plain CPU): the grid needs a
+        # real multi-device mesh, so run it on a virtual 8-device CPU mesh
+        # in a child interpreter (set_cpu_devices must beat backend init)
+        # and latch the child's stats, marked as such
+        import subprocess
+        argv = [str(int(v)) for v in (steps, n_in, hidden, classes, batch,
+                                      model_extent)]
+        p = subprocess.run(
+            [sys.executable, "-c", _PM_CHILD_SRC] + argv
+            + [os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1200,
+            env={k: v for k, v in os.environ.items()
+                 if k != "JAX_PLATFORMS"} | {"JAX_PLATFORMS": "cpu"})
+        _hb()
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"parallel_memory CPU-mesh child failed rc={p.returncode}: "
+                f"{p.stderr.strip()[-500:]}")
+        stats = json.loads(p.stdout.strip().splitlines()[-1])
+        stats["virtual_cpu_mesh"] = True
+        PARALLEL_MEMORY_STATS.update(stats)
+        return stats["grid"]["fsdp_2d"]["steps_per_sec"]
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(batch, n_in)).astype(np.float32),
+                       np.eye(classes, dtype=np.float32)[
+                           rng.integers(0, classes, batch)])
+               for _ in range(steps)]
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(learning_rate=1e-3)).activation("tanh").list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden))
+                .layer(DenseLayer(n_in=hidden, n_out=hidden))
+                .layer(OutputLayer(n_in=hidden, n_out=classes,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def state_bytes_dev0(net):
+        """Exact params+updater bytes resident on device 0 (a replicated
+        leaf costs its full size per device; a sharded leaf 1/N)."""
+        total = 0
+        for leaf in (jax.tree_util.tree_leaves(net.params)
+                     + jax.tree_util.tree_leaves(net.updater_state)):
+            shards = getattr(leaf, "addressable_shards", None)
+            total += (shards[0].data.nbytes if shards
+                      else getattr(leaf, "nbytes", 0))
+        return total
+
+    def mem_gauges():
+        mem = sample_device_memory().get("devices") or {}
+        in_use = [r.get("bytes_in_use") for r in mem.values()
+                  if r.get("bytes_in_use") is not None]
+        peak = [r.get("peak_bytes_in_use") for r in mem.values()
+                if r.get("peak_bytes_in_use") is not None]
+        return (max(in_use) if in_use else None,
+                max(peak) if peak else None)
+
+    def run(style, two_d):
+        net = build_net()
+        b = ParallelWrapper.Builder(net)
+        if two_d:
+            b = b.tensor_parallel(model_extent)
+        if style == "ws":
+            b = b.weight_update_sharding()
+        elif style == "fsdp":
+            b = b.fsdp()
+        pw = b.build()
+        it = ListDataSetIterator(batches)
+        pw.fit(it, epochs=1)                 # compile + placement, un-timed
+        it0 = pw.iteration_count
+        t0 = time.perf_counter()
+        pw.fit(it, epochs=2)
+        _sync(net.score_)
+        dt = time.perf_counter() - t0
+        n_steps = pw.iteration_count - it0
+        in_use, peak = mem_gauges()
+        _hb()
+        return {"steps_per_sec": round(n_steps / dt, 2),
+                "state_bytes_per_device": int(state_bytes_dev0(net)),
+                "bytes_in_use": in_use, "peak_bytes": peak}
+
+    grid = {}
+    for style in ("replicated", "ws", "fsdp"):
+        for two_d in (False, True):
+            key = f"{style}_{'2d' if two_d else '1d'}"
+            grid[key] = run(style, two_d)
+    n_params = (n_in * hidden + hidden + hidden * hidden + hidden
+                + hidden * classes + classes)
+    PARALLEL_MEMORY_STATS.update({
+        "steps": steps, "params": n_params, "model_extent": model_extent,
+        "devices": len(jax.devices()), "grid": grid,
+        "virtual_cpu_mesh": False,
+        # the memory win as one number: ZeRO-3 state bytes vs replicated,
+        # on the composed 2-D mesh
+        "fsdp_vs_replicated_state_ratio": round(
+            grid["fsdp_2d"]["state_bytes_per_device"]
+            / max(grid["replicated_2d"]["state_bytes_per_device"], 1), 4),
+    })
+    return grid["fsdp_2d"]["steps_per_sec"]
+
+
 def bench_word2vec(n_sentences=20000, sent_len=40, vocab_target=5000):
     """Word2Vec skip-gram (HS) words/sec through the jitted kernels.
     800k-word corpus so steady-state batch throughput dominates the one-time
@@ -937,6 +1085,7 @@ ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
     ("input_pipeline_images_per_sec", "images/sec", bench_input_pipeline),
     ("paramserver_steps_per_sec", "steps/sec", bench_paramserver),
+    ("parallel_memory", "steps/sec", bench_parallel_memory),
     ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
@@ -1397,6 +1546,9 @@ def main():
                           # 1-server-dense vs N-server-delta comparison —
                           # populated only by the paramserver config
                           "paramserver": PARAMSERVER_STATS or None,
+                          # {replicated, ws, fsdp} × {1-D, 2-D} mesh grid —
+                          # populated only by the parallel_memory config
+                          "parallel_memory": PARALLEL_MEMORY_STATS or None,
                           # offered-QPS sweep (p50/p99/reject/batch-size) —
                           # populated only by the serving_latency config
                           "serving": SERVING_STATS or None,

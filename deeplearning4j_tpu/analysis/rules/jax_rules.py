@@ -431,3 +431,103 @@ class BareJit(Rule):
                 "bare jax.jit — route it through monitor.jitwatch."
                 "monitored_jit(name=\"area/fn\") so compiles are counted, "
                 "timed, traced, cost-profiled, and retrace-storm-watched")
+
+
+@register
+class RawMeshConstruction(Rule):
+    id = "JAX004"
+    title = "raw Mesh/shard_map construction outside the parallel substrate"
+    rationale = (
+        "parallel/mesh.py is the ONE sanctioned mesh construction site: a "
+        "MeshSpec validates axis names, auto-factorizes extents over the "
+        "available devices (a raw Mesh(...) reshape silently builds the "
+        "degenerate [n, 1, ...] topology or crashes on a non-dividing "
+        "shape), stays multi-process consistent, and registers the "
+        "topology on GET /profile's mesh block. A raw "
+        "jax.sharding.Mesh(...) or shard_map(...) call outside "
+        "parallel/ bypasses all of that — the fit runs on a topology no "
+        "operator can see and no validation ever checked. Route meshes "
+        "through parallel.mesh (MeshSpec/make_mesh) and shard_map-style "
+        "steps through the parallel/ step factories. Exempt: tests/, the "
+        "parallel/ substrate package itself, and compat.py (the "
+        "version-shim that DEFINES the sanctioned shard_map wrapper). "
+        "Ratchet-only via analysis/baseline.json for sites that "
+        "genuinely cannot migrate.")
+
+    def check(self, tree, lines, path) -> Iterator:
+        p = path.replace("\\", "/")
+        parts = p.split("/")
+        if "tests" in parts or "parallel" in parts \
+                or p.endswith("compat.py"):
+            return
+        # names bound to the constructors by import: `from jax.sharding
+        # import Mesh [as m]`, `from jax.experimental.shard_map import
+        # shard_map`, `from jax import shard_map`, and the repo idiom
+        # `from ..compat import shard_map`
+        mesh_names: Set[str] = set()
+        sm_names: Set[str] = set()
+        jax_mods: Set[str] = {"jax"}
+        # module aliases whose .shard_map attribute IS the constructor
+        # (`from jax.experimental import shard_map as smod`,
+        # `import jax.experimental.shard_map as sm`, compat imports) — an
+        # unrelated object's own .shard_map method must NOT flag
+        sm_mods: Set[str] = {"compat"}
+        # aliases of the jax.sharding MODULE itself (`import jax.sharding
+        # as jsh`, `from jax import sharding [as x]`) — jsh.Mesh(...) is
+        # just as raw as jax.sharding.Mesh(...)
+        sharding_mods: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if a.name == "Mesh" and mod.startswith("jax"):
+                        mesh_names.add(bound)
+                    elif a.name == "shard_map":
+                        if mod.startswith("jax") \
+                                or mod.split(".")[-1] == "compat":
+                            sm_names.add(bound)
+                        if mod == "jax.experimental":
+                            sm_mods.add(bound)   # module, not function
+                    elif a.name == "compat":
+                        sm_mods.add(bound)
+                    elif a.name == "sharding" and mod == "jax":
+                        sharding_mods.add(bound)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" and a.asname:
+                        jax_mods.add(a.asname)
+                    elif a.name == "jax.experimental.shard_map":
+                        sm_mods.add(a.asname or "shard_map")
+                    elif a.name == "jax.sharding" and a.asname:
+                        sharding_mods.add(a.asname)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Name):
+                if f.id in mesh_names:
+                    hit = "Mesh"
+                elif f.id in sm_names:
+                    hit = "shard_map"
+            elif isinstance(f, ast.Attribute):
+                root = terminal_name(f.value)
+                if f.attr == "Mesh" and (
+                        root in jax_mods
+                        or (isinstance(f.value, ast.Name)
+                            and f.value.id in sharding_mods)
+                        or (isinstance(f.value, ast.Attribute)
+                            and f.value.attr == "sharding")):
+                    hit = "Mesh"          # jax.sharding.Mesh / jsh.Mesh
+                elif f.attr == "shard_map" and (
+                        root in jax_mods or root in sm_mods):
+                    hit = "shard_map"     # compat.shard_map / jax.shard_map
+            if hit is None:
+                continue
+            yield self.finding(
+                node, lines, path,
+                f"raw {hit}(...) outside the parallel/ substrate — build "
+                f"meshes with parallel.mesh.MeshSpec/make_mesh (validated, "
+                f"auto-factorized, visible on /profile) and mapped steps "
+                f"through the parallel/ step factories")

@@ -768,9 +768,28 @@ def profile_report() -> Dict[str, Any]:
         },
         "pipeline": _pipeline_block(snap),
         "serving": _serving_block(snap),
+        "mesh": _mesh_block(),
         "locks": _locks_block(),
         "trends": _trends_block(),
     }
+
+
+def _mesh_block() -> Dict[str, Any]:
+    """Active parallel topologies (parallel/mesh.py registry): per style
+    the mesh axis names/extents, device count, steps built, and
+    sharded-vs-replicated model-state leaf counts — what topology is this
+    process's training/inference actually running on. Read through
+    sys.modules so a process that never imported the parallel substrate
+    pays nothing (and reports an honest empty block)."""
+    import sys as _sys
+    mod = _sys.modules.get("deeplearning4j_tpu.parallel.mesh")
+    if mod is None:
+        return {}
+    try:
+        return mod.mesh_block()
+    except Exception as e:      # pragma: no cover - defensive scrape path
+        log.debug("jitwatch: mesh block failed: %r", e)
+        return {}
 
 
 #: the trends block's comparison horizons (seconds): "now vs 1m vs 5m"
@@ -1032,6 +1051,20 @@ def render_profile_text(report: Dict[str, Any]) -> str:
                 f"{rate if rate is not None else '-':>6} "
                 f"{(r.get('pad_ms') or {}).get('mean', '-'):>7} "
                 f"{(r.get('transfer_ms') or {}).get('mean', '-'):>8}")
+    meshes = report.get("mesh") or {}
+    if meshes:
+        lines.append("")
+        lines.append("# mesh (active parallel topologies)")
+        lines.append(f"{'style':<28} {'axes':<28} {'devs':>5} "
+                     f"{'steps':>6} {'sharded':>8} {'repl':>6} {'zero':>5}")
+        for style, r in meshes.items():
+            axes = "×".join(f"{a}={n}" for a, n in
+                            (r.get("axes") or {}).items()) or "-"
+            lines.append(
+                f"{style:<28} {axes:<28} {r.get('devices', 0):>5} "
+                f"{r.get('steps', 0):>6} {r.get('sharded_leaves', 0):>8} "
+                f"{r.get('replicated_leaves', 0):>6} "
+                f"{'yes' if r.get('zero') else 'no':>5}")
     locks = report.get("locks") or {}
     if locks:
         lines.append("")

@@ -1,10 +1,15 @@
 """Parallelism & distribution (reference ``deeplearning4j-scaleout/``,
-SURVEY.md §2.4): mesh/sharding substrate, ParallelWrapper (sync + local-SGD
-data parallelism), ParallelInference, gradient accumulation/encoding,
-TrainingMaster SPI with the collective masters, plus TPU-first extensions
-completing the mesh-axis family: tensor (``model``), sequence
-(ring/Ulysses), pipeline (GPipe over ``pipe``) and expert (MoE over
-``expert``) parallelism."""
+SURVEY.md §2.4): the unified mesh substrate (``mesh.py`` — MeshSpec
+validation/auto-factorization, partition-spec machinery, the /profile
+topology registry), ParallelWrapper (sync + local-SGD data parallelism,
+DP × TP composition via ``.tensor_parallel()``, ZeRO via
+``.weight_update_sharding()``/``.fsdp()`` on any mesh's data axis),
+ParallelInference, gradient accumulation/encoding, TrainingMaster SPI
+with the collective masters, plus TPU-first extensions completing the
+mesh-axis family: tensor (``model``), sequence (ring/Ulysses), pipeline
+(GPipe over ``pipe``) and expert (MoE over ``expert``) parallelism.
+See docs/PARALLELISM.md "Unified mesh substrate"."""
+from .mesh import (MeshSpec, mesh_block, require_axes, zero_update_specs)
 from .sharding import (DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS, make_mesh,
                        replicated, batch_sharded, shard_batch,
                        data_parallel_step)
@@ -35,6 +40,7 @@ from .pipeline import (PIPELINE_AXIS, GPipe, spmd_pipeline,
 from .expert import EXPERT_AXIS, expert_rules, expert_parallel_step
 
 __all__ = [
+    "MeshSpec", "mesh_block", "require_axes", "zero_update_specs",
     "DATA_AXIS", "MODEL_AXIS", "SEQUENCE_AXIS", "make_mesh", "replicated",
     "batch_sharded", "shard_batch", "data_parallel_step",
     "ParallelWrapper", "TrainingMode", "ParallelInference", "InferenceMode",

@@ -17,9 +17,8 @@ from typing import Dict, Optional
 
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import EXPERT_AXIS
 from .tensor import tensor_parallel_step
-
-EXPERT_AXIS = "expert"
 
 
 def expert_rules(net, axis: str = EXPERT_AXIS) -> Dict[str, P]:

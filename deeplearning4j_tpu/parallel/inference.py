@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .mesh import record_step
 from .sharding import DATA_AXIS, make_mesh, replicated, batch_sharded
 from ..monitor.jitwatch import monitored_jit
 
@@ -118,6 +119,7 @@ class ParallelInference:
                     return y
                 repl = replicated(self.mesh)
                 data = batch_sharded(self.mesh)
+                record_step("inference/fwd", self.mesh, {"batch": data})
                 self._jit_fwd = monitored_jit(
                     fwd, name="inference/fwd",
                     in_shardings=(repl, repl, data), out_shardings=data)

@@ -1,0 +1,371 @@
+"""Unified 2-D mesh substrate: ONE place that builds, validates and
+describes device meshes for every parallelism style in ``parallel/``.
+
+Before this module, each style constructed its own mesh logic (wrapper,
+tensor, pipeline, sequence each validated axes ad hoc) and the ZeRO paths
+(``weight_update_sharding``/``fsdp``, after PAPERS.md's "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training",
+arXiv:2004.13336) only understood a 1-D data mesh. The substrate makes the
+composition real: a :class:`MeshSpec` names the axes, auto-factorizes the
+extents over the available devices, and validates loudly; the partition-spec
+helpers here (:func:`rule_shardings`, :func:`mirror_updater_shardings`,
+:func:`zero_update_specs`) compose tensor-parallel rules over ``model`` with
+ZeRO sharding over the ``data`` axis *of whatever mesh they are given* —
+reduce-scatter grads along ``data``, update the local shard, all-gather
+weights — so DP × TP/PP stack on one 2-D mesh instead of excluding each
+other.
+
+Axis conventions (canonical order — earlier axes get the larger
+auto-factorized extents):
+  - ``data``     — batch (data parallelism; ParallelWrapper drives it)
+  - ``model``    — tensor parallelism (Megatron-style param rules)
+  - ``pipe``     — pipeline stages (GPipe schedule, ``parallel/pipeline.py``)
+  - ``sequence`` — sequence/context parallelism (ring attention)
+  - ``expert``   — MoE expert sharding (``parallel/expert.py``)
+
+Multi-process: ``jax.devices()`` returns the same globally-ordered device
+list on every process, so a :class:`MeshSpec` resolved from defaults is
+identical fleet-wide — the property every collective schedule depends on.
+The ``data`` axis should span processes (each process feeds its addressable
+share of the global batch, ``sharding.shard_batch``); model-family axes
+are cheapest within a process (ICI, not DCN).
+
+Every step factory in ``parallel/`` reports its topology here
+(:func:`record_step`), so ``GET /profile`` carries a ``mesh`` block —
+axis names, extents, per-style active steps, sharded-vs-replicated leaf
+counts — and an operator can see what topology a fit is actually running
+on (docs/PARALLELISM.md "Unified mesh substrate").
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPELINE_AXIS = "pipe"
+SEQUENCE_AXIS = "sequence"
+EXPERT_AXIS = "expert"
+
+#: canonical axis order — MeshSpec sorts nothing, but docs and the
+#: auto-factorizer's "earlier axes get bigger extents" rule follow it
+CANONICAL_AXES = (DATA_AXIS, MODEL_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS,
+                  EXPERT_AXIS)
+
+
+def _prime_factors(n: int):
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+def auto_factor(n: int, k: int):
+    """Factorize ``n`` devices into ``k`` near-balanced extents,
+    deterministically: prime factors (largest first) go to the currently
+    smallest extent, then the extents are ordered largest-first — so
+    earlier axes get the larger extents (8 over 2 axes → (4, 2); 12 →
+    (4, 3); 8 over 3 → (2, 2, 2))."""
+    ext = [1] * k
+    for f in _prime_factors(n):
+        i = min(range(k), key=lambda j: (ext[j], j))
+        ext[i] *= f
+    return tuple(sorted(ext, reverse=True))
+
+
+class MeshSpec:
+    """Declarative mesh: named axes with fixed or auto (``None``/``-1``)
+    extents, resolved over a device list at :meth:`build` time.
+
+    Validation is loud and actionable: duplicate axes, non-positive
+    extents, and fixed extents that do not divide / cover the device
+    count all raise ``ValueError`` naming the numbers involved — the
+    degenerate ``[n, 1, …]`` default that used to pile every device on
+    the first axis is gone (auto extents factorize instead).
+    """
+
+    def __init__(self, axes: Sequence[str] = (DATA_AXIS,),
+                 shape: Optional[Sequence[Optional[int]]] = None,
+                 devices: Optional[Sequence] = None):
+        axes = tuple(axes)
+        if not axes:
+            raise ValueError("MeshSpec needs at least one axis name")
+        if len(set(axes)) != len(axes):
+            raise ValueError(f"duplicate mesh axis names: {axes}")
+        if shape is None:
+            shape = (None,) * len(axes)
+        shape = tuple(shape)
+        if len(shape) != len(axes):
+            raise ValueError(
+                f"mesh shape {shape} names {len(shape)} extents for "
+                f"{len(axes)} axes {axes}")
+        norm = []
+        for ax, s in zip(axes, shape):
+            if s is None or s == -1:
+                norm.append(None)
+            elif int(s) <= 0:
+                raise ValueError(
+                    f"axis {ax!r} has non-positive extent {s}; use None "
+                    f"(or -1) for an auto-factorized extent")
+            else:
+                norm.append(int(s))
+        self.axes = axes
+        self.shape = tuple(norm)
+        self.devices = None if devices is None else list(devices)
+
+    # ------------------------------------------------------------------
+    def resolve_shape(self, n_devices: int):
+        """Concrete per-axis extents over ``n_devices``: fixed extents must
+        divide the device count; auto extents split the quotient
+        near-balanced (:func:`auto_factor`, earlier axes ≥ later)."""
+        fixed = [s for s in self.shape if s is not None]
+        prod = int(np.prod(fixed)) if fixed else 1
+        if n_devices % prod:
+            raise ValueError(
+                f"mesh axes {dict(zip(self.axes, self.shape))} need a "
+                f"multiple of {prod} devices but {n_devices} are "
+                f"available; change the fixed extents so their product "
+                f"divides {n_devices}, or pass an explicit device subset")
+        n_auto = sum(1 for s in self.shape if s is None)
+        rest = n_devices // prod
+        if n_auto == 0:
+            if rest != 1:
+                raise ValueError(
+                    f"mesh shape {dict(zip(self.axes, self.shape))} covers "
+                    f"{prod} devices but {n_devices} are available; mark "
+                    f"one axis auto (None) to absorb the rest, or shrink "
+                    f"the device list")
+            return tuple(self.shape)
+        auto = list(auto_factor(rest, n_auto))
+        return tuple(s if s is not None else auto.pop(0)
+                     for s in self.shape)
+
+    def build(self) -> Mesh:
+        """Resolve to a ``jax.sharding.Mesh`` (the ONE sanctioned
+        construction site — tpulint JAX004 flags raw ``Mesh(...)`` calls
+        outside the substrate)."""
+        devices = (list(jax.devices()) if self.devices is None
+                   else list(self.devices))
+        shape = self.resolve_shape(len(devices))
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, self.axes)
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    def __repr__(self):
+        return (f"MeshSpec(axes={self.axes!r}, shape={self.shape!r}, "
+                f"devices={'default' if self.devices is None else len(self.devices)})")
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axes: Sequence[str] = (DATA_AXIS,),
+              shape: Optional[Sequence[Optional[int]]] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all) with named ``axes`` —
+    the long-standing entry point, now routed through :class:`MeshSpec`.
+
+    ``shape`` gives per-axis extents; ``None``/``-1`` entries (and a
+    wholly omitted shape) auto-factorize over the device count instead of
+    the old degenerate ``[n, 1, …]`` default. Shapes that don't cover the
+    devices raise with an actionable message."""
+    return MeshSpec(axes=axes, shape=shape, devices=devices).build()
+
+
+def require_axes(mesh: Mesh, axes: Sequence[str], style: str = "step"):
+    """Loudly verify ``mesh`` carries every named axis (the shared
+    validation every style used to hand-roll)."""
+    missing = [a for a in axes if a and a not in mesh.axis_names]
+    if missing:
+        raise ValueError(
+            f"{style} needs mesh axis(es) {missing} but the mesh has "
+            f"{tuple(mesh.axis_names)} (shape "
+            f"{dict(mesh.shape)}); build it with "
+            f"parallel.make_mesh/MeshSpec naming those axes")
+    return mesh
+
+
+# ---------------------------------------------------------------- specs
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dim across ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def spec_for_path(path: str, rules: Dict[str, P]) -> P:
+    """First rule whose regex matches ``path`` (replicated otherwise)."""
+    for pat, spec in rules.items():
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def clean_spec(spec: P, dims, mesh: Mesh) -> P:
+    """Drop spec axes that don't divide their dim (falls back to
+    replication on that dim) and pad to the leaf's rank."""
+    cleaned = []
+    for d, s in zip(dims, tuple(spec) + (None,) * (len(dims)
+                                                   - len(tuple(spec)))):
+        if s is None or d % mesh.shape[s] != 0:
+            cleaned.append(None)
+        else:
+            cleaned.append(s)
+    return P(*cleaned)
+
+
+def _keypath_str(keypath) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in keypath)
+
+
+def rule_shardings(params, mesh: Mesh, rules: Dict[str, P]):
+    """NamedSharding pytree for ``params`` from {path-regex: PartitionSpec}
+    rules (the machinery behind ``tensor.param_shardings`` — axes that
+    don't divide a dim fall back to replication on that dim)."""
+    def one(keypath, leaf):
+        spec = spec_for_path(_keypath_str(keypath), rules)
+        return NamedSharding(mesh, clean_spec(spec, np.shape(leaf), mesh))
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def mirror_updater_shardings(params, updater_state, mesh: Mesh,
+                             rules: Dict[str, P]):
+    """Updater-state entries shaped like a param inherit that param's
+    rule sharding (Adam moments must shard WITH their param or the
+    optimizer-state memory saving is silently lost); everything else
+    replicates. Updater keypaths look like ``layer/param/slot`` (e.g.
+    ``0/W/0`` for Adam's first moment) or ``layer/param``, so the param
+    name is searched among ALL trailing path segments."""
+    p_sh_flat = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = _keypath_str(keypath)
+        p_sh_flat[(path, np.shape(leaf))] = NamedSharding(
+            mesh, clean_spec(spec_for_path(path, rules), np.shape(leaf),
+                             mesh))
+
+    def one(keypath, leaf):
+        parts = [str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in keypath]
+        shape = np.shape(leaf)
+        for (ppath, pshape), sh in p_sh_flat.items():
+            psegs = ppath.split("/")
+            if (shape == pshape and parts and psegs
+                    and parts[0] == psegs[0] and psegs[-1] in parts[1:]):
+                return sh
+        return NamedSharding(mesh, P())
+    return jax.tree_util.tree_map_with_path(one, updater_state)
+
+
+def zero_update_specs(tree, mesh: Mesh, axis: str = DATA_AXIS,
+                      base=None):
+    """ZeRO sharding over the ``axis`` extent of WHATEVER mesh is given
+    (arXiv:2004.13336 expressed as sharding annotations): each leaf
+    shards its largest ``axis``-divisible dim that the ``base`` specs
+    (e.g. tensor-parallel rules over ``model``) have not already claimed
+    — ties broken toward the later dim, so an NHWC/HWIO conv kernel
+    shards over channels rather than a small spatial dim that happens to
+    divide. Leaves with no free divisible dim keep their base sharding
+    (replicated when ``base`` is None).
+
+    With optimizer state annotated this way the SPMD partitioner
+    reduce-scatters gradients along ``axis``, updates the local shard,
+    and all-gathers weights — numerically identical to replicated DP
+    (pinned bit-exact in tests/test_mesh.py) with ~N× less state per
+    device. Composes: on a 2-D ``data × model`` mesh the base specs keep
+    the TP split and ZeRO rides the remaining dims over ``data``."""
+    n = int(mesh.shape[axis])
+
+    def one(x, base_sh):
+        shape = getattr(x, "shape", ())
+        spec = () if base_sh is None else tuple(
+            getattr(base_sh, "spec", base_sh))
+        spec = spec + (None,) * (len(shape) - len(spec))
+        best = None
+        # a base rule may already claim the ZeRO axis itself (a user TP
+        # rule over 'data') — adding it twice would build an invalid
+        # duplicate-axis PartitionSpec, so such leaves keep their base
+        if axis not in spec:
+            for d, s in enumerate(shape):
+                if (spec[d] is None and s >= n and s % n == 0
+                        and (best is None or s >= shape[best])):
+                    best = d
+        new = list(spec)
+        if best is not None:
+            new[best] = axis
+        while new and new[-1] is None:       # P(None,) is not P()
+            new.pop()
+        return NamedSharding(mesh, P(*new))
+
+    if base is None:
+        return jax.tree_util.tree_map(lambda x: one(x, None), tree)
+    return jax.tree_util.tree_map(one, tree, base)
+
+
+# ------------------------------------------------- active-topology registry
+_REG_LOCK = threading.Lock()
+_ACTIVE: Dict[str, Dict] = {}
+
+
+def _leaf_counts(*spec_trees):
+    """(sharded, replicated) leaf counts over NamedSharding/PartitionSpec
+    pytrees (scalars count as one replicated leaf)."""
+    sharded = replicated_n = 0
+    for tree in spec_trees:
+        if tree is None:
+            continue
+        leaves = jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, (NamedSharding, P)))
+        for leaf in leaves:
+            spec = getattr(leaf, "spec", leaf)
+            if any(s is not None for s in tuple(spec)):
+                sharded += 1
+            else:
+                replicated_n += 1
+    return sharded, replicated_n
+
+
+def record_step(style: str, mesh: Mesh, *spec_trees, zero: bool = False):
+    """Register a parallel step built on ``mesh`` under a stable ``style``
+    name (``wrapper/sync``, ``tensor/step``, …) for the ``/profile`` mesh
+    block. ``spec_trees`` are the model-state sharding pytrees the step
+    was built with — their sharded-vs-replicated leaf split is what tells
+    an operator whether a topology is actually sharding anything."""
+    sharded, repl = _leaf_counts(*spec_trees)
+    with _REG_LOCK:
+        row = _ACTIVE.setdefault(style, {
+            "axes": {}, "devices": 0, "steps": 0,
+            "sharded_leaves": 0, "replicated_leaves": 0, "zero": False})
+        row["axes"] = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+        row["devices"] = int(np.prod(mesh.devices.shape))
+        row["steps"] += 1
+        row["sharded_leaves"] = sharded
+        row["replicated_leaves"] = repl
+        row["zero"] = bool(zero) or row["zero"]
+
+
+def mesh_block() -> Dict[str, Dict]:
+    """The ``/profile`` mesh block: per-style active topology (axis names,
+    extents, device count, steps built, sharded-vs-replicated leaf
+    counts). Empty until a parallel step factory runs."""
+    with _REG_LOCK:
+        return {style: dict(row) for style, row in sorted(_ACTIVE.items())}
+
+
+def reset_mesh_registry():
+    """Test hook: forget every recorded topology."""
+    with _REG_LOCK:
+        _ACTIVE.clear()

@@ -33,9 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 from ..monitor.jitwatch import monitored_jit
 
+from .mesh import PIPELINE_AXIS, record_step, require_axes
 from .sharding import pvary
-
-PIPELINE_AXIS = "pipe"
 
 _tm = jax.tree_util.tree_map
 
@@ -199,11 +198,9 @@ class GPipe:
     def __init__(self, block_fn, head_fn, mesh: Mesh, n_microbatches: int,
                  updater, axis: str = PIPELINE_AXIS,
                  data_axis: Optional[str] = None):
-        if axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
-        if data_axis is not None and data_axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{data_axis}' axis: "
-                             f"{mesh.axis_names}")
+        require_axes(mesh, (axis, data_axis), style="GPipe")
+        record_step("pipeline/gpipe", mesh,
+                    {"blocks": P(axis), "head": P()})
         self.mesh = mesh
         self.axis = axis
         self.data_axis = data_axis
@@ -505,11 +502,9 @@ class _PipelinedBase:
     partitioning, the stage/entry/head forward pieces and the loss."""
 
     def _init_common(self, net, mesh, n_microbatches, axis, data_axis):
-        if axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
-        if data_axis is not None and data_axis not in mesh.axis_names:
-            raise ValueError(f"mesh has no '{data_axis}' axis: "
-                             f"{mesh.axis_names}")
+        require_axes(mesh, (axis, data_axis), style=type(self).__name__)
+        record_step("pipeline/" + type(self).__name__, mesh,
+                    {"entry": P(), "blocks": P(axis), "head": P()})
         if int(getattr(net.gc, "iterations", 1) or 1) > 1:
             import logging
             logging.getLogger(__name__).warning(

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 from ..monitor.jitwatch import monitored_jit
 
+from .mesh import record_step, require_axes
 from .sharding import SEQUENCE_AXIS, pvary
 
 _NEG = -1e30
@@ -550,6 +551,7 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
                         f"head_dim. (The per-shard length must also be "
                         f"128-divisible — checked at step time.)")
 
+    require_axes(mesh, (axis, data_axis), style="sequence_parallel_step")
     n_shards = mesh.shape[axis]
 
     # the framework's sequence losses SUM over time (mean over batch,
@@ -653,11 +655,9 @@ def sequence_parallel_step(net, mesh: Mesh, axis: str = SEQUENCE_AXIS,
         new_params = net._apply_constraints(new_params)
         return new_params, new_states, new_upd, loss
 
-    if data_axis is not None and data_axis not in mesh.axis_names:
-        raise ValueError(f"mesh has no '{data_axis}' axis: "
-                         f"{mesh.axis_names}")
     repl = P()
     tsh = P(data_axis, axis)          # [b, T, F]: batch × time sharded
+    record_step("sequence/step", mesh, {"inputs": tsh})
     fn = shard_map(device_step, mesh=mesh,
                    in_specs=(repl, repl, repl, repl, repl, tsh, tsh),
                    out_specs=(repl, repl, repl, repl),

@@ -1,4 +1,4 @@
-"""Device-mesh sharding helpers — the SPMD substrate for data/model parallelism.
+"""Device-mesh sharding helpers — the SPMD data-parallel steps.
 
 TPU-native replacement for the reference's device-affinity machinery
 (``Nd4j.getAffinityManager()`` uses in ``ParallelWrapper.java:484`` and
@@ -9,14 +9,18 @@ ICI collectives (psum for gradient all-reduce) that replace both parameter
 averaging and Aeron gradient broadcast (SURVEY.md §2.4 "Distributed
 communication backend").
 
-Mesh axis conventions used throughout the framework:
-  - ``data``     — batch (data parallelism; ParallelWrapper equivalent)
-  - ``model``    — tensor parallelism (net-new vs the reference, §2.4 note)
-  - ``sequence`` — sequence/context parallelism (ring attention, net-new)
+Mesh construction, axis conventions, validation, and the partition-spec
+machinery all live in ``parallel/mesh.py`` (the unified substrate —
+docs/PARALLELISM.md "Unified mesh substrate"); this module keeps the
+data-parallel STEP factories, now composition-aware: ``tp_rules`` shards
+the ``model`` axis of a 2-D mesh inside the same jitted step, and the
+ZeRO flags (``shard_update``/``shard_params``) ride the ``data`` axis of
+whatever mesh they are given (:func:`~deeplearning4j_tpu.parallel.mesh.
+zero_update_specs`).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 import jax
@@ -24,36 +28,17 @@ import jax
 from ..monitor.jitwatch import monitored_jit
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
-SEQUENCE_AXIS = "sequence"
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS, MeshSpec,
+                   make_mesh, replicated, batch_sharded,
+                   mirror_updater_shardings, require_axes, rule_shardings,
+                   zero_update_specs, record_step)
 
-
-def make_mesh(devices: Optional[Sequence] = None,
-              axes: Sequence[str] = (DATA_AXIS,),
-              shape: Optional[Sequence[int]] = None) -> Mesh:
-    """Build a Mesh over ``devices`` (default: all) with named ``axes``.
-
-    ``shape`` gives the per-axis extents; by default all devices go on the
-    first axis and the rest get extent 1.
-    """
-    devices = list(jax.devices()) if devices is None else list(devices)
-    n = len(devices)
-    if shape is None:
-        shape = [n] + [1] * (len(axes) - 1)
-    if int(np.prod(shape)) != n:
-        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
-    dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, tuple(axes))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
-    """Shard the leading (batch) dim across ``axis``."""
-    return NamedSharding(mesh, P(axis))
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "SEQUENCE_AXIS", "MeshSpec",
+           "make_mesh", "replicated", "batch_sharded", "shard_batch",
+           "put_replicated", "put_sharded_tree", "update_sharded_specs",
+           "composed_specs", "data_parallel_step",
+           "data_parallel_tbptt_step", "data_parallel_tbptt_update_step",
+           "pvary"]
 
 
 def shard_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
@@ -108,36 +93,56 @@ def put_sharded_tree(tree, specs):
 def update_sharded_specs(tree, mesh: Mesh, axis: str = DATA_AXIS):
     """Sharding pytree for OPTIMIZER STATE sharded over the data axis —
     weight-update / optimizer-state sharding (Xu et al. 2020,
-    arXiv:2004.13336 "Automatic Cross-Replica Sharding of Weight Update in
-    Data-Parallel Training"; the ZeRO-1 idea expressed as XLA sharding
-    annotations). Each leaf shards its LARGEST dim divisible by the axis
-    extent (ties broken toward the later dim, so an NHWC/HWIO conv kernel
-    shards over channels rather than a small spatial dim that happens to
-    divide); leaves with no divisible dim — scalar step counts, biases
-    narrower than the axis extent — replicate.
-    With the updater state annotated this way and params replicated, the
-    SPMD partitioner keeps each replica's m/v (etc.) shard-resident —
-    optimizer memory drops ~N-fold — and reshards gradients into the
-    update instead of applying it N times redundantly."""
-    n = int(mesh.shape[axis])
-    repl = replicated(mesh)
+    arXiv:2004.13336; the ZeRO-1 idea expressed as XLA sharding
+    annotations). Thin alias of :func:`~deeplearning4j_tpu.parallel.mesh.
+    zero_update_specs` with no base specs — see it for the dim-selection
+    rule and the 2-D composition semantics."""
+    return zero_update_specs(tree, mesh, axis)
 
-    def spec(x):
-        shape = getattr(x, "shape", ())
-        best = None
-        for d, s in enumerate(shape):
-            if s >= n and s % n == 0 and (best is None or s >= shape[best]):
-                best = d
-        if best is not None:
-            return NamedSharding(mesh, P(*([None] * best + [axis])))
-        return repl
 
-    return jax.tree_util.tree_map(spec, tree)
+def composed_specs(net, mesh: Mesh, axis: str = DATA_AXIS,
+                   tp_rules: Optional[Dict[str, P]] = None,
+                   shard_update: bool = False, shard_params: bool = False):
+    """The ONE place the composed model-state shardings are decided, shared
+    by the step factories below and ``ParallelWrapper._device_put_model``
+    (specs used to jit and specs used to place MUST agree or every fit
+    pays a reshard).
+
+    Returns ``(param_specs, updater_specs)`` pytrees: tensor-parallel
+    ``tp_rules`` claim the ``model`` axis first (updater state mirrors its
+    param's sharding), then the ZeRO flags layer the ``data`` axis of the
+    same mesh onto the remaining dims — ``shard_update`` for optimizer
+    state (ZeRO-1), ``shard_params`` additionally for parameter storage
+    (ZeRO-3/FSDP)."""
+    # every axis the rules (or the ZeRO flags) name must exist on the
+    # mesh — a raw KeyError from deep inside a tree_map is not a
+    # substrate error message
+    needed = set()
+    if tp_rules:
+        needed.update(s for spec in tp_rules.values()
+                      for s in tuple(spec) if s is not None)
+    if shard_update or shard_params:
+        needed.add(axis)
+    require_axes(mesh, sorted(needed), style="composed_specs(tp_rules/ZeRO)")
+    if tp_rules:
+        par = rule_shardings(net.params, mesh, tp_rules)
+        upd = mirror_updater_shardings(net.params, net.updater_state, mesh,
+                                       tp_rules)
+    else:
+        repl = replicated(mesh)
+        par = jax.tree_util.tree_map(lambda _: repl, net.params)
+        upd = jax.tree_util.tree_map(lambda _: repl, net.updater_state)
+    if shard_update:
+        upd = zero_update_specs(net.updater_state, mesh, axis, base=upd)
+    if shard_params:
+        par = zero_update_specs(net.params, mesh, axis, base=par)
+    return par, upd
 
 
 def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
                        shard_update: bool = False,
-                       shard_params: bool = False):
+                       shard_params: bool = False,
+                       tp_rules: Optional[Dict[str, P]] = None):
     """Jit a network's train step for synchronous data parallelism.
 
     Equivalent role to the reference's ``ParallelWrapper`` AVERAGING mode with
@@ -147,30 +152,30 @@ def data_parallel_step(net, mesh: Mesh, axis: str = DATA_AXIS, donate=True,
 
     Returns a jitted ``step(params, states, upd_state, iteration, rng, f, l,
     fm, lm)`` whose batch inputs must be sharded along ``axis`` (use
-    :func:`shard_batch`) and whose params/updater-state are replicated.
+    :func:`shard_batch`) and whose params/updater-state follow
+    :func:`composed_specs`.
+
+    ``tp_rules`` composes tensor parallelism INTO the same jitted step on a
+    2-D ``data × model`` mesh: the rules' param shardings claim the
+    ``model`` axis while the batch stays sharded over ``axis`` — DP and TP
+    in one XLA computation instead of excluding each other.
 
     ``shard_update=True`` enables weight-update/optimizer-state sharding
-    (:func:`update_sharded_specs`): updater state lives sharded over the
-    data axis instead of replicated — numerically identical, ~N× less
-    optimizer memory per device.
-
-    ``shard_params=True`` additionally SHARDS THE PARAMETERS over the data
-    axis (ZeRO-3/FSDP-style sharded storage): each leaf's largest
-    axis-divisible dim (see :func:`update_sharded_specs`) is stored 1/N
-    per device, and the SPMD partitioner inserts the all-gathers at the
-    points of use and reduce-scatters the gradients into the sharded
-    update. Leaves with no divisible dim stay replicated.
-    Numerically identical to replicated DP.
-    """
+    (ZeRO-1 over the ``data`` axis of whatever mesh is given) — numerically
+    identical, ~N× less optimizer memory per device. ``shard_params=True``
+    additionally SHARDS THE PARAMETER STORAGE (ZeRO-3/FSDP-style): the SPMD
+    partitioner inserts the all-gathers at the points of use and
+    reduce-scatters the gradients into the sharded update. Both compose
+    with ``tp_rules`` (ZeRO takes the dims TP left free)."""
     raw = net._raw_step(False)
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
-    upd = (update_sharded_specs(net.updater_state, mesh, axis)
-           if shard_update else repl)
-    par = (update_sharded_specs(net.params, mesh, axis)
-           if shard_params else repl)
+    par, upd = composed_specs(net, mesh, axis, tp_rules,
+                              shard_update, shard_params)
     in_sh = (par, repl, upd, repl, repl, data, data, data, data)
     out_sh = (par, repl, upd, repl)
+    record_step("sharding/dp_step", mesh, par, upd,
+                zero=shard_update or shard_params)
     return monitored_jit(raw, name="sharding/dp_step",
                          in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=(0, 2) if donate else ())
@@ -189,23 +194,25 @@ def _rnn_state_shardings(net, mesh: Mesh, axis: str):
 
 def data_parallel_tbptt_step(net, mesh: Mesh, axis: str = DATA_AXIS,
                              donate=True, shard_update: bool = False,
-                             shard_params: bool = False):
+                             shard_params: bool = False,
+                             tp_rules: Optional[Dict[str, P]] = None):
     """Sharded train step that also threads the detached RNN/KV carry —
     the TBPTT segment step under data parallelism. Reference semantics:
     ``ParallelWrapper`` workers run the full ``MultiLayerNetwork.fit`` loop
     per replica (``trainer/DefaultTrainer.java:244``), truncated-BPTT
     included, so the SPMD equivalent must segment time the same way.
-    ``shard_update`` as in :func:`data_parallel_step`."""
+    ``shard_update``/``shard_params``/``tp_rules`` as in
+    :func:`data_parallel_step`."""
     raw = net._raw_step(True)
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
     state_sh = _rnn_state_shardings(net, mesh, axis)
-    upd = (update_sharded_specs(net.updater_state, mesh, axis)
-           if shard_update else repl)
-    par = (update_sharded_specs(net.params, mesh, axis)
-           if shard_params else repl)
+    par, upd = composed_specs(net, mesh, axis, tp_rules,
+                              shard_update, shard_params)
     in_sh = (par, repl, upd, repl, repl, data, data, data, data, state_sh)
     out_sh = (par, repl, upd, repl, state_sh)
+    record_step("sharding/dp_tbptt_step", mesh, par, upd,
+                zero=shard_update or shard_params)
     return monitored_jit(raw, name="sharding/dp_tbptt_step",
                          in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=(0, 2) if donate else ())
@@ -221,6 +228,7 @@ def data_parallel_tbptt_update_step(net, mesh: Mesh, axis: str = DATA_AXIS):
     state_sh = _rnn_state_shardings(net, mesh, axis)
     in_sh = (repl, repl, repl, repl, repl, data, data, data, data, state_sh)
     out_sh = (repl, repl, repl, repl, state_sh)
+    record_step("sharding/dp_tbptt_update_step", mesh)
     return monitored_jit(raw, name="sharding/dp_tbptt_update_step",
                          in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=(2,))

@@ -9,18 +9,25 @@ inserts the all-gathers/reduce-scatters.
 the output dim (column parallel), odd layers split the input dim (row
 parallel), so activations stay sharded between the pair and only one collective
 per pair is needed.
+
+The spec machinery (path-regex rules → NamedSharding pytrees, updater-state
+mirroring) lives in ``parallel/mesh.py`` — the unified substrate — so the
+same rules compose with data parallelism and ZeRO on a 2-D mesh
+(``ParallelWrapper.Builder.tensor_parallel`` /
+``sharding.data_parallel_step(tp_rules=...)``).
 """
 from __future__ import annotations
 
 import re
 from typing import Dict, Optional
 
-import numpy as np
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from .sharding import DATA_AXIS, MODEL_AXIS, batch_sharded, replicated
+from .mesh import (DATA_AXIS, MODEL_AXIS, batch_sharded, replicated,
+                   clean_spec as _clean_spec, spec_for_path as _spec_for,
+                   mirror_updater_shardings, record_step, require_axes,
+                   rule_shardings, zero_update_specs)
 from ..monitor.jitwatch import monitored_jit
 
 
@@ -81,48 +88,44 @@ def megatron_rules(net, axis: str = MODEL_AXIS) -> Dict[str, P]:
     return rules
 
 
-def _spec_for(path: str, rules: Dict[str, P]) -> P:
-    for pat, spec in rules.items():
-        if re.search(pat, path):
-            return spec
-    return P()
-
-
 def param_shardings(params, mesh: Mesh, rules: Dict[str, P]):
-    """NamedSharding pytree for ``params`` from path-regex rules."""
-    def one(keypath, leaf):
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                        for k in keypath)
-        spec = _spec_for(path, rules)
-        # drop axes that don't divide the dim (falls back to replication)
-        dims = np.shape(leaf)
-        cleaned = []
-        for d, s in zip(dims, tuple(spec) + (None,) * (len(dims) - len(tuple(spec)))):
-            if s is None:
-                cleaned.append(None)
-            else:
-                size = mesh.shape[s]
-                cleaned.append(s if d % size == 0 else None)
-        return NamedSharding(mesh, P(*cleaned))
-    return jax.tree_util.tree_map_with_path(one, params)
+    """NamedSharding pytree for ``params`` from path-regex rules (thin
+    alias of :func:`~deeplearning4j_tpu.parallel.mesh.rule_shardings`)."""
+    return rule_shardings(params, mesh, rules)
 
 
 def tensor_parallel_step(net, mesh: Mesh, rules: Optional[Dict[str, P]] = None,
-                         donate: bool = True):
+                         donate: bool = True, shard_update: bool = False,
+                         shard_params: bool = False):
     """Jit the network's train step with TP param shardings (+DP over the
     ``data`` axis when present in the mesh). Returns (step, place) where
-    ``place(net)`` device_puts the model state according to the rules."""
+    ``place(net)`` device_puts the model state according to the rules.
+
+    ``shard_update``/``shard_params`` layer ZeRO-1/ZeRO-3 sharding over the
+    ``data`` axis of the given mesh on top of the TP rules (the mesh must
+    carry a ``data`` axis) — optimizer state (and param storage) splits
+    over the dims TP left free, exactly like
+    ``ParallelWrapper``'s ``weight_update_sharding``/``fsdp`` flags."""
     if rules is None:
         rules = megatron_rules(net)
+    if shard_update or shard_params:
+        require_axes(mesh, (DATA_AXIS,), style="tensor_parallel_step ZeRO")
     raw = net._raw_step(False)
     p_sh = param_shardings(net.params, mesh, rules)
     # updater state mirrors its param's sharding (Adam moments etc.)
     upd_sh = _mirror_updater_shardings(net, mesh, rules)
+    if shard_update:
+        upd_sh = zero_update_specs(net.updater_state, mesh, DATA_AXIS,
+                                   base=upd_sh)
+    if shard_params:
+        p_sh = zero_update_specs(net.params, mesh, DATA_AXIS, base=p_sh)
     repl = replicated(mesh)
     data = (batch_sharded(mesh) if DATA_AXIS in mesh.axis_names else repl)
     in_sh = (p_sh, repl, upd_sh, repl, repl, data, data, None, None)
     out_sh = (p_sh, repl, upd_sh, repl)
 
+    record_step("tensor/step", mesh, p_sh, upd_sh,
+                zero=shard_update or shard_params)
     step = monitored_jit(raw, name="tensor/step",
                          in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=(0, 2) if donate else ())
@@ -136,39 +139,8 @@ def tensor_parallel_step(net, mesh: Mesh, rules: Optional[Dict[str, P]] = None,
 
 
 def _mirror_updater_shardings(net, mesh, rules):
-    """Updater state entries shaped like a param inherit that param's sharding
-    (Adam moments etc. must shard WITH their param, or TP's optimizer-state
-    memory saving is silently lost); everything else is replicated.
-
-    Updater-state keypaths look like ``layer/param/slot`` (e.g. ``0/W/0`` for
-    Adam's first moment) or ``layer/param`` for single-slot updaters, so the
-    param name is searched among ALL path segments, not just the last."""
-    p_sh_flat = {}
-    for keypath, leaf in jax.tree_util.tree_flatten_with_path(net.params)[0]:
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                        for k in keypath)
-        p_sh_flat[(path, np.shape(leaf))] = NamedSharding(
-            mesh, _clean_spec(_spec_for(path, rules), np.shape(leaf), mesh))
-
-    def one(keypath, leaf):
-        parts = [str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath]
-        shape = np.shape(leaf)
-        for (ppath, pshape), sh in p_sh_flat.items():
-            psegs = ppath.split("/")
-            # same layer key, same shape, and the param name appears on the
-            # state leaf's path (tuple slots append a trailing index segment)
-            if (shape == pshape and parts and psegs
-                    and parts[0] == psegs[0] and psegs[-1] in parts[1:]):
-                return sh
-        return NamedSharding(mesh, P())
-    return jax.tree_util.tree_map_with_path(one, net.updater_state)
-
-
-def _clean_spec(spec, dims, mesh):
-    cleaned = []
-    for d, s in zip(dims, tuple(spec) + (None,) * (len(dims) - len(tuple(spec)))):
-        if s is None or d % mesh.shape[s] != 0:
-            cleaned.append(None)
-        else:
-            cleaned.append(s)
-    return P(*cleaned)
+    """Back-compat shim over :func:`~deeplearning4j_tpu.parallel.mesh.
+    mirror_updater_shardings` (takes the net, the substrate takes the
+    trees)."""
+    return mirror_updater_shardings(net.params, net.updater_state, mesh,
+                                    rules)

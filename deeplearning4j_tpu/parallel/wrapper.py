@@ -31,11 +31,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 from ..monitor.jitwatch import monitored_jit
 
-from .sharding import (DATA_AXIS, make_mesh, replicated, batch_sharded,
+from .mesh import MODEL_AXIS, MeshSpec, record_step, require_axes
+from .sharding import (DATA_AXIS, replicated, batch_sharded,
                        shard_batch, put_replicated, data_parallel_step,
                        data_parallel_tbptt_step,
                        data_parallel_tbptt_update_step, pvary,
-                       update_sharded_specs, put_sharded_tree)
+                       composed_specs, put_sharded_tree)
 from .accumulation import GradientsAccumulator, EncodedGradientsAccumulator
 from ..nn.conf import BackpropType, CacheMode
 from ..datasets.dataset import (DataSet, MultiDataSet, DataSetIterator,
@@ -71,6 +72,8 @@ class ParallelWrapper:
             self._ws = False
             self._fsdp = False
             self._host_dtype = None
+            self._tp = None
+            self._tp_rules = None
 
         def workers(self, n):
             self._workers = int(n)
@@ -120,6 +123,25 @@ class ParallelWrapper:
         def mesh(self, mesh: Mesh):
             self._mesh = mesh
             return self
+
+        def tensor_parallel(self, n: int = 2, rules=None):
+            """Compose tensor parallelism INTO the data-parallel step on a
+            2-D ``data × model`` mesh (parallel/mesh.py substrate): the
+            wrapper keeps driving the batch over the ``data`` axis while
+            ``rules`` ({param-path regex: PartitionSpec}, default
+            :func:`~deeplearning4j_tpu.parallel.tensor.megatron_rules`)
+            shard the params over a ``model`` axis of extent ``n`` in the
+            SAME jitted step. The data extent auto-factorizes to
+            ``devices / n``. Stacks with :meth:`weight_update_sharding` /
+            :meth:`fsdp` — ZeRO takes the dims TP left free, over the
+            ``data`` axis of the composed mesh. Supported for
+            ``TrainingMode.AVERAGING`` with ``averaging_frequency=1``
+            (including TBPTT); other modes reject loudly."""
+            self._tp = int(n)
+            self._tp_rules = rules
+            return self
+
+        tensorParallel = tensor_parallel
 
         def weight_update_sharding(self, flag=True):
             """Shard the OPTIMIZER STATE over the data axis instead of
@@ -175,7 +197,9 @@ class ParallelWrapper:
                                    mesh=self._mesh,
                                    weight_update_sharding=self._ws,
                                    fsdp=self._fsdp,
-                                   host_transfer_dtype=self._host_dtype)
+                                   host_transfer_dtype=self._host_dtype,
+                                   tensor_parallel=self._tp,
+                                   tp_rules=self._tp_rules)
 
     def __init__(self, net, workers: Optional[int] = None,
                  prefetch_buffer: int = 2, prefetch_workers: int = 2,
@@ -186,11 +210,27 @@ class ParallelWrapper:
                  mesh: Optional[Mesh] = None,
                  weight_update_sharding: bool = False,
                  fsdp: bool = False,
-                 host_transfer_dtype=None):
+                 host_transfer_dtype=None,
+                 tensor_parallel: Optional[int] = None,
+                 tp_rules=None):
         self.net = net
         self.host_transfer_dtype = host_transfer_dtype
         self.fsdp = bool(fsdp)
         self.weight_update_sharding = bool(weight_update_sharding) or self.fsdp
+        if tp_rules is not None and tensor_parallel is None and mesh is None:
+            raise ValueError("tp_rules needs a model axis: pass "
+                             "tensor_parallel=<extent> or a mesh carrying "
+                             "a 'model' axis")
+        if tensor_parallel is not None and int(tensor_parallel) < 2:
+            raise ValueError(f"tensor_parallel extent must be >= 2 "
+                             f"(got {tensor_parallel}); without a model "
+                             f"split just omit it")
+        self.tensor_parallel = (None if tensor_parallel is None
+                                else int(tensor_parallel))
+        if self.tensor_parallel and tp_rules is None:
+            from .tensor import megatron_rules
+            tp_rules = megatron_rules(net)
+        self.tp_rules = tp_rules
         if (int(getattr(net.gc, "iterations", 1) or 1) > 1
                 and not getattr(net, "_warned_pw_iterations", False)):
             net._warned_pw_iterations = True
@@ -202,31 +242,73 @@ class ParallelWrapper:
         devices = jax.devices()
         if workers is not None and workers < len(devices):
             devices = devices[:workers]
-        self.mesh = mesh if mesh is not None else make_mesh(devices,
-                                                            axes=(DATA_AXIS,))
-        self.workers_ = int(np.prod(self.mesh.devices.shape))
+        if mesh is not None:
+            self.mesh = mesh
+        elif self.tensor_parallel:
+            # 2-D data × model: the model extent is fixed, the data extent
+            # auto-factorizes over the remaining devices (MeshSpec rejects
+            # non-dividing extents with an actionable message)
+            self.mesh = MeshSpec(axes=(DATA_AXIS, MODEL_AXIS),
+                                 shape=(None, self.tensor_parallel),
+                                 devices=devices).build()
+        else:
+            self.mesh = MeshSpec(axes=(DATA_AXIS,), devices=devices).build()
+        require_axes(self.mesh, (DATA_AXIS,), style="ParallelWrapper")
+        if self.tp_rules is not None:
+            require_axes(self.mesh, (MODEL_AXIS,),
+                         style="ParallelWrapper.tensor_parallel")
+        if (mesh is not None and self.tensor_parallel
+                and int(mesh.shape[MODEL_AXIS]) != self.tensor_parallel):
+            # an explicit mesh whose model extent disagrees with the
+            # requested one must not silently win
+            raise ValueError(
+                f"tensor_parallel={self.tensor_parallel} but the given "
+                f"mesh has model extent {int(mesh.shape[MODEL_AXIS])}; "
+                f"drop one of the two or make them agree")
+        # the wrapper drives the DATA axis: batch divisibility, round-robin
+        # group size and iteration accounting all follow the data extent —
+        # model-family axes shard params, not the batch
+        n_devices = int(np.prod(self.mesh.devices.shape))
+        self.workers_ = int(self.mesh.shape[DATA_AXIS])
         # multi-process (multi-host) awareness: each process feeds only its
         # addressable devices' share of the global batch
         self.process_count = jax.process_count()
         if self.process_count > 1:
             pidx = jax.process_index()
-            self.local_workers_ = sum(1 for d in self.mesh.devices.flat
-                                      if d.process_index == pidx)
+            local_devs = sum(1 for d in self.mesh.devices.flat
+                             if d.process_index == pidx)
+            # devices per data slice = model-family extents product; a
+            # data slice spanning processes would make every process feed
+            # a share of the SAME slice (double-fed global batch) — the
+            # model-family axes must stay within a process (see
+            # parallel/mesh.py axis conventions), so reject loudly
+            per_slice = n_devices // self.workers_
+            if per_slice > 1 and local_devs % per_slice:
+                raise ValueError(
+                    f"this process holds {local_devs} of the mesh's "
+                    f"devices but each data slice spans {per_slice} "
+                    f"(model-family extents); model/pipe/sequence axes "
+                    f"must stay within a process — reshape the mesh so "
+                    f"the data axis is the one crossing hosts")
+            self.local_workers_ = max(1, local_devs // per_slice)
         else:
             self.local_workers_ = self.workers_
         self._mp_batch_size = None  # enforced-uniform size (multi-process)
-        if self.weight_update_sharding:
+        if self.weight_update_sharding or self.tp_rules is not None:
             # supported: AVERAGING freq=1 (fused psum step, incl. its TBPTT
             # variant). Loud rejection elsewhere — a silent no-op would let
-            # a memory-tight job believe it has the N-fold saving
+            # a memory-tight job believe it has the N-fold saving (or the
+            # model split)
             if (training_mode != TrainingMode.AVERAGING
                     or max(1, int(averaging_frequency)) != 1):
+                what = ("weight_update_sharding"
+                        if self.weight_update_sharding else "tensor_parallel")
                 raise NotImplementedError(
-                    "weight_update_sharding applies to "
+                    f"{what} applies to "
                     "TrainingMode.AVERAGING with averaging_frequency=1 "
                     "(the fused-psum sync step); the local-SGD shard_map "
                     "and SHARED_GRADIENTS codec paths keep replicated "
-                    "updater state")
+                    "model state")
         # CacheMode.DEVICE for the sharded dispatch path: merged+sharded
         # global batches keyed by the group's array identities (see
         # DataSet._device_key). Values retain the KEYED HOST ARRAYS (the
@@ -260,7 +342,7 @@ class ParallelWrapper:
             self._sync_step = data_parallel_step(
                 self.net, self.mesh,
                 shard_update=self.weight_update_sharding,
-                shard_params=self.fsdp)
+                shard_params=self.fsdp, tp_rules=self.tp_rules)
         return self._sync_step
 
     def _ensure_sync_tbptt_step(self):
@@ -268,7 +350,7 @@ class ParallelWrapper:
             self._sync_tbptt_step = data_parallel_tbptt_step(
                 self.net, self.mesh,
                 shard_update=self.weight_update_sharding,
-                shard_params=self.fsdp)
+                shard_params=self.fsdp, tp_rules=self.tp_rules)
         return self._sync_tbptt_step
 
     # ------------------------------------------------------------ TBPTT
@@ -421,6 +503,7 @@ class ParallelWrapper:
                                  data, data),
                        out_specs=(repl, repl, repl, repl),
                        check_vma=False)
+        record_step("wrapper/local_sgd", mesh)
         self._local_sgd_step = monitored_jit(
             fn, name="wrapper/local_sgd_step", donate_argnums=(0, 2))
         return self._local_sgd_step
@@ -461,19 +544,18 @@ class ParallelWrapper:
         return self
 
     def _device_put_model(self):
+        """Place params/updater-state with EXACTLY the specs the jitted
+        step was built with (``composed_specs`` is the single source of
+        truth for both) — TP rules claim the model axis, ZeRO flags layer
+        the data axis; everything else replicates."""
         net = self.net
         put = lambda t: _tm(lambda x: put_replicated(x, self.mesh), t)
-        if self.fsdp:
-            pspecs = update_sharded_specs(net.params, self.mesh)
-            net.params = put_sharded_tree(net.params, pspecs)
-        else:
-            net.params = put(net.params)
+        par, upd = composed_specs(net, self.mesh, tp_rules=self.tp_rules,
+                                  shard_update=self.weight_update_sharding,
+                                  shard_params=self.fsdp)
+        net.params = put_sharded_tree(net.params, par)
         net.states = put(net.states)
-        if self.weight_update_sharding:
-            specs = update_sharded_specs(net.updater_state, self.mesh)
-            net.updater_state = put_sharded_tree(net.updater_state, specs)
-        else:
-            net.updater_state = put(net.updater_state)
+        net.updater_state = put_sharded_tree(net.updater_state, upd)
 
     def _resolve_score(self, pending):
         """Resolve a deferred ``(loss, iteration_idx)`` score fetch. The
@@ -644,6 +726,7 @@ class ParallelWrapper:
 
         apply_step = monitored_jit(apply_fn, name="wrapper/shared_apply_step",
                                    out_shardings=repl, donate_argnums=(0,))
+        record_step("wrapper/shared", self.mesh)
         self._shared_steps = (update_step, apply_step)
         return self._shared_steps
 

@@ -17,7 +17,7 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          DEFAULT_BASELINE_PATH,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
-RULE_IDS = {"JAX001", "JAX002", "JAX003", "THR001", "THR002",
+RULE_IDS = {"JAX001", "JAX002", "JAX003", "JAX004", "THR001", "THR002",
             "THR003", "THR004", "RES001", "EXC001", "MON001"}
 
 
@@ -639,6 +639,111 @@ def test_jax001_follows_monitored_jit_wrapped_defs():
             return monitored_jit(step, name="mln/step")
         """, rules=["JAX001"])
     assert rule_ids(fs) == ["JAX001"]
+
+
+# ---------------------------------- JAX004 raw Mesh/shard_map construction
+def test_jax004_flags_raw_mesh_and_shard_map_calls():
+    src = """
+        import jax
+        from jax.sharding import Mesh
+        from ..compat import shard_map
+        import numpy as np
+
+        def build(devices, fn, mesh):
+            m1 = Mesh(np.asarray(devices).reshape(4, 2), ("data", "model"))
+            m2 = jax.sharding.Mesh(np.asarray(devices), ("data",))
+            stepped = shard_map(fn, mesh=mesh, in_specs=(), out_specs=())
+            return m1, m2, stepped
+        """
+    fs = lint_src(src, path="deeplearning4j_tpu/serving/somemod.py")
+    assert rule_ids(fs) == ["JAX004"] * 3
+    assert "MeshSpec" in fs[0].message
+
+
+def test_jax004_flags_jax_shard_map_and_module_form():
+    fs = lint_src("""
+        import jax
+        from jax.experimental import shard_map as smod
+
+        def build(fn, mesh):
+            a = jax.shard_map(fn, mesh=mesh, in_specs=(), out_specs=())
+            b = smod.shard_map(fn, mesh=mesh, in_specs=(), out_specs=())
+            return a, b
+        """, path="deeplearning4j_tpu/somemod.py")
+    assert rule_ids(fs) == ["JAX004"] * 2
+
+
+def test_jax004_follows_sharding_module_aliases():
+    # `import jax.sharding as jsh` / `from jax import sharding` must not
+    # evade the guard (review finding)
+    fs = lint_src("""
+        import numpy as np
+        import jax.sharding as jsh
+        from jax import sharding
+
+        def build(devices):
+            a = jsh.Mesh(np.asarray(devices), ("data",))
+            b = sharding.Mesh(np.asarray(devices), ("data",))
+            return a, b
+        """, path="deeplearning4j_tpu/somemod.py")
+    assert rule_ids(fs) == ["JAX004"] * 2
+
+
+def test_jax004_exempts_substrate_tests_and_annotations():
+    raw = """
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..compat import shard_map
+
+        def build(devices, fn, mesh):
+            m = Mesh(np.asarray(devices), ("data",))
+            return m, shard_map(fn, mesh=mesh, in_specs=(), out_specs=())
+        """
+    # the substrate package, compat.py and tests are exempt by design
+    assert lint_src(raw, path="deeplearning4j_tpu/parallel/mesh.py") == []
+    assert lint_src(raw, path="deeplearning4j_tpu/parallel/wrapper.py") == []
+    assert lint_src(raw, path="deeplearning4j_tpu/compat.py") == []
+    assert lint_src(raw, path="tests/test_x.py") == []
+    assert lint_src(raw, path="deeplearning4j_tpu/x.py") != []
+    # a Mesh type ANNOTATION is not a construction — only calls flag
+    ann = """
+        from jax.sharding import Mesh
+
+        def use(mesh: Mesh) -> Mesh:
+            return mesh
+        """
+    assert lint_src(ann, path="deeplearning4j_tpu/x.py") == []
+    # an unrelated object's own .shard_map method must not flag — only
+    # jax/compat module roots are constructors (review finding)
+    own = """
+        class Router:
+            def shard_map(self, fn):
+                return fn
+
+            def go(self, fn):
+                return self.shard_map(fn)
+        """
+    assert lint_src(own, path="deeplearning4j_tpu/x.py") == []
+    # routed through the substrate: clean
+    good = """
+        from .parallel.mesh import MeshSpec, make_mesh
+
+        def build(devices):
+            return MeshSpec(axes=("data", "model"),
+                            devices=devices).build()
+        """
+    assert lint_src(good, path="deeplearning4j_tpu/x.py") == []
+
+
+def test_jax004_pragma_suppression():
+    src = """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        def build(devices):
+            return Mesh(np.asarray(devices), ("data",))  # tpulint: disable=JAX004
+        """
+    assert lint_src(src, path="deeplearning4j_tpu/x.py") == []
 
 
 # ---------------------------------------------------------------- MON001
