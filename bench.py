@@ -802,6 +802,133 @@ def bench_paramserver(steps=32, n_in=1024, hidden=1024, classes=10,
     return sps_delta
 
 
+#: latched by bench_paramserver_overlap; embedded in its --one record so
+#: the BENCH trajectory carries the sync-vs-overlap comparison AND the
+#: per-phase breakdown that proves WHERE the win came from (comms hidden
+#: under compute), not just the headline number
+PARAMSERVER_OVERLAP_STATS = {}
+
+
+def bench_paramserver_overlap(steps=16, n_in=256, hidden=256, classes=10,
+                              batch=2048, min_delay_s=0.005):
+    """Latency-hiding hot loop (paramserver/overlap.py): the same async-SGD
+    fit run twice against ONE server — sync (``overlap=False``, today's
+    fully-serial loop) and overlapped (``overlap=True``: a comms worker
+    encodes+pushes step k while the device computes step k+1) — with an
+    INJECTED per-push transport delay (``push_delay_s`` ≥ 5 ms: a real
+    cross-host RTT, where localhost would measure ~100 µs and hide
+    nothing worth hiding). The delay is calibrated to the measured
+    compute+d2h mean of an un-delayed sync run, putting the comms round
+    and the device step in the same regime — exactly where the pipeline
+    earns its keep: sync pays compute + comms per step, overlap pays
+    ~max(compute, comms). Latches {steps/sec both modes, speedup, exact
+    per-phase means from ``train_step_phase_ms`` registry deltas, wall
+    step means} into ``PARAMSERVER_OVERLAP_STATS`` for the ``--one``
+    record. Headline value: overlap steps/sec.
+
+    Shape note: SMALL model × LARGE batch on purpose. On the CPU harness
+    the 'device' shares cores with the comms worker, so a big parameter
+    vector makes the worker's encode fight the next step's compute and
+    eat the win; ~68K params keeps encode sub-ms so the comms round is
+    dominated by the injected sleep (which contends with nothing), while
+    batch=2048 keeps compute comparable to the delay."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, DataSet,
+                                    ListDataSetIterator, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import DistributedMultiLayerNetwork
+    from deeplearning4j_tpu.monitor import get_registry
+    from deeplearning4j_tpu.paramserver import (
+        ParameterServer, ParameterServerClient,
+        ParameterServerTrainingMaster)
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(batch, n_in)).astype(np.float32),
+                       np.eye(classes, dtype=np.float32)[
+                           rng.integers(0, classes, batch)])
+               for _ in range(steps)]
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden))
+                .layer(OutputLayer(n_in=hidden, n_out=classes,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def phase_totals():
+        # (ms-sum, n) per phase straight from the registry children —
+        # exact per-mode means come from deltas around each timed fit
+        # (the registry is process-global and cumulative across runs)
+        reg = get_registry()
+        out = {}
+        for p in ("compute", "d2h", "encode", "push"):
+            _, total, n = reg.histogram(
+                "train_step_phase_ms",
+                "paramserver training hot-loop phase latency",
+                phase=p).state()
+            out[p] = (total, n)
+        _, total, n = reg.histogram(
+            "train_step_wall_ms",
+            "paramserver training wall time per step").state()
+        out["wall"] = (total, n)
+        return out
+
+    def run(overlap, delay_s):
+        net = build_net()
+        srv = ParameterServer(port=0)
+        try:
+            # the injected-latency client rides the master's ctor seam;
+            # count_own_pushes=False keeps staleness=0 from re-pulling the
+            # full vector after every own push (single worker, contiguous
+            # versions) so the comms round under test is push-only
+            client = ParameterServerClient(
+                srv.address, staleness=0, max_retries=5, backoff=0.01,
+                push_delay_s=delay_s)
+            master = ParameterServerTrainingMaster(
+                srv.address, staleness=0, threshold=1e-3, backoff=0.01,
+                count_own_pushes=False, client=client, overlap=overlap)
+            dnet = DistributedMultiLayerNetwork(net, master)
+            dnet.fit(ListDataSetIterator(batches[:2]))   # compile, un-timed
+            p0 = phase_totals()
+            t0 = time.perf_counter()
+            dnet.fit(ListDataSetIterator(batches))
+            dt = time.perf_counter() - t0
+            p1 = phase_totals()
+            master.close()
+            phase_ms = {k: round((p1[k][0] - p0[k][0])
+                                 / max(p1[k][1] - p0[k][1], 1), 3)
+                        for k in p1}
+            return steps / dt, phase_ms
+        finally:
+            srv.stop()
+
+    # calibrate: delay ≈ the step's device-side cost, floored at 5 ms
+    _, cal = run(overlap=False, delay_s=0.0)
+    delay_s = max(float(min_delay_s), (cal["compute"] + cal["d2h"]) / 1e3)
+
+    sps_sync, ph_sync = run(overlap=False, delay_s=delay_s)
+    sps_over, ph_over = run(overlap=True, delay_s=delay_s)
+    wall_sync = ph_sync.pop("wall")
+    wall_over = ph_over.pop("wall")
+    PARAMSERVER_OVERLAP_STATS.update({
+        "steps": steps, "params": n_in * hidden + hidden
+                                  + hidden * classes + classes,
+        "push_delay_ms": round(delay_s * 1e3, 3),
+        "steps_per_sec_sync": round(sps_sync, 2),
+        "steps_per_sec_overlap": round(sps_over, 2),
+        "speedup": round(sps_over / max(sps_sync, 1e-9), 2),
+        "phase_ms": {"sync": ph_sync, "overlap": ph_over},
+        "wall_ms_sync": round(wall_sync, 3),
+        "wall_ms_overlap": round(wall_over, 3),
+        # wall < Σ phases is the proof the comms ran UNDER the compute
+        "hidden_ms_per_step": round(
+            sum(ph_over.values()) - wall_over, 3),
+    })
+    return sps_over
+
+
 PARALLEL_MEMORY_STATS = {}
 
 #: child source for the too-few-devices fallback: re-run the grid on a
@@ -1085,6 +1212,8 @@ ALL_BENCHES = [
     ("lenet_mnist_images_per_sec", "images/sec", bench_lenet),
     ("input_pipeline_images_per_sec", "images/sec", bench_input_pipeline),
     ("paramserver_steps_per_sec", "steps/sec", bench_paramserver),
+    ("paramserver_overlap_steps_per_sec", "steps/sec",
+     bench_paramserver_overlap),
     ("parallel_memory", "steps/sec", bench_parallel_memory),
     ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
@@ -1546,6 +1675,12 @@ def main():
                           # 1-server-dense vs N-server-delta comparison —
                           # populated only by the paramserver config
                           "paramserver": PARAMSERVER_STATS or None,
+                          # sync-vs-overlap latency-hiding comparison
+                          # (injected push delay, per-phase means) —
+                          # populated only by the paramserver_overlap
+                          # config
+                          "paramserver_overlap":
+                              PARAMSERVER_OVERLAP_STATS or None,
                           # {replicated, ws, fsdp} × {1-D, 2-D} mesh grid —
                           # populated only by the parallel_memory config
                           "parallel_memory": PARALLEL_MEMORY_STATS or None,

@@ -69,7 +69,7 @@ def all_rules() -> Dict[str, Type[Rule]]:
     (not at package import) keeps ``analysis.linter`` import-light and
     cycle-free."""
     from . import (exception_rules, jax_rules, lockgraph_rules,  # noqa: F401
-                   monitor_rules, resource_rules,  # noqa: F401
+                   monitor_rules, perf_rules, resource_rules,  # noqa: F401
                    threading_rules)  # noqa: F401
     return dict(sorted(_REGISTRY.items()))
 

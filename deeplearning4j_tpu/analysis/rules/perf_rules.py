@@ -1,0 +1,94 @@
+"""PERF rules: host↔device traffic shapes that serialize a hot loop.
+
+PERF001 targets the exact shape the latency-hiding training pass removed
+from ``paramserver/training.py`` and ``parallel/distributed.py``: a
+``tree_map(np.asarray, ...)`` (or ``jax.device_get``) over a jit output
+inside a training loop. Each leaf's conversion BLOCKS on its own
+device→host transfer, so an N-leaf update tree pays N serialized stalls
+per step — and the whole fetch sits between dispatch and comms, where
+``paramserver.overlap.async_device_get`` would overlap the transfers
+(and the overlap pipeline would hide them entirely).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Sequence, Set, Tuple
+
+from . import Rule, register, call_callee
+from ..linter import Finding
+
+#: path components that mark training hot-loop packages — the rule only
+#: fires where a blocking fetch actually stalls an accelerator step
+_HOT_PACKAGES = ("paramserver", "parallel")
+
+
+def _is_blocking_fetch(node: ast.AST) -> bool:
+    """A reference to ``np.asarray`` / ``numpy.asarray`` /
+    ``jax.device_get`` (or bare ``device_get``) — the per-leaf blocking
+    device→host fetches. ``jnp.asarray`` is NOT one (device-resident)."""
+    if isinstance(node, ast.Attribute):
+        if node.attr == "asarray":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in ("np", "numpy"))
+        return node.attr == "device_get"
+    return isinstance(node, ast.Name) and node.id == "device_get"
+
+
+@register
+class BlockingFetchInHotLoop(Rule):
+    id = "PERF001"
+    title = ("blocking device→host fetch (tree_map over np.asarray/"
+             "device_get) inside a training hot loop")
+    rationale = (
+        "tree_map(np.asarray, update) in a paramserver//parallel/ loop "
+        "blocks once PER LEAF on a device→host transfer, serializing the "
+        "accelerator behind the host exactly where throughput is decided; "
+        "paramserver.overlap.async_device_get starts every transfer first "
+        "and gathers once, and the overlap pipeline (overlap=True) hides "
+        "the whole fetch+push behind the next step's compute.")
+
+    def check(self, tree: ast.AST, lines: Sequence[str],
+              path: str) -> Iterator[Finding]:
+        parts = path.replace("\\", "/").split("/")
+        if not any(p in _HOT_PACKAGES for p in parts):
+            return
+        seen: Set[Tuple[int, int]] = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in self._loop_nodes(loop):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                if call_callee(node) != "tree_map":
+                    continue
+                if not _is_blocking_fetch(node.args[0]):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:        # nested loops walk the body twice
+                    continue
+                seen.add(key)
+                fetch = ("device_get"
+                         if not (isinstance(node.args[0], ast.Attribute)
+                                 and node.args[0].attr == "asarray")
+                         else "np.asarray")
+                yield self.finding(
+                    node, lines, path,
+                    f"tree_map({fetch}, ...) inside a loop blocks the "
+                    f"hot path once per leaf on a device→host transfer; "
+                    f"use paramserver.overlap.async_device_get (starts "
+                    f"all transfers, gathers once) or keep the update "
+                    f"device-resident")
+
+    @staticmethod
+    def _loop_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+        """Walk a loop's body without descending into nested function or
+        lambda definitions — code merely *defined* in a loop does not run
+        per iteration."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))

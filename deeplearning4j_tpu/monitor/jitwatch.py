@@ -767,6 +767,7 @@ def profile_report() -> Dict[str, Any]:
             "etl_ms": summary("training_etl_ms"),
         },
         "pipeline": _pipeline_block(snap),
+        "training": _training_block(snap),
         "serving": _serving_block(snap),
         "mesh": _mesh_block(),
         "locks": _locks_block(),
@@ -927,6 +928,41 @@ def _serving_block(snap) -> Dict[str, Any]:
     return per
 
 
+def _training_block(snap) -> Dict[str, Any]:
+    """Paramserver hot-loop phase anatomy (paramserver/training.py +
+    overlap.py): per-phase latency summaries (compute / d2h / encode /
+    push), the wall step time, and whether the latency-hiding comms
+    pipeline is on. ``hidden_ms_total`` is Σ phase totals − wall total —
+    positive means comms genuinely ran UNDER the compute (real overlap),
+    while the sync loop reads at or below zero (phases stack end to
+    end). Empty until a paramserver master has stepped."""
+    phases: Dict[str, Any] = {}
+    phase_total = 0.0
+    for r in snap.get("train_step_phase_ms", []):
+        s = r.get("summary")
+        if not s:
+            continue
+        phases[r["labels"].get("phase", "?")] = {
+            "mean": round(s["mean_ms"], 3), "p95": s["p95_ms"],
+            "max": s["max_ms"], "n": int(s["n"])}
+        phase_total += s["mean_ms"] * s["n"]
+    if not phases:
+        return {}
+    out: Dict[str, Any] = {"phase_ms": phases,
+                           "phase_ms_total": round(phase_total, 3)}
+    wall = _snap_summary(snap, "train_step_wall_ms")
+    if wall:
+        wall_total = wall["mean_ms"] * wall["n"]
+        out["wall_ms"] = {"mean": round(wall["mean_ms"], 3),
+                          "p95": wall["p95_ms"], "max": wall["max_ms"],
+                          "n": int(wall["n"])}
+        out["wall_ms_total"] = round(wall_total, 3)
+        out["hidden_ms_total"] = round(phase_total - wall_total, 3)
+    ov = _snap_value(snap, "train_overlap_active")
+    out["overlap_active"] = bool(ov)
+    return out
+
+
 def _pipeline_block(snap) -> Dict[str, Any]:
     """Input-pipeline anatomy (datasets/prefetch.py): queue depth, the
     residual blocking wait, bytes fed, and the compute/ETL overlap split —
@@ -1024,6 +1060,25 @@ def render_profile_text(report: Dict[str, Any]) -> str:
             lines.append(f"etl_fraction={pipe['etl_fraction']} "
                          f"(etl {pipe.get('etl_ms_total')} ms / step "
                          f"{pipe.get('step_ms_total')} ms)")
+    training = report.get("training") or {}
+    if training:
+        lines.append("")
+        lines.append("# training (paramserver hot-loop phases)")
+        lines.append(f"overlap_active={training.get('overlap_active')}")
+        for p in ("compute", "d2h", "encode", "push"):
+            r = (training.get("phase_ms") or {}).get(p)
+            if r:
+                lines.append(f"{p}: mean={r['mean']:.3f} "
+                             f"p95={r['p95']:.3f} max={r['max']:.3f} "
+                             f"n={r['n']}")
+        w = training.get("wall_ms")
+        if w:
+            lines.append(f"wall: mean={w['mean']:.3f} p95={w['p95']:.3f} "
+                         f"max={w['max']:.3f} n={w['n']}")
+        if training.get("hidden_ms_total") is not None:
+            lines.append(f"hidden_ms_total={training['hidden_ms_total']} "
+                         f"(sum of phases {training.get('phase_ms_total')}"
+                         f" ms - wall {training.get('wall_ms_total')} ms)")
     serving = report.get("serving") or {}
     if serving:
         lines.append("")

@@ -37,7 +37,17 @@ def threshold_encode(grad: np.ndarray, threshold: float
 
 def threshold_decode(idx: np.ndarray, signs: np.ndarray, threshold: float,
                      shape) -> np.ndarray:
-    """Densify an encoded update (reference ``thresholdDecode``)."""
+    """Densify an encoded update (reference ``thresholdDecode``).
+
+    ``signs`` is normally the int8 ±1 vector of a quantized frame; a
+    float32 ``signs`` array is an *exact* frame (lossless accumulator,
+    threshold 0) carrying the raw values, scattered here without ever
+    reaching the int8-only native codec."""
+    signs = np.asarray(signs)
+    if signs.dtype == np.float32:
+        out = np.zeros(int(np.prod(shape)) if shape else 1, np.float32)
+        out[np.asarray(idx, np.int64)] = signs
+        return out.reshape(shape)
     return _native.threshold_decode(idx, signs, threshold, shape)
 
 
@@ -145,10 +155,32 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
             off += n
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
+    @property
+    def lossless(self) -> bool:
+        """True when the codec is exact: threshold 0 encodes the raw f32
+        values, so decode(encode(g)) == g and the residual stays empty."""
+        return self._handler.threshold <= 0.0
+
+    @property
+    def has_residual(self) -> bool:
+        """True when reinjected/sub-threshold mass is pending — the next
+        ``store_update`` will fold it in, so the stored update is NOT equal
+        to the incoming gradient alone."""
+        return self._residual is not None and bool(np.any(self._residual))
+
     def store_update(self, grads):
         g = self._flatten(grads)
         if self._residual is not None:
             g = g + self._residual
+        if self._handler.threshold <= 0.0:
+            # lossless fast path: an *exact* frame (f32 values instead of
+            # int8 signs) — decode is the identity, nothing stays behind
+            idx = np.flatnonzero(g).astype(np.int32)
+            self._residual = None
+            self._handler.iterations += 1
+            self.last_encoded = (idx, np.ascontiguousarray(g[idx]),
+                                 0.0, g.size)
+            return self._unflatten(g)
         (idx, signs, thr), residual = self._handler.encode(g)
         self._residual = residual
         self.last_encoded = (idx, signs, thr, g.size)
@@ -201,19 +233,25 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
 
 
 # ------------------------------------------------------------------ wire I/O
-_WIRE_MAGIC = 0x444C3454  # "DL4T"
+_WIRE_MAGIC = 0x444C3454        # "DL4T" — quantized frame (int8 signs)
+_WIRE_MAGIC_EXACT = 0x444C3458  # "DL4X" — exact frame (f32 values)
 
 
 def serialize_encoded(encoded) -> bytes:
     """Pack (idx, signs, threshold, n) into the wire frame: little-endian
     header [magic u32, n u64, k u64, threshold f32] + idx i32[k] + signs
     i8[k] — the Aeron-free counterpart of the reference's
-    ``SilentUpdatesMessage`` (``networking/messages/SilentUpdatesMessage.java``)."""
+    ``SilentUpdatesMessage`` (``networking/messages/SilentUpdatesMessage.java``).
+    Float32 ``signs`` mark an *exact* frame (lossless accumulator): the
+    payload carries f32 values under ``_WIRE_MAGIC_EXACT`` instead."""
     idx, signs, thr, n = encoded
     idx = np.ascontiguousarray(idx, np.int32)
-    signs = np.ascontiguousarray(signs, np.int8)
+    signs = np.asarray(signs)
+    exact = signs.dtype == np.float32
+    signs = np.ascontiguousarray(signs,
+                                 np.float32 if exact else np.int8)
     header = np.zeros(6, np.uint32)
-    header[0] = _WIRE_MAGIC
+    header[0] = _WIRE_MAGIC_EXACT if exact else _WIRE_MAGIC
     header[1] = n & 0xFFFFFFFF
     header[2] = n >> 32
     header[3] = idx.size & 0xFFFFFFFF
@@ -224,11 +262,14 @@ def serialize_encoded(encoded) -> bytes:
 
 def deserialize_encoded(data: bytes):
     header = np.frombuffer(data[:24], np.uint32)
-    if int(header[0]) != _WIRE_MAGIC:
+    if int(header[0]) not in (_WIRE_MAGIC, _WIRE_MAGIC_EXACT):
         raise ValueError("bad wire frame")
     n = int(header[1]) | (int(header[2]) << 32)
     k = int(header[3]) | (int(header[4]) << 32)
     thr = float(header[5:6].view(np.float32)[0])
     idx = np.frombuffer(data[24:24 + 4 * k], np.int32)
-    signs = np.frombuffer(data[24 + 4 * k:24 + 5 * k], np.int8)
+    if int(header[0]) == _WIRE_MAGIC_EXACT:
+        signs = np.frombuffer(data[24 + 4 * k:24 + 8 * k], np.float32)
+    else:
+        signs = np.frombuffer(data[24 + 4 * k:24 + 5 * k], np.int8)
     return idx, signs, thr, n

@@ -293,6 +293,9 @@ class SharedGradientsClusterTrainer:
 
     def fit(self, iterator, epochs: int = 1):
         import jax.numpy as jnp
+        # function-level import: paramserver.training imports this module,
+        # so a top-level import here would be circular
+        from ..paramserver.overlap import async_device_get
         net = self.net
         acc = self.accumulator
         for _ in range(epochs):
@@ -304,7 +307,11 @@ class SharedGradientsClusterTrainer:
                     self._update_step(net.params, net.states,
                                       net.updater_state, itc,
                                       net._next_rng(), f, l, None, None)
-                update = jax.tree_util.tree_map(np.asarray, update)
+                # overlapped d2h (paramserver/overlap.py): every leaf's
+                # transfer starts before the first gather blocks — the
+                # PERF001 shape (blocking tree_map(np.asarray) in a hot
+                # loop) removed the same way the paramserver master's was
+                update = async_device_get(update)
                 decoded_own = acc.store_update(update)
                 frame = acc.serialize_last()
                 self.wire_bytes_sent += len(frame) * (self.channel.P - 1)

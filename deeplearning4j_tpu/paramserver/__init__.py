@@ -20,7 +20,8 @@ from .sharded import (ShardedParameterServerGroup,
 from .training import (ParameterServerTrainingMaster, flatten_params,
                        set_params_from_flat)
 from .metrics import (ParamServerMetrics, ParamServerMetricsListener,
-                      LatencyHistogram)
+                      LatencyHistogram, TrainStepPhases)
+from .overlap import CommsPipeline, async_device_get
 
 __all__ = [
     "ParameterServer", "OP_TELEMETRY", "OP_PULL_DELTA", "FLAG_TRACE",
@@ -29,5 +30,6 @@ __all__ = [
     "ShardedParameterServerClient", "parse_addresses",
     "shard_slice_length", "ParameterServerTrainingMaster",
     "flatten_params", "set_params_from_flat", "ParamServerMetrics",
-    "ParamServerMetricsListener", "LatencyHistogram",
+    "ParamServerMetricsListener", "LatencyHistogram", "TrainStepPhases",
+    "CommsPipeline", "async_device_get",
 ]

@@ -127,6 +127,9 @@ class ParameterServerClient:
     concurrent requests open temporary sockets that close on return).
     ``shard``: which shard of a fleet this client talks to — metrics
     labeling only (``paramserver_wire_bytes_total{shard=}``).
+    ``push_delay_s``: artificial per-push latency added before the wire
+    round — a fault-injection dial for benchmarks/tests that need a slow
+    transport (the overlap bench drives sync vs overlap against it).
     """
 
     def __init__(self, address: str, staleness: int = 0,
@@ -135,7 +138,8 @@ class ParameterServerClient:
                  timeout: float = 30.0,
                  metrics: Optional[ParamServerMetrics] = None,
                  worker_id: Optional[str] = None, tracer=None,
-                 pool_size: int = 1, shard: Optional[int] = None):
+                 pool_size: int = 1, shard: Optional[int] = None,
+                 push_delay_s: float = 0.0):
         host, _, port = address.rpartition(":")
         self.host, self.port = host, int(port)
         self.address = address
@@ -146,6 +150,7 @@ class ParameterServerClient:
         self.jitter = float(jitter)
         self.timeout = float(timeout)
         self.pool_size = max(1, int(pool_size))
+        self.push_delay_s = float(push_delay_s)
         self.shard_label = "0" if shard is None else str(shard)
         self.metrics = metrics or ParamServerMetrics()
         #: fleet identity this client reports telemetry under; spans land
@@ -337,6 +342,8 @@ class ParameterServerClient:
         t0 = time.perf_counter()
         with self.tracer.span("ps/push", cat="paramserver",
                               bytes=len(frame)) as ctx:
+            if self.push_delay_s > 0.0:
+                time.sleep(self.push_delay_s)  # injected transport latency
             op, payload = self._traced(OP_PUSH, frame, ctx)
             out = self._request(op, payload)
         self.metrics.record_push((time.perf_counter() - t0) * 1e3,

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from contextlib import contextmanager
 from typing import Dict, List
 
 from ..monitor.lockwatch import make_lock
@@ -25,7 +27,7 @@ from ..monitor.registry import LatencyHistogram, get_registry
 from ..optimize.listeners import TrainingListener
 
 __all__ = ["LatencyHistogram", "COUNTERS", "ParamServerMetrics",
-           "ParamServerMetricsListener"]
+           "ParamServerMetricsListener", "TrainStepPhases"]
 
 log = logging.getLogger(__name__)
 
@@ -101,6 +103,46 @@ class ParamServerMetrics:
             return {"counters": dict(self.counters),
                     "push_latency": self.push_latency.summary(),
                     "pull_latency": self.pull_latency.summary()}
+
+
+class TrainStepPhases:
+    """Per-phase timing of the paramserver training hot loop: each phase
+    gets a ``train/<phase>`` tracer span AND a ``train_step_phase_ms``
+    histogram child labeled ``phase=`` — the series behind the ``GET
+    /profile`` training block. ``wall()`` records the whole step; in
+    overlap mode wall < Σ phases is the proof the comms really ran under
+    the compute (not just reordered accounting).
+
+    Thread-agnostic by design: the compute/d2h phases time on the
+    training thread while encode/push time on the comms worker — the
+    registry children and the tracer are both thread-safe."""
+
+    PHASES = ("compute", "d2h", "encode", "push")
+
+    def __init__(self, tracer, overlap: bool = False):
+        reg = get_registry()
+        self.tracer = tracer
+        self._hist = {p: reg.histogram(
+            "train_step_phase_ms",
+            "paramserver training hot-loop phase latency", phase=p)
+            for p in self.PHASES}
+        self._wall = reg.histogram(
+            "train_step_wall_ms",
+            "paramserver training wall time per step")
+        reg.gauge(
+            "train_overlap_active",
+            "1 while the latency-hiding comms pipeline is on"
+        ).set(1.0 if overlap else 0.0)
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        with self.tracer.span(f"train/{name}", cat="train"):
+            yield
+        self._hist[name].observe((time.perf_counter() - t0) * 1e3)
+
+    def wall(self, ms: float):
+        self._wall.observe(ms)
 
 
 class ParamServerMetricsListener(TrainingListener):

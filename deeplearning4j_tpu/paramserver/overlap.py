@@ -1,0 +1,168 @@
+"""Latency-hiding comms pipeline for the paramserver training loop.
+
+The reference stack's headline distributed mode is *async* gradient
+sharing: workers hand their encoded update to a background publisher
+(``SilentTrainingDriver`` over Aeron) and go straight back to compute —
+the wire never stalls the device. This module is that seam for the TPU
+port: :class:`CommsPipeline` is a single background comms worker with a
+**bounded in-flight depth of one** — while the device computes step
+``k+1``, the worker encodes/pushes step ``k``; step ``k+2`` cannot start
+its comms until step ``k``'s are drained, so the staleness bound only
+grows by exactly one step and ``count_own_pushes`` contiguity logic keeps
+working unchanged.
+
+Handoff discipline: jobs run **unlocked** (the condition guards only the
+tiny state machine, never the socket I/O), results and exceptions travel
+back to the training thread at :meth:`CommsPipeline.drain` — a failed
+push re-raises *there*, loudly, instead of dying silently on a daemon
+thread.
+
+:func:`async_device_get` is the d2h half of the latency hiding: it starts
+a non-blocking device→host copy of every leaf first, then gathers — so a
+multi-leaf update tree overlaps its transfers instead of serializing one
+blocking ``np.asarray`` barrier per leaf (the shape tpulint PERF001
+flags in hot loops).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+
+from ..monitor.lockwatch import make_condition
+
+__all__ = ["CommsPipeline", "async_device_get", "start_device_get"]
+
+
+def start_device_get(tree):
+    """Kick off the device→host transfer of every leaf WITHOUT waiting —
+    call right after dispatching the producing computation, pair with a
+    later :func:`async_device_get` to collect. Starting a transfer twice
+    is harmless (the runtime coalesces), so the pair composes freely."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
+
+def async_device_get(tree):
+    """Device→host fetch of a pytree with the transfers overlapped:
+    every leaf's ``copy_to_host_async()`` is started *before* the first
+    blocking gather, so N leaves pay one round of transfer latency, not
+    N. Host-side leaves (plain numpy) pass through untouched. Returns the
+    tree with every leaf as ``np.ndarray`` — same values as
+    ``tree_map(np.asarray, tree)``, without the serialized stalls."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            start()
+    return jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(leaf) for leaf in leaves])
+
+
+class _Job:
+    """One in-flight comms round: the closure plus its outcome slots."""
+    __slots__ = ("fn", "label", "started", "done", "result", "error")
+
+    def __init__(self, fn: Callable[[], Any], label: str):
+        self.fn = fn
+        self.label = label
+        self.started = False
+        self.done = False
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class CommsPipeline:
+    """Single background comms worker, bounded in-flight depth 1.
+
+    Protocol (enforced, not advisory): every :meth:`submit` must be
+    preceded by a :meth:`drain` of the previous job — submitting over an
+    undrained job raises ``RuntimeError`` rather than silently growing
+    the staleness window. ``drain()`` blocks until the in-flight job
+    finishes and returns its result, **re-raising** any exception the job
+    hit; with nothing in flight it returns ``None`` immediately.
+    """
+
+    def __init__(self, name: str = "ps-comms"):
+        self._cond = make_condition("CommsPipeline._cond")
+        self._inflight: Optional[_Job] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._closed and (self._inflight is None
+                                            or self._inflight.started):
+                    self._cond.wait(0.2)
+                if self._closed and (self._inflight is None
+                                     or self._inflight.started):
+                    return
+                job = self._inflight
+                job.started = True
+            # the job runs UNLOCKED: socket rounds / encode work must
+            # never execute under the pipeline's condition (THR001/THR004
+            # discipline — the lock guards the state machine, not I/O)
+            try:
+                job.result = job.fn()
+            except BaseException as e:  # delivered at drain()
+                job.error = e
+            with self._cond:
+                job.done = True
+                self._cond.notify_all()
+
+    # ---------------------------------------------------------------- api
+    def submit(self, fn: Callable[[], Any], label: str = "comms"):
+        """Hand one comms round to the worker. The previous round must
+        have been drained (depth-1 invariant)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("CommsPipeline is closed")
+            if self._inflight is not None:
+                raise RuntimeError(
+                    f"submit('{label}') over undrained in-flight job "
+                    f"'{self._inflight.label}' — drain() first "
+                    f"(depth-1 invariant)")
+            self._inflight = _Job(fn, label)
+            self._cond.notify_all()
+
+    def inflight(self) -> bool:
+        """True while a submitted job has not been drained yet."""
+        with self._cond:
+            return self._inflight is not None
+
+    def drain(self):
+        """Wait for the in-flight job; return its result or re-raise its
+        exception. ``None`` immediately when nothing is in flight."""
+        with self._cond:
+            job = self._inflight
+            if job is None:
+                return None
+            while not job.done:
+                self._cond.wait(0.5)
+            self._inflight = None
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def close(self, timeout: float = 10.0):
+        """Stop the worker. Callers drain before closing — an undrained
+        job still finishes, but its outcome is lost with the pipeline;
+        see ``ParameterServerTrainingMaster.close``."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -272,7 +272,8 @@ class ShardedParameterServerClient:
                  timeout: float = 30.0, pool_size: int = 2,
                  worker_id: Optional[str] = None, tracer=None,
                  down_backoff: float = 1.0,
-                 metrics: Optional[ParamServerMetrics] = None):
+                 metrics: Optional[ParamServerMetrics] = None,
+                 push_delay_s: float = 0.0):
         # compile-once fleet seam (compilecache/): constructing this
         # client is what a worker does on join, REJOIN after a death, and
         # remap after scale_to — exactly the moments its next fit would
@@ -289,7 +290,7 @@ class ShardedParameterServerClient:
         self._client_kw = dict(
             staleness=staleness, max_retries=max_retries, backoff=backoff,
             backoff_max=backoff_max, jitter=jitter, timeout=timeout,
-            pool_size=pool_size)
+            pool_size=pool_size, push_delay_s=push_delay_s)
         self.clients = [ParameterServerClient(
             a, metrics=self.metrics, worker_id=worker_id, tracer=tracer,
             shard=j, **self._client_kw)
@@ -493,7 +494,13 @@ class ShardedParameterServerClient:
         """
         idx, signs, thr, n = encoded
         idx = np.ascontiguousarray(idx, np.int32)
-        signs = np.ascontiguousarray(signs, np.int8)
+        signs = np.asarray(signs)
+        # float32 "signs" are an exact frame (lossless accumulator) and
+        # must keep their dtype through the split — serialize_encoded
+        # branches on it
+        exact = signs.dtype == np.float32
+        signs = np.ascontiguousarray(signs,
+                                     np.float32 if exact else np.int8)
         n = int(n)
         self._n = n
         N = self.num_servers
@@ -523,9 +530,11 @@ class ShardedParameterServerClient:
                     if failed_mass is None:
                         failed_mass = np.zeros(n, np.float32)
                     # what decode(frame) would have applied: ±thr at the
-                    # encoded indices — hand it back for residual reinjection
-                    failed_mass[idx[m]] += (signs[m].astype(np.float32)
-                                            * np.float32(thr))
+                    # encoded indices (the raw values for an exact frame) —
+                    # hand it back for residual reinjection
+                    failed_mass[idx[m]] += (
+                        signs[m] if exact
+                        else signs[m].astype(np.float32) * np.float32(thr))
             else:
                 versions[j] = int(out)
         return versions, failed_mass

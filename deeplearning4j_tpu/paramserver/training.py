@@ -34,6 +34,8 @@ from ..parallel.accumulation import (EncodedGradientsAccumulator,
                                      flatten_tree_f32)
 from .client import ParameterServerClient, ParameterServerError
 from .metrics import ParamServerMetricsListener  # noqa: F401  (re-export)
+from .metrics import TrainStepPhases
+from .overlap import CommsPipeline, async_device_get, start_device_get
 
 __all__ = ["ParameterServerTrainingMaster", "flatten_params",
            "set_params_from_flat"]
@@ -93,6 +95,7 @@ class ParameterServerTrainingMaster(TrainingMaster):
             self._telemetry_interval = 5.0
             self._num_servers = None
             self._delta_push = None
+            self._overlap = False
 
         def staleness(self, n):
             self._staleness = int(n)
@@ -154,6 +157,16 @@ class ParameterServerTrainingMaster(TrainingMaster):
 
         deltaPush = delta_push
 
+        def overlap(self, flag: bool = True):
+            """Latency-hiding comms pipeline (``paramserver/overlap.py``):
+            a background worker encodes+pushes step *k* while the device
+            computes step *k+1*, bounded in-flight depth 1. Default False
+            — the sync loop, bit-identical to the pre-overlap master;
+            True trades one extra step of (honest, bounded) staleness for
+            hiding the wire behind compute."""
+            self._overlap = bool(flag)
+            return self
+
         def build(self):
             return ParameterServerTrainingMaster(
                 self._address, staleness=self._staleness,
@@ -164,7 +177,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
                 worker_id=self._worker_id,
                 telemetry_interval=self._telemetry_interval,
                 num_servers=self._num_servers,
-                delta_push=self._delta_push)
+                delta_push=self._delta_push,
+                overlap=self._overlap)
 
     def __init__(self, server_address, staleness: int = 0,
                  threshold: float = 1e-3, batch_size_per_worker: int = 32,
@@ -174,7 +188,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
                  telemetry_interval: float = 5.0,
                  num_servers: Optional[int] = None,
                  delta_push: Optional[bool] = None,
-                 client: Optional[ParameterServerClient] = None):
+                 client: Optional[ParameterServerClient] = None,
+                 overlap: bool = False):
         self.server_address = server_address
         self.staleness = int(staleness)
         self.threshold = float(threshold)
@@ -206,6 +221,10 @@ class ParameterServerTrainingMaster(TrainingMaster):
         #: the proto v3 delta wire (None = auto: on for multi-server)
         self.num_servers = num_servers
         self.delta_push = delta_push
+        #: latency-hiding mode (``overlap.CommsPipeline``): False (default)
+        #: keeps today's fully-synchronous loop; True pushes step k on a
+        #: background worker while the device computes step k+1
+        self.overlap = bool(overlap)
         self.client = client
         self.accumulator = EncodedGradientsAccumulator(
             initial_threshold=threshold)
@@ -215,6 +234,8 @@ class ParameterServerTrainingMaster(TrainingMaster):
         self._step_net = None
         self._joined_once = False
         self._last_telemetry = 0.0
+        self._pipeline: Optional[CommsPipeline] = None
+        self._phases: Optional[TrainStepPhases] = None
 
     # ------------------------------------------------------------ plumbing
     def _ensure_client(self):
@@ -304,6 +325,96 @@ class ParameterServerTrainingMaster(TrainingMaster):
                 apply_fn, name="paramserver/apply_step",
                 donate_argnums=(0,))
 
+    # ------------------------------------------------------ hot-loop parts
+    def _adopt_pushed_version(self, pushed_version):
+        """``count_own_pushes=False`` contiguity guard: the returned
+        version is the GLOBAL counter (per shard server, for a fleet), so
+        it only provably covers just our own push when it is exactly
+        local+1. Adopt it then (the optimistic local apply already holds
+        this update's effect); any gap means other workers' pushes
+        interleaved — leave ``local_version`` alone so ``pull_if_stale``
+        still sees them and the staleness=k bound stays honest."""
+        if self.count_own_pushes:
+            return
+        if isinstance(pushed_version, list):
+            for j, pv in enumerate(pushed_version):
+                if pv is not None and pv == self.local_version[j] + 1:
+                    self.local_version[j] = pv
+        elif pushed_version == self.local_version + 1:
+            self.local_version = pushed_version
+
+    def _adopt_fresh(self, net, client, fresh):
+        """Adopt a non-None ``pull_if_stale`` answer into the net."""
+        if fresh is None:
+            return
+        self.local_version, payload = fresh
+        if isinstance(payload, dict):
+            # sharded resync: scatter ONLY the refreshed shards' slices;
+            # the fresh shards keep this worker's optimistic local state
+            # (the per-shard bounded-staleness contract)
+            vec = flatten_params(net.params)
+            n_srv = client.num_servers
+            for j, values in payload.items():
+                vec[j::n_srv] = values
+            set_params_from_flat(net, vec)
+        else:
+            set_params_from_flat(net, payload)
+
+    def _comms_round(self, client, acc, update_host, fast):
+        """One full comms round for ``update_host`` — runs on the
+        :class:`~.overlap.CommsPipeline` worker in overlap mode: store/
+        encode, push, failed-mass reinjection, version contiguity, the
+        staleness-bounded pull probe, and the periodic telemetry report
+        (off the hot loop). Returns ``(decoded_own, fast, fresh)`` for
+        the training thread to apply at drain."""
+        with self._phases.phase("encode"):
+            decoded_own = acc.store_update(update_host)
+        with self._phases.phase("push"):
+            pushed_version, failed_mass = client.push_encoded(
+                acc.last_encoded)
+        if failed_mass is not None:
+            # a down shard server's quantized mass re-enters the
+            # accumulator residual — re-encoded and re-pushed next
+            # round instead of vanishing with the dead node
+            acc.reinject(failed_mass)
+        self._adopt_pushed_version(pushed_version)
+        fresh = client.pull_if_stale(self.local_version)
+        self._ship_telemetry(client)
+        return decoded_own, fast, fresh
+
+    def _drain_inflight(self, net, client):
+        """Drain the in-flight comms round (no-op when none): apply its
+        decoded update — unless the lossless fast path already applied
+        the device-resident original — and adopt any pull it brought
+        back. A job that failed re-raises HERE, on the training thread:
+        the overlap window never swallows a push failure."""
+        import jax.numpy as jnp
+        if self._pipeline is None or not self._pipeline.inflight():
+            return
+        decoded_own, fast, fresh = self._pipeline.drain()
+        if not fast:
+            net.params = self._apply_step(
+                net.params,
+                jax.tree_util.tree_map(jnp.asarray, decoded_own))
+        self._adopt_fresh(net, client, fresh)
+
+    def close(self):
+        """Release the master's resources: drain any in-flight comms
+        round LOUDLY (a silently-lost push would shrink the training
+        signal), stop the pipeline worker, close the client. The master
+        stays reusable — the next fit reconnects lazily."""
+        try:
+            if self._pipeline is not None:
+                if self._step_net is not None and self.client is not None:
+                    self._drain_inflight(self._step_net, self.client)
+        finally:
+            if self._pipeline is not None:
+                self._pipeline.close()
+                self._pipeline = None
+            if self.client is not None:
+                self.client.close()
+                self.client = None
+
     # ------------------------------------------------------------ training
     def execute_training(self, net, iterator):
         import jax.numpy as jnp
@@ -319,6 +430,14 @@ class ParameterServerTrainingMaster(TrainingMaster):
         client = self._ensure_client()
         self._ensure_steps(net)
         acc = self.accumulator
+        phases = self._phases = TrainStepPhases(client.tracer,
+                                                overlap=self.overlap)
+        if self.overlap and self._pipeline is None:
+            self._pipeline = CommsPipeline()
+        # a round left in flight by an aborted previous fit must land
+        # BEFORE the join below adopts server state — loudly, so its
+        # outcome (including a failed push) is never silently dropped
+        self._drain_inflight(net, client)
 
         stats0 = {}
         if not self.count_own_pushes:   # the stats round trip is only
@@ -364,6 +483,7 @@ class ParameterServerTrainingMaster(TrainingMaster):
         steps = 0
         try:
             for ds in iterator:
+                step_t0 = time.perf_counter()
                 f = jnp.asarray(ds.features)
                 l = jnp.asarray(ds.labels)
                 itc = jnp.asarray(net.iteration_count, jnp.int32)
@@ -371,60 +491,79 @@ class ParameterServerTrainingMaster(TrainingMaster):
                     self._update_step(net.params, net.states,
                                       net.updater_state,
                                       itc, net._next_rng(), f, l, None, None)
-                update = jax.tree_util.tree_map(np.asarray, update)
-                decoded_own = acc.store_update(update)
-                # optimistic local apply: progress continues between pulls;
-                # the next adopted pull replaces it with the server's
-                # merged state
-                net.params = self._apply_step(
-                    net.params,
-                    jax.tree_util.tree_map(jnp.asarray, decoded_own))
-                pushed_version, failed_mass = client.push_encoded(
-                    acc.last_encoded)
-                if failed_mass is not None:
-                    # a down shard server's quantized mass re-enters the
-                    # accumulator residual — re-encoded and re-pushed next
-                    # round instead of vanishing with the dead node
-                    acc.reinject(failed_mass)
-                if not self.count_own_pushes:
-                    # contiguity guard: the returned version is the GLOBAL
-                    # counter (per shard server, for a fleet), so it only
-                    # provably covers just our own push when it is exactly
-                    # local+1. Adopt it then (the local optimistic apply
-                    # above already holds this update's effect); any gap
-                    # means other workers' pushes interleaved — leave
-                    # local_version alone so pull_if_stale still sees them
-                    # and the staleness=k bound stays honest.
-                    if isinstance(pushed_version, list):
-                        for j, pv in enumerate(pushed_version):
-                            if pv is not None \
-                                    and pv == self.local_version[j] + 1:
-                                self.local_version[j] = pv
-                    elif pushed_version == self.local_version + 1:
-                        self.local_version = pushed_version
-                fresh = client.pull_if_stale(self.local_version)
-                if fresh is not None:
-                    self.local_version, payload = fresh
-                    if isinstance(payload, dict):
-                        # sharded resync: scatter ONLY the refreshed
-                        # shards' slices; the fresh shards keep this
-                        # worker's optimistic local state (the per-shard
-                        # bounded-staleness contract)
-                        vec = flatten_params(net.params)
-                        n_srv = client.num_servers
-                        for j, values in payload.items():
-                            vec[j::n_srv] = values
-                        set_params_from_flat(net, vec)
+                # d2h starts NOW, at dispatch — the transfers ride behind
+                # the still-running computation instead of serializing
+                # after a blocking barrier
+                start_device_get(update)
+                with phases.phase("compute"):
+                    if hasattr(loss, "block_until_ready"):
+                        loss.block_until_ready()
+                with phases.phase("d2h"):
+                    update_host = async_device_get(update)
+                if self.overlap:
+                    # land step k-1's comms (overlapped with the compute
+                    # above), then hand step k's to the worker — bounded
+                    # in-flight depth 1, so staleness grows by exactly one
+                    self._drain_inflight(net, client)
+                    fast = acc.lossless and not acc.has_residual
+                    if fast:
+                        # lossless fast path: decoded == update, apply the
+                        # device-resident original (no encode→decode→h2d
+                        # bounce on the device side)
+                        net.params = self._apply_step(net.params, update)
+                    self._pipeline.submit(
+                        lambda uh=update_host, fa=fast:
+                            self._comms_round(client, acc, uh, fa),
+                        label=f"step-{steps}")
+                else:
+                    fast = acc.lossless and not acc.has_residual
+                    with phases.phase("encode"):
+                        decoded_own = acc.store_update(update_host)
+                    # optimistic local apply: progress continues between
+                    # pulls; the next adopted pull replaces it with the
+                    # server's merged state
+                    if fast:
+                        net.params = self._apply_step(net.params, update)
                     else:
-                        set_params_from_flat(net, payload)
+                        net.params = self._apply_step(
+                            net.params,
+                            jax.tree_util.tree_map(jnp.asarray,
+                                                   decoded_own))
+                    with phases.phase("push"):
+                        pushed_version, failed_mass = client.push_encoded(
+                            acc.last_encoded)
+                    if failed_mass is not None:
+                        # a down shard server's quantized mass re-enters
+                        # the accumulator residual — re-encoded and
+                        # re-pushed next round instead of vanishing with
+                        # the dead node
+                        acc.reinject(failed_mass)
+                    self._adopt_pushed_version(pushed_version)
+                    self._adopt_fresh(net, client,
+                                      client.pull_if_stale(
+                                          self.local_version))
                 net.score_ = loss
                 net.iteration_count += 1
                 steps += 1
                 for lst in net.listeners:
                     lst.iteration_done(net, net.iteration_count - 1,
                                        float(loss))
-                self._ship_telemetry(client)
+                if not self.overlap:
+                    self._ship_telemetry(client)
+                phases.wall((time.perf_counter() - step_t0) * 1e3)
+            # epoch end drains the last in-flight round before the leave
+            # record: its decoded apply/pull adoption land, and a failed
+            # push raises here instead of disappearing with the epoch
+            self._drain_inflight(net, client)
         except BaseException as e:
+            if self._pipeline is not None:
+                # land (or at least surface) the in-flight round without
+                # masking the original unwind cause
+                try:
+                    self._drain_inflight(net, client)
+                except Exception as drain_err:
+                    log.warning("in-flight comms round failed during "
+                                "error unwind: %s", drain_err)
             # the flight-recorder "worker died" record: whatever unwinds
             # (server loss, health raise, a KeyboardInterrupt) leaves an
             # ordered leave event behind so a later rejoin is attributable

@@ -18,7 +18,7 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
 RULE_IDS = {"JAX001", "JAX002", "JAX003", "JAX004", "THR001", "THR002",
-            "THR003", "THR004", "RES001", "EXC001", "MON001"}
+            "THR003", "THR004", "RES001", "EXC001", "MON001", "PERF001"}
 
 
 # default fixture path lives under tests/ so the JAX003 bare-jit rule
@@ -340,6 +340,63 @@ def test_exc001_accepts_narrow_logged_reraised_or_routed():
                 fut.set_exception(e)     # kept, not swallowed
         """)
     assert fs == []
+
+
+# ------------------------------------- PERF001 blocking d2h in hot loop
+_PERF_LOOP = """
+    import jax
+    import numpy as np
+
+    def fit(step, net, iterator):
+        for ds in iterator:
+            update, loss = step(net.params, ds)
+            update = jax.tree_util.tree_map(np.asarray, update)
+            net.apply(update)
+    """
+
+
+def test_perf001_flags_blocking_tree_map_fetch_in_hot_loop():
+    fs = lint_src(_PERF_LOOP, path="pkg/paramserver/training.py")
+    assert rule_ids(fs) == ["PERF001"]
+    assert "async_device_get" in fs[0].message
+    # parallel/ is a hot package too; device_get is the other fetch shape
+    fs = lint_src(_PERF_LOOP.replace("np.asarray", "jax.device_get"),
+                  path="pkg/parallel/distributed.py")
+    assert rule_ids(fs) == ["PERF001"]
+
+
+def test_perf001_only_fires_in_hot_packages_and_loops():
+    # same shape outside paramserver//parallel/: silent
+    assert lint_src(_PERF_LOOP, path="pkg/serving/engine.py") == []
+    # the fetch OUTSIDE a loop: one-shot d2h is fine
+    assert lint_src("""
+        import jax
+        import numpy as np
+
+        def snapshot(update):
+            return jax.tree_util.tree_map(np.asarray, update)
+        """, path="pkg/paramserver/training.py") == []
+    # jnp.asarray keeps the tree device-resident — not a fetch
+    assert lint_src(_PERF_LOOP.replace("np.asarray", "jnp.asarray"),
+                    path="pkg/paramserver/training.py") == []
+    # a closure DEFINED in the loop does not run per iteration
+    assert lint_src("""
+        import jax
+        import numpy as np
+
+        def fit(iterator):
+            for ds in iterator:
+                def later(u):
+                    return jax.tree_util.tree_map(np.asarray, u)
+                yield later
+        """, path="pkg/paramserver/training.py") == []
+
+
+def test_perf001_pragma_suppresses():
+    src = _PERF_LOOP.replace(
+        "tree_map(np.asarray, update)",
+        "tree_map(np.asarray, update)  # tpulint: disable=PERF001")
+    assert lint_src(src, path="pkg/paramserver/training.py") == []
 
 
 # --------------------------------------------------------------- pragmas

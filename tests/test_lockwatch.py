@@ -292,18 +292,61 @@ def _prefetch_flows():
     boom.shutdown()
 
 
+def _overlap_flows():
+    """The latency-hiding suite's core flows: an overlapped fit (the
+    comms worker parks on ``CommsPipeline._cond`` while the training
+    thread computes) plus the bare pipeline's submit/drain/error/close
+    edges."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration,
+                                    MultiLayerNetwork, DataSet,
+                                    ListDataSetIterator, Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.paramserver import (
+        CommsPipeline, ParameterServer, ParameterServerTrainingMaster)
+    rng = np.random.default_rng(9)
+    batches = [DataSet(rng.normal(size=(8, 5)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+               for _ in range(4)]
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+            .layer(DenseLayer(n_in=5, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    with ParameterServer(port=0) as srv:
+        master = ParameterServerTrainingMaster(
+            srv.address, staleness=0, threshold=1e-3, backoff=0.01,
+            overlap=True)
+        master.execute_training(net, ListDataSetIterator(batches))
+        master.close()
+    with CommsPipeline() as p:
+        p.submit(lambda: 1, label="a")
+        p.drain()
+        p.submit(lambda: 1 // 0, label="b")
+        try:
+            p.drain()
+        except ZeroDivisionError:
+            pass
+
+
 def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
-    """Tier-1 pin: the sharded-paramserver + prefetch flows under
-    lockwatch produce ZERO lock-order inversions, and every observed
-    edge is derivable by the static analyzer."""
+    """Tier-1 pin: the sharded-paramserver + prefetch + overlap flows
+    under lockwatch produce ZERO lock-order inversions, and every
+    observed edge is derivable by the static analyzer."""
     _sharded_flows()
     _prefetch_flows()
+    _overlap_flows()
     assert watch.inversions() == [], watch.inversions()
 
     observed = watch.observed_edges()
     # the pipeline's one real nesting must actually have been observed —
     # otherwise this cross-check proves nothing
     assert ("PrefetchIterator._pull_lock", "_Epoch.cond") in observed
+    # the comms pipeline's condition was genuinely exercised (worker
+    # parked + submit/drain handshakes), not just constructed
+    assert watch.contention_table()["CommsPipeline._cond"][
+        "acquisitions"] > 0
 
     from deeplearning4j_tpu.analysis.lockgraph import analyze_package
     static = analyze_package().edge_set()

@@ -1,0 +1,344 @@
+"""Latency-hiding training hot loop (paramserver/overlap.py + the
+``overlap=True`` mode of paramserver/training.py).
+
+The acceptance scenarios from the overlapped-comms pass:
+
+- with an injected ≥5 ms per-push transport delay, overlap mode's
+  steps/sec beats sync mode, and the phase accounting proves WHY (wall
+  step time < Σ phases: the comms genuinely ran under the compute);
+- sync mode (the default) stays bit-identical to the pre-overlap loop —
+  pinned against a hand-rolled twin of the old blocking code path;
+- the lossless threshold-0 fast path (exact f32 wire frames, apply the
+  device-resident update) is bit-identical to the encode→decode→h2d
+  bounce it replaces;
+- a shard server dying MID-OVERLAP hands its decoded mass back through
+  the comms worker into the accumulator residual (never lost);
+- epoch end / close() drain the in-flight round — no silently dropped
+  pushes, and the master stays reusable.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                DataSet, ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.monitor import get_flight_recorder, get_registry
+from deeplearning4j_tpu.parallel.accumulation import (
+    EncodedGradientsAccumulator, deserialize_encoded, serialize_encoded,
+    threshold_decode)
+from deeplearning4j_tpu.paramserver import (
+    CommsPipeline, ParameterServer, ParameterServerClient,
+    ParameterServerTrainingMaster, ShardedParameterServerGroup,
+    async_device_get, flatten_params, set_params_from_flat)
+from deeplearning4j_tpu.paramserver.overlap import start_device_get
+
+
+def _net(n_in=6, hidden=16, classes=4, seed=11):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=5e-2)).activation("tanh").list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden))
+            .layer(OutputLayer(n_in=hidden, n_out=classes,
+                               activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n=8, rows=16, n_in=6, classes=4, seed=3):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(rows, n_in)).astype(np.float32),
+                    np.eye(classes, dtype=np.float32)[
+                        rng.integers(0, classes, rows)])
+            for _ in range(n)]
+
+
+# -------------------------------------------------------- pipeline units
+def test_comms_pipeline_depth_one_error_and_close():
+    with CommsPipeline() as p:
+        assert not p.inflight()
+        p.submit(lambda: 41 + 1, label="ok")
+        assert p.inflight()
+        # bounded in-flight depth 1: a second submit before drain is a
+        # PROGRAMMING error, not a queue
+        with pytest.raises(RuntimeError):
+            p.submit(lambda: None, label="second")
+        assert p.drain() == 42
+        assert not p.inflight()
+        # a job's exception surfaces at drain, on the caller's thread...
+        p.submit(lambda: 1 // 0, label="boom")
+        with pytest.raises(ZeroDivisionError):
+            p.drain()
+        # ...and leaves the pipeline usable
+        p.submit(lambda: "ok", label="after")
+        assert p.drain() == "ok"
+    with pytest.raises(RuntimeError):
+        p.submit(lambda: None, label="closed")
+
+
+def test_async_device_get_matches_blocking_fetch():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": [jnp.ones(4, jnp.float32), jnp.asarray(3.5, jnp.float32)]}
+    start_device_get(tree)          # starting early twice is harmless
+    got = async_device_get(tree)
+    want = jax.tree_util.tree_map(np.asarray, tree)
+    got_l, got_def = jax.tree_util.tree_flatten(got)
+    want_l, want_def = jax.tree_util.tree_flatten(want)
+    assert got_def == want_def
+    for g, w in zip(got_l, want_l):
+        assert isinstance(g, np.ndarray)
+        np.testing.assert_array_equal(g, w)
+
+
+# ------------------------------------------------- lossless wire (thr=0)
+def test_exact_wire_frame_roundtrip_and_decode():
+    idx = np.array([0, 3, 7], np.int32)
+    vals = np.array([0.125, -2.5, 1e-8], np.float32)
+    blob = serialize_encoded((idx, vals, 0.0, 9))
+    i2, s2, thr, n = deserialize_encoded(blob)
+    assert s2.dtype == np.float32 and n == 9 and thr == 0.0
+    np.testing.assert_array_equal(i2, idx)
+    np.testing.assert_array_equal(s2, vals)   # bit-exact, incl. 1e-8
+    dec = threshold_decode(i2, s2, thr, (9,))
+    want = np.zeros(9, np.float32)
+    want[idx] = vals
+    np.testing.assert_array_equal(dec, want)
+    # int8 quantized frames ride the original magic, byte-compatible
+    q = serialize_encoded((idx, np.array([1, -1, 1], np.int8), 0.5, 9))
+    i3, s3, thr3, _ = deserialize_encoded(q)
+    assert s3.dtype == np.int8 and thr3 == 0.5
+    np.testing.assert_array_equal(i3, idx)
+
+
+def test_lossless_accumulator_is_exact_end_to_end():
+    rng = np.random.default_rng(2)
+    g = {"b": rng.normal(size=7).astype(np.float32),
+         "w": (rng.normal(size=(5, 3)).astype(np.float32)
+               * rng.integers(0, 2, (5, 3)))}   # real zeros stay off-wire
+    acc = EncodedGradientsAccumulator(initial_threshold=0.0)
+    assert acc.lossless
+    dec = acc.store_update(g)
+    for k in g:
+        np.testing.assert_array_equal(dec[k], np.asarray(g[k], np.float32))
+    assert not acc.has_residual                  # nothing withheld
+    idx, vals, thr, n = acc.last_encoded
+    assert vals.dtype == np.float32 and n == 22
+    # the exact frame survives the real server arithmetic
+    with ParameterServer(port=0) as srv:
+        with ParameterServerClient(srv.address, max_retries=2,
+                                   backoff=0.01) as c:
+            vec = np.linspace(-1.0, 1.0, n).astype(np.float32)
+            c.set_params(vec)
+            c.push_update(serialize_encoded(acc.last_encoded))
+            _, out = c.pull()
+            dense = threshold_decode(idx, vals, thr, (n,))
+            np.testing.assert_array_equal(out, vec - dense)
+
+
+# ------------------------------------------- bit-equality vs the old loop
+def _twin_fit_pre_overlap(master, net, batches):
+    """Hand-rolled replica of the PRE-overlap sync loop: blocking
+    per-leaf ``tree_map(np.asarray)`` fetch, encode, optimistic h2d apply
+    of the decoded update (no lossless fast path), push, staleness pull —
+    the exact op order the refactored sync mode must stay bit-identical
+    to."""
+    client = master._ensure_client()
+    master._ensure_steps(net)
+    acc = master.accumulator
+    version, created = client.init_params(flatten_params(net.params))
+    if not created:
+        version, vec = client.pull()
+        set_params_from_flat(net, vec)
+    master.local_version = version
+    for ds in batches:
+        f = jnp.asarray(ds.features)
+        l = jnp.asarray(ds.labels)
+        itc = jnp.asarray(net.iteration_count, jnp.int32)
+        update, net.states, net.updater_state, loss = master._update_step(
+            net.params, net.states, net.updater_state, itc,
+            net._next_rng(), f, l, None, None)
+        update_host = jax.tree_util.tree_map(np.asarray, update)
+        decoded_own = acc.store_update(update_host)
+        net.params = master._apply_step(
+            net.params, jax.tree_util.tree_map(jnp.asarray, decoded_own))
+        pushed_version, failed_mass = client.push_encoded(acc.last_encoded)
+        if failed_mass is not None:
+            acc.reinject(failed_mass)
+        master._adopt_pushed_version(pushed_version)
+        master._adopt_fresh(net, client,
+                            client.pull_if_stale(master.local_version))
+        net.iteration_count += 1
+    return net
+
+
+def _master(srv, threshold, **kw):
+    return ParameterServerTrainingMaster(
+        srv.address, staleness=0, threshold=threshold, backoff=0.01, **kw)
+
+
+def test_sync_mode_bit_identical_to_pre_overlap_twin():
+    batches = _batches(6)
+    net_a, net_b = _net(seed=11), _net(seed=11)
+    with ParameterServer(port=0) as sa, ParameterServer(port=0) as sb:
+        ma, mb = _master(sa, 1e-3), _master(sb, 1e-3)
+        ma.execute_training(net_a, ListDataSetIterator(batches))
+        _twin_fit_pre_overlap(mb, net_b, batches)
+        np.testing.assert_array_equal(flatten_params(net_a.params),
+                                      flatten_params(net_b.params))
+        np.testing.assert_array_equal(ma.accumulator._residual,
+                                      mb.accumulator._residual)
+        ma.close()
+        mb.close()
+
+
+def test_lossless_fast_path_bit_identical_to_bounce():
+    """threshold=0 sync mode applies the device-resident update directly;
+    the twin still does the encode→decode→h2d bounce. Same bits."""
+    batches = _batches(6)
+    net_a, net_b = _net(seed=5), _net(seed=5)
+    with ParameterServer(port=0) as sa, ParameterServer(port=0) as sb:
+        ma, mb = _master(sa, 0.0), _master(sb, 0.0)
+        assert ma.accumulator.lossless
+        ma.execute_training(net_a, ListDataSetIterator(batches))
+        assert not ma.accumulator.has_residual   # lossless leaves nothing
+        _twin_fit_pre_overlap(mb, net_b, batches)
+        np.testing.assert_array_equal(flatten_params(net_a.params),
+                                      flatten_params(net_b.params))
+        ma.close()
+        mb.close()
+
+
+# --------------------------------------------------- the overlap win
+def _phase_totals():
+    """(ms-sum, n) per phase from the registry children — per-fit means
+    come from deltas (the registry is process-global and cumulative)."""
+    reg = get_registry()
+    out = {}
+    for p in ("compute", "d2h", "encode", "push"):
+        _, total, n = reg.histogram(
+            "train_step_phase_ms",
+            "paramserver training hot-loop phase latency",
+            phase=p).state()
+        out[p] = (total, n)
+    _, total, n = reg.histogram(
+        "train_step_wall_ms",
+        "paramserver training wall time per step").state()
+    out["wall"] = (total, n)
+    return out
+
+
+def test_overlap_beats_sync_under_injected_push_latency():
+    """THE acceptance: ≥5 ms injected per-push transport delay, same
+    model, same data — overlap mode goes faster than sync mode, and the
+    phase deltas prove the comms ran UNDER the compute (overlap wall
+    total < Σ phase totals)."""
+    delay_s, steps = 0.012, 8
+    n_in, hidden, classes, rows = 128, 128, 10, 2048
+    batches = _batches(steps, rows=rows, n_in=n_in, classes=classes)
+
+    def run(overlap):
+        net = _net(n_in=n_in, hidden=hidden, classes=classes, seed=7)
+        with ParameterServer(port=0) as srv:
+            client = ParameterServerClient(
+                srv.address, staleness=0, max_retries=5, backoff=0.01,
+                push_delay_s=delay_s)
+            master = _master(srv, 1e-3, count_own_pushes=False,
+                             client=client, overlap=overlap)
+            master.execute_training(net,
+                                    ListDataSetIterator(batches[:2]))
+            p0 = _phase_totals()
+            t0 = time.perf_counter()
+            master.execute_training(net, ListDataSetIterator(batches))
+            dt = time.perf_counter() - t0
+            p1 = _phase_totals()
+            master.close()
+        delta = {k: (p1[k][0] - p0[k][0], p1[k][1] - p0[k][1]) for k in p1}
+        return steps / dt, delta
+
+    sps_sync, d_sync = run(overlap=False)
+    sps_over, d_over = run(overlap=True)
+    assert sps_over > sps_sync, (sps_over, sps_sync)
+    # every phase was timed in both modes, once per step
+    for mode in (d_sync, d_over):
+        for p in ("compute", "d2h", "encode", "push", "wall"):
+            assert mode[p][1] == steps, (p, mode[p])
+    # overlap: wall < Σ phases (comms hid under compute); sync: phases
+    # stack end to end, so wall covers at least their sum
+    over_phase_sum = sum(d_over[p][0]
+                        for p in ("compute", "d2h", "encode", "push"))
+    assert d_over["wall"][0] < over_phase_sum, (d_over, over_phase_sum)
+    sync_phase_sum = sum(d_sync[p][0]
+                        for p in ("compute", "d2h", "encode", "push"))
+    assert d_sync["wall"][0] >= sync_phase_sum * 0.99
+
+    # the /profile training block renders the same story
+    from deeplearning4j_tpu.monitor import (profile_report,
+                                            render_profile_text)
+    block = profile_report()["training"]
+    assert set(block["phase_ms"]) >= {"compute", "d2h", "encode", "push"}
+    assert block["overlap_active"] is True      # last fit ran overlapped
+    assert "hidden_ms_total" in block and "wall_ms_total" in block
+    text = render_profile_text(profile_report())
+    assert "# training (paramserver hot-loop phases)" in text
+
+
+# ------------------------------------------- fault + drain under overlap
+def test_failed_mass_reinjected_mid_overlap():
+    """A shard server killed mid-fit: the comms WORKER's push comes back
+    with the dead shard's decoded mass, reinjects it into the residual,
+    and training completes on the surviving shard — no exception, no
+    lost mass."""
+    batches = _batches(8)
+    net = _net()
+    rec = get_flight_recorder()
+    n0 = len(rec.events())
+    with ShardedParameterServerGroup(2) as group:
+        master = ParameterServerTrainingMaster(
+            group.address, staleness=0, threshold=1e-3, backoff=0.01,
+            max_retries=1, overlap=True)
+        reinjected = []
+        orig = master.accumulator.reinject
+
+        def spy(mass):
+            reinjected.append(float(np.abs(mass).sum()))
+            return orig(mass)
+
+        master.accumulator.reinject = spy
+        killed = []
+
+        class Killer:
+            def iteration_done(self, model, iteration, score):
+                if iteration == 2 and not killed:
+                    killed.append(group.kill(1))
+
+        net.listeners = [Killer()]
+        master.execute_training(net, ListDataSetIterator(batches))
+        master.close()
+    assert killed
+    assert reinjected and max(reinjected) > 0.0
+    events = [e["event"] for e in rec.events()[n0:]]
+    assert "shard_server_down" in events
+    assert master.accumulator.has_residual    # the mass is still pending
+
+
+def test_overlap_drains_at_epoch_end_and_close_and_is_reusable():
+    batches = _batches(6)
+    net = _net()
+    with ParameterServer(port=0) as srv:
+        client = ParameterServerClient(srv.address, staleness=0,
+                                       max_retries=2, backoff=0.01)
+        master = _master(srv, 1e-3, client=client, overlap=True)
+        master.execute_training(net, ListDataSetIterator(batches))
+        # epoch end drained the last round: nothing in flight and every
+        # step's push actually landed (none swallowed by the window)
+        assert master._pipeline is not None
+        assert not master._pipeline.inflight()
+        assert client.metrics.snapshot()["counters"]["pushes"] == 6
+        # the master (and its pipeline) are reusable across epochs
+        master.execute_training(net, ListDataSetIterator(batches))
+        assert client.metrics.snapshot()["counters"]["pushes"] == 12
+        master.close()
+        assert master._pipeline is None and master.client is None
+        master.close()    # idempotent
