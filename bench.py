@@ -929,6 +929,148 @@ def bench_paramserver_overlap(steps=16, n_in=256, hidden=256, classes=10,
     return sps_over
 
 
+CONTROL_LOOP_STATS = {}
+
+
+def bench_control_loop(slow_ms=120.0, shards=2, timeout_s=60.0):
+    """Closed-loop control chaos drill (control/plane.py, docs/CONTROL.md):
+    an inference server with a faultable model + a sharded paramserver
+    fleet run under the control plane's daemon (serving-pressure +
+    shard-restart policies), then BOTH faults land at once — the model
+    turns slow (p99 SLO breach) and a shard server is killed
+    (``shard_server_down``) — and the drill measures the wall time until
+    the system is back to an alert-free steady state with ZERO human
+    intervention: admission stepped then restored, the shard restarted
+    from its latched snapshot. Latches {time_to_recover_s, actions_taken,
+    alerts_fired} (plus per-incident reaction times) into
+    ``CONTROL_LOOP_STATS`` for the ``--one`` record. Headline value:
+    seconds to recover (lower is better, unlike the throughput benches —
+    trajectory tooling reads the unit)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.control import (get_control_plane,
+                                            serving_pressure_policy,
+                                            shard_restart_policy)
+    from deeplearning4j_tpu.monitor import (BurnRateRule, get_alert_engine,
+                                            get_flight_recorder,
+                                            get_history)
+    from deeplearning4j_tpu.paramserver import (
+        ShardedParameterServerClient, ShardedParameterServerGroup)
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    class FaultableModel:
+        def __init__(self):
+            self.delay_s = 0.0
+
+        def output(self, x, mask=None):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            x = np.asarray(x)
+            return np.full((x.shape[0], 2), 1.0, np.float32)
+
+    model = FaultableModel()
+    srv = InferenceServer()
+    srv.register("drill", model, batch_buckets=(1, 2, 4), linger_ms=0.5,
+                 max_queue_examples=64, qps_window_s=1.0)
+    port = srv.start(port=0)
+    url = f"http://127.0.0.1:{port}/v1/models/drill/predict"
+    engine, hist = get_alert_engine(), get_history()
+    rec = get_flight_recorder()
+    engine.add(BurnRateRule("drill_p99", kind="latency", target_ms=40.0,
+                            windows=(1.5, 3.0),
+                            latency_labels={"model": "drill"},
+                            for_seconds=0.2))
+    n = 64
+    group = ShardedParameterServerGroup(shards)
+    client = ShardedParameterServerClient(group.addresses, max_retries=0,
+                                          backoff=0.01, down_backoff=0.05)
+    plane = get_control_plane()
+    plane.add(serving_pressure_policy(srv.registry, "drill",
+                                      rules=("drill_p99",),
+                                      cooldown_s=0.5),
+              shard_restart_policy(group, cooldown_s=0.5))
+    served = srv.registry.get("drill")
+    body = _json.dumps({"inputs": [[1.0, 2.0]]}).encode("utf-8")
+
+    def post():
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            e.read()
+            e.close()
+
+    def drive(k):
+        for _ in range(k):
+            post()
+        hist.sample()
+        engine.evaluate(strict=False)
+
+    def actions(name):
+        return [a for a in plane.actions() if a["action"] == name]
+
+    events0 = len(rec.events())
+    try:
+        client.set_params(np.zeros(n, np.float32))
+        plane.start(interval_s=0.05)
+        drive(6)                                  # healthy baseline
+
+        # ---- both faults land; the recovery clock starts HERE
+        t_fault = time.perf_counter()
+        model.delay_s = slow_ms / 1e3
+        group.kill(1)                             # latches the snapshot
+        client.push_encoded((np.array([0, 1], np.int32),
+                             np.array([1, 1], np.int8), 0.5, n))
+
+        stepped = restarted = None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            drive(3)
+            if stepped is None and actions("set_admission"):
+                stepped = time.perf_counter() - t_fault
+                # the clamp shed the load: the incident's cause clears,
+                # and from here recovery is the loop's job alone
+                model.delay_s = 0.0
+            if restarted is None and actions("restart"):
+                restarted = time.perf_counter() - t_fault
+            if stepped is not None and restarted is not None \
+                    and not engine.firing() \
+                    and actions("restore_admission"):
+                break
+            time.sleep(0.05)
+        t_recover = time.perf_counter() - t_fault
+        recovered = (not engine.firing()
+                     and bool(actions("restore_admission"))
+                     and getattr(group.servers[1], "_running", False))
+        fresh = rec.events()[events0:]
+        CONTROL_LOOP_STATS.update({
+            "time_to_recover_s": round(t_recover, 3),
+            "recovered": recovered,
+            "time_to_admission_step_s":
+                round(stepped, 3) if stepped is not None else None,
+            "time_to_shard_restart_s":
+                round(restarted, 3) if restarted is not None else None,
+            "actions_taken": len([e for e in fresh
+                                  if e["event"] == "control_action"]),
+            "alerts_fired": len([e for e in fresh
+                                 if e["event"] == "alert_firing"]),
+            "admission_restored":
+                served.batcher.max_queue_examples == 64,
+        })
+        return t_recover
+    finally:
+        plane.stop()
+        plane.clear()
+        engine.remove("drill_p99")
+        client.close()
+        group.stop()
+        srv.stop()
+
+
 PARALLEL_MEMORY_STATS = {}
 
 #: child source for the too-few-devices fallback: re-run the grid on a
@@ -1216,6 +1358,7 @@ ALL_BENCHES = [
      bench_paramserver_overlap),
     ("parallel_memory", "steps/sec", bench_parallel_memory),
     ("serving_latency_qps", "req/sec", bench_serving_latency),
+    ("control_loop_time_to_recover_s", "s", bench_control_loop),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -1690,7 +1833,11 @@ def main():
                           # cold-vs-warm compile-cache warmup comparison
                           # (compile-once fleet) — populated only by the
                           # serving_latency config's cold-start mode
-                          "cold_start": COLD_START_STATS or None}))
+                          "cold_start": COLD_START_STATS or None,
+                          # chaos-drill recovery telemetry (closed-loop
+                          # control plane) — populated only by the
+                          # control_loop config
+                          "control_loop": CONTROL_LOOP_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

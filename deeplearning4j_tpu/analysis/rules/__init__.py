@@ -68,9 +68,9 @@ def all_rules() -> Dict[str, Type[Rule]]:
     """Every registered rule, id-sorted. Importing the rule modules here
     (not at package import) keeps ``analysis.linter`` import-light and
     cycle-free."""
-    from . import (exception_rules, jax_rules, lockgraph_rules,  # noqa: F401
-                   monitor_rules, perf_rules, resource_rules,  # noqa: F401
-                   threading_rules)  # noqa: F401
+    from . import (control_rules, exception_rules, jax_rules,  # noqa: F401
+                   lockgraph_rules, monitor_rules, perf_rules,  # noqa: F401
+                   resource_rules, threading_rules)  # noqa: F401
     return dict(sorted(_REGISTRY.items()))
 
 

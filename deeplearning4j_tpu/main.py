@@ -205,8 +205,10 @@ def cmd_monitor(args) -> int:
     (per-fn jit compiles/times/flops + device memory + step/ETL split,
     ``/profile`` remotely); ``--alerts`` prints the alert engine's rule
     states (``/alerts`` remotely — docs/OBSERVABILITY.md "Alerting &
-    SLOs"); ``--history`` prints the metric-history ring meta
-    (``/history`` remotely)."""
+    SLOs"); ``--control`` prints the control plane's policy states and
+    recent actions (``/control`` remotely — docs/CONTROL.md);
+    ``--history`` prints the metric-history ring meta (``/history``
+    remotely)."""
     import json
     import urllib.error
     import urllib.request
@@ -265,6 +267,37 @@ def cmd_monitor(args) -> int:
                          if r.get("exemplar_trace_id") else ""))
             if doc.get("firing"):
                 print(f"# FIRING: {', '.join(doc['firing'])}")
+        return 0
+
+    if args.control:
+        # control-plane view: policy state machines + recent actuator
+        # invocations (/control remotely — docs/CONTROL.md runbook)
+        if base:
+            doc = json.loads(_fetch(base, "/control"))
+        else:
+            from .control import get_control_plane
+            doc = get_control_plane().snapshot()
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            rows = doc.get("policies", [])
+            if not rows:
+                print("# no control policies registered")
+            for r in rows:
+                trig = ", ".join(r.get("rules") or []) or r.get("event")
+                print(f"{r['state']:<10} {r['policy']:<28} "
+                      f"on={trig} fired={r.get('fired_count', 0)} "
+                      f"suppressed={r.get('suppressed_count', 0)} "
+                      f"cooldown_remaining="
+                      f"{round(r.get('cooldown_remaining_s', 0.0), 1)}s")
+            for a in doc.get("actions", []):
+                print(f"# action {a.get('policy')}/{a.get('action')} "
+                      f"outcome={a.get('outcome')} rule={a.get('rule')}"
+                      + (f" exemplar={a['exemplar_trace_id']}"
+                         if a.get("exemplar_trace_id") else ""))
+            if doc.get("cooldowns_active"):
+                print("# COOLDOWN: "
+                      + ", ".join(doc["cooldowns_active"]))
         return 0
 
     if args.history:
@@ -526,6 +559,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alert-rule states (OK/PENDING/FIRING) from the "
                         "SLO engine — one line per rule, or the /alerts "
                         "JSON with --format json")
+    m.add_argument("--control", action="store_true",
+                   help="control-plane policy states (OK/PENDING/"
+                        "COOLDOWN) + recent actuator actions — one line "
+                        "per policy, or the /control JSON with --format "
+                        "json")
     m.add_argument("--history", action="store_true",
                    help="metric-history ring meta (/history): sampler "
                         "interval, capacity, sample count, family names")

@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .lockwatch import make_lock
 from .history import MetricsHistory, get_history
@@ -413,6 +413,7 @@ class AlertEngine:
         self._eval_lock = make_lock("AlertEngine._eval_lock")
         self._history = history
         self._rules: Dict[str, AlertRule] = {}
+        self._listeners: List[Callable[[str, Dict[str, Any]], None]] = []
         self._attached = False
         self.last_evaluated: Optional[float] = None
 
@@ -430,16 +431,23 @@ class AlertEngine:
                 self._rules[r.name] = r
         return self
 
-    @staticmethod
-    def _resolve_dangling(name: str):
+    def _resolve_dangling(self, name: str):
         """A FIRING rule leaving the engine (remove/clear) must not leave
-        an unmatched ``alert_firing`` edge: zero the gauge AND record the
-        closing ``alert_resolved`` so flight-stream consumers that pair
-        the edges never see a forever-firing ghost."""
+        an unmatched ``alert_firing`` edge: zero the gauge, record the
+        closing ``alert_resolved``, AND deliver the same edge to every
+        subscribed listener — a controller tracking the incident must see
+        it close, not keep a cooldown latched for a rule that no longer
+        exists. Runs under ``_eval_lock`` (the remove/clear callers hold
+        it), so no listener can observe a firing edge for the deleted
+        rule after this returns."""
         AlertEngine._gauge(name).set(0.0)
         from .flightrec import get_flight_recorder
         get_flight_recorder().record("alert_resolved", rule=name,
                                      detail="rule removed from engine")
+        self._notify("alert_resolved", {
+            "rule": name, "severity": None, "value": None,
+            "detail": "rule removed from engine",
+            "exemplar_trace_id": None})
 
     def remove(self, name: str):
         with self._eval_lock:      # never interleave with an in-flight
@@ -469,6 +477,50 @@ class AlertEngine:
             self._attached = True
         self.history.add_listener(lambda _h: self.evaluate(strict=False))
         return self
+
+    # ---------------------------------------------------------- listeners
+    def subscribe(self, fn: Callable[[str, Dict[str, Any]], None]
+                  ) -> "AlertEngine":
+        """Register ``fn(event, payload)`` for every firing/resolved edge.
+
+        ``event`` is ``"alert_firing"`` or ``"alert_resolved"``; the
+        payload mirrors the flight-recorder record (``rule``,
+        ``severity``, ``value``, ``detail``, ``exemplar_trace_id``).
+        Delivery runs outside ``_lock`` but inside ``_eval_lock``, so a
+        listener sees edges in the exact order the state machine emitted
+        them — and, crucially for controllers, ``remove()``/``clear()``
+        deliver the closing resolved edge under the same lock, so no
+        firing callback for a deleted rule can trail the removal. This
+        replaces controllers polling :meth:`snapshot` (which sees levels,
+        not edges, and so cannot distinguish one long incident from N).
+        Listener errors are logged, never fatal. Idempotent per ``fn``."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+        return self
+
+    def unsubscribe(self, fn: Callable[[str, Dict[str, Any]], None]):
+        """Remove a subscribed listener (no-op when absent). An edge
+        fan-out already in flight may still deliver to ``fn`` once —
+        callers that need a hard cut synchronize on their own state, as
+        :class:`~deeplearning4j_tpu.control.plane.ControlPlane` does."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify(self, event: str, payload: Dict[str, Any]):
+        """Listener fan-out OUTSIDE ``_lock`` (listeners run arbitrary
+        actuator code and take their own locks — THR004 discipline)."""
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event, dict(payload))
+            except Exception:
+                log.exception("alert listener %r failed on %s(%s)",
+                              fn, event, payload.get("rule"))
 
     # --------------------------------------------------------- evaluation
     @staticmethod
@@ -553,6 +605,10 @@ class AlertEngine:
             event, rule=rule.name, severity=rule.severity,
             value=rule.last_value, detail=rule.last_detail,
             exemplar_trace_id=rule.last_exemplar if firing else None)
+        self._notify(event, {
+            "rule": rule.name, "severity": rule.severity,
+            "value": rule.last_value, "detail": rule.last_detail,
+            "exemplar_trace_id": rule.last_exemplar if firing else None})
         if not firing:
             log.info("alert resolved: %s (%s)", rule.name, rule.last_detail)
             return None

@@ -771,6 +771,7 @@ def profile_report() -> Dict[str, Any]:
         "serving": _serving_block(snap),
         "mesh": _mesh_block(),
         "locks": _locks_block(),
+        "control": _control_block(),
         "trends": _trends_block(),
     }
 
@@ -790,6 +791,22 @@ def _mesh_block() -> Dict[str, Any]:
         return mod.mesh_block()
     except Exception as e:      # pragma: no cover - defensive scrape path
         log.debug("jitwatch: mesh block failed: %r", e)
+        return {}
+
+
+def _control_block() -> Dict[str, Any]:
+    """Control-plane summary (control/plane.py): policy count, active
+    cooldowns, total actions, last action. Read through sys.modules like
+    the mesh block — a process that never imported the control plane
+    pays nothing and reports an honest empty block."""
+    import sys as _sys
+    mod = _sys.modules.get("deeplearning4j_tpu.control.plane")
+    if mod is None:
+        return {}
+    try:
+        return mod.control_block()
+    except Exception as e:      # pragma: no cover - defensive scrape path
+        log.debug("jitwatch: control block failed: %r", e)
         return {}
 
 
@@ -1138,6 +1155,22 @@ def render_profile_text(report: Dict[str, Any]) -> str:
                 f"{name:<40} {r['acquisitions']:>8} "
                 f"{r['wait_s_mean']:>12} {r['wait_s_max']:>11} "
                 f"{r['held_s_mean']:>12} {r['held_s_max']:>11}")
+    control = report.get("control") or {}
+    if control:
+        lines.append("")
+        lines.append("# control (closed-loop control plane)")
+        lines.append(f"policies={control.get('policies', 0)} "
+                     f"running={'yes' if control.get('running') else 'no'} "
+                     f"cooldowns_active={control.get('cooldowns_active', 0)} "
+                     f"pending={control.get('pending', 0)} "
+                     f"actions_total={control.get('actions_total', 0)}")
+        last = control.get("last_action")
+        if last:
+            lines.append(f"last_action: policy={last.get('policy')} "
+                         f"action={last.get('action')} "
+                         f"outcome={last.get('outcome')} "
+                         f"rule={last.get('rule')} "
+                         f"exemplar={last.get('exemplar_trace_id')}")
     trends = report.get("trends") or {}
     if trends:
         lines.append("")

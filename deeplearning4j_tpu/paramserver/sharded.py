@@ -109,6 +109,7 @@ class ShardedParameterServerGroup:
         self.journal = int(journal)
         self._tracer = tracer
         self._fleet = fleet
+        self._last_snapshots: Dict[int, tuple] = {}
         self.servers: List[ParameterServer] = [
             self._spawn(j, port=(ports[j] if ports else 0))
             for j in range(int(num_servers))]
@@ -150,10 +151,21 @@ class ShardedParameterServerGroup:
         snap = srv.snapshot()
         port = srv.port
         srv.stop()
+        # latch for the control plane's auto-restart path: a policy
+        # reacting to shard_server_down asks last_snapshot(shard) instead
+        # of threading the kill() return value through the alert loop
+        self._last_snapshots[int(shard)] = snap
         get_flight_recorder().record(
             "shard_server_leave", shard=int(shard), address=srv.address,
             reason="killed")
         return port, snap
+
+    def last_snapshot(self, shard: int) -> Optional[tuple]:
+        """The most recent snapshot latched for ``shard`` (by
+        :meth:`kill`), or None — the control plane's restart-from-latest
+        source. A None means a cold restart (empty journal, clients
+        resync DELTA_FULL once), which is still correct, just slower."""
+        return self._last_snapshots.get(int(shard))
 
     def restart(self, shard: int, snapshot: Optional[tuple] = None,
                 port: Optional[int] = None) -> ParameterServer:

@@ -269,7 +269,16 @@ class ParameterServerTrainingMaster(TrainingMaster):
         ``fit``/``execute_training`` re-joins against the new layout
         (``init_params`` → ``created=False`` → adopt the rebalanced
         state); an attached sharded client remaps in place (flight event
-        ``client_remap``), a legacy client is rebuilt."""
+        ``client_remap``), a legacy client is rebuilt.
+
+        Overlap-safe: a comms round still in flight on the PR 15 pipeline
+        is drained FIRST — its push targeted the old shard layout, and
+        remapping under it would split one logical round across two
+        incompatible server sets (the shard-modulus of every index
+        changes). The drain re-raises a failed push loudly on this
+        thread, so a controller-driven remap over a half-dead fleet
+        surfaces the loss instead of silently re-registering."""
+        self._drain_for_membership_change("remap")
         from .sharded import parse_addresses
         addrs = parse_addresses(addresses)
         self.server_address = ",".join(addrs)
@@ -381,6 +390,25 @@ class ParameterServerTrainingMaster(TrainingMaster):
         fresh = client.pull_if_stale(self.local_version)
         self._ship_telemetry(client)
         return decoded_own, fast, fresh
+
+    def _drain_for_membership_change(self, what: str):
+        """Pin the mid-overlap membership-change path (remap/restart
+        drains the controller exercises): an in-flight comms round is
+        applied via :meth:`_drain_inflight` when the net it belongs to is
+        still known, else drained raw — and either way a failed push
+        re-raises HERE, before the shard set changes underneath it. Never
+        silently discards an undrained round."""
+        if self._pipeline is None or not self._pipeline.inflight():
+            return
+        if self._step_net is not None and self.client is not None:
+            self._drain_inflight(self._step_net, self.client)
+        else:
+            # inflight with no known net should be impossible (submits
+            # only happen inside execute_training) — drain raw rather
+            # than leave the depth-1 slot poisoned, still re-raising
+            log.warning("%s with in-flight comms round but no bound net "
+                        "— draining without apply", what)
+            self._pipeline.drain()
 
     def _drain_inflight(self, net, client):
         """Drain the in-flight comms round (no-op when none): apply its

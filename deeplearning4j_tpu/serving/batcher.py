@@ -79,7 +79,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError
-from typing import (Callable, Deque, Dict, List, Optional, Sequence,
+from typing import (Any, Callable, Deque, Dict, List, Optional, Sequence,
                     Tuple)
 
 import numpy as np
@@ -1044,6 +1044,33 @@ class ContinuousBatcher:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def set_admission(self, max_queue_examples: Optional[int] = None,
+                      linger_ms: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """Mutate the admission knobs of a LIVE batcher — the control
+        plane's serving actuator. A lowered ``max_queue_examples`` only
+        tightens the gate for FUTURE submits (already-queued examples are
+        served, never evicted — admission was a promise); a lowered
+        ``linger_ms`` wakes the scheduler so a queue that was sitting out
+        a long linger re-arms on the new deadline immediately. Returns
+        the previous values so a resolve-edge can restore them."""
+        with self._cond:
+            prev = {"max_queue_examples": self.max_queue_examples,
+                    "linger_ms": self.linger_ms}
+            if max_queue_examples is not None:
+                cap = int(max_queue_examples)
+                if cap < 1:
+                    raise ValueError(
+                        f"max_queue_examples must be >= 1, got {cap}")
+                self.max_queue_examples = cap
+            if linger_ms is not None:
+                lg = float(linger_ms)
+                if lg < 0:
+                    raise ValueError(f"linger_ms must be >= 0, got {lg}")
+                self.linger_ms = lg
+            self._cond.notify_all()
+        return prev
 
     def close(self, drain: bool = True, timeout: float = 30.0):
         """Stop admission, then either serve (``drain=True`` — no accepted

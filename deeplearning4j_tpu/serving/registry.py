@@ -303,6 +303,14 @@ class ServedModel:
             "aot_signatures": len(self._aot),
         }
 
+    def set_admission(self, max_queue_examples: Optional[int] = None,
+                      linger_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Step this model's admission knobs on the live batcher (the
+        control plane's pressure-relief actuator); returns the previous
+        values so the caller can restore them on resolve."""
+        return self.batcher.set_admission(
+            max_queue_examples=max_queue_examples, linger_ms=linger_ms)
+
     def close(self, drain: bool = True, timeout: float = 30.0):
         self.batcher.close(drain=drain, timeout=timeout)
 

@@ -207,7 +207,8 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _monitor_get(self, url, q) -> bool:
         """Serve the process-monitor endpoints every server shares —
         ``/metrics``, ``/healthz``, ``/profile``, ``/alerts``,
-        ``/history`` — so the training UI and the serving front door
+        ``/history``, ``/control`` — so the training UI and the serving
+        front door
         cannot drift on routing, status-code mapping, or framing. Returns
         True when the path was handled."""
         if url.path == "/metrics":
@@ -242,6 +243,14 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             engine = get_alert_engine()
             engine.evaluate(strict=False)
             self._json(engine.snapshot())
+            return True
+        if url.path == "/control":
+            # control-plane state (control/plane.py): policy state
+            # machines, active cooldowns, recent actuator invocations.
+            # ALWAYS HTTP 200 for the /alerts reason — the loop's
+            # surface must stay readable exactly while it is acting
+            from ..control.plane import get_control_plane
+            self._json(get_control_plane().snapshot())
             return True
         if url.path == "/history":
             # metric-history ring (monitor/history.py): ring meta by

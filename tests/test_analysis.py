@@ -18,7 +18,8 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
 RULE_IDS = {"JAX001", "JAX002", "JAX003", "JAX004", "THR001", "THR002",
-            "THR003", "THR004", "RES001", "EXC001", "MON001", "PERF001"}
+            "THR003", "THR004", "RES001", "EXC001", "MON001", "PERF001",
+            "CTL001"}
 
 
 # default fixture path lives under tests/ so the JAX003 bare-jit rule
@@ -397,6 +398,56 @@ def test_perf001_pragma_suppresses():
         "tree_map(np.asarray, update)",
         "tree_map(np.asarray, update)  # tpulint: disable=PERF001")
     assert lint_src(src, path="pkg/paramserver/training.py") == []
+
+
+# ------------------------------- CTL001 actuator outside the control plane
+_CTL_SRC = """
+    def autoscale(group, master):
+        addrs = group.scale_to(4)
+        master.remap(addrs)
+
+    def heal(group, shard):
+        group.restart(shard)
+
+    def shed(registry, model):
+        registry.get(model).set_admission(max_queue_examples=8)
+    """
+
+
+def test_ctl001_flags_actuator_calls_outside_control_plane():
+    fs = lint_src(_CTL_SRC, path="pkg/serving/engine.py")
+    assert rule_ids(fs) == ["CTL001"] * 4
+    assert "ControlPolicy" in fs[0].message
+    hit = {f.message.split("actuator call ")[1].split("(")[0]
+           for f in fs}
+    assert hit == {".scale_to", ".remap", ".restart", ".set_admission"}
+
+
+def test_ctl001_exempts_sanctioned_packages_and_self_forwards():
+    # the control plane, the paramserver package (manual runbook paths),
+    # tests, and bench harnesses all legitimately actuate
+    for path in ("pkg/control/policies.py", "pkg/paramserver/training.py",
+                 "tests/test_x.py", "bench.py"):
+        assert lint_src(_CTL_SRC, path=path) == []
+    # self.* forward: the definition pattern (ServedModel.set_admission
+    # delegating to its own batcher), not an automated action
+    assert lint_src("""
+        class Served:
+            def set_admission(self, **kw):
+                return self.batcher.set_admission(**kw)
+        """, path="pkg/serving/registry.py") == []
+    # unrelated methods that merely share a name fragment stay silent
+    assert lint_src("""
+        def f(video):
+            video.restart_playback()
+        """, path="pkg/serving/engine.py") == []
+
+
+def test_ctl001_pragma_suppresses():
+    src = _CTL_SRC.replace("group.scale_to(4)",
+                           "group.scale_to(4)  # tpulint: disable=CTL001")
+    fs = lint_src(src, path="pkg/serving/engine.py")
+    assert rule_ids(fs) == ["CTL001"] * 3
 
 
 # --------------------------------------------------------------- pragmas

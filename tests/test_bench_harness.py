@@ -504,6 +504,26 @@ def test_input_pipeline_bench_hides_etl(bench):
     assert stats["prefetch_images_per_sec"] > stats["sync_images_per_sec"]
 
 
+def test_control_loop_bench_latches_chaos_drill(bench):
+    """Acceptance (ISSUE 16): the control-loop bench runs the chaos
+    drill — slow served model + killed shard, both policies on the
+    control plane's daemon — and latches {time_to_recover_s,
+    actions_taken, alerts_fired} for the --one record, with the system
+    actually back to an alert-free steady state (admission restored,
+    shard restarted) with zero human intervention."""
+    value = bench.bench_control_loop(timeout_s=45.0)
+    stats = bench.CONTROL_LOOP_STATS
+    assert value > 0
+    assert stats["recovered"] is True
+    assert stats["admission_restored"] is True
+    assert stats["time_to_recover_s"] == round(value, 3)
+    # at least: admission step + shard restart + admission restore
+    assert stats["actions_taken"] >= 3
+    assert stats["alerts_fired"] >= 1
+    assert stats["time_to_admission_step_s"] > 0
+    assert stats["time_to_shard_restart_s"] > 0
+
+
 def test_cold_start_block_cold_vs_warm_cache_dir(bench):
     """ISSUE 12: the serving bench's cold-start mode runs the warmup in
     a child process twice against one shared compile-cache dir and

@@ -1,0 +1,823 @@
+"""Closed-loop control plane (ISSUE 16 — docs/CONTROL.md).
+
+Acceptance: the chaos drill — inject a slow served model AND kill a
+paramserver shard while the control plane runs; the system returns to an
+alert-free steady state with zero human intervention (admission stepped
+then restored, shard auto-restarted from its latched snapshot), every
+action fired ONCE per incident, and the whole double incident
+reconstructs from ``/events`` in seq order. Plus: the policy state
+machine's edge/hysteresis/cooldown pins driven deterministically through
+``tick(now=)``, the AlertEngine subscribe/unsubscribe listener API
+(including the closing resolved edge ``remove()``/``clear()`` deliver),
+the shipped policy pack against real actuators, the policy-removed-mid-
+action race, the satellite drain-before-remap pin on the PR 15 overlap
+pipeline, and the ``/control`` + ``monitor --control`` + ``/profile``
+``control``-block surfaces.
+"""
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.control import (ControlPlane, ControlPolicy,
+                                        default_control_policies,
+                                        fleet_scale_policy,
+                                        get_control_plane,
+                                        serving_pressure_policy,
+                                        shard_restart_policy)
+from deeplearning4j_tpu.control.plane import COOLDOWN, OK, PENDING
+from deeplearning4j_tpu.main import main
+from deeplearning4j_tpu.monitor import (BurnRateRule, ThresholdRule,
+                                        get_alert_engine,
+                                        get_flight_recorder, get_health,
+                                        get_history, get_registry,
+                                        profile_report,
+                                        render_profile_text)
+from deeplearning4j_tpu.paramserver import (CommsPipeline,
+                                            ParameterServerTrainingMaster,
+                                            ShardedParameterServerClient,
+                                            ShardedParameterServerGroup)
+from deeplearning4j_tpu.serving import (InferenceServer, ModelRegistry,
+                                        TRACE_HEADER)
+
+
+@pytest.fixture(autouse=True)
+def _clean_control_state():
+    """Engine/history/flight/plane state is process-global — isolate."""
+    def _reset():
+        plane = get_control_plane()
+        plane.stop(timeout=5.0)
+        plane.clear()
+        get_alert_engine().clear()
+        get_history().clear()
+        get_flight_recorder().clear()
+        get_health().reset()
+    _reset()
+    yield
+    _reset()
+
+
+def _events(kind):
+    return [e for e in get_flight_recorder().events()
+            if e.get("event") == kind]
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read().decode("utf-8"))
+
+
+def _post(url, doc, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+def _counter(policy, action, outcome):
+    return get_registry().counter("control_actions_total",
+                                  policy=policy, action=action,
+                                  outcome=outcome).value
+
+
+def _gauge(policy):
+    return get_registry().gauge("control_cooldown_active",
+                                policy=policy).value
+
+
+class FaultableModel:
+    """Serving stub with an injectable fault: slow, erroring, or clean."""
+
+    def __init__(self):
+        self.delay_s = 0.0
+        self.fail = False
+
+    def output(self, x, mask=None):
+        if self.fail:
+            raise RuntimeError("injected model fault")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        x = np.asarray(x)
+        return np.full((x.shape[0], 2), 1.0, np.float32)
+
+
+# ------------------------------------------------- policy state machine
+class TestPolicyStateMachine:
+    """Deterministic pins: edges fed straight into ``_on_edge`` and the
+    clock driven through ``tick(now=)`` — no daemon, no sleeps."""
+
+    def test_policy_must_match_something(self):
+        with pytest.raises(ValueError):
+            ControlPolicy("matchless", lambda ctx: None)
+
+    def test_duplicate_policy_name_rejected(self):
+        plane = ControlPlane()
+        plane.add(ControlPolicy("dup", lambda ctx: None, rules=("r",)))
+        with pytest.raises(ValueError):
+            plane.add(ControlPolicy("dup", lambda ctx: None, rules=("r",)))
+
+    def test_edge_fires_once_then_cooldown_suppresses_then_rearms(self):
+        calls = []
+        pol = ControlPolicy("sm_once", lambda ctx: calls.append(ctx) or "ok",
+                            rules=("sm_rule",), cooldown_s=10.0)
+        plane = ControlPlane().add(pol)
+        t0 = 1000.0
+        plane._on_edge("alert_firing", {"rule": "sm_rule", "value": 9.0,
+                                        "detail": "d",
+                                        "exemplar_trace_id": "cafe01"})
+        assert plane.tick(now=t0) == 1
+        assert len(calls) == 1 and calls[0]["exemplar_trace_id"] == "cafe01"
+        assert pol.state == COOLDOWN and pol.fired_count == 1
+        assert _gauge("sm_once") == 1.0
+        assert _counter("sm_once", pol.action_name, "ok") == 1.0
+        ev = _events("control_action")
+        assert len(ev) == 1
+        assert ev[0]["policy"] == "sm_once" and ev[0]["rule"] == "sm_rule"
+        assert ev[0]["exemplar_trace_id"] == "cafe01"
+
+        # edge while latched: suppressed, counted, never re-acted
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 1.0)
+        assert len(calls) == 1 and pol.suppressed_count == 1
+        assert _counter("sm_once", pol.action_name, "suppressed") == 1.0
+        assert len(_events("control_action")) == 1   # suppression: no event
+
+        # resolve before the cooldown elapses: stays latched
+        plane._on_edge("alert_resolved", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 2.0)
+        assert pol.state == COOLDOWN and pol.resolved_seen
+
+        # cooldown elapses → re-arm, gauge drops, next incident fires again
+        plane.tick(now=t0 + 11.0)
+        assert pol.state == OK and _gauge("sm_once") == 0.0
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 12.0)
+        assert len(calls) == 2 and pol.fired_count == 2
+
+    def test_rearm_requires_resolve_not_just_elapsed_cooldown(self):
+        pol = ControlPolicy("sm_latch", lambda ctx: "ok",
+                            rules=("sm_rule",), cooldown_s=1.0)
+        plane = ControlPlane().add(pol)
+        t0 = 2000.0
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane.tick(now=t0)
+        # cooldown long gone but the alert never resolved: still latched
+        plane.tick(now=t0 + 50.0)
+        assert pol.state == COOLDOWN and _gauge("sm_latch") == 1.0
+        plane._on_edge("alert_resolved", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 51.0)
+        assert pol.state == OK and _gauge("sm_latch") == 0.0
+
+    def test_hysteresis_sustain_cancels_transient_breach(self):
+        calls = []
+        pol = ControlPolicy("sm_hyst", lambda ctx: calls.append(ctx),
+                            rules=("sm_rule",), cooldown_s=10.0,
+                            sustain_s=5.0)
+        plane = ControlPlane().add(pol)
+        t0 = 3000.0
+        plane._on_edge("alert_firing", {"rule": "sm_rule",
+                                        "exemplar_trace_id": "beef02"})
+        assert plane.tick(now=t0) == 0
+        assert pol.state == PENDING and calls == []
+        # resolves inside the sustain window: hysteresis swallows it
+        plane._on_edge("alert_resolved", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 2.0)
+        assert pol.state == OK and calls == []
+
+        # a breach that SUSTAINS matures through the timer and acts once,
+        # with the firing edge's ctx (exemplar included)
+        plane._on_edge("alert_firing", {"rule": "sm_rule",
+                                        "exemplar_trace_id": "beef03"})
+        plane.tick(now=t0 + 10.0)
+        plane.tick(now=t0 + 14.0)            # 4s < sustain_s: still pending
+        assert pol.state == PENDING and calls == []
+        assert plane.tick(now=t0 + 16.0) == 1
+        assert pol.state == COOLDOWN
+        assert len(calls) == 1 and calls[0]["exemplar_trace_id"] == "beef03"
+
+    def test_fire_and_resolve_in_same_tick_cancels_the_action(self):
+        calls = []
+        pol = ControlPolicy("sm_cancel", lambda ctx: calls.append(ctx),
+                            rules=("sm_rule",), cooldown_s=10.0)
+        plane = ControlPlane().add(pol)
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane._on_edge("alert_resolved", {"rule": "sm_rule"})
+        assert plane.tick(now=4000.0) == 0
+        assert calls == [] and pol.state == OK
+        assert _events("control_action") == []
+
+    def test_actuator_error_still_latches_the_cooldown(self):
+        pol = ControlPolicy("sm_boom", lambda ctx: 1 // 0,
+                            rules=("sm_rule",), cooldown_s=10.0,
+                            action_name="explode")
+        plane = ControlPlane().add(pol)
+        t0 = 5000.0
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane.tick(now=t0)
+        assert pol.state == COOLDOWN                 # error ≠ retry storm
+        assert pol.last_action["outcome"] == "error"
+        assert "ZeroDivisionError" in pol.last_action["detail"]
+        assert _counter("sm_boom", "explode", "error") == 1.0
+        ev = _events("control_action")
+        assert len(ev) == 1 and ev[0]["outcome"] == "error"
+        plane._on_edge("alert_firing", {"rule": "sm_rule"})
+        plane.tick(now=t0 + 1.0)
+        assert pol.suppressed_count == 1             # no retry every tick
+
+    def test_flight_event_policy_cursor_primes_and_rearms_on_cooldown(self):
+        rec = get_flight_recorder()
+        rec.record("ctl_probe_evt", shard=0)         # pre-start history
+        calls = []
+        pol = ControlPolicy("sm_evt", lambda ctx: calls.append(ctx) or "ok",
+                            event="ctl_probe_evt", cooldown_s=5.0)
+        plane = ControlPlane().add(pol)
+        plane._prime_cursor()
+        # history is never replayed as a fresh incident
+        assert plane.tick() == 0 and calls == []
+        rec.record("ctl_probe_evt", shard=1, server="s1")
+        assert plane.tick() == 1
+        assert calls[0]["shard"] == 1 and calls[0]["server"] == "s1"
+        assert calls[0]["rule"] == "ctl_probe_evt"   # rule defaults to kind
+        assert pol.state == COOLDOWN
+        # same event while latched: suppressed
+        rec.record("ctl_probe_evt", shard=1)
+        plane.tick()
+        assert len(calls) == 1 and pol.suppressed_count == 1
+        # no resolve edge exists for flight events: cooldown alone re-arms
+        plane.tick(now=time.time() + 10.0)
+        assert pol.state == OK
+        rec.record("ctl_probe_evt", shard=2)
+        plane.tick(now=time.time() + 11.0)
+        assert len(calls) == 2 and calls[1]["shard"] == 2
+
+
+# ------------------------------------------- engine listeners (satellite)
+class TestAlertEngineListeners:
+    def test_subscribe_sees_edges_not_levels_and_unsubscribe_cuts(self):
+        engine, hist = get_alert_engine(), get_history()
+        get_registry().counter("ctl_sub_probe_total").inc(5)
+        engine.add(ThresholdRule("ctl_sub_probe", "ctl_sub_probe_total",
+                                 threshold=1.0, mode="value"))
+        seen = []
+
+        def listener(event, payload):
+            seen.append((event, payload["rule"],
+                         payload["exemplar_trace_id"]))
+
+        engine.subscribe(listener)
+        engine.subscribe(listener)               # idempotent per fn
+        hist.sample()
+        engine.evaluate(strict=False)
+        assert seen == [("alert_firing", "ctl_sub_probe", None)]
+        engine.evaluate(strict=False)            # still firing: a LEVEL
+        assert len(seen) == 1                    # edges only, no repeat
+        engine.unsubscribe(listener)
+        engine.unsubscribe(listener)             # no-op when absent
+        engine.remove("ctl_sub_probe")
+        assert len(seen) == 1                    # hard-cut after unsubscribe
+
+    def test_remove_and_clear_deliver_the_closing_resolved_edge(self):
+        engine, hist = get_alert_engine(), get_history()
+        get_registry().counter("ctl_rm_probe_total").inc(5)
+        engine.add(ThresholdRule("ctl_rm_probe", "ctl_rm_probe_total",
+                                 threshold=1.0, mode="value"),
+                   ThresholdRule("ctl_rm_other", "ctl_rm_probe_total",
+                                 threshold=1.0, mode="value"))
+        seen = []
+        engine.subscribe(lambda ev, p: seen.append((ev, p["rule"],
+                                                    p.get("detail"))))
+        hist.sample()
+        engine.evaluate(strict=False)
+        assert {(e, r) for e, r, _ in seen} == {
+            ("alert_firing", "ctl_rm_probe"),
+            ("alert_firing", "ctl_rm_other")}
+        # a controller tracking the incident must see it CLOSE on removal
+        engine.remove("ctl_rm_probe")
+        assert seen[-1] == ("alert_resolved", "ctl_rm_probe",
+                            "rule removed from engine")
+        assert get_registry().gauge("alerts_firing",
+                                    rule="ctl_rm_probe").value == 0.0
+        # clear() does the same for every still-firing rule
+        engine.clear()
+        assert ("alert_resolved", "ctl_rm_other",
+                "rule removed from engine") in seen
+
+
+# -------------------------------------------------- the shipped policies
+class TestServingPressurePolicy:
+    def test_set_admission_mutates_live_batcher_and_validates(self):
+        reg = ModelRegistry()
+        reg.register("adm", FaultableModel(), batch_buckets=(1, 2),
+                     linger_ms=5.0, max_queue_examples=64)
+        try:
+            served = reg.get("adm")
+            prev = served.set_admission(max_queue_examples=16, linger_ms=0.0)
+            assert prev == {"max_queue_examples": 64, "linger_ms": 5.0}
+            assert served.batcher.max_queue_examples == 16
+            assert served.batcher.linger_ms == 0.0
+            with pytest.raises(ValueError):
+                served.set_admission(max_queue_examples=0)
+            with pytest.raises(ValueError):
+                served.set_admission(linger_ms=-1.0)
+            # failed validation mutated nothing
+            assert served.batcher.max_queue_examples == 16
+            served.set_admission(**prev)         # restore round-trips
+            assert served.batcher.max_queue_examples == 64
+            assert served.batcher.linger_ms == 5.0
+        finally:
+            reg.close_all()
+
+    def test_policy_steps_on_fire_and_restores_on_resolve(self):
+        reg = ModelRegistry()
+        reg.register("press", FaultableModel(), batch_buckets=(1, 2),
+                     linger_ms=5.0, max_queue_examples=64)
+        try:
+            served = reg.get("press")
+            pol = serving_pressure_policy(reg, "press", rules=("p99x",),
+                                          factor=0.5, min_cap=8,
+                                          cooldown_s=5.0)
+            plane = ControlPlane().add(pol)
+            t0 = 6000.0
+            plane._on_edge("alert_firing", {"rule": "p99x",
+                                            "exemplar_trace_id": "abc123",
+                                            "detail": "p99 120ms"})
+            plane.tick(now=t0)
+            assert served.batcher.max_queue_examples == 32
+            assert served.batcher.linger_ms == 0.0
+            assert pol.last_action["outcome"] == "cap_32"
+            assert pol.last_action["rule"] == "p99x"
+            assert pol.last_action["exemplar_trace_id"] == "abc123"
+            # resolve: pre-incident knobs restored, latch holds until
+            # the cooldown elapses too
+            plane._on_edge("alert_resolved", {"rule": "p99x"})
+            plane.tick(now=t0 + 1.0)
+            assert served.batcher.max_queue_examples == 64
+            assert served.batcher.linger_ms == 5.0
+            assert pol.last_action["outcome"] == "restored"
+            assert pol.state == COOLDOWN
+            plane.tick(now=t0 + 6.0)
+            assert pol.state == OK
+            # nothing latched → restore is a harmless no-op outcome
+            assert pol.on_resolve({}) == "nothing_to_restore"
+            # a second incident steps from the restored baseline again
+            plane._on_edge("alert_firing", {"rule": "p99x"})
+            plane.tick(now=t0 + 7.0)
+            assert served.batcher.max_queue_examples == 32
+        finally:
+            reg.close_all()
+
+
+class TestShardRestartPolicy:
+    def test_auto_restart_from_latched_snapshot_exactly_once(self):
+        n = 10
+        vec = np.arange(n, dtype=np.float32)
+        group = ShardedParameterServerGroup(2)
+        try:
+            c = ShardedParameterServerClient(group.addresses,
+                                             max_retries=0, backoff=0.01,
+                                             down_backoff=0.05)
+            try:
+                c.set_params(vec)
+                pol = shard_restart_policy(group, cooldown_s=30.0)
+                plane = ControlPlane().add(pol)
+                plane._prime_cursor()
+                group.kill(1)                    # latches the snapshot
+                idx = np.array([0, 1], np.int32)
+                signs = np.array([1, 1], np.int8)
+                versions, failed = c.push_encoded((idx, signs, 0.5, n))
+                assert versions[1] is None and failed is not None
+                assert len(_events("shard_server_down")) == 1
+
+                assert plane.tick() == 1
+                assert pol.last_action["outcome"] == "restarted"
+                assert pol.last_action["rule"] == "shard_server_down"
+                assert group.servers[1]._running
+                # fire-once: nothing new on the next pass
+                assert plane.tick() == 0
+
+                # restarted FROM the latched snapshot: shard 1's slice is
+                # the pre-incident state, shard 0 kept its applied push
+                time.sleep(0.06)                 # past the down-backoff
+                _, out = c.pull()
+                exp = vec.copy()
+                exp[0] -= 0.5
+                np.testing.assert_array_equal(out, exp)
+                assert len(_events("shard_server_restored")) == 1
+            finally:
+                c.close()
+        finally:
+            group.stop()
+
+    def test_still_running_server_is_left_alone(self):
+        group = ShardedParameterServerGroup(2)
+        try:
+            pol = shard_restart_policy(group, cooldown_s=30.0)
+            plane = ControlPlane().add(pol)
+            plane._prime_cursor()
+            srv0 = group.servers[0]
+            # a stale/raced down report for a healthy node: no bounce
+            get_flight_recorder().record("shard_server_down", shard=0,
+                                         worker="w0", error="transient")
+            plane.tick()
+            assert pol.last_action["outcome"] == "still_running"
+            assert group.servers[0] is srv0      # not replaced/bounced
+            # and nonsense shards degrade to a recorded outcome, not a crash
+            plane.tick(now=time.time() + 60.0)   # rearm (event policy)
+            get_flight_recorder().record("shard_server_down", shard=7)
+            plane.tick(now=time.time() + 61.0)
+            assert pol.last_action["outcome"] == "unknown_shard"
+        finally:
+            group.stop()
+
+
+class TestFleetScalePolicy:
+    def test_scales_out_and_remaps_master_then_reports_at_max(self):
+        vec = np.arange(12, dtype=np.float32)
+        group = ShardedParameterServerGroup(2)
+        master = ParameterServerTrainingMaster(group.address, staleness=0,
+                                               backoff=0.01, max_retries=1)
+        try:
+            with ShardedParameterServerClient(group.addresses,
+                                              max_retries=1,
+                                              backoff=0.01) as c:
+                c.set_params(vec)
+            pol = fleet_scale_policy(group, master, max_servers=3,
+                                     cooldown_s=5.0)
+            plane = ControlPlane().add(pol)
+            t0 = 7000.0
+            plane._on_edge("alert_firing", {"rule": "fleet_worker_stale"})
+            plane.tick(now=t0)
+            assert group.num_servers == 3
+            assert pol.last_action["outcome"] == "scaled_to_3"
+            assert master.server_address == ",".join(group.addresses)
+            # the rebalanced fleet still reassembles the merged state
+            with ShardedParameterServerClient(group.addresses,
+                                              max_retries=1,
+                                              backoff=0.01) as c:
+                _, out = c.pull()
+                np.testing.assert_array_equal(out, vec)
+            # next incident: already at the cap → acts as a no-op outcome
+            plane._on_edge("alert_resolved", {"rule": "fleet_worker_stale"})
+            plane.tick(now=t0 + 6.0)
+            plane._on_edge("alert_firing", {"rule": "fleet_worker_stale"})
+            plane.tick(now=t0 + 7.0)
+            assert pol.last_action["outcome"] == "at_max"
+            assert group.num_servers == 3
+        finally:
+            master.close()
+            group.stop()
+
+
+class TestDefaultPack:
+    def test_composition_and_override_forwarding(self):
+        g, m, r = object(), object(), object()
+        pols = default_control_policies(group=g, master=m, registry=r,
+                                        model="mnist", cooldown_s=2.5)
+        assert [p.name for p in pols] == ["fleet_scale", "shard_restart",
+                                         "serving_pressure_mnist"]
+        assert all(p.cooldown_s == 2.5 for p in pols)
+        only_serving = default_control_policies(registry=r, model="mnist")
+        assert [p.name for p in only_serving] == ["serving_pressure_mnist"]
+        group_only = default_control_policies(group=g)
+        assert [p.name for p in group_only] == ["shard_restart"]
+
+
+# ------------------------------------------- removed-mid-action race pin
+class TestRemoveMidAction:
+    def test_remove_while_actuator_in_flight_discards_state_and_gauge(self):
+        started, release = threading.Event(), threading.Event()
+
+        def blocking(ctx):
+            started.set()
+            release.wait(5.0)
+            return "done"
+
+        pol = ControlPolicy("racey", blocking, rules=("race_rule",),
+                            cooldown_s=30.0, action_name="block")
+        plane = ControlPlane().add(pol)
+        plane._on_edge("alert_firing", {"rule": "race_rule"})
+        t = threading.Thread(target=plane.tick, daemon=True)
+        t.start()
+        assert started.wait(5.0)
+        plane.remove("racey")                    # races the in-flight action
+        release.set()
+        t.join(5.0)
+        assert not t.is_alive()
+        # the actuator ran for a real edge: its flight event stands...
+        ev = _events("control_action")
+        assert len(ev) == 1 and ev[0]["outcome"] == "done"
+        # ...but the detached policy's bookkeeping is discarded and the
+        # cooldown latch does not outlive the policy
+        assert plane.policies() == [] and plane.actions() == []
+        assert _gauge("racey") == 0.0
+
+    def test_clear_zeroes_every_cooldown_gauge(self):
+        plane = ControlPlane().add(
+            ControlPolicy("clr_a", lambda ctx: "ok", rules=("r",),
+                          cooldown_s=30.0),
+            ControlPolicy("clr_b", lambda ctx: "ok", rules=("r",),
+                          cooldown_s=30.0))
+        plane._on_edge("alert_firing", {"rule": "r"})
+        plane.tick(now=8000.0)
+        assert _gauge("clr_a") == 1.0 and _gauge("clr_b") == 1.0
+        plane.clear()
+        assert _gauge("clr_a") == 0.0 and _gauge("clr_b") == 0.0
+        assert plane.policies() == []
+
+
+# ------------------------------------ drain-before-remap (satellite pin)
+class TestMembershipChangeDrain:
+    def test_remap_drains_inflight_round_and_reraises_failures(self):
+        group = ShardedParameterServerGroup(2)
+        master = ParameterServerTrainingMaster(group.address, staleness=0,
+                                               backoff=0.01, max_retries=1)
+        pipe = CommsPipeline()
+        try:
+            master._pipeline = pipe
+            before = master.server_address
+            # a failed in-flight push surfaces ON the remap caller, BEFORE
+            # the shard set changes underneath it — never swallowed
+            pipe.submit(lambda: 1 // 0, label="doomed-push")
+            with pytest.raises(ZeroDivisionError):
+                master.remap(group.addresses)
+            assert not pipe.inflight()           # slot not left poisoned
+            assert master.server_address == before
+
+            # a healthy in-flight round is drained, then the remap lands
+            pipe.submit(lambda: "round-ok", label="benign-push")
+            new_addrs = list(reversed(group.addresses))
+            master.remap(new_addrs)
+            assert not pipe.inflight()
+            assert master.server_address == ",".join(new_addrs)
+        finally:
+            pipe.close()
+            master._pipeline = None
+            master.close()
+            group.stop()
+
+
+# ----------------------------------------------------------- surfaces
+class TestSurfaces:
+    def test_control_endpoint_profile_block_and_cli(self, capsys):
+        plane = get_control_plane()
+        plane.add(ControlPolicy("surface_probe", lambda ctx: "ok",
+                                rules=("surface_rule",), cooldown_s=1.0))
+        # /profile carries the compact control block + text section
+        rep = profile_report()
+        assert rep["control"]["policies"] == 1
+        assert rep["control"]["actions_total"] == 0
+        assert rep["control"]["running"] is False
+        assert "# control" in render_profile_text(rep)
+
+        # GET /control on the UI server
+        from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+        srv = UIServer(port=0)
+        srv.attach(InMemoryStatsStorage())
+        port = srv.start()
+        try:
+            status, doc = _get_json(f"http://127.0.0.1:{port}/control")
+            assert status == 200
+            assert [r["policy"] for r in doc["policies"]] \
+                == ["surface_probe"]
+            assert doc["policies"][0]["state"] == OK
+            assert doc["cooldowns_active"] == []
+            assert doc["running"] is False
+            # monitor --control against the remote server
+            assert main(["monitor", "--control", "--url",
+                         f"127.0.0.1:{port}"]) == 0
+            assert "surface_probe" in capsys.readouterr().out
+        finally:
+            srv.stop()
+
+        # GET /control on the inference server (both servers, same seam)
+        isrv = InferenceServer()
+        iport = isrv.start(port=0)
+        try:
+            status, doc = _get_json(f"http://127.0.0.1:{iport}/control")
+            assert status == 200
+            assert [r["policy"] for r in doc["policies"]] \
+                == ["surface_probe"]
+        finally:
+            isrv.stop()
+
+        # monitor --control locally, text and json
+        assert main(["monitor", "--control"]) == 0
+        out = capsys.readouterr().out
+        assert "surface_probe" in out and "on=surface_rule" in out
+        assert main(["monitor", "--control", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["policies"][0]["policy"] == "surface_probe"
+
+    def test_empty_plane_surfaces_stay_scriptable(self, capsys):
+        assert profile_report()["control"] == {}
+        assert main(["monitor", "--control"]) == 0
+        assert "# no control policies" in capsys.readouterr().out
+
+    def test_daemon_start_stop_idempotent_and_subscribed(self):
+        plane = get_control_plane()
+        engine = get_alert_engine()
+        plane.add(ControlPolicy("daemon_probe", lambda ctx: "ok",
+                                rules=("daemon_rule",), cooldown_s=1.0))
+        plane.start(interval_s=0.05)
+        plane.start()                            # idempotent
+        assert plane.running()
+        assert plane.snapshot()["running"] is True
+        deadline = time.monotonic() + 5.0
+        while plane.last_tick is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert plane.last_tick is not None
+        plane.stop()
+        assert not plane.running()
+        # stopped: no listener left behind on the engine
+        assert plane._on_edge not in engine._listeners
+
+
+# -------------------------------------------- THE chaos-drill acceptance
+class TestChaosDrill:
+    def test_double_incident_recovers_alert_free_and_reconstructs(self):
+        """THE acceptance: a slow served model AND a killed paramserver
+        shard, concurrently, with the control plane's daemon running —
+        admission is stepped then restored, the shard auto-restarts from
+        its latched snapshot, each action fires ONCE per incident, the
+        system returns to an alert-free steady state with zero human
+        intervention, and the whole double incident reconstructs from
+        ``/events`` in seq order."""
+        model = FaultableModel()
+        srv = InferenceServer()
+        srv.register("chaos", model, batch_buckets=(1, 2, 4),
+                     linger_ms=0.5, max_queue_examples=64,
+                     qps_window_s=1.0)
+        port = srv.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        url = f"{base}/v1/models/chaos/predict"
+        engine, hist = get_alert_engine(), get_history()
+        engine.add(BurnRateRule("chaos_p99", kind="latency",
+                                target_ms=40.0, windows=(1.5, 3.0),
+                                latency_labels={"model": "chaos"},
+                                for_seconds=0.2))
+        n = 8
+        vec = np.arange(n, dtype=np.float32)
+        group = ShardedParameterServerGroup(2)
+        client = ShardedParameterServerClient(group.addresses,
+                                              max_retries=0, backoff=0.01,
+                                              down_backoff=0.05)
+        plane = get_control_plane()
+        plane.add(serving_pressure_policy(srv.registry, "chaos",
+                                          rules=("chaos_p99",),
+                                          factor=0.5, min_cap=8,
+                                          cooldown_s=0.5),
+                  shard_restart_policy(group, cooldown_s=0.5))
+        served = srv.registry.get("chaos")
+        trace = itertools.count(1)
+
+        def drive(k):
+            for _ in range(k):
+                _post(url, {"inputs": [[1.0, 2.0]]},
+                      headers={TRACE_HEADER: f"{next(trace):08x}:1"})
+            hist.sample()
+
+        def acts(name):
+            return [a for a in plane.actions() if a["action"] == name]
+
+        try:
+            client.set_params(vec)
+            plane.start(interval_s=0.05)
+
+            # healthy baseline: nothing fires, nothing acts
+            drive(6)
+            engine.evaluate(strict=False)
+            time.sleep(0.15)
+            assert engine.firing() == [] and plane.actions() == []
+
+            # ---- incident 1: the served model turns slow; the pressure
+            # policy steps the admission cap and flushes — exactly once,
+            # carrying the alert's rule and exemplar
+            model.delay_s = 0.12
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                drive(3)
+                engine.evaluate(strict=False)
+                if acts("set_admission"):
+                    break
+            stepped = acts("set_admission")
+            assert len(stepped) == 1, [
+                (r.name, r.state, r.last_detail) for r in engine.rules()]
+            assert stepped[0]["rule"] == "chaos_p99"
+            assert stepped[0]["outcome"] == "cap_32"
+            assert stepped[0]["exemplar_trace_id"]
+            assert served.batcher.max_queue_examples == 32
+            assert served.batcher.linger_ms == 0.0
+
+            # ---- incident 2 (overlapping): kill a shard; the client's
+            # down report triggers the auto-restart from the latched
+            # snapshot — zero human intervention
+            group.kill(1)
+            idx = np.array([0, 1], np.int32)
+            signs = np.array([1, 1], np.int8)
+            versions, failed = client.push_encoded((idx, signs, 0.5, n))
+            assert versions[1] is None and failed is not None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                drive(1)                         # keep the p99 alert fed
+                engine.evaluate(strict=False)
+                if acts("restart"):
+                    break
+            restarted = acts("restart")
+            assert len(restarted) == 1
+            assert restarted[0]["rule"] == "shard_server_down"
+            assert restarted[0]["outcome"] == "restarted"
+            assert group.servers[1]._running
+            time.sleep(0.06)                     # past the down-backoff
+            versions, failed = client.push_encoded((idx, signs, 0.5, n))
+            assert versions[1] is not None and failed is None
+
+            # ---- recovery: the fault clears, the alert resolves, the
+            # pre-incident admission knobs come back — alert-free steady
+            # state, no operator in the loop
+            model.delay_s = 0.0
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                drive(4)
+                engine.evaluate(strict=False)
+                if not engine.firing() and acts("restore_admission"):
+                    break
+                time.sleep(0.2)
+            assert engine.firing() == [], [
+                (r.name, r.state, r.last_detail) for r in engine.rules()]
+            restores = acts("restore_admission")
+            assert len(restores) == 1
+            assert restores[0]["outcome"] == "restored"
+            assert served.batcher.max_queue_examples == 64
+            assert served.batcher.linger_ms == 0.5
+
+            # fire-once held across the WHOLE drill (flight-event view)
+            ca = _events("control_action")
+            assert len([e for e in ca
+                        if e["action"] == "set_admission"]) == 1
+            assert len([e for e in ca if e["action"] == "restart"]) == 1
+
+            # the double incident reconstructs from /events in seq order
+            # (the flight recorder's HTTP surface lives on the UI server)
+            from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+            ui = UIServer(port=0)
+            ui.attach(InMemoryStatsStorage())
+            uiport = ui.start()
+            try:
+                status, doc = _get_json(
+                    f"http://127.0.0.1:{uiport}/events")
+            finally:
+                ui.stop()
+            assert status == 200
+            evs = doc["events"]
+
+            def seq(pred):
+                return next(e["seq"] for e in evs if pred(e))
+
+            fire = seq(lambda e: e["event"] == "alert_firing"
+                       and e["rule"] == "chaos_p99")
+            step = seq(lambda e: e["event"] == "control_action"
+                       and e["action"] == "set_admission")
+            down = seq(lambda e: e["event"] == "shard_server_down")
+            restart = seq(lambda e: e["event"] == "control_action"
+                          and e["action"] == "restart")
+            restored = seq(lambda e: e["event"] == "shard_server_restored")
+            resolved = seq(lambda e: e["event"] == "alert_resolved"
+                           and e["rule"] == "chaos_p99")
+            restore = seq(lambda e: e["event"] == "control_action"
+                          and e["action"] == "restore_admission")
+            assert fire < step                   # alert → pressure action
+            assert down < restart < restored     # down → restart → healed
+            assert resolved < restore            # resolve → restore
+            # the pressure action names the SAME incident the alert
+            # exemplified — the runbook's pivot from /control to /trace
+            step_ev = next(e for e in evs
+                           if e["event"] == "control_action"
+                           and e["action"] == "set_admission")
+            fire_ev = next(e for e in evs if e["event"] == "alert_firing"
+                           and e["rule"] == "chaos_p99")
+            assert step_ev["exemplar_trace_id"] \
+                == fire_ev["exemplar_trace_id"]
+
+            # the /control surface tells the same story
+            status, doc = _get_json(f"{base}/control")
+            assert status == 200
+            byname = {r["policy"]: r for r in doc["policies"]}
+            assert byname["serving_pressure_chaos"]["fired_count"] == 1
+            assert byname["shard_restart"]["fired_count"] == 1
+            assert doc["running"] is True
+        finally:
+            plane.stop()
+            client.close()
+            group.stop()
+            srv.stop()
