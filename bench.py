@@ -1071,6 +1071,82 @@ def bench_control_loop(slow_ms=120.0, shards=2, timeout_s=60.0):
         srv.stop()
 
 
+FLEET_SCRAPE_STATS = {}
+
+
+def bench_fleet_scrape(replicas=3, ticks=25, warm_requests=4):
+    """Scrape-plane collector bench (monitor/collector.py): K in-process
+    inference replicas polled over real HTTP by one TelemetryCollector
+    into a PRIVATE FleetState, measuring the per-target ``/telemetry``
+    scrape cost and the whole-tick overhead around the scrapes (fleet
+    merge + history sample + alert evaluation). Latches
+    {scrape_ms_p50, scrape_ms_p99, targets, merged_series,
+    tick_overhead_ms, scrape_errors} into ``FLEET_SCRAPE_STATS`` for
+    the ``--one`` record. Headline value: scrape p99 ms (lower is
+    better — trajectory tooling reads the unit)."""
+    import json as _json
+    import urllib.request
+
+    from deeplearning4j_tpu.monitor.collector import TelemetryCollector
+    from deeplearning4j_tpu.monitor.fleet import FleetState
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    class TinyModel:
+        def output(self, x, mask=None):
+            x = np.asarray(x)
+            return np.full((x.shape[0], 2), 1.0, np.float32)
+
+    servers = []
+    collector = TelemetryCollector(fleet=FleetState())
+    body = _json.dumps({"inputs": [[1.0, 2.0]]}).encode("utf-8")
+    try:
+        for i in range(int(replicas)):
+            srv = InferenceServer()
+            srv.register(f"m{i}", TinyModel(), batch_buckets=(1, 2, 4),
+                         linger_ms=0.0, max_queue_examples=64)
+            port = srv.start(port=0)
+            servers.append(srv)
+            collector.add_target(f"replica{i}", f"127.0.0.1:{port}")
+            # a few real requests so each reply carries latency series
+            # (and exemplars) — an idle registry would undercount the
+            # merge cost
+            for _ in range(int(warm_requests)):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/m{i}/predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+        collector.tick()                        # cursor-priming pass
+        samples, overhead, errors = [], [], 0
+        for _ in range(int(ticks)):
+            summary = collector.tick()
+            errors += len(summary["errors"])
+            ms = list(summary["scrape_ms"].values())
+            samples.extend(ms)
+            overhead.append(summary["duration_ms"] - sum(ms))
+        samples.sort()
+
+        def pct(q):
+            return samples[min(len(samples) - 1,
+                               int(q * (len(samples) - 1)))]
+
+        p99 = round(pct(0.99), 3)
+        FLEET_SCRAPE_STATS.update({
+            "scrape_ms_p50": round(pct(0.50), 3),
+            "scrape_ms_p99": p99,
+            "targets": int(replicas),
+            "merged_series": len(collector.fleet_dump()),
+            "tick_overhead_ms": round(sum(overhead) / len(overhead), 3),
+            "scrape_errors": errors,
+        })
+        return p99
+    finally:
+        collector.stop()
+        for srv in servers:
+            srv.stop()
+
+
 PARALLEL_MEMORY_STATS = {}
 
 #: child source for the too-few-devices fallback: re-run the grid on a
@@ -1359,6 +1435,7 @@ ALL_BENCHES = [
     ("parallel_memory", "steps/sec", bench_parallel_memory),
     ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("control_loop_time_to_recover_s", "s", bench_control_loop),
+    ("fleet_scrape_p99_ms", "ms", bench_fleet_scrape),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -1837,7 +1914,11 @@ def main():
                           # chaos-drill recovery telemetry (closed-loop
                           # control plane) — populated only by the
                           # control_loop config
-                          "control_loop": CONTROL_LOOP_STATS or None}))
+                          "control_loop": CONTROL_LOOP_STATS or None,
+                          # scrape-plane collector cost over K HTTP
+                          # replicas — populated only by the
+                          # fleet_scrape config
+                          "fleet_scrape": FLEET_SCRAPE_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

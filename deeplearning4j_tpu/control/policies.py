@@ -28,7 +28,8 @@ from .plane import ControlPolicy
 log = logging.getLogger(__name__)
 
 __all__ = ["fleet_scale_policy", "shard_restart_policy",
-           "serving_pressure_policy", "default_control_policies"]
+           "serving_pressure_policy", "fleet_replica_policy",
+           "default_control_policies"]
 
 
 def fleet_scale_policy(group, master, *, rule: str = "fleet_worker_stale",
@@ -139,12 +140,48 @@ def serving_pressure_policy(registry, model: str, *,
                     f"restore on resolve")
 
 
+def fleet_replica_policy(collector, restart, *,
+                         rule: str = "fleet_target_down",
+                         cooldown_s: float = 30.0,
+                         sustain_s: float = 0.0,
+                         name: str = "fleet_replica_restart"
+                         ) -> ControlPolicy:
+    """Bounce unresponsive scraped replicas on a sustained
+    ``fleet_target_down`` alert (the scrape-plane pack,
+    ``monitor.alerts.default_fleet_scope_rules``).
+
+    ``restart`` is the caller's actuator — ``fn(label, url)`` doing
+    whatever "restart" means in its deployment (respawn a process,
+    re-create a container, page a human). The policy asks the
+    ``collector`` which targets are currently down at FIRE time rather
+    than trusting the alert payload: between the rule sustaining and the
+    plane acting, a replica may have recovered on its own, and bouncing
+    a healthy node is the one thing a remediation loop must never do."""
+
+    def restart_down(ctx):
+        down = collector.down_targets()
+        if not down:
+            return "none_down"
+        for t in down:
+            restart(t.label, t.url)
+        return "restarted_" + ",".join(t.label for t in down)
+
+    return ControlPolicy(
+        name, restart_down, rules=(rule,), action_name="restart_replica",
+        cooldown_s=cooldown_s, sustain_s=sustain_s,
+        description=f"restart scraped replicas that are down at fire "
+                    f"time on sustained {rule}")
+
+
 def default_control_policies(*, group=None, master=None, registry=None,
-                             model: Optional[str] = None, **overrides):
+                             model: Optional[str] = None, collector=None,
+                             restart=None, **overrides):
     """The full shipped pack for whatever actuators the caller has:
     fleet scale + shard restart when a ``group`` (and ``master``) is
-    given, serving pressure relief when a ``registry`` + ``model`` is.
-    ``overrides`` are forwarded to every builder that accepts them."""
+    given, serving pressure relief when a ``registry`` + ``model`` is,
+    replica restart when a scrape-plane ``collector`` + ``restart``
+    actuator is. ``overrides`` are forwarded to every builder that
+    accepts them."""
     import inspect
     out = []
 
@@ -161,4 +198,7 @@ def default_control_policies(*, group=None, master=None, registry=None,
     if registry is not None and model is not None:
         out.append(serving_pressure_policy(
             registry, model, **_kw(serving_pressure_policy)))
+    if collector is not None and restart is not None:
+        out.append(fleet_replica_policy(collector, restart,
+                                        **_kw(fleet_replica_policy)))
     return out

@@ -208,7 +208,9 @@ def cmd_monitor(args) -> int:
     SLOs"); ``--control`` prints the control plane's policy states and
     recent actions (``/control`` remotely — docs/CONTROL.md);
     ``--history`` prints the metric-history ring meta (``/history``
-    remotely)."""
+    remotely); ``--collect LABEL=URL[,...]`` runs one scrape-plane tick
+    over the given ``/telemetry`` targets and prints the merged fleet
+    view (exit 1 if any scrape failed)."""
     import json
     import urllib.error
     import urllib.request
@@ -225,6 +227,37 @@ def cmd_monitor(args) -> int:
     if args.url:
         base = args.url if "://" in args.url else f"http://{args.url}"
         base = base.rstrip("/")
+
+    if args.collect:
+        # one-shot scrape-plane tick (monitor/collector.py): poll each
+        # target's /telemetry into a PRIVATE FleetState and print the
+        # merged view — the daemonized version of this is
+        # TelemetryCollector.start() inside the serving process
+        from .monitor.collector import TelemetryCollector
+        from .monitor.fleet import FleetState
+        collector = TelemetryCollector(fleet=FleetState())
+        for spec in args.collect.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            label, sep, url = spec.partition("=")
+            if not sep:
+                # bare URL: derive the label from host:port
+                url = spec
+                label = (url.split("://", 1)[-1].rstrip("/")
+                         .replace("/", "_"))
+            collector.add_target(label.strip(), url.strip())
+        summary = collector.tick()
+        for label, err in sorted(summary.get("errors", {}).items()):
+            print(f"# scrape {label} FAILED: {err}", file=sys.stderr)
+        if args.format == "json":
+            print(json.dumps({"targets": collector.snapshot(),
+                              "liveness": collector.fleet.liveness()},
+                             indent=2, default=repr))
+        else:
+            from .monitor import render_prometheus_dump
+            print(render_prometheus_dump(collector.fleet_dump()), end="")
+        return 0 if not summary.get("errors") else 1
 
     if args.profile:
         # step-anatomy view (docs/OBSERVABILITY.md "Compilation & memory")
@@ -567,6 +600,12 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--history", action="store_true",
                    help="metric-history ring meta (/history): sampler "
                         "interval, capacity, sample count, family names")
+    m.add_argument("--collect", default=None, metavar="LABEL=URL[,...]",
+                   help="one-shot scrape-plane tick: poll each target's "
+                        "/telemetry, print the merged fleet view "
+                        "(Prometheus text with worker labels, or the "
+                        "liveness table with --format json); bare URLs "
+                        "get host:port labels")
     m.set_defaults(fn=cmd_monitor)
     c = sub.add_parser("cache",
                        help="compile-once fleet: persistent XLA compile "

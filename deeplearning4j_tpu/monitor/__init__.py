@@ -20,6 +20,12 @@ across (``ParamServerMetrics``, ``PerformanceListener``/
 - :func:`get_fleet` — per-worker telemetry shipped over the paramserver's
   ``OP_TELEMETRY``: the merged ``GET /fleet`` scrape, the merged
   multi-``pid`` Chrome trace, and worker staleness for ``/healthz``.
+- :func:`get_collector` — the pull-based scrape plane: a
+  :class:`TelemetryCollector` polling each replica's ``GET /telemetry``
+  (registry + trace tail + seq-cursored flight events + health in one
+  round trip) into the same :class:`FleetState` table, with a private
+  history ring so the alert rules evaluate FLEET-scope SLOs
+  (``default_fleet_scope_rules``).
 - :func:`get_history` — the bounded ring of timestamped registry
   snapshots behind ``GET /history`` and the ``trends`` block of
   ``/profile`` (opt-in background sampler; windowed rate/delta/quantile
@@ -54,9 +60,11 @@ from .fleet import FleetState, get_fleet, merge_traces
 from .history import MetricsHistory, get_history
 from .alerts import (AlertEngine, AlertError, AlertRule, BurnRateRule,
                      FleetStalenessRule, HealthRule, ThresholdRule,
-                     default_fleet_rules, default_rules,
-                     default_serving_rules, default_training_rules,
-                     get_alert_engine)
+                     default_fleet_rules, default_fleet_scope_rules,
+                     default_rules, default_serving_rules,
+                     default_training_rules, get_alert_engine)
+from .collector import (ScrapeTarget, TelemetryCollector, get_collector,
+                        telemetry_snapshot)
 from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
                        get_jit_registry, sample_device_memory,
                        maybe_sample_device_memory, profile_report,
@@ -77,7 +85,9 @@ __all__ = [
     "AlertRule", "ThresholdRule", "BurnRateRule", "HealthRule",
     "FleetStalenessRule", "get_alert_engine", "default_rules",
     "default_serving_rules", "default_training_rules",
-    "default_fleet_rules",
+    "default_fleet_rules", "default_fleet_scope_rules",
+    "ScrapeTarget", "TelemetryCollector", "get_collector",
+    "telemetry_snapshot",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 

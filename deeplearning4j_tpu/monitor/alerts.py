@@ -64,7 +64,7 @@ __all__ = ["AlertError", "AlertRule", "ThresholdRule", "BurnRateRule",
            "HealthRule", "FleetStalenessRule", "AlertEngine",
            "get_alert_engine", "default_serving_rules",
            "default_training_rules", "default_fleet_rules",
-           "default_rules"]
+           "default_fleet_scope_rules", "default_rules"]
 
 OK, PENDING, FIRING = "OK", "PENDING", "FIRING"
 
@@ -149,8 +149,8 @@ class ThresholdRule(AlertRule):
             raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
         if mode not in ("value", "rate", "max", "quantile"):
             raise ValueError(f"unknown mode {mode!r}")
-        if agg not in ("sum", "max"):
-            raise ValueError(f"agg must be sum|max, got {agg!r}")
+        if agg not in ("sum", "max", "min"):
+            raise ValueError(f"agg must be sum|max|min, got {agg!r}")
         self.metric = metric
         self.threshold = float(threshold)
         self.op = op
@@ -211,7 +211,20 @@ class BurnRateRule(AlertRule):
     ``kind="latency"``: windowed p-``q`` of ``latency_metric`` over
     ``target_ms`` on BOTH windows; the exemplar is the worst latched
     trace id of the latency histogram (requests route it via the serving
-    batcher)."""
+    batcher).
+
+    ``per_label`` (latency kind): evaluate the windowed quantile
+    SEPARATELY for each observed value of that label — "max over
+    replicas" instead of "quantile of the merged fleet histogram", the
+    fleet-scope reading where one slow replica must not be averaged
+    away by N healthy ones. Breach when ANY value breaches on both
+    windows; the detail names the guilty label value.
+
+    ``exemplar_lookup``: ``fn(guilty_label_value_or_None) -> trace id``
+    replaces the live-registry exemplar read — fleet-scope rules
+    evaluate over a MERGED history whose exemplars live on the remote
+    replicas; the fleet table stores what ``/telemetry`` shipped
+    (``FleetState.worst_exemplar``)."""
 
     def __init__(self, name: str, *, kind: str = "availability",
                  slo: float = 0.999, burn_factor: float = 14.4,
@@ -222,7 +235,11 @@ class BurnRateRule(AlertRule):
                  latency_metric: str = "serving_request_latency_ms",
                  latency_labels: Optional[Dict[str, str]] = None,
                  target_ms: float = 250.0, q: float = 0.99,
-                 min_requests: float = 1.0, **kw):
+                 min_requests: float = 1.0,
+                 per_label: Optional[str] = None,
+                 exemplar_lookup: Optional[
+                     Callable[[Optional[str]], Optional[str]]] = None,
+                 **kw):
         super().__init__(name, **kw)
         if kind not in ("availability", "latency"):
             raise ValueError(f"kind must be availability|latency, "
@@ -242,6 +259,8 @@ class BurnRateRule(AlertRule):
         self.target_ms = float(target_ms)
         self.q = float(q)
         self.min_requests = float(min_requests)
+        self.per_label = per_label
+        self.exemplar_lookup = exemplar_lookup
 
     def _bad_delta(self, history, window, now) -> float:
         total = 0.0
@@ -277,27 +296,91 @@ class BurnRateRule(AlertRule):
                   + f" vs {self.burn_factor:g}x (slo {self.slo})")
         return breached, max(burns), detail, None
 
+    def _exemplar(self, guilty: Optional[str]) -> Optional[str]:
+        if self.exemplar_lookup is not None:
+            try:
+                return self.exemplar_lookup(guilty)
+            except Exception:
+                log.exception("exemplar lookup for rule %r failed",
+                              self.name)
+                return None
+        return self._worst_trace()
+
+    def _per_label_values(self, history) -> List[str]:
+        """Observed values of ``per_label`` in the NEWEST sample's
+        latency family (restricted to ``latency_labels``) — the replica
+        roster the per-replica quantiles iterate."""
+        samples = history.samples()
+        if not samples:
+            return []
+        from .history import _match
+        fam = samples[-1][1].get(self.latency_metric) or {}
+        values = set()
+        for row in fam.get("children", []):
+            labels = row.get("labels", {})
+            if not _match(labels, self.latency_labels):
+                continue
+            v = labels.get(self.per_label)
+            if v is not None:
+                values.add(v)
+        return sorted(values)
+
     def _latency(self, history, now):
-        ps = []
         for w in self.windows:
             if not history.covers(w, now=now):
                 return False, None, (f"history does not cover the "
                                      f"{w:g}s window yet"), None
-            p = history.quantile_over(self.latency_metric, self.q, w,
-                                      self.latency_labels, now=now)
-            if p is None:
-                return False, None, (f"p{int(self.q * 100)}: no samples in "
-                                     f"{w:g}s window"), None
-            ps.append(p)
-        breached = all(p > self.target_ms for p in ps)
-        exemplar = None
-        if breached:
-            exemplar = self._worst_trace()
-        detail = (f"p{int(self.q * 100)} "
+        if self.per_label is None:
+            ps = []
+            for w in self.windows:
+                p = history.quantile_over(self.latency_metric, self.q, w,
+                                          self.latency_labels, now=now)
+                if p is None:
+                    return False, None, (f"p{int(self.q * 100)}: no "
+                                         f"samples in {w:g}s window"), None
+                ps.append(p)
+            breached = all(p > self.target_ms for p in ps)
+            exemplar = self._exemplar(None) if breached else None
+            detail = (f"p{int(self.q * 100)} "
+                      + "/".join(f"{p:.1f}ms@{w:g}s"
+                                 for p, w in zip(ps, self.windows))
+                      + f" vs target {self.target_ms:g}ms")
+            return breached, max(ps), detail, exemplar
+        # per-label (fleet-scope): the quantile is computed per value of
+        # per_label and the rule reads the WORST one — a merged-histogram
+        # quantile would let N fast replicas dilute one slow replica
+        # below the target (the exact failure mode a router cares about)
+        worst = None          # (peak_p, value_breached, label, ps)
+        for v in self._per_label_values(history):
+            labels = {**(self.latency_labels or {}), self.per_label: v}
+            ps = []
+            for w in self.windows:
+                p = history.quantile_over(self.latency_metric, self.q, w,
+                                          labels, now=now)
+                if p is None:
+                    ps = None       # idle on this window: not a breach,
+                    break           # not a candidate for "worst" either
+                ps.append(p)
+            if ps is None:
+                continue
+            breached = all(p > self.target_ms for p in ps)
+            peak = max(ps)
+            # breaching values outrank non-breaching ones — the guilty
+            # replica named in the detail must actually be a breacher
+            rank = (breached, peak)
+            if worst is None or rank > (worst[1], worst[0]):
+                worst = (peak, breached, v, ps)
+        if worst is None:
+            return False, None, (f"p{int(self.q * 100)}: no "
+                                 f"{self.per_label} series with samples "
+                                 f"in window"), None
+        peak, breached, guilty, ps = worst
+        exemplar = self._exemplar(guilty) if breached else None
+        detail = (f"worst {self.per_label}={guilty} p{int(self.q * 100)} "
                   + "/".join(f"{p:.1f}ms@{w:g}s"
                              for p, w in zip(ps, self.windows))
                   + f" vs target {self.target_ms:g}ms")
-        return breached, max(ps), detail, exemplar
+        return breached, peak, detail, exemplar
 
     def _worst_trace(self) -> Optional[str]:
         """Worst latched exemplar across the latency histogram's matching
@@ -716,6 +799,49 @@ def default_fleet_rules(for_seconds: float = DEFAULT_FOR_SECONDS
                            severity="ticket",
                            description="worker missed its telemetry "
                                        "interval on /fleet"),
+    ]
+
+
+def default_fleet_scope_rules(*, fleet=None, slo: float = 0.999,
+                              burn_factor: float = 14.4,
+                              windows: Sequence[float] = (60.0, 300.0),
+                              p99_target_ms: float = 250.0,
+                              per_label: str = "worker",
+                              for_seconds: float = DEFAULT_FOR_SECONDS
+                              ) -> List[AlertRule]:
+    """The scrape-plane pack, evaluated against a history ring fed by
+    :meth:`TelemetryCollector.fleet_dump` (where every series carries a
+    ``worker=<label>`` re-label):
+
+    - ``fleet_error_burn`` — error-budget burn on the SUM across
+      replicas (one replica's 5xx storm burns the shared budget);
+    - ``fleet_p99_worst_replica`` — windowed p99 per replica, rule
+      reads the worst one (``per_label``), exemplar resolved from the
+      guilty replica's scraped exemplar table;
+    - ``fleet_target_down`` — any configured scrape target failing
+      (min over ``fleet_target_up`` gauges below 1).
+    """
+    if fleet is None:
+        from .fleet import get_fleet
+        fleet = get_fleet()
+    return [
+        BurnRateRule("fleet_error_burn", kind="availability",
+                     slo=slo, burn_factor=burn_factor, windows=windows,
+                     for_seconds=for_seconds,
+                     description="aggregate 5xx error-budget burn "
+                                 "across scraped replicas"),
+        BurnRateRule("fleet_p99_worst_replica", kind="latency",
+                     target_ms=p99_target_ms, windows=windows,
+                     per_label=per_label, for_seconds=for_seconds,
+                     exemplar_lookup=lambda w: fleet.worst_exemplar(
+                         "serving_request_latency_ms", w),
+                     description="worst single replica's windowed p99 "
+                                 "over target on both windows"),
+        ThresholdRule("fleet_target_down", "fleet_target_up",
+                      threshold=1.0, op="<", mode="value", agg="min",
+                      for_seconds=for_seconds, severity="page",
+                      description="a configured scrape target is not "
+                                  "answering /telemetry"),
     ]
 
 

@@ -206,9 +206,11 @@ class MetricsHistory:
                  agg: str = "sum") -> Optional[float]:
         """Aggregate of a dump family's matching scalar children (None
         when the family or a matching child is absent). ``agg="sum"``
-        (counters, totals) or ``"max"`` (the worst single child — e.g.
+        (counters, totals), ``"max"`` (the worst single child — e.g.
         "any one model's queue near ITS cap", where a sum across models
-        would compare apples to one model's cap)."""
+        would compare apples to one model's cap) or ``"min"`` (the
+        weakest child — e.g. "any scrape target down" reads min of
+        ``fleet_target_up`` across targets)."""
         fam = dump.get(metric)
         if not fam:
             return None
@@ -216,7 +218,11 @@ class MetricsHistory:
                 if "value" in row and _match(row.get("labels", {}), labels)]
         if not vals:
             return None
-        return float(max(vals)) if agg == "max" else float(sum(vals))
+        if agg == "max":
+            return float(max(vals))
+        if agg == "min":
+            return float(min(vals))
+        return float(sum(vals))
 
     def current(self, metric: str,
                 labels: Optional[Dict[str, str]] = None,

@@ -15,10 +15,15 @@ JsonRequestHandler` plumbing and POST Content-Length cap), serving:
   and response-cache occupancy — docs/SERVING.md "Data-plane tuning").
 - ``GET /v1/models/<name>`` — one model's row.
 - ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` /
-  ``GET /alerts`` / ``GET /history`` — the monitor endpoints re-exposed
-  here so a serving replica is scrapeable (and alertable) without a
-  training UI attached; ``/profile`` carries the per-model ``serving``
-  block (p50/p99 latency, QPS, batch-size distribution, queue depth).
+  ``GET /alerts`` / ``GET /history`` / ``GET /trace`` /
+  ``GET /events`` / ``GET /fleet`` / ``GET /fleet/trace`` /
+  ``GET /telemetry`` — the monitor endpoints (shared ``_monitor_get``
+  routing) re-exposed here so a serving replica is scrapeable (and
+  alertable) without a training UI attached; ``/profile`` carries the
+  per-model ``serving`` block (p50/p99 latency, QPS, batch-size
+  distribution, queue depth), and ``/telemetry`` is the one-round-trip
+  bundle the fleet :class:`~deeplearning4j_tpu.monitor.collector.
+  TelemetryCollector` scrapes.
 
 Requests are request-scope traced: the ``X-DL4J-Trace`` header
 (``<trace hex>:<span hex>``, the proto-v2 ``SpanContext`` ids) joins the
@@ -81,7 +86,7 @@ class _ServingHandler(JsonRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         if self._monitor_get(url, parse_qs(url.query)):
-            return                     # shared /metrics /healthz /profile
+            return                  # shared /metrics /healthz /telemetry …
         parts = [p for p in url.path.split("/") if p]
         if parts == ["v1", "models"]:
             self._json({"models": self.registry.list_models()})

@@ -31,6 +31,9 @@ TPU-native equivalent of reference ``deeplearning4j-play``
    per process, propagated trace IDs intact
  - ``/events``               — the crash flight recorder's structured
    event log (worker join/leave, peer failures, health transitions)
+ - ``/telemetry``            — one-round-trip scrape bundle for the fleet
+   collector (registry dump + trace tail + seq-cursored flight events +
+   health + exemplars; ``?since_seq=N`` for only-newer events)
  - POST ``/remote``          — remote StatsReport receiver (the reference's
    remote listener posting seam)
 
@@ -207,10 +210,10 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _monitor_get(self, url, q) -> bool:
         """Serve the process-monitor endpoints every server shares —
         ``/metrics``, ``/healthz``, ``/profile``, ``/alerts``,
-        ``/history``, ``/control`` — so the training UI and the serving
-        front door
-        cannot drift on routing, status-code mapping, or framing. Returns
-        True when the path was handled."""
+        ``/history``, ``/control``, ``/trace``, ``/events``, ``/fleet``,
+        ``/fleet/trace``, ``/telemetry`` — so the training UI and the
+        serving front door cannot drift on routing, status-code mapping,
+        or framing. Returns True when the path was handled."""
         if url.path == "/metrics":
             # Prometheus scrape of the process-global monitor registry.
             # Device-memory gauges are sampled scrape-time (pull-model
@@ -269,6 +272,53 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             else:
                 self._json(hist.describe())
             return True
+        if url.path == "/trace":
+            self._json(get_tracer().export())
+            return True
+        if url.path == "/fleet":
+            # merged per-worker registry view (OP_TELEMETRY reports and
+            # collector scrapes landed in the process-global FleetState):
+            # Prometheus text with a worker label, or the liveness table
+            # as JSON (?format=json — includes the per-shard
+            # staleness/wire-bytes block when the fleet runs the sharded
+            # paramserver client)
+            fleet = get_fleet()
+            if q.get("format", [""])[0] == "json":
+                self._json(fleet.liveness())
+                return True
+            self._text(fleet.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return True
+        if url.path == "/fleet/trace":
+            # whole-fleet Chrome trace: every worker's shipped spans plus
+            # this process's own, one pid row each (open in Perfetto)
+            self._json(get_fleet().merged_trace())
+            return True
+        if url.path == "/events":
+            rec = get_flight_recorder()
+            # default=repr: event fields may be non-serializable by the
+            # recorder's contract — they degrade here exactly as in dumps
+            self._json({"events": rec.events(), "dropped": rec.dropped,
+                        "last_dump_path": rec.last_dump_path},
+                       default=repr)
+            return True
+        if url.path == "/telemetry":
+            # one-round-trip scrape for the fleet collector
+            # (monitor/collector.py): registry dump + trace tail +
+            # seq-cursored flight events + health + latched exemplars.
+            # No since_seq → prime reply (last_seq only, NO events — a
+            # collector joining late must not replay history as fresh
+            # incidents); ?since_seq=N → events with seq > N
+            from ..monitor.collector import telemetry_snapshot
+            since = q.get("since_seq", [None])[0]
+            if since is not None:
+                try:
+                    since = int(since)
+                except ValueError:
+                    self._json({"error": "since_seq must be an int"}, 400)
+                    return True
+            self._json(telemetry_snapshot(since_seq=since), default=repr)
+            return True
         return False
 
 
@@ -279,36 +329,7 @@ class _Handler(JsonRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
-        if self._monitor_get(url, q):    # /metrics /healthz /profile
-            return
-        if url.path == "/trace":
-            self._json(get_tracer().export())
-            return
-        if url.path == "/fleet":
-            # merged per-worker registry view (OP_TELEMETRY reports landed
-            # in the process-global FleetState): Prometheus text with a
-            # worker label, or the liveness table as JSON (?format=json —
-            # includes the per-shard staleness/wire-bytes block when the
-            # fleet runs the sharded paramserver client)
-            fleet = get_fleet()
-            if q.get("format", [""])[0] == "json":
-                self._json(fleet.liveness())
-                return
-            self._text(fleet.render_prometheus(),
-                       "text/plain; version=0.0.4; charset=utf-8")
-            return
-        if url.path == "/fleet/trace":
-            # whole-fleet Chrome trace: every worker's shipped spans plus
-            # this process's own, one pid row each (open in Perfetto)
-            self._json(get_fleet().merged_trace())
-            return
-        if url.path == "/events":
-            rec = get_flight_recorder()
-            # default=repr: event fields may be non-serializable by the
-            # recorder's contract — they degrade here exactly as in dumps
-            self._json({"events": rec.events(), "dropped": rec.dropped,
-                        "last_dump_path": rec.last_dump_path},
-                       default=repr)
+        if self._monitor_get(url, q):    # /metrics /healthz /telemetry ...
             return
         if url.path in ("/", "/train", "/train/overview.html"):
             payload = _PAGE.encode("utf-8")

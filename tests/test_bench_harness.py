@@ -566,3 +566,20 @@ def test_cold_start_child_hang_costs_only_the_garnish(bench, monkeypatch):
 
     monkeypatch.setattr(sp, "run", unspawnable)
     assert bench._measure_cold_start() is None
+
+def test_fleet_scrape_bench_latches_scrape_plane_stats(bench):
+    """ISSUE 17: the scrape-plane bench polls K in-process replicas over
+    real HTTP and latches {scrape_ms_p50, scrape_ms_p99, targets,
+    merged_series, tick_overhead_ms, scrape_errors} — the ``--one``
+    record's ``fleet_scrape`` block. At steady state against live
+    loopback replicas every scrape must succeed."""
+    value = bench.bench_fleet_scrape(replicas=2, ticks=6, warm_requests=2)
+    stats = bench.FLEET_SCRAPE_STATS
+    assert stats["scrape_ms_p99"] == value
+    assert 0 < stats["scrape_ms_p50"] <= stats["scrape_ms_p99"]
+    assert stats["targets"] == 2
+    assert stats["scrape_errors"] == 0
+    # the merged dump carries both replicas' serving series plus the
+    # synthesized liveness and scrape-observability families
+    assert stats["merged_series"] >= 3
+    assert stats["tick_overhead_ms"] >= 0.0

@@ -267,6 +267,41 @@ def test_monitor_events_subcommand_smoke(capsys):
         srv_ui.stop()
 
 
+def test_monitor_collect_subcommand_smoke(capsys):
+    """`monitor --collect LABEL=URL`: one scrape-plane tick against a
+    live /telemetry endpoint prints the merged Prometheus view (or the
+    collector snapshot as JSON); an unreachable target prints a stderr
+    diagnostic and exits non-zero."""
+    from deeplearning4j_tpu.monitor import get_registry
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    get_registry().counter("cli_collect_probe_total").inc(3)
+    srv_ui = UIServer(port=0)
+    srv_ui.attach(InMemoryStatsStorage())
+    port = srv_ui.start()
+    try:
+        assert main(["monitor", "--collect", f"cli0=127.0.0.1:{port}"]) == 0
+        out = capsys.readouterr().out
+        assert 'fleet_target_up{target="cli0"}' in out
+        assert 'cli_collect_probe_total{worker="cli0"} 3' in out
+
+        assert main(["monitor", "--collect", f"cli0=127.0.0.1:{port}",
+                     "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["targets"]["targets"]["cli0"]["up"] is True
+        assert "cli0" in doc["liveness"]["workers"]
+
+        # bare URL spec: the label derives from host:port
+        assert main(["monitor", "--collect", f"127.0.0.1:{port}"]) == 0
+        assert f'worker="127.0.0.1:{port}"' in capsys.readouterr().out
+    finally:
+        srv_ui.stop()
+
+    assert main(["monitor", "--collect", "dead=127.0.0.1:9"]) == 1
+    captured = capsys.readouterr()
+    assert "# scrape dead FAILED" in captured.err
+
+
 def test_monitor_profile_subcommand_smoke(capsys):
     """`monitor --profile`: the step-anatomy report, local and over --url,
     text and JSON (docs/OBSERVABILITY.md "Compilation & memory")."""

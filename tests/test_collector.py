@@ -1,0 +1,437 @@
+"""Scrape-plane fleet collector (docs/OBSERVABILITY.md "Scrape plane"):
+the ``/telemetry`` one-round-trip bundle with seq-cursored flight
+events, the pull-based :class:`TelemetryCollector` landing scrapes in
+the fleet table, and THE fleet acceptance drill — two REAL replica
+subprocesses (each owns its registry/tracer/flight recorder, exactly
+the isolation the scrape plane exists for) scraped by a live
+collector: fleet-scope SLO rules walk OK→PENDING→FIRING naming the
+guilty replica with a trace id resolvable on THAT replica, a
+mid-drill kill trips ``fleet_target_down``, recovery resolves
+everything, and the whole incident reconstructs from ``/events``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.monitor import (FleetState, ScrapeTarget,
+                                        TelemetryCollector,
+                                        default_fleet_scope_rules,
+                                        get_fleet, telemetry_snapshot)
+from deeplearning4j_tpu.monitor.flightrec import get_flight_recorder
+from deeplearning4j_tpu.monitor.tracer import get_tracer
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+
+
+def _get_json(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+def _get_text(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+def _post_predict(port, model="drill"):
+    """One predict round trip; 500s (injected model faults) are DATA for
+    the burn rule, so they come back as (code, body), never raise."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{model}/predict",
+        data=json.dumps({"inputs": [[1.0, 2.0]]}).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+# ------------------------------------------------- /telemetry semantics
+class TestTelemetrySnapshot:
+    def test_prime_then_cursor_then_full_history(self):
+        """No ``since_seq`` → the priming reply: ``last_seq`` only, NO
+        events (a collector joining late must never replay history as
+        fresh incidents). ``since_seq=<cursor>`` → only newer events.
+        ``since_seq=-1`` is the explicit opt-in to full history."""
+        rec = get_flight_recorder()
+        rec.record("collector_unit_t1")
+        prime = telemetry_snapshot()
+        assert prime["flight_events"] == []
+        assert prime["last_seq"] == rec.events()[-1]["seq"]
+        for key in ("registry", "trace_events", "health", "exemplars"):
+            assert key in prime
+
+        rec.record("collector_unit_t2")
+        fresh = telemetry_snapshot(since_seq=prime["last_seq"])
+        assert [e["event"] for e in fresh["flight_events"]] \
+            == ["collector_unit_t2"]
+        assert fresh["last_seq"] > prime["last_seq"]
+
+        full = telemetry_snapshot(since_seq=-1)
+        names = [e["event"] for e in full["flight_events"]]
+        assert "collector_unit_t1" in names and "collector_unit_t2" in names
+
+    def test_endpoint_served_with_cursor_and_400(self):
+        """Both server families route ``/telemetry`` through the shared
+        ``_monitor_get`` — here the UI server: prime reply, cursored
+        reply, and a non-int ``since_seq`` is a 400, not a 500."""
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        port = ui.start()
+        rec = get_flight_recorder()
+        try:
+            status, prime = _get_json(port, "/telemetry")
+            assert status == 200
+            assert prime["flight_events"] == []
+            rec.record("collector_http_fresh")
+            status, doc = _get_json(
+                port, f"/telemetry?since_seq={prime['last_seq']}")
+            assert status == 200
+            assert "collector_http_fresh" in [
+                e["event"] for e in doc["flight_events"]]
+            status, err = _get_json(port, "/telemetry?since_seq=banana")
+            assert status == 400 and "since_seq" in err["error"]
+        finally:
+            ui.stop()
+
+
+# --------------------------------------------------- collector plumbing
+class TestCollectorTick:
+    def test_tick_lands_report_and_cursors_remote_events(self):
+        """One tick against an in-process server: the reply lands as a
+        fleet report (worker-labeled series on the merged dump), the
+        cursor primes on the first scrape, and a flight event recorded
+        between ticks is re-recorded locally WITH provenance."""
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        port = ui.start()
+        fleet = FleetState()
+        c = TelemetryCollector(fleet=fleet, timeout_s=10.0)
+        c.add_target("u0", f"127.0.0.1:{port}")
+        rec = get_flight_recorder()
+        try:
+            res = c.tick()
+            assert res["scraped"] == ["u0"] and not res["errors"]
+            snap = c.snapshot()["targets"]["u0"]
+            assert snap["up"] is True and isinstance(snap["cursor"], int)
+            dump = c.fleet_dump()
+            ups = {r["labels"]["target"]: r["value"]
+                   for r in dump["fleet_target_up"]["children"]}
+            assert ups == {"u0": 1.0}
+            assert any(
+                row.get("labels", {}).get("worker") == "u0"
+                for fam in fleet.merged_dump().values()
+                for row in fam.get("children", []))
+
+            rec.record("collector_remote_boom", shard=3)
+            c.tick()
+            landed = [e for e in rec.events()
+                      if e["event"] == "collector_remote_boom"
+                      and e.get("target") == "u0"]
+            assert landed, "cursor-fresh remote event must re-record " \
+                           "locally with target provenance"
+            assert landed[0].get("origin_seq") is not None
+            assert landed[0].get("shard") == 3
+
+            # one history sample + engine pass per tick (the upward loop)
+            assert len(c.history.samples()) == 2
+        finally:
+            c.stop()
+            ui.stop()
+
+    def test_remove_target_drops_scrape_series_from_fleet_dump(self):
+        """A retired target's stale ``fleet_target_up 0`` must not leak
+        into the merged dump and trip gap rules forever."""
+        c = TelemetryCollector(fleet=FleetState(), timeout_s=0.2)
+        c.add_target("gone", "127.0.0.1:9")      # refused → up=0
+        c.tick()
+        assert [t.label for t in c.down_targets()] == ["gone"]
+        assert "fleet_target_up" in c.fleet_dump()
+        c.remove_target("gone")
+        fam = c.fleet_dump().get("fleet_target_up")
+        assert not fam or not [
+            r for r in fam.get("children", [])
+            if r.get("labels", {}).get("target") == "gone"]
+
+
+# ------------------------------------------------ THE acceptance drill
+# One replica subprocess: registers a flag-file-faultable model, starts
+# an InferenceServer on an ephemeral port, prints the port, then blocks
+# on stdin (kill/terminate is the drill's failure injection). It records
+# a flight event BEFORE serving so the drill can prove cursor priming
+# keeps pre-existing incident history from replaying in the collector.
+_REPLICA_SRC = r"""
+import os, sys, time
+import numpy as np
+
+flag = sys.argv[1]
+
+class DrillModel:
+    def __init__(self):
+        self.n = 0
+    def output(self, x, mask=None):
+        x = np.asarray(x)
+        if os.path.exists(flag):          # fault switch: slow + erroring
+            time.sleep(0.08)
+            self.n += 1
+            if self.n % 2 == 0:
+                raise RuntimeError("injected drill fault")
+        return np.full((x.shape[0], 2), 1.0, np.float32)
+
+from deeplearning4j_tpu.serving import InferenceServer
+from deeplearning4j_tpu.monitor import get_flight_recorder
+
+get_flight_recorder().record("preexisting_incident", origin="replica")
+srv = InferenceServer()
+srv.register("drill", DrillModel(), batch_buckets=(1,), linger_ms=0.0,
+             max_queue_examples=64)
+print(srv.start(port=0), flush=True)
+sys.stdin.read()
+"""
+
+
+def _spawn_replica(flag_path, err_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"      # numpy model; never wait on a device
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    errf = open(err_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REPLICA_SRC, str(flag_path)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errf,
+        text=True, env=env, cwd=root)
+    box = {}
+
+    def _read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=_read, daemon=True)
+    t.start()
+    t.join(120)
+    line = (box.get("line") or "").strip()
+    if not line:
+        proc.kill()
+        proc.wait(timeout=30)
+        errf.close()
+        with open(err_path) as f:
+            raise RuntimeError(f"replica failed to start:\n{f.read()}")
+    errf.close()
+    return proc, int(line)
+
+
+class TestFleetAcceptanceDrill:
+    def test_two_replica_fleet_drill(self, tmp_path):
+        """THE acceptance scenario, end to end: two real replica
+        processes scraped by a live collector; a slow+erroring model on
+        r1 walks ``fleet_p99_worst_replica`` and ``fleet_error_burn``
+        through OK→PENDING→FIRING with the guilty replica named in the
+        detail and an exemplar trace id resolvable on r1's own
+        ``/trace``; killing r1 mid-drill trips ``fleet_target_down``;
+        respawning resolves every rule with a ``fleet_target_recovered``
+        edge; the whole incident reads back off ``/events``; and
+        ``stop()`` leaves no collector thread behind."""
+        fleet = get_fleet()
+        fleet.clear()
+        rec = get_flight_recorder()
+        rec.clear()
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        ui_port = ui.start()
+        flag = tmp_path / "fault_r1"
+        collector = TelemetryCollector(timeout_s=10.0)
+        edges = []
+        collector.engine.subscribe(
+            lambda ev, payload: edges.append((ev, dict(payload))))
+        collector.engine.add(*default_fleet_scope_rules(
+            fleet=collector.fleet, windows=(1.5, 3.0),
+            p99_target_ms=40.0, for_seconds=0.2))
+        procs = []
+        states = []
+        step = [0]
+
+        def beat(posts, per=2):
+            """Drive ``per`` requests per listed replica, then one
+            deterministic synthetic-time tick (0.5s per beat — 7 beats
+            cover the 3s window with the quarter-window tolerance)."""
+            for port in posts:
+                for _ in range(per):
+                    _post_predict(port)
+            step[0] += 1
+            res = collector.tick(now=t0 + 0.5 * step[0])
+            states.append({r.name: r.state
+                           for r in collector.engine.rules()})
+            return res
+
+        try:
+            p0, port0 = _spawn_replica(tmp_path / "no_fault_r0",
+                                       tmp_path / "r0.err")
+            procs.append(p0)
+            p1, port1 = _spawn_replica(flag, tmp_path / "r1.err")
+            procs.append(p1)
+            collector.add_target("r0", f"127.0.0.1:{port0}")
+            collector.add_target("r1", f"127.0.0.1:{port1}")
+
+            # live collector: start() scrapes immediately (interval far
+            # beyond the drill so the deterministic beats own the clock)
+            collector.start(interval_s=120.0)
+            assert collector.running()
+            assert "telemetry-collector" in [
+                t.name for t in threading.enumerate()]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                targets = collector.snapshot()["targets"]
+                if len(targets) == 2 and all(
+                        v["up"] for v in targets.values()):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"live scrape never landed: "
+                            f"{collector.snapshot()}")
+            time.sleep(0.25)          # let the first tick's sample+eval
+            t0 = time.time()          # finish before synthetic beats
+
+            # cursor priming: both replicas recorded incident history
+            # BEFORE the first scrape; none of it replays locally
+            assert not [e for e in rec.events()
+                        if e["event"] == "preexisting_incident"]
+
+            # ---- healthy baseline: windows covered, everything OK
+            for _ in range(7):
+                res = beat([port0, port1])
+                assert not res["errors"], res
+            assert states[-1] == {"fleet_error_burn": "OK",
+                                  "fleet_p99_worst_replica": "OK",
+                                  "fleet_target_down": "OK"}
+
+            # merged surfaces while healthy: one GET /fleet serves both
+            # replicas' series under stable worker labels; the merged
+            # trace carries both replicas' spans exactly once
+            text = _get_text(ui_port, "/fleet")
+            assert 'worker="r0"' in text and 'worker="r1"' in text
+            assert "fleet_worker_up" in text
+            status, trace = _get_json(ui_port, "/fleet/trace")
+            assert status == 200
+            spans = [e for e in trace["traceEvents"]
+                     if e.get("ph") == "X"
+                     and (e.get("args") or {}).get("trace_id")]
+            keys = [(e["args"]["trace_id"], e["args"].get("span_id"),
+                     e["ts"]) for e in spans]
+            assert spans and len(keys) == len(set(keys))
+            assert len({e["pid"] for e in spans}) >= 2
+
+            # ---- inject the fault on r1: slow forwards + one 500 per
+            # two requests; both burn rules must walk the state machine
+            flag.write_text("x")
+            for _ in range(14):
+                beat([port0, port1])
+                if (states[-1]["fleet_p99_worst_replica"] == "FIRING"
+                        and states[-1]["fleet_error_burn"] == "FIRING"):
+                    break
+            assert states[-1]["fleet_p99_worst_replica"] == "FIRING", \
+                [(r.name, r.state, r.last_detail)
+                 for r in collector.engine.rules()]
+            assert states[-1]["fleet_error_burn"] == "FIRING"
+            p99_walk = [s["fleet_p99_worst_replica"] for s in states]
+            assert "PENDING" in p99_walk, p99_walk   # hold-down honored
+
+            # the firing edge names the GUILTY replica and carries an
+            # exemplar trace id resolvable against THAT replica's /trace
+            fired = [p for ev, p in edges if ev == "alert_firing"
+                     and p.get("rule") == "fleet_p99_worst_replica"]
+            assert fired, edges
+            assert "worker=r1" in (fired[-1].get("detail") or "")
+            exemplar = fired[-1].get("exemplar_trace_id")
+            assert exemplar
+            status, rtrace = _get_json(port1, "/trace")
+            assert exemplar in {
+                (e.get("args") or {}).get("trace_id")
+                for e in rtrace["traceEvents"]}
+
+            # ---- kill r1 mid-drill: the scrape fails, liveness drops,
+            # the gap rule fires, and the error counter shows the miss
+            p1.kill()
+            p1.wait(timeout=30)
+            flag.unlink()                # respawn will come back healthy
+            res = beat([port0])
+            assert "r1" in res["errors"]
+            assert [t.label for t in collector.down_targets()] == ["r1"]
+            beat([port0])                # hold-down (0.2s < one beat)
+            assert states[-1]["fleet_target_down"] == "FIRING"
+            dump = collector.fleet_dump()
+            ups = {r["labels"]["target"]: r["value"]
+                   for r in dump["fleet_target_up"]["children"]}
+            assert ups["r1"] == 0.0 and ups["r0"] == 1.0
+            errs = {r["labels"]["target"]: r["value"]
+                    for r in dump["fleet_scrape_errors_total"]["children"]}
+            assert errs.get("r1", 0) >= 1
+            assert any(e["event"] == "fleet_target_down"
+                       and e.get("target") == "r1" for e in rec.events())
+
+            # ---- recovery: respawn r1 (same label, new port), drive
+            # healthy beats until the fault ages out of both windows
+            p1b, port1b = _spawn_replica(flag, tmp_path / "r1b.err")
+            procs.append(p1b)
+            collector.add_target("r1", f"127.0.0.1:{port1b}")
+            for _ in range(16):
+                beat([port0, port1b])
+                if states[-1] == {"fleet_error_burn": "OK",
+                                  "fleet_p99_worst_replica": "OK",
+                                  "fleet_target_down": "OK"}:
+                    break
+            assert states[-1] == {"fleet_error_burn": "OK",
+                                  "fleet_p99_worst_replica": "OK",
+                                  "fleet_target_down": "OK"}, \
+                [(r.name, r.state, r.last_detail)
+                 for r in collector.engine.rules()]
+            assert any(e["event"] == "fleet_target_recovered"
+                       and e.get("target") == "r1" for e in rec.events())
+            # the respawned replica's pre-scrape history stays suppressed
+            assert not [e for e in rec.events()
+                        if e["event"] == "preexisting_incident"]
+            assert {p.get("rule") for ev, p in edges
+                    if ev == "alert_resolved"} >= {
+                        "fleet_error_burn", "fleet_p99_worst_replica",
+                        "fleet_target_down"}
+
+            # ---- the incident reconstructs from GET /events alone
+            status, evdoc = _get_json(ui_port, "/events")
+            assert status == 200
+            names = [e["event"] for e in evdoc["events"]]
+            for needed in ("alert_firing", "fleet_target_down",
+                           "fleet_target_recovered", "alert_resolved"):
+                assert needed in names, names
+            assert names.index("fleet_target_down") \
+                < names.index("fleet_target_recovered")
+
+            # ---- lifecycle: timed-join stop leaves no thread behind
+            collector.stop()
+            assert not collector.running()
+            assert "telemetry-collector" not in [
+                t.name for t in threading.enumerate()]
+        finally:
+            collector.stop()
+            collector.engine.clear()
+            fleet.clear()
+            rec.clear()
+            get_tracer().clear()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+            ui.stop()

@@ -246,6 +246,65 @@ def test_merge_traces_assigns_pid_rows():
     assert spans["s"] != spans["t"]     # original identical pids split
 
 
+def _trace_span(i, trace_id=7):
+    return {"name": f"s{i}", "ph": "X", "pid": 0, "tid": 1, "ts": i * 10,
+            "dur": 5, "args": {"trace_id": trace_id, "span_id": i}}
+
+
+def test_merged_trace_pids_stable_across_join_and_leave():
+    """Scrape-plane satellite pin: pids come from the table's first-seen
+    assignment, NOT sorted enumeration — a replica joining (even one
+    sorting BEFORE existing labels) or leaving must never renumber the
+    other Perfetto process rows between successive exports."""
+    fleet = FleetState()
+    fleet.record_report("b", {"trace_events": [_trace_span(1)]})
+
+    def pid_rows(doc):
+        return {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                if e.get("ph") == "M"}
+
+    pids1 = pid_rows(fleet.merged_trace(local_events=[]))
+    assert set(pids1) == {"worker:b", "server"}
+
+    fleet.record_report("a", {"trace_events": [_trace_span(2)]})
+    pids2 = pid_rows(fleet.merged_trace(local_events=[]))
+    # existing rows keep their pids; the joiner gets a NEW row even
+    # though "worker:a" sorts before both
+    assert pids2["worker:b"] == pids1["worker:b"]
+    assert pids2["server"] == pids1["server"]
+    assert pids2["worker:a"] not in set(pids1.values())
+
+    pids3 = pid_rows(fleet.merged_trace(local_events=[]))
+    assert pids3 == pids2                    # repeat export: unchanged
+
+
+def test_merged_trace_dedups_overlapping_report_windows():
+    """Scrape-plane satellite pin (the two-report test): telemetry ships
+    the newest ring TAIL each report, so consecutive reports overlap —
+    each span occurrence must appear exactly once in the merged trace,
+    keyed by (trace_id, span_id, ts)."""
+    fleet = FleetState()
+    fleet.record_report("w", {"trace_events": [_trace_span(1),
+                                               _trace_span(2)]})
+    fleet.record_report("w", {"trace_events": [_trace_span(2),
+                                               _trace_span(3)]})
+    doc = fleet.merged_trace(local_events=[])
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert sorted(e["name"] for e in spans) == ["s1", "s2", "s3"]
+    # keyless events (metadata, foreign formats) are never deduped
+    assert len([e for e in doc["traceEvents"]
+                if e.get("ph") == "M"]) == 2    # one per pid row
+
+
+def test_merge_traces_global_dedup_across_labels():
+    """merge-time dedup is GLOBAL: the same span occurrence arriving via
+    two labels (a replica's ring tail and the local tracer both holding
+    it) renders once — first pid row wins."""
+    doc = merge_traces({"a": [_trace_span(5)], "b": [_trace_span(5)]})
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 1
+
+
 def test_telemetry_survives_nonserializable_flight_events():
     """The recorder's contract allows non-JSON field values (degraded to
     repr at dump time) — a weird event in the buffer must not kill

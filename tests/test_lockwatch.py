@@ -363,15 +363,39 @@ def _control_flows():
     assert len(calls) == 2
 
 
+def _collector_flows():
+    """The scrape-plane suite's core flows: target-table mutation, a
+    failing scrape (the lock-heavy path — liveness flips and error
+    counters update under the lock, the down edge records a flight
+    event), the merged fleet dump + history sample + a zero-rule alert
+    evaluation, and the read surfaces. The design invariant this
+    exercises: ``TelemetryCollector._lock`` is a LEAF — HTTP scrapes,
+    ``record_report``, history sampling and alert evaluation all run
+    with no collector lock held."""
+    from deeplearning4j_tpu.monitor.collector import TelemetryCollector
+    from deeplearning4j_tpu.monitor.fleet import FleetState
+    c = TelemetryCollector(fleet=FleetState(), timeout_s=0.2)
+    c.add_target("lw0", "127.0.0.1:9")    # nothing listens: refused fast
+    c.tick()               # error path + history sample + engine evaluate
+    c.tick()               # repeat: the down event stays edge-triggered
+    assert [t.label for t in c.down_targets()] == ["lw0"]
+    c.snapshot()
+    c.fleet_dump()
+    c.remove_target("lw0")
+    c.tick()               # empty target table: no sample, no evaluation
+    assert not c.running()
+
+
 def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
     """Tier-1 pin: the sharded-paramserver + prefetch + overlap +
-    control-plane flows under lockwatch produce ZERO lock-order
-    inversions, and every observed edge is derivable by the static
-    analyzer."""
+    control-plane + scrape-collector flows under lockwatch produce ZERO
+    lock-order inversions, and every observed edge is derivable by the
+    static analyzer."""
     _sharded_flows()
     _prefetch_flows()
     _overlap_flows()
     _control_flows()
+    _collector_flows()
     assert watch.inversions() == [], watch.inversions()
 
     observed = watch.observed_edges()
@@ -391,6 +415,13 @@ def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
         "acquisitions"] > 0
     assert not [e for e in observed if e[0] == "ControlPlane._lock"], \
         [e for e in observed if e[0] == "ControlPlane._lock"]
+    # same leaf discipline for the scrape-plane collector: scrapes,
+    # record_report, history sampling and alert evaluation all run
+    # unlocked, so its lock must show acquisitions but no outgoing edge
+    assert watch.contention_table()["TelemetryCollector._lock"][
+        "acquisitions"] > 0
+    assert not [e for e in observed if e[0] == "TelemetryCollector._lock"], \
+        [e for e in observed if e[0] == "TelemetryCollector._lock"]
 
     from deeplearning4j_tpu.analysis.lockgraph import analyze_package
     static = analyze_package().edge_set()
