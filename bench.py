@@ -1419,6 +1419,40 @@ def bench_transformer_lm(batch=4, seq_len=8192, vocab=4096, embed=512,
     return batch * seq_len * iters / dt
 
 
+LINT_FULL_STATS = {}
+
+
+def bench_lint_full(repeats=3):
+    """tpulint whole-package cost (analysis/): wall-seconds for one full
+    default run — every rule, including the interprocedural lock graph
+    (THR003/THR004) and the racegraph lockset pass (THR005) — against
+    the shipped baseline. Pure host CPU, no backend needed. Latches
+    {wall_s, files, rules, findings_new, findings_baselined} into
+    ``LINT_FULL_STATS`` for the ``--one`` record so a linter cost
+    regression shows up in the trajectory next to the numbers it taxes
+    (the pre-commit hook and the tier-1 self-host guard both pay this
+    wall time). Headline value: best-of-N wall seconds (lower is
+    better)."""
+    from deeplearning4j_tpu.analysis import (Linter, load_baseline,
+                                             DEFAULT_BASELINE_PATH,
+                                             PACKAGE_ROOT, all_rules)
+    baseline = load_baseline(DEFAULT_BASELINE_PATH)
+    best, res = None, None
+    for _ in range(int(repeats)):
+        t0 = time.perf_counter()
+        res = Linter().run([PACKAGE_ROOT], baseline=baseline)
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    LINT_FULL_STATS.update({
+        "wall_s": round(best, 3),
+        "files": res.files_checked,
+        "rules": len(all_rules()),
+        "findings_new": len(res.new),
+        "findings_baselined": len(res.baselined),
+    })
+    return round(best, 3)
+
+
 # Sweep order = information value under a flapping tunnel (round-4 lesson:
 # a 50-min up-window banked only the configs that happened to come first).
 # Smallest honest measurement (lenet) proves the window, then the configs
@@ -1436,6 +1470,7 @@ ALL_BENCHES = [
     ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("control_loop_time_to_recover_s", "s", bench_control_loop),
     ("fleet_scrape_p99_ms", "ms", bench_fleet_scrape),
+    ("lint_full_wall_s", "s", bench_lint_full),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
      bench_keras_import_parallel),
@@ -1594,7 +1629,7 @@ def _read_baseline():
             base_doc = json.load(fh)
         return base_doc, base_doc.get("published", {}).get(
             "resnet50_imagenet_images_per_sec")
-    except Exception:
+    except Exception:  # tpulint: disable=EXC001 — no baseline file = no headline, by design
         return None, None
 
 
@@ -1679,7 +1714,7 @@ def _backend_stale() -> bool:
     try:
         import jax
         return jax.default_backend() not in ("tpu", "axon")
-    except Exception:   # unreachable backend = nothing fresh to trust
+    except Exception:  # tpulint: disable=EXC001 — unreachable backend = nothing fresh to trust
         return True
 
 
@@ -1800,7 +1835,7 @@ def _kill_children():
     for p in list(_CHILDREN):
         try:
             p.kill()
-        except Exception:
+        except Exception:  # tpulint: disable=EXC001 — best-effort kill on the way down
             pass
 
 
@@ -1918,7 +1953,11 @@ def main():
                           # scrape-plane collector cost over K HTTP
                           # replicas — populated only by the
                           # fleet_scrape config
-                          "fleet_scrape": FLEET_SCRAPE_STATS or None}))
+                          "fleet_scrape": FLEET_SCRAPE_STATS or None,
+                          # whole-package tpulint wall time (all rules,
+                          # shipped baseline) — populated only by the
+                          # lint_full config
+                          "lint_full": LINT_FULL_STATS or None}))
         return
 
     run_all = "--all" in sys.argv

@@ -242,7 +242,13 @@ class LockGraphAnalyzer:
 
     def _lock_ctor_identity(self, value: ast.AST):
         """Is ``value`` a lock construction? Returns the literal name for
-        factory calls, True for bare threading ctors, None otherwise."""
+        factory calls, True for bare threading ctors, None otherwise.
+        Sees through conditional construction — the batcher's
+        ``make_lock(...) if caching else None`` — so the optional lock
+        still gets its stable factory identity."""
+        if isinstance(value, ast.IfExp):
+            return (self._lock_ctor_identity(value.body)
+                    or self._lock_ctor_identity(value.orelse))
         if not isinstance(value, ast.Call):
             return None
         callee = terminal_name(value.func)

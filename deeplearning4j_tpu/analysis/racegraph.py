@@ -1,0 +1,668 @@
+"""Static data-race detection (THR005 backend): guarded-field inference.
+
+THR003/THR004 see *lock-order* hazards; this module sees *shared-field*
+hazards — the race class behind every recent incident here (the
+batcher's cache/close races, the control plane's remove-mid-action race,
+the collector's cursor races). It is the Eraser lockset idea grafted
+onto :mod:`~deeplearning4j_tpu.analysis.lockgraph`'s existing machinery
+(stable lock identities, class-attr type resolution, call resolution),
+in three passes:
+
+1. **Thread entries.** Every way code enters a second thread is
+   enumerated: ``threading.Thread(target=...)`` / ``Timer`` spawns
+   (target resolved like any lockgraph call — ``self._loop``, imported
+   functions, annotated receivers), ``executor.submit(fn)``, ``run``
+   methods of ``Thread`` subclasses, and ``do_GET``-style HTTP handler
+   methods (each request runs on its own thread). Every class owning a
+   thread-entry *method* additionally gets one ``caller:`` pseudo-entry
+   covering its public methods — the submit/stop/snapshot surface that
+   runs on the *calling* thread and races the daemon.
+
+2. **Guard inference.** A depth-bounded DFS from each entry walks the
+   resolvable call graph carrying the set of lock identities provably
+   held (lexical ``with``-regions plus everything inherited from the
+   call path), recording every ``self._field`` access with its held set
+   and its ``file:line`` hop chain. A field with **>= 2 distinct write
+   sites, all holding one common lock identity**, acquires that lock as
+   its inferred guard. Writes sited in ``__init__`` are publication
+   (before ``start()``) and never count.
+
+3. **Race detection.** Any access to a guarded field, reachable from a
+   *different* entry than some guarded write, where the guard is not in
+   the held set, is a race — reported with BOTH witness paths
+   (THR003's two-witness shape): the guarded write chain and the
+   unguarded access chain, every hop ``file:line``.
+
+Honest escapes (the repo's deliberate lock-free patterns):
+
+- ctor-only fields (published before the thread starts) are exempt by
+  construction — no non-ctor writes, no guard, no reports;
+- fields bound to internally-synchronized objects — ``deque`` (the
+  control plane's edge queue), ``queue.Queue``, ``threading.Event``,
+  semaphores — are exempt: their operations are GIL-atomic/lock-backed
+  by design (rebinding such a field remains out of scope);
+- a ``# tpulint: thread-safe[reason]`` pragma on an access line exempts
+  that site; on a *write* site it also removes the write from guard
+  inference, so one deliberate lock-free writer does not disable
+  checking for everyone else. The reason is mandatory — the bracket
+  form will not parse without it.
+
+The inferred guard map is runtime-cross-checked: ``tests/
+test_lockwatch.py`` drives the real batcher/collector flows under
+``monitor/lockwatch.py`` and asserts every inferred guard names a lock
+the instrumented run actually acquired (inferred ⊆ observed), the dual
+of the lockgraph's observed ⊆ static edge pin — so the inference can't
+silently rot as the code evolves.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .lockgraph import (LockGraphAnalyzer, ModuleSource, _FuncInfo,
+                        _MAX_DEPTH, _walk_same_thread)
+from .rules import terminal_name
+
+__all__ = ["RaceGraph", "RaceGraphAnalyzer", "FieldAccess",
+           "analyze_package_races", "THREAD_SAFE_PRAGMA"]
+
+#: ``# tpulint: thread-safe[reason]`` — site-level lock-free-by-design
+#: marker. The reason inside the brackets is mandatory.
+THREAD_SAFE_PRAGMA = re.compile(r"#\s*tpulint:\s*thread-safe\[([^\]]+)\]")
+
+#: ctors whose instances synchronize themselves — field operations on
+#: them are lock-free by design (the control plane's edge deque, stop
+#: Events, bounded queues); the *rebinding* hazard is out of scope
+_SELF_SYNCING_CTORS = {
+    "Event", "deque", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "Semaphore", "BoundedSemaphore", "Barrier",
+}
+
+#: method calls on a field that mutate the container in place — writes
+#: for lockset purposes (``self._queue.append(...)`` guards like
+#: ``self._queue = ...``)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse", "move_to_end",
+}
+
+#: spawn callees whose ``target=`` becomes a new thread's entry point
+_SPAWN_CTORS = {"Thread", "Timer"}
+
+_HTTP_HANDLER_METHODS = re.compile(r"^do_[A-Z]+$")
+
+
+class FieldAccess:
+    """One read/write of ``self.<attr>`` observed on some entry's DFS."""
+
+    __slots__ = ("classname", "attr", "kind", "path", "line", "held",
+                 "hops", "entry")
+
+    def __init__(self, classname: str, attr: str, kind: str, path: str,
+                 line: int, held: FrozenSet[str], hops: Tuple[str, ...],
+                 entry: str):
+        self.classname = classname
+        self.attr = attr
+        self.kind = kind            # "read" | "write"
+        self.path = path
+        self.line = line
+        self.held = held            # lock identities provably held
+        self.hops = hops            # entry -> ... -> this access
+        self.entry = entry          # entry id ("thread:..." / "caller:C")
+
+    @property
+    def site(self) -> Tuple[str, int]:
+        return (self.path, self.line)
+
+
+class _Entry:
+    """One thread entry point: where a second thread begins executing."""
+
+    __slots__ = ("id", "key", "kind", "anchor")
+
+    def __init__(self, entry_id: str, key: tuple, kind: str, anchor: str):
+        self.id = entry_id          # unique; "caller:C" shared per class
+        self.key = key              # function key in analyzer.funcs
+        self.kind = kind            # thread|run|handler|submit|caller
+        self.anchor = anchor        # first hop: spawn/def site file:line
+
+
+class RaceGraph:
+    """The analysis result: inferred guards + race reports."""
+
+    def __init__(self):
+        #: {(classname, attr): guard lock identity}
+        self.guards: Dict[Tuple[str, str], str] = {}
+        #: [{path, line, classname, attr, guard, kind,
+        #:   write_witness, access_witness, write_entry, access_entry}]
+        self.races: List[dict] = []
+        #: entry ids discovered (introspection / tests)
+        self.entries: List[dict] = []
+        #: access sites exempted by a thread-safe[...] pragma:
+        #: [{path, line, classname, attr, reason}]
+        self.pragma_exempt: List[dict] = []
+
+    def guard_names(self, classes: Optional[Iterable[str]] = None
+                    ) -> Set[str]:
+        """Distinct guard lock identities, optionally restricted to the
+        given classes — the set the lockwatch cross-check compares with
+        the runtime-observed acquisition census."""
+        want = set(classes) if classes is not None else None
+        return {g for (cls, _attr), g in self.guards.items()
+                if want is None or cls in want}
+
+
+def analyze_package_races(root: Optional[str] = None) -> RaceGraph:
+    """Parse every .py under ``root`` (default: the installed package)
+    and build its race graph — the static half of the inferred ⊆
+    observed cross-check in ``tests/test_lockwatch.py``."""
+    from .linter import Linter, PACKAGE_ROOT
+    linter = Linter(rules=[])
+    modules: List[ModuleSource] = []
+    for fp in Linter.iter_files([root or PACKAGE_ROOT]):
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=fp)
+        except (OSError, UnicodeDecodeError, SyntaxError):
+            continue
+        modules.append(ModuleSource(linter._relpath(fp), tree,
+                                    source.splitlines()))
+    return RaceGraphAnalyzer(modules).build_races()
+
+
+class RaceGraphAnalyzer(LockGraphAnalyzer):
+    """Guarded-field inference + race detection over parsed modules.
+
+    Subclasses :class:`LockGraphAnalyzer` for its whole resolution layer
+    (class index, attr lock identities, imports, ``_resolve_call_key``,
+    ``_resolve_lock``, ``_local_types``) and adds the lockset pass.
+    """
+
+    def __init__(self, modules: Iterable[ModuleSource]):
+        super().__init__(modules)
+        self._lines_by_path = {m.path: m.lines for m in self.modules}
+        #: per-func body scan memo: key -> list of items (see _body_items)
+        self._body_memo: Dict[tuple, list] = {}
+        self._types_memo: Dict[tuple, Dict[str, str]] = {}
+        #: (classname, attr) accessed by that class's own methods
+        self._attr_access_owners: Set[Tuple[str, str]] = set()
+        #: (classname, attr) bound to a self-syncing ctor result
+        self._self_syncing: Set[Tuple[str, str]] = set()
+        self._index_field_facts()
+
+    # ------------------------------------------------------------ indexing
+    def _index_field_facts(self):
+        for fn in self.funcs.values():
+            if fn.classname is None:
+                continue
+            for node in _walk_same_thread(fn.node):
+                targets, value = [], None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                ctor = (terminal_name(value.func)
+                        if isinstance(value, ast.Call) else None)
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and ctor in _SELF_SYNCING_CTORS:
+                        self._self_syncing.add((fn.classname, t.attr))
+
+    # ----------------------------------------------------- entry discovery
+    def _resolve_func_ref(self, expr: ast.AST, fn: _FuncInfo,
+                          types: Dict[str, str]) -> Optional[tuple]:
+        """A function *reference* (Thread target, submit arg) -> func
+        key, mirroring ``_resolve_call_key``'s resolution for calls."""
+        if isinstance(expr, ast.Name):
+            key = (fn.mod.modkey, None, expr.id)
+            if key in self.funcs:
+                return key
+            imp = self.imports.get((fn.mod.modkey, expr.id))
+            if imp is not None:
+                key = (imp[0], None, imp[1])
+                if key in self.funcs:
+                    return key
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" \
+                and fn.classname is not None:
+            return self._method_key(fn.classname, expr.attr)
+        if isinstance(base, ast.Name):
+            cls = types.get(base.id)
+            if cls is not None:
+                return self._method_key(cls, expr.attr)
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and fn.classname is not None:
+            for c in self._class_chain(fn.classname):
+                cls = self.attr_types.get((c, base.attr))
+                if cls is not None:
+                    return self._method_key(cls, expr.attr)
+        return None
+
+    def _find_entries(self) -> List[_Entry]:
+        entries: Dict[str, _Entry] = {}
+
+        def add(kind: str, key: tuple, anchor: str):
+            tfn = self.funcs.get(key)
+            if tfn is None:
+                return
+            eid = f"thread:{tfn.display}"
+            entries.setdefault(eid, _Entry(eid, key, kind, anchor))
+
+        for fn in self.funcs.values():
+            types = self._types(fn)
+            here = fn.mod.path
+            for node in _walk_same_thread(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = terminal_name(node.func)
+                if callee in _SPAWN_CTORS:
+                    target = None
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                    if target is None and callee == "Timer" \
+                            and len(node.args) >= 2:
+                        target = node.args[1]
+                    if target is None:
+                        continue
+                    key = self._resolve_func_ref(target, fn, types)
+                    if key is not None:
+                        add("thread", key,
+                            f"[thread spawned at {here}:{node.lineno}]")
+                elif callee == "submit" and node.args:
+                    key = self._resolve_func_ref(node.args[0], fn, types)
+                    if key is not None:
+                        add("submit", key,
+                            f"[submitted to executor at "
+                            f"{here}:{node.lineno}]")
+        # Thread subclasses: run() is the entry
+        for classname, (modkey, node, _bases) in self.classes.items():
+            if "Thread" in self._class_chain(classname) \
+                    or "Thread" in (self.classes[classname][2]):
+                key = self._method_key(classname, "run")
+                if key is not None:
+                    tfn = self.funcs[key]
+                    add("run", key,
+                        f"[{classname}(Thread).run at "
+                        f"{tfn.mod.path}:{tfn.node.lineno}]")
+            # HTTP handlers: each do_* serves on its own thread
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and _HTTP_HANDLER_METHODS.match(item.name):
+                    hkey = (modkey, classname, item.name)
+                    hfn = self.funcs.get(hkey)
+                    if hfn is not None:
+                        add("handler", hkey,
+                            f"[HTTP handler {classname}.{item.name} at "
+                            f"{hfn.mod.path}:{item.lineno}]")
+
+        # caller pseudo-entries: the public surface of every class that
+        # owns a thread-entry method runs on OTHER threads than its loop
+        thread_classes = sorted({
+            e.key[1] for e in entries.values() if e.key[1] is not None})
+        out = sorted(entries.values(), key=lambda e: e.id)
+        for classname in thread_classes:
+            modkey, cnode, _bases = self.classes.get(
+                classname, (None, None, None))
+            if cnode is None:
+                continue
+            eid = f"caller:{classname}"
+            for item in cnode.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name.startswith("_") \
+                        and item.name not in ("__enter__", "__exit__"):
+                    continue
+                key = (modkey, classname, item.name)
+                if key in self.funcs:
+                    out.append(_Entry(
+                        eid, key, "caller",
+                        f"[public API {classname}.{item.name}, caller "
+                        f"thread]"))
+        return out
+
+    # --------------------------------------------------------- body scans
+    def _types(self, fn: _FuncInfo) -> Dict[str, str]:
+        t = self._types_memo.get(fn.key)
+        if t is None:
+            t = self._types_memo[fn.key] = self._local_types(fn)
+        return t
+
+    def _field_owner(self, classname: str, attr: str) -> str:
+        """Canonical owning class for a field: the base-most class in
+        the chain whose own methods touch it (so a subclass override and
+        its base method talk about ONE field)."""
+        chain = self._class_chain(classname)
+        owner = classname
+        for c in chain:
+            if (c, attr) in self._attr_access_owners:
+                owner = c
+        return owner
+
+    def _body_items(self, key: tuple) -> list:
+        """Scan one function body once: source-ordered list of
+        ``("access", attr, kind, line, held)`` and
+        ``("call", callee_key, line, held)`` items, where ``held`` is
+        the frozenset of lock identities lexically held at that point
+        (``with``-region aware, same-thread walk)."""
+        memo = self._body_memo.get(key)
+        if memo is not None:
+            return memo
+        fn = self.funcs[key]
+        types = self._types(fn)
+        items: list = []
+
+        def self_attr(node: ast.AST) -> Optional[str]:
+            n = node
+            while isinstance(n, ast.Subscript):
+                n = n.value
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                return n.attr
+            return None
+
+        def record(attr: str, kind: str, line: int,
+                   held: FrozenSet[str]):
+            items.append(("access", attr, kind, line, held))
+            if fn.classname is not None:
+                self._attr_access_owners.add((fn.classname, attr))
+
+        def write_target(t: ast.AST, held: FrozenSet[str]):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    write_target(e, held)
+                return
+            if isinstance(t, ast.Starred):
+                write_target(t.value, held)
+                return
+            if isinstance(t, ast.Subscript):
+                visit(t.slice, held)        # index expr may read fields
+                attr = self_attr(t)
+                if attr is not None:
+                    record(attr, "write", t.lineno, held)
+                else:
+                    visit(t.value, held)
+                return
+            if isinstance(t, ast.Attribute):
+                if isinstance(t.value, ast.Name) and t.value.id == "self":
+                    record(t.attr, "write", t.lineno, held)
+                else:
+                    visit(t.value, held)    # other-object attr store:
+                return                      # base expr may read fields
+
+        def visit(node: ast.AST, held: FrozenSet[str]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return                      # separate execution
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call):
+                        visit(ce, held)     # context manager, not a lock
+                        continue
+                    lockid = self._resolve_lock(ce, fn, types)
+                    if lockid is not None:
+                        inner.add(lockid)
+                    else:
+                        visit(ce, held)
+                inner_f = frozenset(inner)
+                for stmt in node.body:
+                    visit(stmt, inner_f)
+                return
+            if isinstance(node, ast.Assign):
+                visit(node.value, held)
+                for t in node.targets:
+                    write_target(t, held)
+                return
+            if isinstance(node, ast.AugAssign):
+                visit(node.value, held)
+                write_target(node.target, held)   # read+write: write wins
+                return
+            if isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    visit(node.value, held)
+                    write_target(node.target, held)
+                return
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    write_target(t, held)
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    attr = self_attr(f.value)
+                    if attr is not None and f.attr in _MUTATORS:
+                        # self._q.append(x): in-place container write
+                        record(attr, "write", node.lineno, held)
+                        for a in node.args:
+                            visit(a, held)
+                        for kw in node.keywords:
+                            visit(kw.value, held)
+                        return
+                callee_key = self._resolve_call_key(node, fn, types)
+                if callee_key is not None and callee_key != fn.key:
+                    items.append(("call", callee_key, node.lineno, held))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+                return
+            if isinstance(node, ast.Compare):
+                # `self._f is None` / `is not None`: a GIL-atomic
+                # identity test of a publish-once reference — it
+                # observes no mutable state, so the bare self-attr
+                # operands are exempt (the batcher's optional-cache
+                # checks). `self._f[k] is None` still records: the
+                # subscript DOES observe container contents.
+                operands = [node.left] + list(node.comparators)
+                if all(isinstance(o, (ast.Is, ast.IsNot))
+                       for o in node.ops) \
+                        and any(isinstance(o, ast.Constant)
+                                and o.value is None for o in operands):
+                    for o in operands:
+                        if isinstance(o, ast.Attribute) \
+                                and isinstance(o.value, ast.Name) \
+                                and o.value.id == "self":
+                            continue
+                        visit(o, held)
+                    return
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+                return
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    record(node.attr, "read", node.lineno, held)
+                    return
+                visit(node.value, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.node.body:
+            visit(stmt, frozenset())
+        self._body_memo[key] = items
+        return items
+
+    # ------------------------------------------------------------ the DFS
+    def _explore(self, entry: _Entry, accesses: List[FieldAccess]):
+        visited: Set[tuple] = set()
+
+        def go(key: tuple, held: FrozenSet[str],
+               hops: Tuple[str, ...], depth: int):
+            if depth > _MAX_DEPTH:
+                return
+            state = (key, held)
+            if state in visited:
+                return
+            visited.add(state)
+            fn = self.funcs.get(key)
+            if fn is None:
+                return
+            here = fn.mod.path
+            for item in self._body_items(key):
+                if item[0] == "access":
+                    _, attr, kind, line, local = item
+                    if fn.classname is None:
+                        continue
+                    eff = held | local
+                    cls = self._field_owner(fn.classname, attr)
+                    verb = "writes" if kind == "write" else "reads"
+                    accesses.append(FieldAccess(
+                        cls, attr, kind, here, line, eff,
+                        hops + (f"{fn.display} {verb} {cls}.{attr} "
+                                f"({here}:{line})",),
+                        entry.id))
+                else:
+                    _, callee_key, line, local = item
+                    callee = self.funcs.get(callee_key)
+                    if callee is None:
+                        continue
+                    go(callee_key, held | local,
+                       hops + (f"{fn.display} -> {callee.display} "
+                               f"({here}:{line})",),
+                       depth + 1)
+
+        go(entry.key, frozenset(), (entry.anchor,), 0)
+
+    # ------------------------------------------------------------- pragma
+    def _thread_safe_reason(self, path: str, line: int) -> Optional[str]:
+        lines = self._lines_by_path.get(path)
+        if not lines or not 1 <= line <= len(lines):
+            return None
+        m = THREAD_SAFE_PRAGMA.search(lines[line - 1])
+        return m.group(1).strip() if m else None
+
+    def _field_exempt(self, classname: str, attr: str) -> bool:
+        """Locks themselves and self-syncing objects never race-check."""
+        for c in self._class_chain(classname):
+            if (c, attr) in self.attr_locks \
+                    or (c, attr) in self._self_syncing:
+                return True
+        return False
+
+    # -------------------------------------------------------------- build
+    def build_races(self) -> RaceGraph:
+        graph = RaceGraph()
+        # pre-scan every body so _field_owner sees the complete
+        # (class, attr) access index before any DFS consults it
+        for key in list(self.funcs):
+            self._body_items(key)
+        entries = self._find_entries()
+        graph.entries = [{"id": e.id, "kind": e.kind,
+                          "func": self.funcs[e.key].display}
+                         for e in entries if e.key in self.funcs]
+        accesses: List[FieldAccess] = []
+        for e in entries:
+            self._explore(e, accesses)
+
+        # which classes own a thread entry — only THEIR fields are
+        # checked (a helper class shared by accident of call graphs
+        # would drown the report in instance-identity guesses)
+        race_classes = {
+            self.funcs[e.key].classname for e in entries
+            if e.kind != "caller" and e.key in self.funcs
+            and self.funcs[e.key].classname is not None}
+
+        # field -> write accesses (non-ctor, non-pragma'd)
+        writes: Dict[Tuple[str, str], List[FieldAccess]] = {}
+        reads_and_writes: Dict[Tuple[str, str], List[FieldAccess]] = {}
+        for a in accesses:
+            field = (a.classname, a.attr)
+            if a.classname not in race_classes \
+                    or self._field_exempt(a.classname, a.attr):
+                continue
+            reads_and_writes.setdefault(field, []).append(a)
+            if a.kind != "write":
+                continue
+            fn_name = a.hops[-1].split(" ", 1)[0]
+            if fn_name.endswith(".__init__"):
+                continue                    # publication before start()
+            reason = self._thread_safe_reason(a.path, a.line)
+            if reason is not None:
+                graph.pragma_exempt.append(
+                    {"path": a.path, "line": a.line,
+                     "classname": a.classname, "attr": a.attr,
+                     "reason": reason})
+                continue
+            writes.setdefault(field, []).append(a)
+
+        # guard inference: >= 2 distinct LOCKED write sites, one common
+        # lock. The intersection runs over writes that hold anything at
+        # all — a bare write must not dissolve the guard it violates
+        # (it gets reported against it instead, Eraser-style).
+        for field, ws in sorted(writes.items()):
+            locked = [w for w in ws if w.held]
+            sites = {w.site for w in locked}
+            if len(sites) < 2:
+                continue
+            common = frozenset.intersection(*[w.held for w in locked])
+            if not common:
+                continue
+            cls = field[0]
+            guard = sorted(
+                common,
+                key=lambda g: (0 if g.startswith(cls + ".") else 1, g))[0]
+            graph.guards[field] = guard
+
+        # race detection: unguarded access from a different entry
+        reported: Set[Tuple[str, int, str, str]] = set()
+        for field, guard in sorted(graph.guards.items()):
+            cls, attr = field
+            all_acc = reads_and_writes.get(field, [])
+            guarded_writes = sorted(
+                (w for w in writes.get(field, []) if guard in w.held),
+                key=lambda w: (w.path, w.line, w.entry))
+            if not guarded_writes:
+                continue
+            for a in sorted(all_acc,
+                            key=lambda x: (x.path, x.line, x.entry)):
+                if guard in a.held:
+                    continue
+                if a.kind == "write" and self._thread_safe_reason(
+                        a.path, a.line) is not None:
+                    continue                # pragma'd lock-free writer
+                witness = next(
+                    (w for w in guarded_writes if w.entry != a.entry),
+                    None)
+                if witness is None:
+                    continue                # same thread end to end
+                rkey = (a.path, a.line, cls, attr)
+                if rkey in reported:
+                    continue
+                reason = self._thread_safe_reason(a.path, a.line)
+                if reason is not None:
+                    graph.pragma_exempt.append(
+                        {"path": a.path, "line": a.line,
+                         "classname": cls, "attr": attr,
+                         "reason": reason})
+                    reported.add(rkey)
+                    continue
+                reported.add(rkey)
+                graph.races.append({
+                    "path": a.path, "line": a.line,
+                    "classname": cls, "attr": attr, "guard": guard,
+                    "kind": a.kind,
+                    "write_witness": " -> ".join(witness.hops)
+                    + f" [holding {guard}]",
+                    "access_witness": " -> ".join(a.hops)
+                    + f" [{guard} NOT held]",
+                    "write_entry": witness.entry,
+                    "access_entry": a.entry,
+                })
+        graph.races.sort(key=lambda r: (r["path"], r["line"],
+                                        r["classname"], r["attr"]))
+        return graph

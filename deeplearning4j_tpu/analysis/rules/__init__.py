@@ -70,7 +70,7 @@ def all_rules() -> Dict[str, Type[Rule]]:
     cycle-free."""
     from . import (control_rules, exception_rules, jax_rules,  # noqa: F401
                    lockgraph_rules, monitor_rules, perf_rules,  # noqa: F401
-                   resource_rules, threading_rules)  # noqa: F401
+                   race_rules, resource_rules, threading_rules)  # noqa: F401
     return dict(sorted(_REGISTRY.items()))
 
 

@@ -1,0 +1,106 @@
+"""Interprocedural data-race rule: unguarded shared-field access
+(THR005).
+
+A **project rule** (``project = True``), like THR003/THR004: shared-field
+races are, by construction, a property of two *different* threads' code
+paths — a single-function scan cannot see that ``_loop`` writes a field
+under a lock while ``snapshot()`` reads it bare on the caller's thread.
+The backend is :mod:`~deeplearning4j_tpu.analysis.racegraph` (Eraser-style
+lockset inference over the lockgraph's resolution layer): a field written
+at >= 2 distinct sites, always holding one common lock identity, acquires
+that lock as its inferred guard; any access to the field reachable from a
+*different* thread entry without the guard is a race, reported with BOTH
+witness paths (every hop ``file:line``).
+
+The runtime half of the pass is ``monitor/lockwatch.py``'s acquisition
+census: ``tests/test_lockwatch.py`` pins that every guard this analyzer
+infers for the batcher/collector names a lock the instrumented runs
+actually acquire (inferred ⊆ observed), the dual of the lockgraph's
+observed ⊆ static edge pin.
+
+Escapes are part of the contract, not suppression folklore: ctor-only
+fields (published before ``start()``) and internally-synchronized fields
+(``deque``/``Queue``/``Event``...) are exempt by construction; a
+deliberately lock-free site carries ``# tpulint: thread-safe[reason]``
+on the access line — the reason is mandatory, and a pragma'd *write*
+also leaves guard inference so one lock-free writer doesn't turn off
+checking for the rest of the class (docs/STATIC_ANALYSIS.md has the
+catalog entry and runbook).
+
+Subset-run caveat (same as THR003): ``lint --changed`` analyzes only the
+files given, so thread spawns and accesses living outside the subset are
+invisible there. The tier-1 self-host guard always runs the whole
+package.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+from . import Rule, register, make_finding
+from ..racegraph import RaceGraph, RaceGraphAnalyzer
+from ..lockgraph import ModuleSource
+
+
+#: one-slot cache keyed on module-list identity (the linter passes one
+#: list object to every project rule), same contract as lockgraph_rules
+_LAST: list = [None, None]
+
+
+def _analyze(modules: Sequence[ModuleSource]) -> RaceGraph:
+    if _LAST[0] is modules:
+        return _LAST[1]
+    graph = RaceGraphAnalyzer(modules).build_races()
+    _LAST[0], _LAST[1] = modules, graph
+    return graph
+
+
+@register
+class UnguardedSharedField(Rule):
+    id = "THR005"
+    title = "shared field accessed without its inferred guard lock"
+    project = True
+    rationale = (
+        "Every recent incident class here was a shared-field race, not a "
+        "lock-order bug: a daemon thread writes `self._field` under a "
+        "lock while the caller's thread reads or writes it bare — torn "
+        "snapshots, lost updates, use-after-close. This rule infers each "
+        "field's guard from the code's own behavior (>= 2 write sites, "
+        "one common lock identity held at all of them) and reports any "
+        "cross-thread access where that guard is provably not held, with "
+        "both witness paths. Fix: take the guard at the access site, or "
+        "— if the site is lock-free by design (GIL-atomic read of an "
+        "int, publication-before-start) — mark the line with "
+        "`# tpulint: thread-safe[reason]` so the decision is recorded "
+        "where the next reader will look.")
+
+    def check(self, tree, lines, path) -> Iterator:
+        # single-file entry (lint_source): analyze just this module —
+        # project runs use check_project with the whole file set
+        yield from self.check_project(
+            [ModuleSource(path, tree, lines)])
+
+    def check_project(self, modules: Sequence[ModuleSource]) -> Iterator:
+        graph = _analyze(modules)
+        lines_by_path = {m.path: m.lines for m in modules}
+        for race in graph.races:
+            lines = lines_by_path.get(race["path"], [])
+            node = _Anchor(race["line"])
+            verb = ("written" if race["kind"] == "write" else "read")
+            yield make_finding(
+                self.id, node, lines, race["path"],
+                f"{race['classname']}.{race['attr']} is guarded by "
+                f"{race['guard']!r} but {verb} without it here: "
+                f"guarded-write path [{race['write_witness']}] vs "
+                f"unguarded-access path [{race['access_witness']}] — "
+                f"these threads race on the field; take the guard at "
+                f"this site, or mark the line "
+                f"`# tpulint: thread-safe[reason]` if it is lock-free "
+                f"by design")
+
+
+class _Anchor:
+    """Minimal node stand-in for make_finding (line-anchored findings)."""
+
+    def __init__(self, line: int, col: int = 0):
+        self.lineno = int(line)
+        self.col_offset = int(col)

@@ -275,28 +275,39 @@ class ControlPlane:
     def _prime_cursor(self):
         """Fast-forward the flight-event cursor to 'now' without
         reacting — the plane only answers for events recorded after it
-        came up, never replays history as fresh incidents."""
+        came up, never replays history as fresh incidents. The recorder
+        read happens OUTSIDE ``_lock`` (it takes its own; ours stays a
+        leaf), only the cursor store goes under it: the cursor is
+        written here on start()'s thread AND on the tick thread, and
+        cleared by clear() on any caller's — all under ``_lock``."""
         from ..monitor.flightrec import get_flight_recorder
         events = get_flight_recorder().events()
-        self._event_seq = int(events[-1]["seq"]) if events else 0
+        seq = int(events[-1]["seq"]) if events else 0
+        with self._lock:
+            self._event_seq = seq
 
     def _new_flight_events(self) -> List[Dict[str, Any]]:
         with self._lock:
             watched = {p.event for p in self._policies.values()
                        if p.event is not None}
+            cursor = self._event_seq
         if not watched:
             return []
-        if self._event_seq is None:
+        if cursor is None:
             self._prime_cursor()
             return []
         from ..monitor.flightrec import get_flight_recorder
         events = get_flight_recorder().events()
-        cursor = self._event_seq
         fresh = [e for e in events
                  if int(e.get("seq", 0)) > cursor
                  and e.get("event") in watched]
         if events:
-            self._event_seq = max(cursor, int(events[-1]["seq"]))
+            with self._lock:
+                if self._event_seq is not None:
+                    # clear() raced the recorder read: stay reset so the
+                    # next tick re-primes instead of resurrecting the
+                    # pre-clear cursor
+                    self._event_seq = max(cursor, int(events[-1]["seq"]))
         return fresh
 
     def tick(self, now: Optional[float] = None) -> int:

@@ -492,7 +492,8 @@ def cmd_lint(args) -> int:
     jitted code (JAX001), PRNG key reuse (JAX002), blocking calls under a
     lock (THR001), leaked threads (THR002), lock-order inversions and
     cross-function blocking-under-lock on the interprocedural lock graph
-    (THR003/THR004), silent broad excepts (EXC001), leaked
+    (THR003/THR004), unguarded shared-field races via lockset guard
+    inference (THR005), silent broad excepts (EXC001), leaked
     sockets/executors/servers (RES001), metric-name unit-suffix
     violations (MON001). Exit 0 iff no finding outside the
     baseline; deterministic output. ``--changed`` scopes the run to

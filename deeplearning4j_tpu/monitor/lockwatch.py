@@ -337,6 +337,16 @@ class LockWatch:
         with self._lock:
             return {k: dict(v)["witness"] for k, v in self._edges.items()}
 
+    def observed_locks(self) -> Set[str]:
+        """Every lock name this watch has seen acquired — the runtime
+        acquisition census. ``tests/test_lockwatch.py`` pins the dual of
+        the edge cross-check against it: every guard the racegraph
+        *infers* (THR005) must name a lock the instrumented flows
+        actually acquire (inferred ⊆ observed), so guard inference can't
+        silently drift off the real locking behavior."""
+        with self._lock:
+            return set(self._stats)
+
     def inversions(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [dict(i) for i in self._inversions]

@@ -17,9 +17,13 @@ from deeplearning4j_tpu.analysis import (Linter, load_baseline,
                                          DEFAULT_BASELINE_PATH,
                                          PACKAGE_ROOT, all_rules, get_rule)
 
+#: the full registry, pinned at 14 — EXC001 included (it was silently
+#: missing from an earlier revision of this set) and THR005 with it;
+#: a rule added without extending this pin fails the registry test
 RULE_IDS = {"JAX001", "JAX002", "JAX003", "JAX004", "THR001", "THR002",
-            "THR003", "THR004", "RES001", "EXC001", "MON001", "PERF001",
-            "CTL001"}
+            "THR003", "THR004", "THR005", "RES001", "EXC001", "MON001",
+            "PERF001", "CTL001"}
+assert len(RULE_IDS) == 14
 
 
 # default fixture path lives under tests/ so the JAX003 bare-jit rule
@@ -545,6 +549,26 @@ def test_json_output_schema_and_determinism(tmp_path):
     assert f["rule"] == "EXC001" and f["baselined"] is False
     assert f["path"] == "mod.py" and f["line"] >= 1
     json.dumps(d1)                                     # serializable
+
+
+# ------------------------------------------------ pre-commit fast path
+def test_lint_changed_empty_diff_exits_zero_without_linting(monkeypatch,
+                                                            capsys):
+    """Pre-commit wiring pin (docs/STATIC_ANALYSIS.md runbook): `lint
+    --changed` on an empty diff exits 0 FAST — it must return before
+    constructing a Linter (no rule imports, no file parses), so the
+    hook costs nothing when there is nothing to check."""
+    from deeplearning4j_tpu import main as main_mod
+    from deeplearning4j_tpu import analysis as analysis_mod
+    monkeypatch.setattr(main_mod, "_changed_files", lambda root: [])
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "an empty --changed diff must not construct a Linter")
+
+    monkeypatch.setattr(analysis_mod, "Linter", _boom)
+    assert main_mod.main(["lint", "--changed"]) == 0
+    assert "no changed python files" in capsys.readouterr().out
 
 
 # -------------------------------------------------- self-hosting (tier-1)

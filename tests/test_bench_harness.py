@@ -583,3 +583,19 @@ def test_fleet_scrape_bench_latches_scrape_plane_stats(bench):
     # synthesized liveness and scrape-observability families
     assert stats["merged_series"] >= 3
     assert stats["tick_overhead_ms"] >= 0.0
+
+
+def test_lint_full_bench_latches_linter_cost(bench):
+    """ISSUE 18: the lint_full bench times a whole-package tpulint run
+    (all rules, shipped baseline) and latches {wall_s, files, rules,
+    findings_new, findings_baselined} — the ``--one`` record's
+    ``lint_full`` block, so linter cost regressions show up in the
+    trajectory. The shipped package must come back clean (new == 0)."""
+    value = bench.bench_lint_full(repeats=1)
+    stats = bench.LINT_FULL_STATS
+    assert stats["wall_s"] == value
+    assert value > 0
+    assert stats["files"] > 100             # the whole package, not a slice
+    assert stats["rules"] == 14             # the full registry ran
+    assert stats["findings_new"] == 0       # tier-1 invariant restated
+    assert stats["findings_baselined"] >= 1  # the ratchet is in force

@@ -430,3 +430,63 @@ def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
         f"runtime-observed lock edges the static analyzer cannot derive "
         f"(lockgraph.py resolution gap): {sorted(unexplained)}; "
         f"witnesses: { {e: watch.edge_witnesses()[e] for e in unexplained} }")
+
+
+# ------------------- THR005 guard inference vs the acquisition census
+def _batcher_flows():
+    """The serving batcher's core flows: queued submits through the
+    scheduler thread (the ``_cond`` handshake), the response cache
+    (``_cache_lock`` — miss, hit-fast-path, stats), flush + close."""
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+
+    def fwd(x, mask=None):
+        return np.asarray(x) * 2.0
+
+    b = ContinuousBatcher(fwd, name="lw_batch", batch_buckets=(1, 2, 4),
+                          linger_ms=1.0, cache_size=8)
+    try:
+        futs = [b.submit(np.full((1, 2), float(i), np.float32))
+                for i in range(5)]
+        for f in futs:
+            f.result(timeout=10)
+        x = np.ones((1, 2), np.float32)
+        b.submit(x).result(timeout=10)      # cache miss -> insert
+        b.submit(x).result(timeout=10)      # cache hit fast path
+        b.flush()
+        b.queue_depth()
+        b.cache_stats()
+    finally:
+        b.close()
+
+
+def test_inferred_guards_subset_of_observed_locks(watch):
+    """ISSUE 18's runtime cross-check, the dual of the edge pin above:
+    every guard THR005's racegraph INFERS for the batcher and the
+    collector must name a lock the instrumented flows actually acquire
+    (inferred ⊆ observed acquisition census) — otherwise guard
+    inference has drifted off the real locking behavior (a renamed
+    lock, a guard derived from dead code) and the rule is checking
+    fiction."""
+    _batcher_flows()
+    _collector_flows()
+
+    from deeplearning4j_tpu.analysis.racegraph import \
+        analyze_package_races
+    g = analyze_package_races()
+    inferred = g.guard_names(classes=("ContinuousBatcher",
+                                      "TelemetryCollector"))
+    # the inference must have teeth before the subset check means
+    # anything: the batcher's condition AND its cache lock, plus the
+    # collector's leaf lock, are all inferred as guards
+    assert "ContinuousBatcher._cond" in inferred
+    assert "ContinuousBatcher._cache_lock" in inferred
+    assert "TelemetryCollector._lock" in inferred
+
+    observed = watch.observed_locks()
+    missing = inferred - observed
+    assert not missing, (
+        f"guards inferred statically but never acquired by the "
+        f"instrumented flows (inference drift): {sorted(missing)}; "
+        f"observed census: {sorted(observed)}")
+    # and the shipped package carries no unguarded-field race
+    assert g.races == []

@@ -1,0 +1,287 @@
+"""Static data-race detection: THR005 + the racegraph backend.
+
+Per-fixture positive/negative coverage (deleting the rule's
+implementation fails these), the honest escapes (ctor publication,
+self-synchronizing fields, `is None` identity checks, the
+``thread-safe[reason]`` pragma), cross-module thread-entry resolution,
+and the pragma/baseline round-trips. The runtime half — inferred guards
+⊆ lockwatch-observed acquisitions on the real batcher/collector flows —
+lives in ``tests/test_lockwatch.py``.
+"""
+import textwrap
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (Linter, load_baseline,
+                                         save_baseline)
+from deeplearning4j_tpu.analysis.racegraph import (RaceGraphAnalyzer,
+                                                   analyze_package_races)
+from deeplearning4j_tpu.analysis.lockgraph import ModuleSource
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+def run_src(sources, rules=None):
+    """{path: src} -> new findings (dedented, no baseline)."""
+    blobs = {p: textwrap.dedent(s) for p, s in sources.items()}
+    return Linter(rules=rules).run_sources(blobs).new
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+def build_graph(sources):
+    import ast
+    mods = []
+    for path, src in sources.items():
+        src = textwrap.dedent(src)
+        mods.append(ModuleSource(path, ast.parse(src), src.splitlines()))
+    return RaceGraphAnalyzer(mods).build_races()
+
+
+_RACY = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._count += 1
+                    self._count = self._count % 1000
+
+        def peek(self):
+            return self._count
+"""
+
+
+# ------------------------------------------------- THR005 the basic race
+def test_thr005_flags_unguarded_read_with_both_witness_paths():
+    fs = run_src({"pkg/worker.py": _RACY}, rules=["THR005"])
+    assert rule_ids(fs) == ["THR005"]
+    msg = fs[0].message
+    # the inferred guard is named, with BOTH witness paths
+    assert "Worker._count" in msg and "Worker._lock" in msg
+    assert "guarded-write path" in msg and "unguarded-access path" in msg
+    assert "Worker._loop" in msg                   # the writing thread
+    assert "Worker.peek" in msg                    # the racing reader
+    assert "pkg/worker.py:" in msg                 # file:line witnesses
+    assert "thread-safe[reason]" in msg            # the escape is taught
+
+
+def test_thr005_guard_inference_shape():
+    g = build_graph({"pkg/worker.py": _RACY})
+    assert g.guards[("Worker", "_count")] == "Worker._lock"
+    # one write site only (start) -> no guard for _thread, no report
+    assert ("Worker", "_thread") not in g.guards
+    (race,) = g.races
+    assert race["write_entry"].startswith("thread:")
+    assert race["access_entry"] == "caller:Worker"
+
+
+def test_thr005_unguarded_write_also_flagged():
+    src = _RACY.replace("return self._count",
+                        "self._count = 0")
+    fs = run_src({"pkg/worker.py": src}, rules=["THR005"])
+    assert rule_ids(fs) == ["THR005"]
+    assert "written without" in fs[0].message
+
+
+def test_thr005_clean_when_access_takes_the_guard():
+    fs = run_src({"pkg/ok.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._count += 1
+                    self._count = self._count % 1000
+
+            def peek(self):
+                with self._lock:
+                    return self._count
+        """}, rules=["THR005"])
+    assert fs == []
+
+
+# ------------------------------------------------------- honest escapes
+def test_thr005_ctor_only_publication_is_exempt():
+    # written only in __init__ (published before start()): no guard is
+    # inferred, no report — by construction, not by suppression
+    fs = run_src({"pkg/pub.py": """
+        import threading
+
+        class Worker:
+            def __init__(self, cfg):
+                self._cfg = dict(cfg)
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                if self._cfg:
+                    pass
+
+            def describe(self):
+                return dict(self._cfg)
+        """}, rules=["THR005"])
+    assert fs == []
+
+
+def test_thr005_self_synchronizing_fields_are_exempt():
+    # deque/Event fields synchronize themselves (the control plane's
+    # edge queue): in-place ops on them never race-check
+    fs = run_src({"pkg/dq.py": """
+        import threading
+        from collections import deque
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._edges = deque()
+                self._stop = threading.Event()
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                while not self._stop.is_set():
+                    with self._lock:
+                        self._edges.append(1)
+                        self._edges.append(2)
+
+            def drain(self):
+                return self._edges.popleft()
+
+            def stop(self):
+                self._stop.set()
+        """}, rules=["THR005"])
+    assert fs == []
+
+
+def test_thr005_is_none_identity_check_is_exempt():
+    # `self._f is not None` observes no mutable state (GIL-atomic
+    # reference test, the batcher's optional-cache idiom)
+    src = _RACY.replace("return self._count",
+                        "return self._count is not None")
+    assert run_src({"pkg/worker.py": src}, rules=["THR005"]) == []
+
+
+def test_thr005_thread_safe_pragma_exempts_and_records_reason():
+    src = _RACY.replace(
+        "return self._count",
+        "return self._count  "
+        "# tpulint: thread-safe[GIL-atomic int read, metrics-only]")
+    assert run_src({"pkg/worker.py": src}, rules=["THR005"]) == []
+    g = build_graph({"pkg/worker.py": src})
+    (ex,) = g.pragma_exempt
+    assert ex["reason"] == "GIL-atomic int read, metrics-only"
+    assert (ex["classname"], ex["attr"]) == ("Worker", "_count")
+    # the guard is still inferred — the pragma exempts the SITE, it
+    # does not un-guard the field
+    assert g.guards[("Worker", "_count")] == "Worker._lock"
+
+
+def test_thr005_pragmad_write_leaves_guard_inference():
+    # a deliberately lock-free WRITE site must not poison inference for
+    # the rest of the class: with the pragma it is excluded, the two
+    # locked writes still agree, and the bare read still races
+    src = _RACY.replace(
+        "def peek(self):",
+        "def reset(self):\n"
+        "            self._count = -1  "
+        "# tpulint: thread-safe[test-only reset, single-threaded]\n\n"
+        "        def peek(self):")
+    fs = run_src({"pkg/worker.py": src}, rules=["THR005"])
+    assert rule_ids(fs) == ["THR005"]
+    assert "peek" in fs[0].message
+
+
+# -------------------------------------------- cross-module thread entry
+def test_thr005_cross_module_thread_entry_resolution():
+    # the spawn lives in another file and targets a method through an
+    # annotated parameter — only project-scoped resolution can see that
+    # Shared.bump runs on a second thread
+    a = """
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+                    self._n = self._n % 10
+
+            def read(self):
+                return self._n
+    """
+    b = """
+        import threading
+        from pkg.a import Shared
+
+        def launch(s: Shared):
+            threading.Thread(target=s.bump, daemon=True).start()
+    """
+    fs = run_src({"pkg/a.py": a, "pkg/b.py": b}, rules=["THR005"])
+    assert rule_ids(fs) == ["THR005"]
+    assert "Shared._n" in fs[0].message
+    assert "Shared._lock" in fs[0].message
+    # without the spawning module, Shared owns no thread: clean
+    assert run_src({"pkg/a.py": a}, rules=["THR005"]) == []
+
+
+# -------------------------------------------- pragma/baseline round-trip
+def test_thr005_disable_pragma_and_baseline_round_trip(tmp_path):
+    src = textwrap.dedent(_RACY)
+    fs = Linter(rules=["THR005"]).run_sources({"pkg/worker.py": src})
+    (finding,) = fs.new
+    # standard disable pragma on the reported line suppresses
+    lines = src.splitlines()
+    lines[finding.line - 1] += "  # tpulint: disable=THR005"
+    patched = "\n".join(lines)
+    assert Linter(rules=["THR005"]).run_sources(
+        {"pkg/worker.py": patched}).new == []
+    # baseline round-trip: the same fingerprint, re-observed, ratchets
+    bl = tmp_path / "bl.json"
+    save_baseline(str(bl), fs.new)
+    again = Linter(rules=["THR005"]).run_sources(
+        {"pkg/worker.py": src}, baseline=load_baseline(str(bl)))
+    assert again.new == [] and len(again.baselined) == 1
+
+
+# ------------------------------------------------- whole-package health
+def test_package_race_graph_is_clean_and_guards_are_inferred():
+    g = analyze_package_races()
+    # the concurrent core's guards are inferred, with the stable
+    # identities lockwatch labels at runtime
+    expect = {
+        ("ContinuousBatcher", "_queue"): "ContinuousBatcher._cond",
+        ("TelemetryCollector", "_targets"): "TelemetryCollector._lock",
+        ("ControlPlane", "_event_seq"): "ControlPlane._lock",
+        ("ParameterServer", "_shards"): "ParameterServer._lock",
+    }
+    for field, guard in expect.items():
+        assert g.guards.get(field) == guard, field
+    # and the package carries no unguarded-field race
+    assert g.races == []
