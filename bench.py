@@ -1147,6 +1147,193 @@ def bench_fleet_scrape(replicas=3, ticks=25, warm_requests=4):
             srv.stop()
 
 
+PROBE_OVERHEAD_STATS = {}
+
+
+_PROBE_REPLICA_SRC = r"""
+import json, sys
+import numpy as np
+from deeplearning4j_tpu.serving import InferenceServer
+
+class TinyModel:
+    def output(self, x, mask=None):
+        x = np.asarray(x)
+        return np.full((x.shape[0], 2), 1.0, np.float32)
+
+srv = InferenceServer()
+served = srv.register("probed", TinyModel(), input_shape=(2,),
+                      batch_buckets=(1, 2, 4), linger_ms=0.0,
+                      max_queue_examples=64, cache_size=16)
+golden = served.golden()
+port = srv.start(port=0)
+print(json.dumps({"port": port, "golden": golden}), flush=True)
+sys.stdin.read()
+"""
+
+#: prober child for bench_probe_overhead: a Prober in its OWN process
+#: (the deployment shape — co-located with neither the replica nor the
+#: latency-measuring driver), started/stopped between phases over a
+#: stdin line protocol: "start <interval_s>" / "stop" / "quit" (each
+#: ack'd with "ok"); "quit" prints the target's final snapshot row
+_PROBE_PROBER_SRC = r"""
+import json, sys
+from deeplearning4j_tpu.monitor.probes import Prober
+
+cfg = json.loads(sys.stdin.readline())
+p = Prober()
+p.add_target("bench", cfg["url"], cfg["golden"])
+for line in sys.stdin:
+    cmd = line.split()
+    if cmd[0] == "start":
+        p.start(interval_s=float(cmd[1]))
+    elif cmd[0] == "stop":
+        p.stop()
+    elif cmd[0] == "quit":
+        p.stop()
+        print(json.dumps(p.snapshot()["targets"]["bench"]), flush=True)
+        break
+    print("ok", flush=True)
+"""
+
+
+def bench_probe_overhead(requests=2000, probe_qps=(1.0, 4.0)):
+    """Probe-plane interference bench (monitor/probes.py): serving
+    p50/p99 over real HTTP against a REPLICA SUBPROCESS with the prober
+    OFF, then at each probe QPS point with a live Prober firing
+    golden-set probes at the same replica — the deployment shape (the
+    probe plane is external by definition; co-locating the prober inside
+    the replica would measure GIL contention no real probe causes). The
+    probe plane's pitch is "black-box monitoring at negligible serving
+    cost" — this latches the receipt: {p50_off_ms, p99_off_ms, points:
+    [{probe_qps, p50_ms, p99_ms, p99_overhead_pct, probes,
+    last_outcome}], max_p99_overhead_pct, cache_entries_after} into
+    ``PROBE_OVERHEAD_STATS`` for the ``--one`` record. Headline value:
+    worst p99 overhead percent across the QPS points (lower is better;
+    the acceptance pin is < 5%). The replica serves with its response
+    cache ON: real traffic lands exactly one entry and every probe
+    bypasses it, so ``cache_entries_after == 1`` restates the drill's
+    cache-purity invariant under load."""
+    import json as _json
+    import subprocess
+    import urllib.request
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"        # numpy model: never wait on a device
+    root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_REPLICA_SRC],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=root)
+    doc = _json.loads(proc.stdout.readline())
+    port, golden = int(doc["port"]), doc["golden"]
+    url = f"http://127.0.0.1:{port}/v1/models/probed/predict"
+    body = _json.dumps({"inputs": [[1.0, 2.0]]}).encode("utf-8")
+    pproc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_PROBER_SRC],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=root)
+    pproc.stdin.write(_json.dumps(
+        {"url": f"127.0.0.1:{port}", "golden": golden}) + "\n")
+    pproc.stdin.flush()
+
+    def prober_cmd(cmd):
+        pproc.stdin.write(cmd + "\n")
+        pproc.stdin.flush()
+        return pproc.stdout.readline().strip()
+
+    def drive(n):
+        lat = []
+        for _ in range(int(n)):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=10) as r:
+                r.read()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return lat
+
+    def pct(lat, q):
+        lat = sorted(lat)
+        return round(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))], 3)
+
+    try:
+        # warm the whole serving path until the startup transient is
+        # gone: the first ~100 requests of a fresh replica show one-off
+        # multi-ms hiccups (thread-pool growth, allocator warmup) that
+        # would land entirely in whichever pool is measured first
+        drive(max(150, int(requests) // 4))
+        # interleaved + shuffled design: loopback p99s are
+        # sub-millisecond, so two phases measured at different times
+        # mostly measure machine drift, not probes. Each rep drives one
+        # OFF segment and one ON segment per QPS point in a (seeded)
+        # shuffled order — slow machine periods and position effects
+        # land evenly across the pools — and the per-phase pools are
+        # compared as wholes, so the p99 index sits on a real 1% tail
+        # instead of a tiny segment's max sample
+        import random
+        rng = random.Random(0)
+        reps = 5
+        per = max(1, int(requests) // reps)
+        off = []
+        on = {float(qps): [] for qps in probe_qps}
+        for _ in range(reps):
+            phases = [None] + [float(q) for q in probe_qps]
+            rng.shuffle(phases)
+            for qps in phases:
+                # every phase opens with an UNMEASURED ~32-request burst:
+                # the serving path shows a one-off ~5ms hiccup ~25
+                # requests into a fresh burst (observed with the prober
+                # completely absent), and a phase comparison is only fair
+                # if that transient lands in nobody's measured pool
+                if qps is None:
+                    drive(32)
+                    off += drive(per)
+                    continue
+                # each start fires an immediate probe, so every rep
+                # guarantees at least one probe lands inside its phase
+                assert prober_cmd(f"start {1.0 / qps}") == "ok"
+                try:
+                    drive(32)
+                    on[qps] += drive(per)
+                finally:
+                    assert prober_cmd("stop") == "ok"
+        p50_off = pct(off, 0.50)
+        p99_off = pct(off, 0.99)
+        PROBE_OVERHEAD_STATS.update({
+            "p50_off_ms": p50_off, "p99_off_ms": p99_off,
+            "requests_per_point": per * reps, "points": []})
+        snap = _json.loads(prober_cmd("quit"))
+        worst = 0.0
+        for qps in probe_qps:
+            overhead = ((pct(on[float(qps)], 0.99) - p99_off)
+                        / max(p99_off, 1e-9) * 100.0)
+            worst = max(worst, overhead)
+            PROBE_OVERHEAD_STATS["points"].append({
+                "probe_qps": float(qps),
+                "p50_ms": pct(on[float(qps)], 0.50),
+                "p99_ms": pct(on[float(qps)], 0.99),
+                "p99_overhead_pct": round(overhead, 2),
+                "probes": snap["probes"],
+                "last_outcome": snap["last_outcome"],
+            })
+        worst = round(max(0.0, worst), 2)
+        PROBE_OVERHEAD_STATS["max_p99_overhead_pct"] = worst
+        # cache purity under load: drive()'s identical bodies land ONE
+        # entry; every probe bypassed the cache or this would be 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/probed",
+                timeout=10) as r:
+            PROBE_OVERHEAD_STATS["cache_entries_after"] = \
+                _json.loads(r.read())["cache"]["entries"]
+        return worst
+    finally:
+        for p in (pproc, proc):
+            p.kill()
+            p.wait(timeout=30)
+
+
 PARALLEL_MEMORY_STATS = {}
 
 #: child source for the too-few-devices fallback: re-run the grid on a
@@ -1470,6 +1657,7 @@ ALL_BENCHES = [
     ("serving_latency_qps", "req/sec", bench_serving_latency),
     ("control_loop_time_to_recover_s", "s", bench_control_loop),
     ("fleet_scrape_p99_ms", "ms", bench_fleet_scrape),
+    ("probe_overhead_p99_pct", "%", bench_probe_overhead),
     ("lint_full_wall_s", "s", bench_lint_full),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
@@ -1954,6 +2142,10 @@ def main():
                           # replicas — populated only by the
                           # fleet_scrape config
                           "fleet_scrape": FLEET_SCRAPE_STATS or None,
+                          # probe-plane interference on serving p99 at
+                          # 1-4 probe QPS — populated only by the
+                          # probe_overhead config
+                          "probe_overhead": PROBE_OVERHEAD_STATS or None,
                           # whole-package tpulint wall time (all rules,
                           # shipped baseline) — populated only by the
                           # lint_full config

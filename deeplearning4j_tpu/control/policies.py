@@ -29,7 +29,7 @@ log = logging.getLogger(__name__)
 
 __all__ = ["fleet_scale_policy", "shard_restart_policy",
            "serving_pressure_policy", "fleet_replica_policy",
-           "default_control_policies"]
+           "probe_failure_policy", "default_control_policies"]
 
 
 def fleet_scale_policy(group, master, *, rule: str = "fleet_worker_stale",
@@ -173,15 +173,55 @@ def fleet_replica_policy(collector, restart, *,
                     f"time on sustained {rule}")
 
 
+def probe_failure_policy(prober, restart, *,
+                         rules: Sequence[str] = ("probe_mismatch",
+                                                 "probe_deadman"),
+                         cooldown_s: float = 30.0,
+                         sustain_s: float = 0.0,
+                         name: str = "probe_failure_restart"
+                         ) -> ControlPolicy:
+    """Bounce replicas that FAIL PROBES on a sustained probe-plane alert
+    (``monitor.alerts.default_probe_rules``: mismatch — wrong answers vs
+    the golden set — or deadman — no correct answer inside the window).
+
+    This is the gray-failure remediation no self-reported signal can
+    drive: the scrape-plane ``fleet_replica_policy`` only sees a replica
+    that stops ANSWERING, while a wedged model keeps ``/telemetry``
+    perfectly healthy. ``restart`` is the caller's actuator —
+    ``fn(label, url)``, same contract as the scrape-plane policy. The
+    policy asks the ``prober`` which targets are failing at FIRE time
+    rather than trusting the alert payload: a replica whose probes
+    recovered between the rule sustaining and the plane acting must not
+    be bounced."""
+
+    def restart_failing(ctx):
+        failing = prober.failing_targets()
+        if not failing:
+            return "none_failing"
+        for t in failing:
+            restart(t.label, t.url)
+        return "restarted_" + ",".join(t.label for t in failing)
+
+    return ControlPolicy(
+        name, restart_failing, rules=tuple(rules),
+        action_name="restart_replica",
+        cooldown_s=cooldown_s, sustain_s=sustain_s,
+        description="restart replicas failing synthetic probes "
+                    "(mismatch/deadman) at fire time — the gray-failure "
+                    "remediation path")
+
+
 def default_control_policies(*, group=None, master=None, registry=None,
                              model: Optional[str] = None, collector=None,
-                             restart=None, **overrides):
+                             restart=None, prober=None, probe_restart=None,
+                             **overrides):
     """The full shipped pack for whatever actuators the caller has:
     fleet scale + shard restart when a ``group`` (and ``master``) is
     given, serving pressure relief when a ``registry`` + ``model`` is,
     replica restart when a scrape-plane ``collector`` + ``restart``
-    actuator is. ``overrides`` are forwarded to every builder that
-    accepts them."""
+    actuator is, probe-failure restart when a ``prober`` is (its
+    actuator is ``probe_restart``, falling back to ``restart``).
+    ``overrides`` are forwarded to every builder that accepts them."""
     import inspect
     out = []
 
@@ -201,4 +241,8 @@ def default_control_policies(*, group=None, master=None, registry=None,
     if collector is not None and restart is not None:
         out.append(fleet_replica_policy(collector, restart,
                                         **_kw(fleet_replica_policy)))
+    probe_actuator = probe_restart if probe_restart is not None else restart
+    if prober is not None and probe_actuator is not None:
+        out.append(probe_failure_policy(prober, probe_actuator,
+                                        **_kw(probe_failure_policy)))
     return out

@@ -208,7 +208,10 @@ def cmd_monitor(args) -> int:
     SLOs"); ``--control`` prints the control plane's policy states and
     recent actions (``/control`` remotely — docs/CONTROL.md);
     ``--history`` prints the metric-history ring meta (``/history``
-    remotely); ``--collect LABEL=URL[,...]`` runs one scrape-plane tick
+    remotely); ``--probes`` prints the probe plane's target table —
+    golden-set versions, last outcomes, deadman ages (``/probes``
+    remotely — docs/OBSERVABILITY.md "Probe plane");
+    ``--collect LABEL=URL[,...]`` runs one scrape-plane tick
     over the given ``/telemetry`` targets and prints the merged fleet
     view (exit 1 if any scrape failed)."""
     import json
@@ -331,6 +334,36 @@ def cmd_monitor(args) -> int:
             if doc.get("cooldowns_active"):
                 print("# COOLDOWN: "
                       + ", ".join(doc["cooldowns_active"]))
+        return 0
+
+    if args.probes:
+        # probe-plane view: per-target last outcome / consecutive
+        # failures / deadman age (/probes remotely —
+        # docs/OBSERVABILITY.md "Probe plane")
+        if base:
+            doc = json.loads(_fetch(base, "/probes"))
+        else:
+            from .monitor import get_prober
+            doc = get_prober().snapshot()
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            rows = doc.get("targets", {})
+            if not rows:
+                print("# no probe targets configured")
+            for label, r in sorted(rows.items()):
+                age = r.get("last_success_age_s")
+                print(f"{(r.get('last_outcome') or 'never'):<10} "
+                      f"{label:<24} model={r.get('model')} "
+                      f"golden={r.get('golden_version')} "
+                      f"fails={r.get('consecutive_failures', 0)} "
+                      f"last_success_age="
+                      f"{round(age, 1) if age is not None else '-'}s"
+                      + (f" trace={r['last_trace_id']}"
+                         if r.get("last_trace_id") else ""))
+            print(f"# running={doc.get('running')} "
+                  f"interval={doc.get('interval_s')}s "
+                  f"fail_threshold={doc.get('fail_threshold')}")
         return 0
 
     if args.history:
@@ -601,6 +634,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--history", action="store_true",
                    help="metric-history ring meta (/history): sampler "
                         "interval, capacity, sample count, family names")
+    m.add_argument("--probes", action="store_true",
+                   help="probe-plane target table (/probes): golden-set "
+                        "versions, last outcomes, consecutive failures, "
+                        "deadman ages — one line per target, or the "
+                        "/probes JSON with --format json")
     m.add_argument("--collect", default=None, metavar="LABEL=URL[,...]",
                    help="one-shot scrape-plane tick: poll each target's "
                         "/telemetry, print the merged fleet view "

@@ -26,6 +26,11 @@ across (``ParamServerMetrics``, ``PerformanceListener``/
   round trip) into the same :class:`FleetState` table, with a private
   history ring so the alert rules evaluate FLEET-scope SLOs
   (``default_fleet_scope_rules``).
+- :func:`get_prober` — the probe plane: a :class:`Prober` firing real
+  ``POST /v1/models/<m>/predict`` requests at each :class:`ProbeTarget`
+  from the outside and comparing answers against the target's golden
+  set (``ServedModel.golden()``) — the black-box correctness signal
+  self-reported telemetry cannot provide (``default_probe_rules``).
 - :func:`get_history` — the bounded ring of timestamped registry
   snapshots behind ``GET /history`` and the ``trends`` block of
   ``/profile`` (opt-in background sampler; windowed rate/delta/quantile
@@ -61,10 +66,12 @@ from .history import MetricsHistory, get_history
 from .alerts import (AlertEngine, AlertError, AlertRule, BurnRateRule,
                      FleetStalenessRule, HealthRule, ThresholdRule,
                      default_fleet_rules, default_fleet_scope_rules,
-                     default_rules, default_serving_rules,
-                     default_training_rules, get_alert_engine)
+                     default_probe_rules, default_rules,
+                     default_serving_rules, default_training_rules,
+                     get_alert_engine)
 from .collector import (ScrapeTarget, TelemetryCollector, get_collector,
                         telemetry_snapshot)
+from .probes import ProbeTarget, Prober, get_prober
 from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
                        get_jit_registry, sample_device_memory,
                        maybe_sample_device_memory, profile_report,
@@ -86,8 +93,9 @@ __all__ = [
     "FleetStalenessRule", "get_alert_engine", "default_rules",
     "default_serving_rules", "default_training_rules",
     "default_fleet_rules", "default_fleet_scope_rules",
+    "default_probe_rules",
     "ScrapeTarget", "TelemetryCollector", "get_collector",
-    "telemetry_snapshot",
+    "telemetry_snapshot", "ProbeTarget", "Prober", "get_prober",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 

@@ -64,7 +64,8 @@ __all__ = ["AlertError", "AlertRule", "ThresholdRule", "BurnRateRule",
            "HealthRule", "FleetStalenessRule", "AlertEngine",
            "get_alert_engine", "default_serving_rules",
            "default_training_rules", "default_fleet_rules",
-           "default_fleet_scope_rules", "default_rules"]
+           "default_fleet_scope_rules", "default_probe_rules",
+           "default_rules"]
 
 OK, PENDING, FIRING = "OK", "PENDING", "FIRING"
 
@@ -143,7 +144,11 @@ class ThresholdRule(AlertRule):
     def __init__(self, name: str, metric: str, *, threshold: float,
                  op: str = ">", mode: str = "value", window_s: float = 60.0,
                  q: float = 0.99, labels: Optional[Dict[str, str]] = None,
-                 agg: str = "sum", **kw):
+                 agg: str = "sum",
+                 exemplar_lookup: Optional[
+                     Callable[[], Optional[str]]] = None,
+                 detail_lookup: Optional[Callable[[], str]] = None,
+                 **kw):
         super().__init__(name, **kw)
         if op not in _OPS:
             raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
@@ -152,6 +157,13 @@ class ThresholdRule(AlertRule):
         if agg not in ("sum", "max", "min"):
             raise ValueError(f"agg must be sum|max|min, got {agg!r}")
         self.metric = metric
+        #: optional breach-time annotation seams (the probe rules use
+        #: them: a deadman/mismatch breach should name the guilty target
+        #: and carry a trace id resolvable on THAT replica's /trace) —
+        #: ``exemplar_lookup() -> trace id``, ``detail_lookup() -> str``
+        #: appended to the numeric detail; both failure-isolated
+        self.exemplar_lookup = exemplar_lookup
+        self.detail_lookup = detail_lookup
         self.threshold = float(threshold)
         self.op = op
         self.mode = mode
@@ -193,8 +205,24 @@ class ThresholdRule(AlertRule):
                 "rate": f"rate({self.metric})/s",
                 "max": f"max_{self.window_s:g}s({self.metric})",
                 "quantile": f"p{int(self.q * 100)}({self.metric})"}[self.mode]
-        return breached, v, (f"{what} = {v:.6g} "
-                             f"{self.op} {self.threshold:g}"), None
+        detail = f"{what} = {v:.6g} {self.op} {self.threshold:g}"
+        exemplar = None
+        if breached:
+            if self.detail_lookup is not None:
+                try:
+                    extra = self.detail_lookup()
+                    if extra:
+                        detail += f" — {extra}"
+                except Exception:
+                    log.exception("detail lookup for rule %r failed",
+                                  self.name)
+            if self.exemplar_lookup is not None:
+                try:
+                    exemplar = self.exemplar_lookup()
+                except Exception:
+                    log.exception("exemplar lookup for rule %r failed",
+                                  self.name)
+        return breached, v, detail, exemplar
 
 
 class BurnRateRule(AlertRule):
@@ -842,6 +870,66 @@ def default_fleet_scope_rules(*, fleet=None, slo: float = 0.999,
                       for_seconds=for_seconds, severity="page",
                       description="a configured scrape target is not "
                                   "answering /telemetry"),
+    ]
+
+
+def default_probe_rules(prober=None, *, slo: float = 0.999,
+                        burn_factor: float = 14.4,
+                        windows: Sequence[float] = (60.0, 300.0),
+                        p99_target_ms: float = 500.0,
+                        deadman_s: float = 60.0,
+                        for_seconds: float = DEFAULT_FOR_SECONDS
+                        ) -> List[AlertRule]:
+    """The probe-plane pack (attach to ``prober.engine``, which samples
+    the registry where the probe SLIs land):
+
+    - ``probe_availability_burn`` — error-budget burn over
+      ``probe_requests_total`` where EVERY non-ok outcome is bad: a
+      wrong answer (mismatch) burns the budget exactly like a 5xx;
+    - ``probe_p99_client`` — client-observed windowed p99 per target
+      (the latency the FRONT DOOR sees, network included), worst target
+      read via ``per_label``;
+    - ``probe_mismatch`` — ANY mismatch in the short window pages
+      immediately: correctness has no error budget;
+    - ``probe_deadman`` — ``probe_last_success_age_s`` over
+      ``deadman_s``: only a CORRECT answer resets it, so a replica
+      answering quickly but wrongly still trips it.
+
+    ``prober`` (optional) wires breach-time annotations: mismatch and
+    deadman breaches name the guilty target and carry the failing
+    probe's own trace id — resolvable on that replica's ``/trace``."""
+    ex = prober.last_failure_trace if prober is not None else None
+    why = prober.failure_detail if prober is not None else None
+    return [
+        BurnRateRule("probe_availability_burn", kind="availability",
+                     slo=slo, burn_factor=burn_factor, windows=windows,
+                     total_metric="probe_requests_total",
+                     bad_labels=[{"outcome": "error"},
+                                 {"outcome": "timeout"},
+                                 {"outcome": "mismatch"}],
+                     for_seconds=for_seconds,
+                     description="synthetic-probe error-budget burn "
+                                 "(any non-ok outcome is bad)"),
+        BurnRateRule("probe_p99_client", kind="latency",
+                     latency_metric="probe_latency_ms",
+                     target_ms=p99_target_ms, windows=windows,
+                     per_label="target", for_seconds=for_seconds,
+                     description="worst target's client-observed probe "
+                                 "p99 over target on both windows"),
+        ThresholdRule("probe_mismatch", "probe_requests_total",
+                      threshold=0.0, op=">", mode="rate",
+                      window_s=windows[0],
+                      labels={"outcome": "mismatch"},
+                      for_seconds=for_seconds, severity="page",
+                      exemplar_lookup=ex, detail_lookup=why,
+                      description="a probed replica returned an answer "
+                                  "diverging from its golden set"),
+        ThresholdRule("probe_deadman", "probe_last_success_age_s",
+                      threshold=deadman_s, op=">", mode="value",
+                      agg="max", for_seconds=for_seconds, severity="page",
+                      exemplar_lookup=ex, detail_lookup=why,
+                      description="a probe target has not answered "
+                                  "correctly within the deadman window"),
     ]
 
 

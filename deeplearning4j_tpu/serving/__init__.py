@@ -44,9 +44,10 @@ accumulate-then-flush path to the same scheduler.
 from .batcher import (ContinuousBatcher, DeadlineExceededError,
                       ModelNotFoundError, OverloadedError)
 from .registry import ModelRegistry, ServedModel, DEFAULT_BATCH_BUCKETS
-from .server import InferenceServer, TRACE_HEADER, parse_trace_header
+from .server import (InferenceServer, PROBE_HEADER, TRACE_HEADER,
+                     parse_trace_header)
 
 __all__ = ["ContinuousBatcher", "ModelRegistry", "ServedModel",
            "InferenceServer", "OverloadedError", "DeadlineExceededError",
            "ModelNotFoundError", "DEFAULT_BATCH_BUCKETS", "TRACE_HEADER",
-           "parse_trace_header"]
+           "PROBE_HEADER", "parse_trace_header"]

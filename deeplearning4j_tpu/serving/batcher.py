@@ -505,7 +505,7 @@ class ContinuousBatcher:
 
     # -------------------------------------------------------------- submit
     def submit(self, x, deadline_ms: Optional[float] = None,
-               trace_ctx=None) -> Future:
+               trace_ctx=None, cache_bypass: bool = False) -> Future:
         """Queue a request; returns a Future resolving to the result rows
         for exactly the submitted examples (padding never leaks out).
 
@@ -513,6 +513,13 @@ class ContinuousBatcher:
         :class:`OverloadedError` when the queue is at capacity (policy
         ``"reject"``) or the batcher is closed; ``ValueError`` when ``b``
         exceeds the largest bucket (configure a bucket that fits).
+
+        ``cache_bypass``: skip the response cache ENTIRELY for this
+        request — no lookup, and the computed result is never stored
+        (the request keeps ``ckey=None`` end to end). The probe plane
+        sets this (via the ``X-DL4J-Probe`` header): a synthetic probe
+        answered from the LRU would prove nothing about the live model
+        path, and a probe must not evict real traffic's entries either.
 
         ``trace_ctx``: the request's :class:`SpanContext` (the HTTP front
         door forwards the caller's ``X-DL4J-Trace`` header, or its own
@@ -557,7 +564,7 @@ class ContinuousBatcher:
                 f"bucket {self.max_batch} — split the request or "
                 f"configure a bigger bucket")
         ckey = None
-        if self._cache is not None and not self._closed:
+        if self._cache is not None and not self._closed and not cache_bypass:
             # a closed (draining) batcher must not keep answering cached
             # inputs while rejecting uncached ones — admission after
             # close() is uniform: skip the fast path, let the cond-

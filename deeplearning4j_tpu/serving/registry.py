@@ -147,6 +147,8 @@ class ServedModel:
         #: deserialized executable. Populated only by a successful
         #: ``warm(artifact=)``; empty = every forward rides model.output
         self._aot: Dict[Any, Any] = {}
+        #: latched golden set (see :meth:`golden`) — None until captured
+        self._golden: Optional[Dict[str, Any]] = None
         self.batcher = ContinuousBatcher(
             self._forward, name=name,
             batch_buckets=batch_buckets, time_buckets=time_buckets,
@@ -276,15 +278,74 @@ class ServedModel:
             else self.model.output(xs, mask=mask)
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               trace_ctx=None) -> Future:
+               trace_ctx=None, cache_bypass: bool = False) -> Future:
         return self.batcher.submit(x, deadline_ms=deadline_ms,
-                                   trace_ctx=trace_ctx)
+                                   trace_ctx=trace_ctx,
+                                   cache_bypass=cache_bypass)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
-                timeout: float = 60.0, trace_ctx=None):
+                timeout: float = 60.0, trace_ctx=None,
+                cache_bypass: bool = False):
         """Synchronous convenience: submit + wait for the result rows."""
         return self.submit(x, deadline_ms=deadline_ms,
-                           trace_ctx=trace_ctx).result(timeout)
+                           trace_ctx=trace_ctx,
+                           cache_bypass=cache_bypass).result(timeout)
+
+    def golden(self, inputs=None, examples: int = 2,
+               refresh: bool = False) -> Dict[str, Any]:
+        """The model's **golden set**: canonical inputs plus their f32
+        expected outputs, captured through the REAL serving path (batcher
+        bucketing and precision cast included, response cache bypassed —
+        the oracle must describe the live model path, not the LRU). The
+        probe plane (:mod:`deeplearning4j_tpu.monitor.probes`) replays
+        these inputs from the outside and compares answers within
+        ``atol`` — the correctness half of black-box monitoring.
+
+        ``inputs`` defaults to a deterministic canonical batch derived
+        from ``input_shape`` (``examples`` rows, values in ``[0, 1)``) —
+        the same inputs on every capture, so two captures of the same
+        weights produce the same ``version``. The ``version`` key is a
+        content hash over inputs + expected outputs + precision: a
+        retrained or re-precisioned model gets a NEW version, and an AOT
+        warmup artifact exported from this model
+        (:meth:`export_warmup`) ships the golden set whose version names
+        exactly the weights it was captured against. ``atol`` follows
+        the serving precision (bf16 answers are compared loosely — the
+        docs/SERVING.md bf16 tolerance). The capture is latched; pass
+        ``refresh=True`` after mutating the model's weights."""
+        if self._golden is not None and not refresh and inputs is None:
+            return self._golden
+        if inputs is None:
+            if self.input_shape is None:
+                raise ValueError(
+                    f"model {self.name!r}: golden() needs input_shape= "
+                    f"at registration (or pass canonical inputs=)")
+            per = int(np.prod(self.input_shape, dtype=np.int64))
+            n = max(1, int(examples))
+            x = (np.arange(n * per, dtype=np.float32)
+                 .reshape((n,) + self.input_shape) % 7.0) / 7.0
+        else:
+            x = np.asarray(inputs, np.float32)
+            if x.ndim < 2:
+                x = x.reshape(1, -1)
+        expected = np.asarray(
+            self.predict(x, cache_bypass=True), np.float32)
+        import hashlib
+        h = hashlib.sha256()
+        h.update(x.tobytes())
+        h.update(expected.tobytes())
+        h.update(self.precision.encode())
+        self._golden = {
+            "model": self.name,
+            "version": h.hexdigest()[:16],
+            "precision": self.precision,
+            "inputs": x.tolist(),
+            "outputs": expected.tolist(),
+            # bf16 forwards round-trip through ~8 mantissa bits; the f32
+            # oracle must not flag that as a gray failure
+            "atol": 5e-2 if self.precision == "bf16" else 1e-4,
+        }
+        return self._golden
 
     def stats(self) -> Dict[str, Any]:
         b = self.batcher
@@ -301,6 +362,7 @@ class ServedModel:
             "cache_size": b.cache_size,
             "cache": b.cache_stats(),
             "aot_signatures": len(self._aot),
+            "golden_version": (self._golden or {}).get("version"),
         }
 
     def set_admission(self, max_queue_examples: Optional[int] = None,
@@ -381,14 +443,17 @@ class ModelRegistry:
         return [m.stats() for _, m in models]
 
     def submit(self, name: str, x, deadline_ms: Optional[float] = None,
-               trace_ctx=None) -> Future:
+               trace_ctx=None, cache_bypass: bool = False) -> Future:
         return self.get(name).submit(x, deadline_ms=deadline_ms,
-                                     trace_ctx=trace_ctx)
+                                     trace_ctx=trace_ctx,
+                                     cache_bypass=cache_bypass)
 
     def predict(self, name: str, x, deadline_ms: Optional[float] = None,
-                timeout: float = 60.0, trace_ctx=None):
+                timeout: float = 60.0, trace_ctx=None,
+                cache_bypass: bool = False):
         return self.get(name).predict(x, deadline_ms=deadline_ms,
-                                      timeout=timeout, trace_ctx=trace_ctx)
+                                      timeout=timeout, trace_ctx=trace_ctx,
+                                      cache_bypass=cache_bypass)
 
     def close_all(self, drain: bool = True, timeout: float = 30.0):
         """Graceful shutdown: stop admission on every model, serve what

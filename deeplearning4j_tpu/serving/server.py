@@ -54,13 +54,21 @@ from .batcher import (DeadlineExceededError, ModelNotFoundError,
                       OverloadedError)
 from .registry import ModelRegistry
 
-__all__ = ["InferenceServer", "TRACE_HEADER", "parse_trace_header"]
+__all__ = ["InferenceServer", "TRACE_HEADER", "PROBE_HEADER",
+           "parse_trace_header"]
 
 #: request trace-context header: ``<trace_id hex>:<span_id hex>`` — the
 #: same 64-bit ids the paramserver proto v2 FLAG_TRACE frame carries
 #: (``struct "<QQ"`` there, hex here), so one trace id follows a request
 #: across HTTP serving and paramserver hops alike
 TRACE_HEADER = "X-DL4J-Trace"
+
+#: probe-traffic marker (``X-DL4J-Probe: 1``): the request bypasses the
+#: response cache end to end — a synthetic probe answered from the LRU
+#: would prove nothing about the live model path, and probes must not
+#: evict real traffic's cached entries either (monitor/probes.py sets
+#: this on every golden-set replay)
+PROBE_HEADER = "X-DL4J-Probe"
 
 
 def parse_trace_header(value: Optional[str]) -> Optional[SpanContext]:
@@ -131,13 +139,21 @@ class _ServingHandler(JsonRequestHandler):
         # span's context, so /trace shows http/predict → queue_wait →
         # (linked) serving/flush as one causal chain per request
         remote = parse_trace_header(self.headers.get(TRACE_HEADER))
+        probe = self.headers.get(PROBE_HEADER) not in (None, "", "0")
         ctx = None
+        # probe requests tag their span (visible on /trace) and ride the
+        # cache-bypass path — never answered from, never stored into, the
+        # response LRU
+        span_args = {"model": name}
+        if probe:
+            span_args["probe"] = True
         try:
             with get_tracer().span("http/predict", cat="serving",
-                                   parent=remote, model=name) as ctx:
+                                   parent=remote, **span_args) as ctx:
                 fut = self.registry.submit(name, inputs,
                                            deadline_ms=deadline_ms,
-                                           trace_ctx=ctx)
+                                           trace_ctx=ctx,
+                                           cache_bypass=probe)
                 # generous transport-level backstop — per-request shedding
                 # is the batcher's deadline, not this timeout
                 out = fut.result(timeout=max(

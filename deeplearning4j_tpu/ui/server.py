@@ -210,7 +210,8 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _monitor_get(self, url, q) -> bool:
         """Serve the process-monitor endpoints every server shares —
         ``/metrics``, ``/healthz``, ``/profile``, ``/alerts``,
-        ``/history``, ``/control``, ``/trace``, ``/events``, ``/fleet``,
+        ``/history``, ``/control``, ``/probes``, ``/trace``,
+        ``/events``, ``/fleet``,
         ``/fleet/trace``, ``/telemetry`` — so the training UI and the
         serving front door cannot drift on routing, status-code mapping,
         or framing. Returns True when the path was handled."""
@@ -254,6 +255,14 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             # surface must stay readable exactly while it is acting
             from ..control.plane import get_control_plane
             self._json(get_control_plane().snapshot())
+            return True
+        if url.path == "/probes":
+            # probe-plane state (monitor/probes.py): targets, golden-set
+            # versions, last outcomes, deadman ages. ALWAYS HTTP 200 —
+            # the black-box plane's own surface must stay readable
+            # exactly while its targets are failing
+            from ..monitor.probes import get_prober
+            self._json(get_prober().snapshot())
             return True
         if url.path == "/history":
             # metric-history ring (monitor/history.py): ring meta by

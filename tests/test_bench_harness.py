@@ -585,6 +585,36 @@ def test_fleet_scrape_bench_latches_scrape_plane_stats(bench):
     assert stats["tick_overhead_ms"] >= 0.0
 
 
+def test_probe_overhead_bench_latches_interference_grid(bench):
+    """ISSUE 19: the probe_overhead bench serves real traffic with the
+    prober off, then per probe-QPS point with a live prober firing
+    golden-set probes at the same replica, and latches {p50_off_ms,
+    p99_off_ms, requests_per_point, points, max_p99_overhead_pct} — the
+    ``--one`` record's ``probe_overhead`` block. Probes must have
+    actually run (and come back ``ok``), and at the default ~1-4 probe
+    QPS against local serving the p99 interference must stay under the
+    5% budget (one retry absorbs scheduler noise on a loaded box)."""
+    for attempt in (1, 2):
+        value = bench.bench_probe_overhead(requests=2000,
+                                           probe_qps=(2.0,))
+        stats = bench.PROBE_OVERHEAD_STATS
+        assert stats["max_p99_overhead_pct"] == value
+        assert 0 < stats["p50_off_ms"] <= stats["p99_off_ms"]
+        assert stats["requests_per_point"] == 2000
+        [point] = stats["points"]
+        assert point["probe_qps"] == 2.0
+        assert point["probes"] >= 5             # the prober really fired
+        assert point["last_outcome"] == "ok"    # and the answers matched
+        assert 0 < point["p50_ms"] <= point["p99_ms"]
+        if value < 5.0:
+            break
+        if attempt == 2:
+            assert value < 5.0, stats
+    # cache purity under load: real traffic's single entry, zero probe
+    # entries (every probe bypassed the live response cache)
+    assert stats["cache_entries_after"] == 1
+
+
 def test_lint_full_bench_latches_linter_cost(bench):
     """ISSUE 18: the lint_full bench times a whole-package tpulint run
     (all rules, shipped baseline) and latches {wall_s, files, rules,

@@ -302,6 +302,60 @@ def test_monitor_collect_subcommand_smoke(capsys):
     assert "# scrape dead FAILED" in captured.err
 
 
+def test_monitor_probes_subcommand_smoke(capsys):
+    """`monitor --probes`: the probe plane's target table — per-target
+    last outcome / golden version / deadman age in text, the raw
+    snapshot with --format json, and the /probes endpoint over --url."""
+    from deeplearning4j_tpu.monitor import get_prober
+    from deeplearning4j_tpu.serving import InferenceServer
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    class _Twice:
+        def output(self, x, mask=None):
+            return np.asarray(x, np.float32) * 2.0
+
+    prober = get_prober()
+    assert main(["monitor", "--probes"]) == 0
+    assert "# no probe targets configured" in capsys.readouterr().out
+
+    srv = InferenceServer()
+    m = srv.register("cliprobe", _Twice(), input_shape=(2,),
+                     batch_buckets=(1, 2), linger_ms=0.0)
+    port = srv.start(port=0)
+    try:
+        prober.add_target("cli_t", f"127.0.0.1:{port}", m.golden())
+        prober.tick()
+
+        assert main(["monitor", "--probes"]) == 0
+        out = capsys.readouterr().out
+        assert "ok" in out and "cli_t" in out
+        assert f"golden={m.golden()['version']}" in out
+        assert "fails=0" in out and "# running=False" in out
+
+        assert main(["monitor", "--probes", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["targets"]["cli_t"]["last_outcome"] == "ok"
+        assert doc["targets"]["cli_t"]["model"] == "cliprobe"
+
+        srv_ui = UIServer(port=0)
+        srv_ui.attach(InMemoryStatsStorage())
+        ui_port = srv_ui.start()
+        try:
+            assert main(["monitor", "--probes", "--url",
+                         f"127.0.0.1:{ui_port}",
+                         "--format", "json"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["targets"]["cli_t"]["last_outcome"] == "ok"
+            assert main(["monitor", "--probes", "--url",
+                         f"127.0.0.1:{ui_port}"]) == 0
+            assert "cli_t" in capsys.readouterr().out
+        finally:
+            srv_ui.stop()
+    finally:
+        prober.remove_target("cli_t")
+        srv.stop()
+
+
 def test_monitor_profile_subcommand_smoke(capsys):
     """`monitor --profile`: the step-anatomy report, local and over --url,
     text and JSON (docs/OBSERVABILITY.md "Compilation & memory")."""
@@ -386,15 +440,20 @@ def test_monitor_alerts_and_history_subcommand_smoke(capsys):
 
 
 def test_lint_subcommand_smoke(tmp_path, capsys):
-    """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over the
-    shipped package (self-hosting against analysis/baseline.json), emits
-    schema-stable JSON, and exits 1 deterministically on a violation."""
-    # the package itself is clean against the shipped baseline
-    assert main(["lint"]) == 0
+    """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over a
+    clean subtree, emits schema-stable JSON, and exits 1
+    deterministically on a violation. (Full-package self-hosting
+    against analysis/baseline.json is
+    test_analysis.py::test_package_lints_clean_against_shipped_baseline
+    — this smoke covers the CLI wiring, so it lints one subtree to keep
+    tier-1 wall time down.)"""
+    sub = os.path.join(os.path.dirname(__file__), "..",
+                       "deeplearning4j_tpu", "analysis")
+    assert main(["lint", sub]) == 0
     out = capsys.readouterr().out
     assert "0 new finding(s)" in out
 
-    assert main(["lint", "--format", "json"]) == 0
+    assert main(["lint", sub, "--format", "json"]) == 0
     data = json.loads(capsys.readouterr().out)
     assert data["tool"] == "tpulint" and data["new_count"] == 0
 

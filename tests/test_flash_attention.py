@@ -439,6 +439,7 @@ def test_mha_routes_masked_to_flash(monkeypatch):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_matches_full_attention():
     """Flash kernel INSIDE the ring schedule (round-3 VERDICT item 5): the
     sp path == dense full attention, forward and gradients, on a 4-device
@@ -607,6 +608,7 @@ def test_sequence_parallel_step_rejects_activation_dropout():
         sequence_parallel_step(MultiLayerNetwork(conf).init(), mesh)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_step_attention_dropout_matches_unsharded():
     """Attention-probability dropout through the ring: the sp step derives
     the same per-step seed as the unsharded flash path (replicated rng) and
@@ -760,6 +762,7 @@ def test_sequence_parallel_step_computation_graph():
                                    rtol=3e-3, atol=3e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_matches_full_attention():
     """Ulysses layout + ONE local flash kernel over the gathered sequence
     == dense full attention (fwd AND grads): the sp path's preferred
@@ -858,6 +861,7 @@ def test_sp_attend_routes_ulysses_when_heads_divide(monkeypatch):
     assert not calls, "indivisible heads should stay on the ring"
 
 
+@pytest.mark.slow
 def test_sequence_parallel_transformer_lm_matches_unsharded():
     """The flagship composition: TransformerLM (pre-LN residual CG with
     [b, T] token-id input) trains through sequence_parallel_step — the

@@ -183,6 +183,7 @@ def test_custom_layer_registration(tmp_path):
     assert out.shape == (1, 2)
 
 
+@pytest.mark.slow
 def test_real_inceptionv3_import_end_to_end(tmp_path):
     """The BASELINE.md import config, for real: build tf.keras applications
     InceptionV3 (313 layers, 21.8M params, weights=None), save legacy h5,

@@ -386,16 +386,41 @@ def _collector_flows():
     assert not c.running()
 
 
+def _prober_flows():
+    """The probe-plane suite's core flows: target-table mutation, a
+    failing probe (refused fast — outcome lands, state updates, the
+    failing edge records a flight event), history sample + zero-rule
+    evaluation, and the read surfaces (snapshot, failing_targets, the
+    filtered probe_dump). Only TWO ticks — below the default
+    fail_threshold, so the flow never dirties the process health ring.
+    The design invariant this exercises: ``Prober._lock`` is a LEAF —
+    HTTP probes, metric writes, flight events, history sampling and
+    alert evaluation all run with no prober lock held."""
+    from deeplearning4j_tpu.monitor.probes import Prober
+    golden = {"model": "lwm", "inputs": [[1.0]], "outputs": [[1.0]]}
+    p = Prober(timeout_s=0.2)
+    p.add_target("lwp0", "127.0.0.1:9", golden)   # refused fast
+    p.tick()               # error path + history sample + engine evaluate
+    p.tick()               # repeat: the failing event stays edge-triggered
+    assert [t.label for t in p.failing_targets()] == ["lwp0"]
+    p.snapshot()
+    p.probe_dump()
+    p.remove_target("lwp0")
+    p.tick()               # empty target table: no sample, no evaluation
+    assert not p.running()
+
+
 def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
     """Tier-1 pin: the sharded-paramserver + prefetch + overlap +
-    control-plane + scrape-collector flows under lockwatch produce ZERO
-    lock-order inversions, and every observed edge is derivable by the
-    static analyzer."""
+    control-plane + scrape-collector + prober flows under lockwatch
+    produce ZERO lock-order inversions, and every observed edge is
+    derivable by the static analyzer."""
     _sharded_flows()
     _prefetch_flows()
     _overlap_flows()
     _control_flows()
     _collector_flows()
+    _prober_flows()
     assert watch.inversions() == [], watch.inversions()
 
     observed = watch.observed_edges()
@@ -422,6 +447,11 @@ def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
         "acquisitions"] > 0
     assert not [e for e in observed if e[0] == "TelemetryCollector._lock"], \
         [e for e in observed if e[0] == "TelemetryCollector._lock"]
+    # and for the probe plane's prober: probes, metric writes, flight
+    # events, history sampling and alert evaluation all run unlocked
+    assert watch.contention_table()["Prober._lock"]["acquisitions"] > 0
+    assert not [e for e in observed if e[0] == "Prober._lock"], \
+        [e for e in observed if e[0] == "Prober._lock"]
 
     from deeplearning4j_tpu.analysis.lockgraph import analyze_package
     static = analyze_package().edge_set()
@@ -469,18 +499,20 @@ def test_inferred_guards_subset_of_observed_locks(watch):
     fiction."""
     _batcher_flows()
     _collector_flows()
+    _prober_flows()
 
     from deeplearning4j_tpu.analysis.racegraph import \
         analyze_package_races
     g = analyze_package_races()
     inferred = g.guard_names(classes=("ContinuousBatcher",
-                                      "TelemetryCollector"))
+                                      "TelemetryCollector", "Prober"))
     # the inference must have teeth before the subset check means
     # anything: the batcher's condition AND its cache lock, plus the
-    # collector's leaf lock, are all inferred as guards
+    # collector's and prober's leaf locks, are all inferred as guards
     assert "ContinuousBatcher._cond" in inferred
     assert "ContinuousBatcher._cache_lock" in inferred
     assert "TelemetryCollector._lock" in inferred
+    assert "Prober._lock" in inferred
 
     observed = watch.observed_locks()
     missing = inferred - observed

@@ -73,6 +73,7 @@ def _run_workers(worker_path, tmp_path, port, n=2, timeout=540, check=True):
     return procs, outs
 
 
+@pytest.mark.slow
 def test_two_process_training_identical_params(tmp_path):
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "resources", "multiproc_worker.py")
@@ -185,6 +186,7 @@ def test_two_process_sharded_tbptt(tmp_path):
     assert int(r0[2]) == 24
 
 
+@pytest.mark.slow
 def test_four_process_fsdp_sharded_storage(tmp_path):
     """DP×FSDP at 4 processes × 2 devices (VERDICT r4 item 6: multi-process
     coverage must scale past 2 workers): an 8-way data axis spanning four
@@ -203,6 +205,7 @@ def test_four_process_fsdp_sharded_storage(tmp_path):
     assert len(set(scores)) == 1 and np.isfinite(scores[0])
 
 
+@pytest.mark.slow
 def test_four_process_shared_gradients_wire(tmp_path):
     """SHARED_GRADIENTS across FOUR independent processes: every encoded
     update crosses a real TCP wire to 3 peers, replicas stay bit-identical,

@@ -674,6 +674,7 @@ def test_parallel_wrapper_unsharded_tail_runs_one_iteration():
     assert np.isfinite(pw.last_score)
 
 
+@pytest.mark.slow
 def test_tensor_parallel_transformer_lm_matches_replicated():
     """megatron_rules on a ComputationGraph: TransformerLM's attention gets
     the Megatron QKV-column/Wo-row pattern, FFN up/down alternate — the tp

@@ -207,6 +207,7 @@ def test_pipeline_parallel_step_partition():
         partition_network(net, 8)
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_zoo_lstm_loss_parity():
     """TextGenerationLSTM(num_layers=5) pipelined over pipe=4 × data=2:
     first-step loss AND updated params must match the unpipelined container
@@ -579,6 +580,7 @@ def test_pipelined_graph_aux_output_from_entry():
                 rtol=2e-4, atol=1e-5, err_msg=f"{k}/{name}")
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_masked_sequences_match_raw_step():
     """[b, T] feature/label masks ride the schedule: the pipelined masked
     LSTM step must reproduce the container's masked step (loss + params) —
@@ -667,6 +669,7 @@ def test_pipeline_parallel_dropout_active_and_deterministic():
     assert abs(la1 - la0) > 1e-9           # fresh mask per iteration
 
 
+@pytest.mark.slow
 def test_pipelined_graph_output_dropout_active():
     """OutputLayer input-dropout configured on a CG must stay ACTIVE inside
     the pipelined step (it gets a folded key, not rng=None)."""
@@ -704,6 +707,7 @@ def test_pipelined_graph_output_dropout_active():
     assert abs(la - ln) > 1e-6            # dropout fires in the head loss
 
 
+@pytest.mark.slow
 def test_pipelined_graph_masked_sequences_match_raw_step():
     """PipelinedGraph masks: per-input [b, T] feature masks propagate
     through entry → body → head with ComputationGraph._apply_graph's rules
@@ -765,6 +769,7 @@ def test_pipelined_graph_masked_sequences_match_raw_step():
     assert abs(loss_unmasked - loss_pp) > 1e-6
 
 
+@pytest.mark.slow
 def test_pipelined_graph_label_mask_only_fallback():
     """With no label mask, a 3-dim output falls back to the PROPAGATED
     feature mask (the container's mask rule) — pipelined == raw."""
@@ -803,6 +808,7 @@ def test_pipelined_graph_label_mask_only_fallback():
     np.testing.assert_allclose(loss_pp, float(loss_raw), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_graph_residual_blocks_transformer_parity():
     """Block-body pipelining (partition_graph_blocks): TransformerLM's
     residual blocks — skip connections INSIDE each block, which the linear
@@ -863,6 +869,7 @@ def test_pipelined_graph_residual_blocks_train_and_dp_pp():
     assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_pipelined_graph_residual_blocks_masked_parity():
     """[b, T] masks through the BLOCK body: every block vertex propagates
     the identity mask (LN/attn/dense + ElementWise add), so the masked

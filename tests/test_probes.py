@@ -1,0 +1,755 @@
+"""Probe plane (docs/OBSERVABILITY.md "Probe plane"): golden-set
+capture through the real serving path, probe traffic's end-to-end
+response-cache bypass, the black-box :class:`Prober` daemon with its
+ok/error/timeout/mismatch SLIs and deadman gauge, the
+``default_probe_rules`` pack, ``GET /probes`` on both server families,
+and THE gray-failure acceptance drill — a real replica subprocess that
+keeps self-reporting healthy while serving WRONG answers is detected
+only by probes, named in a firing alert carrying a trace id resolvable
+on that replica, auto-restarted by ``probe_failure_policy``, and the
+whole incident reconstructs from ``/events``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.control import ControlPlane, probe_failure_policy
+from deeplearning4j_tpu.monitor import (ProbeTarget, Prober,
+                                        default_probe_rules)
+from deeplearning4j_tpu.monitor.flightrec import get_flight_recorder
+from deeplearning4j_tpu.monitor.health import get_health
+from deeplearning4j_tpu.monitor.tracer import get_tracer
+from deeplearning4j_tpu.serving import (InferenceServer, ModelRegistry,
+                                        PROBE_HEADER, TRACE_HEADER)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+
+
+def _get_json(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+def _post_predict(port, inputs, model="drill", headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{model}/predict",
+        data=json.dumps({"inputs": inputs}).encode("utf-8"),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+class DoubleModel:
+    """Deterministic duck-typed model: first two columns, doubled.
+    ``bias`` is mutable so tests can flip the LIVE path's answer after
+    an entry is cached — the only way to tell a cache read from a real
+    forward."""
+
+    def __init__(self, bias=0.0):
+        self.bias = bias
+
+    def output(self, x, mask=None):
+        return np.asarray(x, np.float32)[:, :2] * 2.0 + self.bias
+
+
+# ----------------------------------------------------- golden capture
+class TestGolden:
+    def test_golden_is_deterministic_version_keyed_and_latched(self):
+        """Two captures of the same weights produce the same version
+        (the canonical inputs are deterministic); the capture is latched
+        (same object back) and surfaces in stats(); refresh re-captures."""
+        reg = ModelRegistry()
+        reg.register("g", DoubleModel(), input_shape=(4,),
+                     batch_buckets=(1, 2), linger_ms=0.0)
+        try:
+            m = reg.get("g")
+            g1 = m.golden()
+            assert g1["model"] == "g" and g1["precision"] == "f32"
+            assert g1["atol"] == pytest.approx(1e-4)
+            x = np.asarray(g1["inputs"], np.float32)
+            np.testing.assert_allclose(
+                np.asarray(g1["outputs"], np.float32), x[:, :2] * 2.0)
+            assert m.golden() is g1            # latched
+            assert m.stats()["golden_version"] == g1["version"]
+            g2 = m.golden(refresh=True)
+            assert g2 is not g1
+            assert g2["version"] == g1["version"]   # same weights
+        finally:
+            reg.close_all()
+
+    def test_golden_needs_input_shape_or_explicit_inputs(self):
+        reg = ModelRegistry()
+        reg.register("noshape", DoubleModel(), batch_buckets=(1, 2),
+                     linger_ms=0.0)
+        try:
+            m = reg.get("noshape")
+            with pytest.raises(ValueError, match="input_shape"):
+                m.golden()
+            g = m.golden(inputs=[[1.0, 2.0, 3.0, 4.0]])
+            np.testing.assert_allclose(
+                np.asarray(g["outputs"], np.float32), [[2.0, 4.0]])
+        finally:
+            reg.close_all()
+
+    def test_golden_capture_bypasses_the_response_cache(self):
+        """The oracle must describe the live model path: capturing a
+        golden set on a cache-enabled model stores NOTHING in the LRU."""
+        reg = ModelRegistry()
+        reg.register("gc", DoubleModel(), input_shape=(4,),
+                     batch_buckets=(1, 2), linger_ms=0.0, cache_size=16)
+        try:
+            m = reg.get("gc")
+            m.golden()
+            assert m.stats()["cache"]["entries"] == 0
+        finally:
+            reg.close_all()
+
+    def test_bf16_golden_gets_loose_atol(self):
+        reg = ModelRegistry()
+        reg.register("gb", DoubleModel(), input_shape=(4,),
+                     batch_buckets=(1, 2), linger_ms=0.0,
+                     precision="bf16")
+        try:
+            assert reg.get("gb").golden()["atol"] == pytest.approx(5e-2)
+        finally:
+            reg.close_all()
+
+
+# ------------------------------------------------- cache-bypass pins
+class TestProbeCacheBypass:
+    def test_submit_cache_bypass_neither_reads_nor_populates(self):
+        """Direct-submit pin: ``cache_bypass=True`` requests keep
+        ``ckey=None`` end to end — no lookup (a stale cached answer
+        cannot mask the live path) and no store (probes never evict
+        real traffic's entries)."""
+        reg = ModelRegistry()
+        model = DoubleModel()
+        reg.register("cb", model, input_shape=(4,),
+                     batch_buckets=(1, 2), linger_ms=0.0, cache_size=16)
+        try:
+            m = reg.get("cb")
+            x = [[1.0, 2.0, 3.0, 4.0]]
+            # bypass submits never populate
+            m.predict(x, cache_bypass=True)
+            m.predict(x, cache_bypass=True)
+            assert m.stats()["cache"]["entries"] == 0
+            # a normal request populates with the CORRECT answer ...
+            np.testing.assert_allclose(
+                np.asarray(m.predict(x), np.float32), [[2.0, 4.0]])
+            assert m.stats()["cache"]["entries"] == 1
+            # ... then the live path goes wrong: a bypass request must
+            # see the wrong LIVE answer (no read), a normal request the
+            # cached right one (the LRU still serves real traffic)
+            model.bias = 100.0
+            np.testing.assert_allclose(
+                np.asarray(m.predict(x, cache_bypass=True), np.float32),
+                [[102.0, 104.0]])
+            np.testing.assert_allclose(
+                np.asarray(m.predict(x), np.float32), [[2.0, 4.0]])
+            assert m.stats()["cache"]["entries"] == 1   # no new entry
+        finally:
+            reg.close_all()
+
+    def test_probe_header_bypasses_cache_over_http(self):
+        """Wire-level pin: ``X-DL4J-Probe: 1`` rides the header to
+        ``cache_bypass`` — probe POSTs leave the LRU empty, an identical
+        normal POST populates it, and a subsequent probe POST still
+        reaches the live model rather than the cached entry."""
+        srv = InferenceServer()
+        model = DoubleModel()
+        srv.register("h", model, input_shape=(4,),
+                     batch_buckets=(1, 2), linger_ms=0.0, cache_size=16)
+        port = srv.start(port=0)
+        x = [[1.0, 2.0, 3.0, 4.0]]
+        try:
+            m = srv.registry.get("h")
+            for _ in range(2):
+                status, doc = _post_predict(port, x, model="h",
+                                            headers={PROBE_HEADER: "1"})
+                assert status == 200
+                assert doc["outputs"] == [[2.0, 4.0]]
+            assert m.stats()["cache"]["entries"] == 0
+            status, _ = _post_predict(port, x, model="h")
+            assert status == 200
+            assert m.stats()["cache"]["entries"] == 1
+            # wedge the live path: a probe POST must see the wrong LIVE
+            # answer through the cached-right-answer trap, a normal POST
+            # the cached entry
+            model.bias = 100.0
+            status, doc = _post_predict(port, x, model="h",
+                                        headers={PROBE_HEADER: "1"})
+            assert status == 200
+            assert doc["outputs"] == [[102.0, 104.0]]
+            status, doc = _post_predict(port, x, model="h")
+            assert status == 200
+            assert doc["outputs"] == [[2.0, 4.0]]
+            assert m.stats()["cache"]["entries"] == 1
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------- prober unit tests
+class TestProbeTarget:
+    def test_url_normalization_and_golden_validation(self):
+        g = {"model": "m", "inputs": [[1.0]], "outputs": [[2.0]],
+             "atol": 1e-3, "version": "abc"}
+        t = ProbeTarget("r0", "127.0.0.1:8500/", g)
+        assert t.url == "http://127.0.0.1:8500"
+        assert t.model == "m" and t.atol == pytest.approx(1e-3)
+        with pytest.raises(ValueError, match="golden"):
+            ProbeTarget("bad", "127.0.0.1:1", {"inputs": [[1.0]]})
+        with pytest.raises(ValueError, match="model"):
+            ProbeTarget("bad", "127.0.0.1:1",
+                        {"inputs": [[1.0]], "outputs": [[1.0]]})
+
+
+class TestProberTick:
+    def test_ok_probe_lands_slis_and_resolvable_trace(self):
+        """A healthy target probes ``ok``: the request counter, the
+        client-side latency histogram (probe trace id latched as its
+        exemplar) and a ~0 deadman land; the probe's minted trace id is
+        resolvable in the replica's trace ring; the LRU stays empty."""
+        srv = InferenceServer()
+        m = srv.register("ok", DoubleModel(), input_shape=(4,),
+                         batch_buckets=(1, 2), linger_ms=0.0,
+                         cache_size=8)
+        port = srv.start(port=0)
+        p = Prober()
+        try:
+            p.add_target("u_ok", f"127.0.0.1:{port}", m.golden())
+            t0 = time.time()
+            res = p.tick(now=t0)
+            assert res["probed"] == ["u_ok"]
+            assert res["outcomes"] == {"u_ok": "ok"} and not res["errors"]
+            snap = p.snapshot()["targets"]["u_ok"]
+            assert snap["last_outcome"] == "ok"
+            assert snap["consecutive_failures"] == 0
+            assert snap["golden_version"] == m.golden()["version"]
+            # the probe's trace id joined the replica's /trace (the
+            # in-process server shares this tracer)
+            assert snap["last_trace_id"] in {
+                (e.get("args") or {}).get("trace_id")
+                for e in get_tracer().export()["traceEvents"]}
+            # SLIs: counter child + deadman ~0 in the prober's dump
+            dump = p.probe_dump()
+            oks = [r["value"]
+                   for r in dump["probe_requests_total"]["children"]
+                   if r["labels"] == {"target": "u_ok", "model": "ok",
+                                      "outcome": "ok"}]
+            assert oks and oks[0] >= 1
+            ages = [r["value"]
+                    for r in dump["probe_last_success_age_s"]["children"]
+                    if r["labels"]["target"] == "u_ok"]
+            assert ages == [0.0]
+            assert m.stats()["cache"]["entries"] == 0
+            # one history sample + engine pass per tick (the upward loop)
+            assert len(p.history.samples()) == 1
+        finally:
+            p.remove_target("u_ok")
+            srv.stop()
+            get_tracer().clear()
+
+    def test_mismatch_outcome_holds_the_deadman_and_edges_once(self):
+        """A replica answering quickly but WRONGLY is a mismatch: the
+        deadman keeps growing (only a correct answer resets it), the
+        failing flight event fires exactly once on the edge, sustained
+        failure lands ONE health_problem(kind=probe), and recovery
+        (fixed golden) emits the recovered edge."""
+        srv = InferenceServer()
+        m = srv.register("wrong", DoubleModel(), input_shape=(4,),
+                         batch_buckets=(1, 2), linger_ms=0.0)
+        port = srv.start(port=0)
+        rec = get_flight_recorder()
+        good = m.golden()
+        bad = dict(good)
+        bad["outputs"] = (np.asarray(good["outputs"], np.float32)
+                          + 5.0).tolist()
+        p = Prober(fail_threshold=2)
+        try:
+            before = len([e for e in rec.events()
+                          if e["event"] == "health_problem"
+                          and e.get("kind") == "probe"])
+            p.add_target("u_mm", f"127.0.0.1:{port}", bad)
+            t0 = time.time()
+            for k in range(3):
+                res = p.tick(now=t0 + k)
+                assert res["outcomes"] == {"u_mm": "mismatch"}
+            snap = p.snapshot()["targets"]["u_mm"]
+            assert snap["consecutive_failures"] == 3
+            assert [t.label for t in p.failing_targets()] == ["u_mm"]
+            # deadman grew across the synthetic beats in the sampled ring
+            ages = p.history.series("probe_last_success_age_s")
+            vals = [pt["value"] for pt in ages["points"]]
+            assert max(vals) >= 2.0
+            fails = [e for e in rec.events()
+                     if e["event"] == "probe_target_failing"
+                     and e.get("target") == "u_mm"]
+            assert len(fails) == 1              # edge, not per-tick
+            assert fails[0]["outcome"] == "mismatch"
+            assert fails[0].get("trace_id")
+            probs = [e for e in rec.events()
+                     if e["event"] == "health_problem"
+                     and e.get("kind") == "probe"]
+            assert len(probs) - before == 1     # once per incident
+            assert "u_mm" in probs[-1]["message"]
+            assert any(pr.startswith("probe:")
+                       for pr in get_health().snapshot()["problems"])
+            # fix the oracle: recovery edge + deadman reset
+            p.add_target("u_mm", f"127.0.0.1:{port}", good)
+            res = p.tick(now=t0 + 3)
+            assert res["outcomes"] == {"u_mm": "ok"}
+            assert any(e["event"] == "probe_target_recovered"
+                       and e.get("target") == "u_mm"
+                       for e in rec.events())
+            assert p.snapshot()["targets"]["u_mm"][
+                "consecutive_failures"] == 0
+        finally:
+            p.remove_target("u_mm")
+            srv.stop()
+            get_tracer().clear()
+
+    def test_down_target_is_an_error_and_removal_retires_series(self):
+        g = {"model": "m", "inputs": [[1.0]], "outputs": [[1.0]]}
+        p = Prober(timeout_s=0.2, fail_threshold=99)
+        p.add_target("u_gone", "127.0.0.1:9", g)     # refused
+        res = p.tick(now=time.time())
+        assert res["outcomes"] == {"u_gone": "error"}
+        assert "u_gone" in res["errors"]
+        assert [t.label for t in p.failing_targets()] == ["u_gone"]
+        assert any(r["labels"]["target"] == "u_gone"
+                   for r in p.probe_dump()
+                   ["probe_last_success_age_s"]["children"])
+        p.remove_target("u_gone")
+        fam = p.probe_dump().get("probe_last_success_age_s")
+        assert not fam or not [
+            r for r in fam.get("children", [])
+            if r["labels"]["target"] == "u_gone"]
+
+    def test_lifecycle_start_is_idempotent_and_stop_joins(self):
+        p = Prober()
+        p.start(interval_s=120.0)
+        try:
+            assert p.running()
+            assert "prober" in [t.name for t in threading.enumerate()]
+            p.start()                        # idempotent
+            assert p.snapshot()["running"] is True
+        finally:
+            p.stop()
+        assert not p.running()
+        assert "prober" not in [t.name for t in threading.enumerate()]
+
+
+# ---------------------------------------- endpoint + default rules
+class TestProbesEndpoint:
+    def test_get_probes_served_on_both_server_families(self):
+        """The shared ``_monitor_get`` serves ``/probes`` on the training
+        UI server AND the serving front door — same payload shape."""
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        ui_port = ui.start()
+        srv = InferenceServer()
+        srv_port = srv.start(port=0)
+        try:
+            for port in (ui_port, srv_port):
+                status, doc = _get_json(port, "/probes")
+                assert status == 200
+                for key in ("interval_s", "fail_threshold", "running",
+                            "targets"):
+                    assert key in doc
+        finally:
+            ui.stop()
+            srv.stop()
+
+
+class TestDefaultProbeRules:
+    def test_pack_names_and_prober_wired_annotations(self):
+        rules = default_probe_rules()
+        assert [r.name for r in rules] == [
+            "probe_availability_burn", "probe_p99_client",
+            "probe_mismatch", "probe_deadman"]
+        burn = rules[0]
+        assert {"outcome": "mismatch"} in burn.bad_labels
+        p = Prober()
+        wired = default_probe_rules(p)
+        assert wired[2].exemplar_lookup == p.last_failure_trace
+        assert wired[3].detail_lookup == p.failure_detail
+
+    def test_mismatch_rule_fires_with_guilty_detail_and_exemplar(self):
+        """In-process walk of the pack: a wrong golden drives
+        ``probe_mismatch`` and ``probe_deadman`` to FIRING with the
+        failing target named via ``detail_lookup`` and the probe's own
+        trace id as the exemplar; fixing the oracle resolves both."""
+        srv = InferenceServer()
+        m = srv.register("rw", DoubleModel(), input_shape=(4,),
+                         batch_buckets=(1, 2), linger_ms=0.0)
+        port = srv.start(port=0)
+        good = m.golden()
+        bad = dict(good)
+        bad["outputs"] = (np.asarray(good["outputs"], np.float32)
+                          + 9.0).tolist()
+        p = Prober(fail_threshold=99)
+        p.engine.add(*default_probe_rules(
+            p, windows=(1.5, 3.0), deadman_s=2.0, for_seconds=0.2))
+        edges = []
+        p.engine.subscribe(lambda ev, pl: edges.append((ev, dict(pl))))
+        try:
+            p.add_target("u_rule", f"127.0.0.1:{port}", good)
+            t0 = time.time()
+            step = 0
+            for _ in range(7):               # healthy: cover the windows
+                step += 1
+                p.tick(now=t0 + 0.5 * step)
+            states = {r.name: r.state for r in p.engine.rules()}
+            assert set(states.values()) == {"OK"}, states
+            p.add_target("u_rule", f"127.0.0.1:{port}", bad)
+            for _ in range(14):
+                step += 1
+                p.tick(now=t0 + 0.5 * step)
+                states = {r.name: r.state for r in p.engine.rules()}
+                if (states["probe_mismatch"] == "FIRING"
+                        and states["probe_deadman"] == "FIRING"):
+                    break
+            assert states["probe_mismatch"] == "FIRING", \
+                [(r.name, r.state, r.last_detail)
+                 for r in p.engine.rules()]
+            assert states["probe_deadman"] == "FIRING"
+            fired = [pl for ev, pl in edges if ev == "alert_firing"
+                     and pl.get("rule") == "probe_mismatch"]
+            assert fired
+            assert "u_rule" in fired[-1]["detail"]
+            exemplar = fired[-1].get("exemplar_trace_id")
+            assert exemplar
+            assert exemplar in {
+                (e.get("args") or {}).get("trace_id")
+                for e in get_tracer().export()["traceEvents"]}
+            p.add_target("u_rule", f"127.0.0.1:{port}", good)
+            for _ in range(16):
+                step += 1
+                p.tick(now=t0 + 0.5 * step)
+                states = {r.name: r.state for r in p.engine.rules()}
+                if set(states.values()) == {"OK"}:
+                    break
+            assert set(states.values()) == {"OK"}, \
+                [(r.name, r.state, r.last_detail)
+                 for r in p.engine.rules()]
+            assert {pl.get("rule") for ev, pl in edges
+                    if ev == "alert_resolved"} >= {"probe_mismatch",
+                                                   "probe_deadman"}
+        finally:
+            p.engine.clear()
+            p.remove_target("u_rule")
+            srv.stop()
+            get_tracer().clear()
+
+
+# ------------------------------------------ THE gray-failure drill
+# One replica subprocess: a model whose answers go WRONG (but stay fast
+# and 200) when the flag file exists — /telemetry and /healthz keep
+# self-reporting healthy, which is exactly the failure no push/scrape
+# signal can see. Prints one JSON line {"port": ..., "golden": ...}
+# (the golden set captured at registration, pre-fault), then blocks on
+# stdin so kill/terminate is the drill's process control.
+_REPLICA_SRC = r"""
+import json, os, sys
+import numpy as np
+
+flag = sys.argv[1]
+
+class GrayModel:
+    def output(self, x, mask=None):
+        out = np.asarray(x, np.float32)[:, :2] * 2.0
+        if os.path.exists(flag):       # gray failure: fast, 200, WRONG
+            out = out + 37.0
+        return out
+
+from deeplearning4j_tpu.serving import InferenceServer
+
+srv = InferenceServer()
+served = srv.register("drill", GrayModel(), input_shape=(4,),
+                      batch_buckets=(1, 2), linger_ms=0.0,
+                      max_queue_examples=64, cache_size=16)
+golden = served.golden()
+port = srv.start(port=0)
+print(json.dumps({"port": port, "golden": golden}), flush=True)
+sys.stdin.read()
+"""
+
+
+def _spawn_replica(flag_path, err_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"      # numpy model; never wait on a device
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    errf = open(err_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REPLICA_SRC, str(flag_path)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errf,
+        text=True, env=env, cwd=root)
+    box = {}
+
+    def _read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=_read, daemon=True)
+    t.start()
+    t.join(120)
+    line = (box.get("line") or "").strip()
+    if not line:
+        proc.kill()
+        proc.wait(timeout=30)
+        errf.close()
+        with open(err_path) as f:
+            raise RuntimeError(f"replica failed to start:\n{f.read()}")
+    errf.close()
+    doc = json.loads(line)
+    return proc, int(doc["port"]), doc["golden"]
+
+
+class TestGrayFailureDrill:
+    def test_gray_failure_detected_probed_restarted_reconstructed(
+            self, tmp_path):
+        """THE acceptance scenario, end to end: two real replica
+        processes probed by a live Prober. Wedging r1's model (wrong
+        answers, still fast, still 200) leaves every self-reported
+        surface green — r1's own ``/healthz`` says healthy and its
+        ``/telemetry`` keeps answering — while ``probe_mismatch`` and
+        ``probe_deadman`` walk OK→PENDING→FIRING naming r1 with a probe
+        trace id resolvable on r1's own ``/trace``;
+        ``probe_failure_policy`` restarts r1 at fire time; steady state
+        returns alert-free with a ``probe_target_recovered`` edge; the
+        whole incident reads back off ``/events``; and no probe ever
+        lands in any response cache."""
+        rec = get_flight_recorder()
+        rec.clear()
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        ui_port = ui.start()
+        flag = tmp_path / "gray_r1"
+        prober = Prober(timeout_s=10.0, fail_threshold=3)
+        edges = []
+        prober.engine.subscribe(
+            lambda ev, payload: edges.append((ev, dict(payload))))
+        prober.engine.add(*default_probe_rules(
+            prober, windows=(1.5, 3.0), deadman_s=2.0, for_seconds=0.2))
+        plane = ControlPlane(engine=prober.engine)
+        procs = []
+        restarted = []
+        box = {}                             # live r1 port for asserts
+
+        def restart_replica(label, url):
+            """The drill's actuator: bounce the wedged replica — kill,
+            clear the fault, respawn, re-register the probe target with
+            the NEW process's own golden set."""
+            restarted.append(label)
+            old = box.pop("proc")
+            old.kill()
+            old.wait(timeout=30)
+            if flag.exists():
+                flag.unlink()
+            p1b, port1b, golden1b = _spawn_replica(
+                flag, tmp_path / "r1b.err")
+            procs.append(p1b)
+            box.update(proc=p1b, port=port1b, golden=golden1b)
+            prober.add_target(label, f"127.0.0.1:{port1b}", golden1b)
+
+        plane.add(probe_failure_policy(prober, restart_replica,
+                                       cooldown_s=60.0))
+        prober.engine.subscribe(plane._on_edge)
+        states = []
+        step = [0]
+
+        def beat(drive_plane=True):
+            # synthetic clock: one beat = 0.5s. The plane's tick is
+            # held back during the wedge (drive_plane=False) so the
+            # drill can watch BOTH rules reach FIRING before the
+            # remediation kicks in — a real deployment's plane cadence
+            # simply lagging the prober's.
+            step[0] += 1
+            now = t0 + 0.5 * step[0]
+            res = prober.tick(now=now)
+            if drive_plane:
+                plane.tick(now=now)
+            states.append({r.name: r.state
+                           for r in prober.engine.rules()})
+            return res
+
+        try:
+            p0, port0, golden0 = _spawn_replica(tmp_path / "no_fault_r0",
+                                                tmp_path / "r0.err")
+            procs.append(p0)
+            p1, port1, golden1 = _spawn_replica(flag, tmp_path / "r1.err")
+            procs.append(p1)
+            box.update(proc=p1, port=port1, golden=golden1)
+            prober.add_target("r0", f"127.0.0.1:{port0}", golden0)
+            prober.add_target("r1", f"127.0.0.1:{port1}", golden1)
+
+            # live prober: start() probes immediately (interval far
+            # beyond the drill so the deterministic beats own the clock)
+            prober.start(interval_s=120.0)
+            assert prober.running()
+            assert "prober" in [t.name for t in threading.enumerate()]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                targets = prober.snapshot()["targets"]
+                if len(targets) == 2 and all(
+                        v["last_outcome"] == "ok"
+                        for v in targets.values()):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"live probe never landed: "
+                            f"{prober.snapshot()}")
+            time.sleep(0.25)          # let the first tick's sample+eval
+            t0 = time.time()          # finish before synthetic beats
+
+            # ---- healthy baseline: windows covered, everything OK
+            for _ in range(7):
+                res = beat()
+                assert res["outcomes"] == {"r0": "ok", "r1": "ok"}, res
+            assert set(states[-1].values()) == {"OK"}, states[-1]
+
+            # seed r1's cache with the CORRECT answer for the golden
+            # inputs via a normal request — the poisoned-cache trap: a
+            # cache-reading probe would keep seeing this right answer
+            # straight through the wedge
+            status, _ = _post_predict(port1, golden1["inputs"])
+            assert status == 200
+            status, stats = _get_json(port1, "/v1/models/drill")
+            assert status == 200 and stats["cache"]["entries"] == 1
+            assert stats["golden_version"] == golden1["version"]
+
+            # ---- wedge r1: answers go wrong, everything self-reported
+            # stays green
+            flag.write_text("x")
+            status, cached = _post_predict(port1, golden1["inputs"])
+            assert status == 200     # normal traffic: cached RIGHT answer
+            np.testing.assert_allclose(
+                np.asarray(cached["outputs"], np.float32),
+                np.asarray(golden1["outputs"], np.float32),
+                atol=float(golden1["atol"]))
+            for _ in range(18):
+                beat(drive_plane=False)
+                if (states[-1]["probe_mismatch"] == "FIRING"
+                        and states[-1]["probe_deadman"] == "FIRING"):
+                    break
+                # the gray failure is invisible to self-report while the
+                # probes close in on it
+                status, h = _get_json(box["port"], "/healthz")
+                assert status == 200 and h["healthy"] is True
+                status, _ = _get_json(box["port"], "/telemetry")
+                assert status == 200
+            assert states[-1]["probe_mismatch"] == "FIRING", \
+                [(r.name, r.state, r.last_detail)
+                 for r in prober.engine.rules()]
+            assert states[-1]["probe_deadman"] == "FIRING"
+            walk = [s["probe_mismatch"] for s in states]
+            assert "PENDING" in walk, walk       # hold-down honored
+
+            # the firing edge names the GUILTY replica and carries the
+            # probe's own trace id, resolvable on THAT replica's /trace
+            fired = [p for ev, p in edges if ev == "alert_firing"
+                     and p.get("rule") == "probe_mismatch"]
+            assert fired, edges
+            assert "r1" in (fired[-1].get("detail") or "")
+            exemplar = fired[-1].get("exemplar_trace_id")
+            assert exemplar
+            status, rtrace = _get_json(port1, "/trace")
+            assert status == 200
+            assert exemplar in {
+                (e.get("args") or {}).get("trace_id")
+                for e in rtrace["traceEvents"]}
+
+            # sustained failure landed on the PROBER's /healthz as a
+            # timestamped problem (kind=probe), and the failing edge hit
+            # the flight recorder exactly once
+            assert any(e["event"] == "health_problem"
+                       and e.get("kind") == "probe"
+                       and "r1" in e.get("message", "")
+                       for e in rec.events())
+            assert len([e for e in rec.events()
+                        if e["event"] == "probe_target_failing"
+                        and e.get("target") == "r1"]) == 1
+
+            # ---- the control plane catches up on the queued alert
+            # edges and restarts r1 at fire time (the second matching
+            # edge is suppressed by the cooldown — exactly one bounce)
+            assert restarted == []
+            plane.tick(now=t0 + 0.5 * step[0])
+            assert restarted == ["r1"], restarted
+            pol = plane.policies()[0]
+            assert pol.last_action["outcome"] == "restarted_r1"
+            assert pol.last_action["rule"] in ("probe_mismatch",
+                                               "probe_deadman")
+            assert box["proc"].poll() is None       # respawn is alive
+            assert p1.poll() is not None            # old process is gone
+            # same weights, same deterministic capture → same oracle
+            assert box["golden"]["version"] == golden1["version"]
+
+            # ---- recovery: healthy beats until the mismatch ages out
+            # of both windows and the deadman resets
+            for _ in range(20):
+                beat()
+                if set(states[-1].values()) == {"OK"}:
+                    break
+            assert set(states[-1].values()) == {"OK"}, \
+                [(r.name, r.state, r.last_detail)
+                 for r in prober.engine.rules()]
+            assert any(e["event"] == "probe_target_recovered"
+                       and e.get("target") == "r1" for e in rec.events())
+            assert {p.get("rule") for ev, p in edges
+                    if ev == "alert_resolved"} >= {"probe_mismatch",
+                                                   "probe_deadman"}
+            assert restarted == ["r1"]          # cooldown held: no flap
+
+            # ---- zero probe entries in ANY response cache: r0 was only
+            # ever probed (empty LRU); r1's respawn only probed too
+            status, stats0 = _get_json(port0, "/v1/models/drill")
+            assert status == 200 and stats0["cache"]["entries"] == 0
+            status, stats1b = _get_json(box["port"], "/v1/models/drill")
+            assert status == 200 and stats1b["cache"]["entries"] == 0
+
+            # ---- the incident reconstructs from GET /events alone
+            status, evdoc = _get_json(ui_port, "/events")
+            assert status == 200
+            names = [e["event"] for e in evdoc["events"]]
+            for needed in ("probe_target_failing", "health_problem",
+                           "alert_firing", "control_action",
+                           "probe_target_recovered", "alert_resolved"):
+                assert needed in names, names
+            assert names.index("probe_target_failing") \
+                < names.index("control_action") \
+                < names.index("probe_target_recovered")
+
+            # ---- lifecycle: timed-join stop leaves no thread behind
+            prober.stop()
+            assert not prober.running()
+            assert "prober" not in [t.name for t in threading.enumerate()]
+        finally:
+            prober.stop()
+            prober.engine.clear()
+            plane.clear()
+            rec.clear()
+            get_tracer().clear()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+            ui.stop()

@@ -108,6 +108,7 @@ def test_graph_transfer_helper_featurize():
     helper.fit_featurized(mds)  # trains without touching the frozen block
 
 
+@pytest.mark.slow
 def test_finetune_zoo_resnet50_head():
     """VERDICT done-criterion: fine-tune zoo ResNet50's head (new class
     count), body frozen, params carried over."""

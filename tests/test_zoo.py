@@ -204,6 +204,7 @@ def test_transformer_lm_trains_and_streams():
     assert np.abs(out_a[:, -1] - out_b[:, -1]).max() > 1e-4
 
 
+@pytest.mark.slow
 def test_transformer_lm_moe_trains_and_ep_shards():
     """num_experts > 0 turns every block FFN into a sparse MoE; the model
     trains, and the expert dim shards over an `expert` mesh via
