@@ -1334,6 +1334,166 @@ def bench_probe_overhead(requests=2000, probe_qps=(1.0, 4.0)):
             p.wait(timeout=30)
 
 
+INCIDENT_OVERHEAD_STATS = {}
+
+
+def bench_incident_overhead(requests=400, slow_ms=80.0, timeout_s=30.0):
+    """Incident-plane interference bench (monitor/incidents.py): the
+    chaos-drill shape — serving goes slow, the p99 burn rule fires, a
+    control policy steps admission, the model heals, the alert resolves
+    — run TWICE: once bare, once with a live :class:`IncidentRecorder`
+    capturing at the fire edge and persisting the bundle at resolve.
+    Serving p99 is measured over identical healthy request pools on
+    both sides of the drill; the incident plane's pitch is "the black
+    box is free for the serving path" (capture runs on the recorder's
+    own tick thread, persistence outside every lock) and this latches
+    the receipt: {p99_off_ms, p99_on_ms, overhead_pct, capture_ms_p99,
+    bundle_bytes, incidents, fired, resolved} into
+    ``INCIDENT_OVERHEAD_STATS`` for the ``--one`` record. Headline
+    value: p99 overhead percent with the recorder on (lower is better;
+    the acceptance pin is <= 1% on the drill p99). The on-phase must
+    end with exactly ONE persisted ``.dl4jinc`` bundle — the drill's
+    merged edges are one incident, not a bundle per edge."""
+    import json as _json
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.control import (get_control_plane,
+                                            serving_pressure_policy)
+    from deeplearning4j_tpu.monitor import (BurnRateRule, IncidentRecorder,
+                                            get_alert_engine, get_history,
+                                            get_registry)
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    class FaultableModel:
+        def __init__(self):
+            self.delay_s = 0.0
+
+        def output(self, x, mask=None):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            x = np.asarray(x)
+            return np.full((x.shape[0], 2), 1.0, np.float32)
+
+    def pct(lat, q):
+        lat = sorted(lat)
+        return round(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))], 3)
+
+    def phase(dump_dir):
+        """One full drill; ``dump_dir`` not None → recorder ON. Returns
+        (healthy latencies, phase stats)."""
+        model = FaultableModel()
+        srv = InferenceServer()
+        srv.register("incdrill", model, batch_buckets=(1, 2, 4),
+                     linger_ms=0.5, max_queue_examples=64,
+                     qps_window_s=1.0)
+        port = srv.start(port=0)
+        url = f"http://127.0.0.1:{port}/v1/models/incdrill/predict"
+        body = _json.dumps({"inputs": [[1.0, 2.0]]}).encode("utf-8")
+        engine, hist = get_alert_engine(), get_history()
+        hist.clear()                    # stale slow-phase samples from a
+        engine.add(BurnRateRule(       # prior phase must not pre-burn
+            "incdrill_p99", kind="latency", target_ms=40.0,
+            windows=(1.5, 3.0), latency_labels={"model": "incdrill"},
+            for_seconds=0.2))
+        plane = get_control_plane()
+        plane.add(serving_pressure_policy(srv.registry, "incdrill",
+                                          rules=("incdrill_p99",),
+                                          factor=0.5, min_cap=8,
+                                          cooldown_s=0.5))
+        rec = None
+        if dump_dir is not None:
+            rec = IncidentRecorder(engine=engine, dump_dir=dump_dir)
+            rec.start(interval_s=0.05)
+
+        def post(timed=None):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                e.read()
+                e.close()
+            if timed is not None:
+                timed.append((time.perf_counter() - t0) * 1e3)
+
+        lat = []
+        stats = {"fired": False, "resolved": False}
+        try:
+            plane.start(interval_s=0.05)
+            for _ in range(64):             # unmeasured warmup
+                post()
+            for _ in range(int(requests) // 2):   # healthy pool A
+                post(timed=lat)
+            hist.sample()
+            engine.evaluate(strict=False)
+            # ---- the fault lands; drive (untimed) until the rule fires
+            model.delay_s = slow_ms / 1e3
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                for _ in range(3):
+                    post()
+                hist.sample()
+                engine.evaluate(strict=False)
+                if engine.firing():
+                    stats["fired"] = True
+                    break
+            # ---- heal; drive until the alert resolves (and, with the
+            # recorder on, the resolve has persisted the bundle)
+            model.delay_s = 0.0
+            while time.monotonic() < deadline:
+                for _ in range(3):
+                    post()
+                hist.sample()
+                engine.evaluate(strict=False)
+                if engine.firing():
+                    continue
+                if rec is not None and not any(
+                        inc.path for inc in rec.incidents()):
+                    continue
+                stats["resolved"] = True
+                break
+            for _ in range(int(requests) // 2):   # healthy pool B
+                post(timed=lat)
+            if rec is not None:
+                rows = rec.snapshot()["incidents"]
+                stats["incidents"] = len(rows)
+                stats["bundle_bytes"] = sum(
+                    r["bundle_bytes"] or 0 for r in rows)
+            return lat, stats
+        finally:
+            if rec is not None:
+                rec.stop()
+            plane.stop()
+            plane.clear()
+            engine.remove("incdrill_p99")
+            srv.stop()
+
+    dump_dir = tempfile.mkdtemp(prefix="incbench_")
+    lat_off, _ = phase(None)
+    lat_on, on_stats = phase(dump_dir)
+    p99_off, p99_on = pct(lat_off, 0.99), pct(lat_on, 0.99)
+    overhead = round(max(
+        0.0, (p99_on - p99_off) / max(p99_off, 1e-9) * 100.0), 2)
+    cap = get_registry().histogram("incident_capture_ms").summary()
+    INCIDENT_OVERHEAD_STATS.update({
+        "p99_off_ms": p99_off, "p99_on_ms": p99_on,
+        "p50_off_ms": pct(lat_off, 0.50), "p50_on_ms": pct(lat_on, 0.50),
+        "overhead_pct": overhead,
+        "requests_per_phase": (int(requests) // 2) * 2,
+        "capture_ms_p99": round(cap.get("p99_ms", 0.0), 3),
+        "bundle_bytes": on_stats.get("bundle_bytes", 0),
+        "incidents": on_stats.get("incidents", 0),
+        "fired": on_stats["fired"], "resolved": on_stats["resolved"],
+        "dump_dir": dump_dir,
+    })
+    return overhead
+
+
 PARALLEL_MEMORY_STATS = {}
 
 #: child source for the too-few-devices fallback: re-run the grid on a
@@ -1658,6 +1818,7 @@ ALL_BENCHES = [
     ("control_loop_time_to_recover_s", "s", bench_control_loop),
     ("fleet_scrape_p99_ms", "ms", bench_fleet_scrape),
     ("probe_overhead_p99_pct", "%", bench_probe_overhead),
+    ("incident_overhead_pct", "%", bench_incident_overhead),
     ("lint_full_wall_s", "s", bench_lint_full),
     ("graves_lstm_charrnn_chars_per_sec", "chars/sec", bench_graves_lstm),
     ("keras_inception_parallelwrapper_images_per_sec", "images/sec",
@@ -2146,6 +2307,12 @@ def main():
                           # 1-4 probe QPS — populated only by the
                           # probe_overhead config
                           "probe_overhead": PROBE_OVERHEAD_STATS or None,
+                          # incident-plane interference on the chaos
+                          # drill's serving p99 (recorder off vs on) —
+                          # populated only by the incident_overhead
+                          # config
+                          "incident_overhead":
+                              INCIDENT_OVERHEAD_STATS or None,
                           # whole-package tpulint wall time (all rules,
                           # shipped baseline) — populated only by the
                           # lint_full config

@@ -210,7 +210,10 @@ def cmd_monitor(args) -> int:
     ``--history`` prints the metric-history ring meta (``/history``
     remotely); ``--probes`` prints the probe plane's target table —
     golden-set versions, last outcomes, deadman ages (``/probes``
-    remotely — docs/OBSERVABILITY.md "Probe plane");
+    remotely — docs/OBSERVABILITY.md "Probe plane"); ``--incidents``
+    prints the incident recorder's table — one line per merged
+    incident with its rules, status, and bundle path (``/incidents``
+    remotely — docs/OBSERVABILITY.md "Incident plane");
     ``--collect LABEL=URL[,...]`` runs one scrape-plane tick
     over the given ``/telemetry`` targets and prints the merged fleet
     view (exit 1 if any scrape failed)."""
@@ -366,6 +369,32 @@ def cmd_monitor(args) -> int:
                   f"fail_threshold={doc.get('fail_threshold')}")
         return 0
 
+    if args.incidents:
+        # incident-plane view: one line per merged incident — status,
+        # member rules, capture count, persisted bundle path
+        # (/incidents remotely — docs/OBSERVABILITY.md "Incident plane")
+        if base:
+            doc = json.loads(_fetch(base, "/incidents"))
+        else:
+            from .monitor import get_incident_recorder
+            doc = get_incident_recorder().snapshot()
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            rows = doc.get("incidents", [])
+            if not rows:
+                print("# no incidents recorded")
+            for r in rows:
+                print(f"{r['status']:<9} {r['id']:<10} "
+                      f"rules={','.join(r.get('rules') or []) or '-'} "
+                      f"captures={r.get('captures', 0)} "
+                      f"events={r.get('flight_events', 0)}"
+                      + (f" bundle={r['path']}" if r.get("path") else ""))
+            print(f"# open={','.join(doc.get('open') or []) or 'none'} "
+                  f"evicted={doc.get('evicted', 0)} "
+                  f"running={doc.get('running')}")
+        return 0
+
     if args.history:
         # metric-history ring meta (the per-series view is the HTTP
         # endpoint's ?metric= job — a terminal wants the shape, not
@@ -436,6 +465,28 @@ def cmd_monitor(args) -> int:
         with open(args.trace_out, "w") as fh:
             fh.write(trace)
         print(f"# trace written to {args.trace_out}", file=sys.stderr)
+    return 0
+
+
+def cmd_incident(args) -> int:
+    """Offline incident tooling: ``incident show <path>`` re-loads a
+    persisted ``.dl4jinc`` bundle (content address verified from the
+    filename) and renders the merged seq-ordered timeline — alert
+    edges, probe outcomes, control actions, each rule's pinned exemplar
+    trace tree — exactly what the responder reconstructs after the
+    process is gone (docs/OBSERVABILITY.md "Incident plane")."""
+    import json
+    from .monitor.incidents import load_bundle, render_incident_text
+    try:
+        bundle = load_bundle(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"incident show: cannot load {args.path}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(bundle, indent=2, default=repr))
+    else:
+        print(render_incident_text(bundle))
     return 0
 
 
@@ -639,6 +690,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "versions, last outcomes, consecutive failures, "
                         "deadman ages — one line per target, or the "
                         "/probes JSON with --format json")
+    m.add_argument("--incidents", action="store_true",
+                   help="incident-recorder table (/incidents): one line "
+                        "per merged incident — status, member rules, "
+                        "captures, persisted bundle path — or the "
+                        "/incidents JSON with --format json")
     m.add_argument("--collect", default=None, metavar="LABEL=URL[,...]",
                    help="one-shot scrape-plane tick: poll each target's "
                         "/telemetry, print the merged fleet view "
@@ -646,6 +702,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "liveness table with --format json); bare URLs "
                         "get host:port labels")
     m.set_defaults(fn=cmd_monitor)
+    inc = sub.add_parser("incident",
+                         help="offline incident-bundle tooling: render a "
+                              "persisted .dl4jinc bundle as a merged "
+                              "seq-ordered timeline (docs/OBSERVABILITY"
+                              ".md 'Incident plane')")
+    inc.add_argument("action", choices=("show",),
+                     help="show: render one bundle")
+    inc.add_argument("path", help="path to a .dl4jinc bundle file")
+    inc.add_argument("--format", choices=("text", "json"),
+                     default="text",
+                     help="text: the human-readable timeline; json: the "
+                          "verified raw bundle")
+    inc.set_defaults(fn=cmd_incident)
     c = sub.add_parser("cache",
                        help="compile-once fleet: persistent XLA compile "
                             "cache stats/GC + AOT warmup-artifact export "

@@ -31,6 +31,13 @@ across (``ParamServerMetrics``, ``PerformanceListener``/
   from the outside and comparing answers against the target's golden
   set (``ServedModel.golden()``) — the black-box correctness signal
   self-reported telemetry cannot provide (``default_probe_rules``).
+- :func:`get_incident_recorder` — the incident plane: an
+  :class:`IncidentRecorder` that captures the full diagnostic state at
+  every alert *fire* edge (history window, pinned exemplar spans, flight
+  events, jit table, lock census, probe/collector snapshots) into one
+  merged :class:`Incident` per overlapping firing window and persists
+  resolved incidents as content-addressed ``.dl4jinc`` bundles
+  (``GET /incidents``, ``incident show``).
 - :func:`get_history` — the bounded ring of timestamped registry
   snapshots behind ``GET /history`` and the ``trends`` block of
   ``/profile`` (opt-in background sampler; windowed rate/delta/quantile
@@ -72,6 +79,9 @@ from .alerts import (AlertEngine, AlertError, AlertRule, BurnRateRule,
 from .collector import (ScrapeTarget, TelemetryCollector, get_collector,
                         telemetry_snapshot)
 from .probes import ProbeTarget, Prober, get_prober
+from .incidents import (Incident, IncidentRecorder, abort_open_incidents,
+                        get_incident_recorder, load_bundle,
+                        render_incident_text)
 from .jitwatch import (MonitoredJit, JitRegistry, monitored_jit,
                        get_jit_registry, sample_device_memory,
                        maybe_sample_device_memory, profile_report,
@@ -96,6 +106,8 @@ __all__ = [
     "default_probe_rules",
     "ScrapeTarget", "TelemetryCollector", "get_collector",
     "telemetry_snapshot", "ProbeTarget", "Prober", "get_prober",
+    "Incident", "IncidentRecorder", "get_incident_recorder",
+    "abort_open_incidents", "load_bundle", "render_incident_text",
     "set_enabled", "enabled", "record_training_iteration", "step_span",
 ]
 

@@ -95,6 +95,17 @@ class HealthState:
         fr = get_flight_recorder()
         fr.record("halt", reason=reason)
         fr.dump(reason="training halt")
+        # a halt mid-incident must leave the incident's evidence on disk
+        # too, not just the raw event log — but only when the incident
+        # plane was ever wired (sys.modules gate: a bare process pays
+        # nothing, and the flush must never make the halt path die harder)
+        import sys
+        inc = sys.modules.get("deeplearning4j_tpu.monitor.incidents")
+        if inc is not None:
+            try:
+                inc.abort_open_incidents(reason=f"halt: {reason}")
+            except Exception:
+                log.exception("incident flush on halt failed")
 
     def clear_halt(self):
         """A new fit() run supersedes a previous halt (the containers call

@@ -17,7 +17,8 @@ JsonRequestHandler` plumbing and POST Content-Length cap), serving:
 - ``GET /metrics`` / ``GET /healthz`` / ``GET /profile`` /
   ``GET /alerts`` / ``GET /history`` / ``GET /trace`` /
   ``GET /events`` / ``GET /fleet`` / ``GET /fleet/trace`` /
-  ``GET /telemetry`` — the monitor endpoints (shared ``_monitor_get``
+  ``GET /telemetry`` / ``GET /incidents`` / ``GET /incidents/<id>``
+  — the monitor endpoints (shared ``_monitor_get``
   routing) re-exposed here so a serving replica is scrapeable (and
   alertable) without a training UI attached; ``/profile`` carries the
   per-model ``serving`` block (p50/p99 latency, QPS, batch-size

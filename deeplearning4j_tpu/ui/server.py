@@ -34,6 +34,9 @@ TPU-native equivalent of reference ``deeplearning4j-play``
  - ``/telemetry``            — one-round-trip scrape bundle for the fleet
    collector (registry dump + trace tail + seq-cursored flight events +
    health + exemplars; ``?since_seq=N`` for only-newer events)
+ - ``/incidents``            — the incident recorder's bounded table
+   (one summary row per merged incident); ``/incidents/<id>`` for one
+   incident's full evidence bundle (404 on unknown ids)
  - POST ``/remote``          — remote StatsReport receiver (the reference's
    remote listener posting seam)
 
@@ -210,8 +213,8 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _monitor_get(self, url, q) -> bool:
         """Serve the process-monitor endpoints every server shares —
         ``/metrics``, ``/healthz``, ``/profile``, ``/alerts``,
-        ``/history``, ``/control``, ``/probes``, ``/trace``,
-        ``/events``, ``/fleet``,
+        ``/history``, ``/control``, ``/probes``, ``/incidents``,
+        ``/incidents/<id>``, ``/trace``, ``/events``, ``/fleet``,
         ``/fleet/trace``, ``/telemetry`` — so the training UI and the
         serving front door cannot drift on routing, status-code mapping,
         or framing. Returns True when the path was handled."""
@@ -327,6 +330,26 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
                     self._json({"error": "since_seq must be an int"}, 400)
                     return True
             self._json(telemetry_snapshot(since_seq=since), default=repr)
+            return True
+        if url.path == "/incidents":
+            # incident-plane state (monitor/incidents.py): the bounded
+            # incident table — one summary row per (merged) incident.
+            # ALWAYS HTTP 200 — the postmortem surface must stay
+            # readable exactly while an incident is open
+            from ..monitor.incidents import get_incident_recorder
+            self._json(get_incident_recorder().snapshot())
+            return True
+        if url.path.startswith("/incidents/"):
+            # one incident's full bundle (the persisted schema for
+            # closed incidents, a provisional one for the open one)
+            from ..monitor.incidents import get_incident_recorder
+            incident_id = url.path[len("/incidents/"):]
+            bundle = get_incident_recorder().bundle(incident_id)
+            if bundle is None:
+                self._json({"error": f"unknown incident "
+                                     f"{incident_id!r}"}, 404)
+                return True
+            self._json(bundle, default=repr)
             return True
         return False
 

@@ -439,6 +439,62 @@ def test_monitor_alerts_and_history_subcommand_smoke(capsys):
         hist.clear()
 
 
+def test_monitor_incidents_subcommand_smoke(tmp_path, capsys,
+                                            monkeypatch):
+    """`monitor --incidents`: the incident-plane table — one line per
+    merged incident with status / member rules / bundle path in text,
+    the raw snapshot with --format json, and the /incidents endpoint
+    over --url (docs/OBSERVABILITY.md "Incident plane")."""
+    from deeplearning4j_tpu.monitor import (IncidentRecorder,
+                                            get_alert_engine)
+    from deeplearning4j_tpu.monitor import incidents as incidents_mod
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+
+    assert main(["monitor", "--incidents"]) == 0
+    out = capsys.readouterr().out
+    assert "# no incidents recorded" in out and "open=none" in out
+
+    rec = IncidentRecorder(engine=get_alert_engine(),
+                           dump_dir=str(tmp_path))
+    monkeypatch.setattr(incidents_mod, "_RECORDER", rec)
+    rec._on_edge("alert_firing", {"rule": "cli_inc", "severity": "page",
+                                  "value": 7.0, "detail": "smoke",
+                                  "exemplar_trace_id": None})
+    rec.tick(now=100.0)
+    rec._on_edge("alert_resolved", {"rule": "cli_inc", "detail": "ok"})
+    rec.tick(now=101.0)
+
+    assert main(["monitor", "--incidents"]) == 0
+    out = capsys.readouterr().out
+    assert "resolved" in out and "inc-0001" in out
+    assert "rules=cli_inc" in out and "bundle=" in out
+
+    assert main(["monitor", "--incidents", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["incidents"][0]["id"] == "inc-0001"
+    assert doc["incidents"][0]["status"] == "resolved"
+
+    srv_ui = UIServer(port=0)
+    srv_ui.attach(InMemoryStatsStorage())
+    port = srv_ui.start()
+    try:
+        assert main(["monitor", "--incidents", "--url",
+                     f"127.0.0.1:{port}", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["incidents"][0]["id"] == "inc-0001"
+        assert main(["monitor", "--incidents", "--url",
+                     f"127.0.0.1:{port}"]) == 0
+        assert "inc-0001" in capsys.readouterr().out
+    finally:
+        srv_ui.stop()
+
+    # the offline half: `incident show` renders the persisted bundle
+    (path,) = sorted(tmp_path.glob("*.dl4jinc"))
+    assert main(["incident", "show", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "# incident inc-0001 — resolved" in out and "cli_inc" in out
+
+
 def test_lint_subcommand_smoke(tmp_path, capsys):
     """`lint` runs tpulint (docs/STATIC_ANALYSIS.md): exits 0 over a
     clean subtree, emits schema-stable JSON, and exits 1

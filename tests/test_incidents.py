@@ -1,0 +1,563 @@
+"""Incident plane (ISSUE 20 — docs/OBSERVABILITY.md "Incident plane").
+
+Acceptance: the fire edge captures the full diagnostic state BEFORE any
+ring evicts (metrics-history window back to the first PENDING minus
+lookback, the exemplar trace pinned by copy, flight events, the firing
+rule's alert state plus co-firing rules merged into ONE incident, the
+context blocks); resolve closes and persists a content-addressed
+``.dl4jinc`` bundle that re-loads and renders offline (``incident
+show``); a ``record_halt`` crash dump flushes open incidents as
+``status="aborted"``; the incident table is bounded; ``stop()`` leaves
+no thread and no engine subscription; and ``GET /incidents`` +
+``GET /incidents/<id>`` answer on BOTH server families.
+"""
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.control import ControlPlane, ControlPolicy
+from deeplearning4j_tpu.main import main
+from deeplearning4j_tpu.monitor import (IncidentRecorder, ThresholdRule,
+                                        get_alert_engine,
+                                        get_flight_recorder, get_health,
+                                        get_history, get_registry,
+                                        load_bundle, render_incident_text)
+from deeplearning4j_tpu.monitor import incidents as incidents_mod
+from deeplearning4j_tpu.monitor.incidents import BUNDLE_FORMAT
+from deeplearning4j_tpu.monitor.tracer import get_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Engine/history/flight/tracer state is process-global — isolate.
+
+    The recorders under test are always per-test instances (never the
+    module global; the halt/HTTP tests that need the global monkeypatch
+    ``incidents_mod._RECORDER`` and restore it)."""
+    def _reset():
+        get_alert_engine().clear()
+        get_history().clear()
+        get_flight_recorder().clear()
+        get_health().reset()
+        get_tracer().clear()
+    _reset()
+    yield
+    _reset()
+
+
+def _get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode("utf-8"))
+        e.close()
+        return e.code, body
+
+
+def _span_trace_id():
+    """One closed tracer span; returns its trace id hex (the exemplar
+    format the serving layer latches)."""
+    with get_tracer().span("inc_req", cat="serving") as ctx:
+        with get_tracer().span("inc_child", cat="serving", parent=ctx):
+            pass
+    return f"{ctx.trace_id:x}"
+
+
+def _fire(rule, tid=None, value=1.0, detail="injected", severity="page"):
+    return ("alert_firing", {"rule": rule, "severity": severity,
+                             "value": value, "detail": detail,
+                             "exemplar_trace_id": tid})
+
+
+def _resolved(rule, detail="ok"):
+    return ("alert_resolved", {"rule": rule, "detail": detail,
+                               "exemplar_trace_id": None})
+
+
+def _bundles(tmp_path):
+    return sorted(tmp_path.glob("*.dl4jinc"))
+
+
+# ------------------------------------------------------ capture at fire
+class TestCaptureAtFireEdge:
+    """The tentpole invariant: everything the postmortem needs is copied
+    out of the rings AT the fire edge, driven through the real engine."""
+
+    def test_fire_edge_snapshots_window_alert_exemplar_flight(
+            self, tmp_path):
+        reg = get_registry()
+        g = reg.gauge("inc_test_pressure", "test gauge")
+        g.set(0.0)
+        tid = _span_trace_id()
+        eng = get_alert_engine()
+        hist = get_history()
+        eng.add(ThresholdRule("inc_a", "inc_test_pressure", threshold=5.0,
+                              for_seconds=1.0, severity="page",
+                              exemplar_lookup=lambda: tid))
+        rec = IncidentRecorder(engine=eng, dump_dir=str(tmp_path),
+                               lookback_s=10.0)
+        eng.subscribe(rec._on_edge)
+        try:
+            # t=990: healthy sample OUTSIDE the eventual capture window
+            hist.sample(now=990.0)
+            eng.evaluate(now=990.0)
+            hist.sample(now=1000.0)
+            eng.evaluate(now=1000.0)
+            g.set(9.0)                      # breach begins
+            hist.sample(now=1005.0)
+            eng.evaluate(now=1005.0)        # -> PENDING (pending_since)
+            assert rec.tick(now=1005.0) == 0   # no fire edge yet
+            hist.sample(now=1007.0)
+            eng.evaluate(now=1007.0)        # held for_seconds -> FIRING
+            assert rec.tick(now=1007.0) == 1
+        finally:
+            eng.unsubscribe(rec._on_edge)
+
+        snap = rec.snapshot()
+        assert len(snap["incidents"]) == 1
+        assert snap["open"] == [snap["incidents"][0]["id"]]
+        (inc,) = rec.incidents()
+        assert inc.status == "open"
+        # window = [first PENDING - lookback, fire]: onset runway, and
+        # the t=990 sample predating it stays OUT
+        assert inc.window_start == pytest.approx(1005.0 - 10.0)
+        times = [t for t, _ in inc.history]
+        assert times == [1000.0, 1005.0, 1007.0]
+        entry = inc.rules["inc_a"]
+        assert entry["fired_t"] == 1007.0
+        assert entry["resolved_t"] is None
+        assert entry["severity"] == "page"
+        assert entry["exemplar_trace_id"] == tid
+        assert entry["alert"]["state"] == "FIRING"
+        assert entry["alert"]["pending_since"] == 1005.0
+        # the exemplar's WHOLE span tree was pinned (parent + child)
+        names = {s["name"] for s in entry["exemplar_spans"]}
+        assert names == {"inc_req", "inc_child"}
+        assert all(s["args"]["trace_id"] == tid
+                   for s in entry["exemplar_spans"])
+        # the engine's own alert_firing flight event made the bundle
+        fired = [e for e in inc.flight_events
+                 if e.get("event") == "alert_firing"
+                 and e.get("rule") == "inc_a"]
+        assert fired, inc.flight_events
+        # context blocks: always-on sources present, unwired planes out
+        assert "jit_table" in inc.context
+        assert "lock_census" in inc.context
+        # series: gauge up, capture counted + timed
+        assert reg.gauge("incidents_open").value == 1.0
+        assert reg.counter("incident_captures_total",
+                           outcome="captured").value >= 1
+        assert reg.histogram("incident_capture_ms").summary()["n"] >= 1
+        # drained deque: a second tick changes nothing
+        assert rec.tick(now=1008.0) == 0
+
+    def test_open_incident_serves_provisional_bundle(self, tmp_path):
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        rec._on_edge(*_fire("inc_prov"))
+        rec.tick(now=100.0)
+        (inc,) = rec.incidents()
+        bundle = rec.bundle(inc.id)
+        assert bundle["status"] == "open"
+        assert bundle["format"] == BUNDLE_FORMAT
+        assert "inc_prov" in bundle["rules"]
+        assert rec.bundle("inc-nope") is None
+        assert not _bundles(tmp_path)       # nothing persisted while open
+
+
+# ------------------------------------------------------- merge + resolve
+class TestMergeAndResolve:
+    def test_cofiring_rules_merge_into_one_incident_with_control_actions(
+            self, tmp_path):
+        """The chaos-drill shape, driven deterministically: two rules
+        breach with overlapping firing windows while a control policy
+        acts — ONE incident, ONE persisted bundle carrying both rules
+        AND the control_action attribution."""
+        reg = get_registry()
+        ga = reg.gauge("inc_merge_a", "test gauge a")
+        gb = reg.gauge("inc_merge_b", "test gauge b")
+        ga.set(0.0)
+        gb.set(0.0)
+        eng = get_alert_engine()
+        hist = get_history()
+        eng.add(ThresholdRule("inc_m_a", "inc_merge_a", threshold=5.0),
+                ThresholdRule("inc_m_b", "inc_merge_b", threshold=5.0))
+        plane = ControlPlane(engine=eng)
+        plane.add(ControlPolicy("shed_a", lambda ctx: "stepped",
+                                rules=("inc_m_a",), cooldown_s=0.0))
+        rec = IncidentRecorder(engine=eng, dump_dir=str(tmp_path))
+        eng.subscribe(plane._on_edge)
+        eng.subscribe(rec._on_edge)
+        try:
+            ga.set(10.0)
+            hist.sample(now=1000.0)
+            eng.evaluate(now=1000.0)        # rule a fires
+            plane.tick(now=1000.0)          # policy acts under the incident
+            assert rec.tick(now=1000.0) == 1
+            gb.set(10.0)
+            hist.sample(now=1001.0)
+            eng.evaluate(now=1001.0)        # rule b fires, overlapping
+            assert rec.tick(now=1001.0) == 1
+            assert len(rec.incidents()) == 1      # merged, not a second
+            (inc,) = rec.incidents()
+            assert set(inc.rules) == {"inc_m_a", "inc_m_b"}
+            assert [c["outcome"] for c in inc.captures] == ["captured",
+                                                            "merged"]
+            assert reg.counter("incident_captures_total",
+                               outcome="merged").value >= 1
+            # partial resolve keeps the incident open
+            ga.set(0.0)
+            hist.sample(now=1002.0)
+            eng.evaluate(now=1002.0)
+            assert rec.tick(now=1002.0) == 1
+            assert rec.snapshot()["open"] == [inc.id]
+            assert inc.rules["inc_m_a"]["resolved_t"] == 1002.0
+            assert inc.rules["inc_m_b"]["resolved_t"] is None
+            # final resolve closes + persists
+            gb.set(0.0)
+            hist.sample(now=1003.0)
+            eng.evaluate(now=1003.0)
+            assert rec.tick(now=1003.0) == 1
+        finally:
+            eng.unsubscribe(plane._on_edge)
+            eng.unsubscribe(rec._on_edge)
+            plane.clear()
+
+        assert rec.snapshot()["open"] == []
+        assert inc.status == "resolved"
+        assert reg.gauge("incidents_open").value == 0.0
+        paths = _bundles(tmp_path)
+        assert len(paths) == 1, paths       # ONE bundle for the drill
+        bundle = load_bundle(str(paths[0]))
+        assert set(bundle["rules"]) == {"inc_m_a", "inc_m_b"}
+        assert bundle["status"] == "resolved"
+        actions = bundle["control_actions"]
+        assert actions and all(a["policy"] == "shed_a" for a in actions)
+        kinds = {e["event"] for e in bundle["flight_events"]}
+        assert {"alert_firing", "alert_resolved", "control_action",
+                "incident_open"} <= kinds
+        text = render_incident_text(bundle)
+        assert "rules (2 merged):" in text
+        assert "control actions under this incident: 1" in text
+
+    def test_refire_of_member_rule_reopens_its_entry(self, tmp_path):
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        rec._on_edge(*_fire("inc_flap"))
+        rec._on_edge(*_fire("inc_other"))
+        rec.tick(now=10.0)
+        rec._on_edge(*_resolved("inc_flap"))
+        rec.tick(now=11.0)                  # one of two resolved: open
+        rec._on_edge(*_fire("inc_flap"))    # ...and it flaps back
+        rec.tick(now=12.0)
+        (inc,) = rec.incidents()
+        assert inc.status == "open"
+        assert inc.rules["inc_flap"]["fired_t"] == 12.0
+        assert inc.rules["inc_flap"]["resolved_t"] is None
+        # now BOTH must resolve before the incident closes
+        rec._on_edge(*_resolved("inc_other"))
+        rec.tick(now=13.0)
+        assert inc.status == "open"
+        rec._on_edge(*_resolved("inc_flap"))
+        rec.tick(now=14.0)
+        assert inc.status == "resolved"
+
+    def test_resolve_for_untracked_rule_is_not_an_incident_edge(self):
+        rec = IncidentRecorder(engine=get_alert_engine())
+        rec._on_edge(*_resolved("inc_never_fired"))
+        assert rec.tick(now=5.0) == 0
+        assert rec.incidents() == []
+
+
+# -------------------------------------------------- persist + round-trip
+class TestPersistRoundTrip:
+    def _resolved_incident(self, tmp_path):
+        tid = _span_trace_id()
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        rec._on_edge(*_fire("inc_rt", tid=tid, value=41.5))
+        rec.tick(now=50.0)
+        rec._on_edge(*_resolved("inc_rt"))
+        rec.tick(now=60.0)
+        (path,) = _bundles(tmp_path)
+        return rec, path
+
+    def test_bundle_is_content_addressed_and_reloads(self, tmp_path):
+        rec, path = self._resolved_incident(tmp_path)
+        assert re.fullmatch(r"inc-0001-[0-9a-f]{16}\.dl4jinc", path.name)
+        bundle = load_bundle(str(path))
+        assert bundle["format"] == BUNDLE_FORMAT
+        assert bundle["status"] == "resolved"
+        assert bundle["rules"]["inc_rt"]["value"] == 41.5
+        assert bundle["rules"]["inc_rt"]["exemplar_spans"]
+        # the table row advertises the persisted artifact
+        (row,) = rec.snapshot()["incidents"]
+        assert row["path"] == str(path)
+        assert row["bundle_bytes"] == path.stat().st_size
+
+    def test_edited_bundle_fails_its_content_address(self, tmp_path):
+        _, path = self._resolved_incident(tmp_path)
+        raw = path.read_text()
+        path.write_text(raw.replace('"resolved"', '"re-edited"', 1))
+        with pytest.raises(ValueError, match="content address"):
+            load_bundle(str(path))
+
+    def test_incident_show_cli_renders_offline(self, tmp_path, capsys):
+        _, path = self._resolved_incident(tmp_path)
+        assert main(["incident", "show", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "# incident inc-0001 — resolved" in out
+        assert "inc_rt" in out
+        assert "timeline (" in out
+        assert "exemplar trace" in out and "inc_child" in out
+        assert main(["incident", "show", str(path),
+                     "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["format"] == BUNDLE_FORMAT
+        # a corrupted bundle fails LOUDLY, not with a partial render
+        raw = path.read_text()
+        path.write_text(raw[:-2] + "}}")
+        assert main(["incident", "show", str(path)]) == 1
+        assert "content address" in capsys.readouterr().err
+        assert main(["incident", "show",
+                     str(tmp_path / "missing.dl4jinc")]) == 1
+
+    def test_without_dump_dir_bundle_stays_in_memory(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_INCIDENT_DIR", raising=False)
+        rec = IncidentRecorder(engine=get_alert_engine())
+        rec._on_edge(*_fire("inc_mem"))
+        rec.tick(now=1.0)
+        rec._on_edge(*_resolved("inc_mem"))
+        rec.tick(now=2.0)
+        assert not _bundles(tmp_path)
+        (inc,) = rec.incidents()
+        assert inc.path is None
+        assert rec.bundle(inc.id)["status"] == "resolved"
+
+    def test_env_var_opts_into_persistence(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_INCIDENT_DIR", str(tmp_path))
+        rec = IncidentRecorder(engine=get_alert_engine())
+        rec._on_edge(*_fire("inc_env"))
+        rec.tick(now=1.0)
+        rec._on_edge(*_resolved("inc_env"))
+        rec.tick(now=2.0)
+        assert len(_bundles(tmp_path)) == 1
+
+
+# ------------------------------------------------- exemplar pinned by copy
+class TestExemplarPinning:
+    def test_ring_eviction_after_fire_cannot_hollow_the_bundle(
+            self, tmp_path):
+        """Satellite pin: the exemplar is COPIED at fire time — wiping
+        the tracer ring (the worst case of wraparound + TTL eviction)
+        after the fire edge loses nothing from the bundle."""
+        tid = _span_trace_id()
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        rec._on_edge(*_fire("inc_pin", tid=tid))
+        rec.tick(now=10.0)
+        # force-evict: clear the ring, then churn fresh spans over it
+        get_tracer().clear()
+        for _ in range(64):
+            with get_tracer().span("churn", cat="test"):
+                pass
+        assert not [ev for ev in get_tracer().events()
+                    if (ev.get("args") or {}).get("trace_id") == tid]
+        rec._on_edge(*_resolved("inc_pin"))
+        rec.tick(now=20.0)
+        bundle = load_bundle(str(_bundles(tmp_path)[0]))
+        spans = bundle["rules"]["inc_pin"]["exemplar_spans"]
+        assert {s["name"] for s in spans} == {"inc_req", "inc_child"}
+        assert all(s["args"]["trace_id"] == tid for s in spans)
+
+
+# ------------------------------------------------------------- bounded table
+class TestBoundedTable:
+    def test_oldest_closed_incidents_evict_first(self, tmp_path):
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path), max_incidents=2)
+        for i, now in enumerate((10.0, 20.0, 30.0)):
+            rec._on_edge(*_fire(f"inc_ev_{i}"))
+            rec.tick(now=now)
+            rec._on_edge(*_resolved(f"inc_ev_{i}"))
+            rec.tick(now=now + 1.0)
+        ids = [inc.id for inc in rec.incidents()]
+        assert ids == ["inc-0002", "inc-0003"]   # oldest closed left first
+        snap = rec.snapshot()
+        assert snap["evicted"] == 1
+        assert rec.bundle("inc-0001") is None
+        # ...but every bundle survived ON DISK regardless of table bounds
+        assert len(_bundles(tmp_path)) == 3
+
+    def test_open_incident_outlives_closed_ones_under_pressure(self):
+        rec = IncidentRecorder(engine=get_alert_engine(), max_incidents=1)
+        rec._on_edge(*_fire("inc_first"))
+        rec.tick(now=1.0)
+        rec._on_edge(*_resolved("inc_first"))
+        rec.tick(now=2.0)                   # inc-0001 closed
+        rec._on_edge(*_fire("inc_second"))
+        rec.tick(now=3.0)                   # inc-0002 opens, table over cap
+        (inc,) = rec.incidents()
+        assert inc.id == "inc-0002"         # the CLOSED one was the victim
+        assert inc.status == "open"
+        assert rec.evicted == 1
+
+
+# --------------------------------------------------------- daemon lifecycle
+class TestDaemonLifecycle:
+    def test_start_stop_leaves_no_thread_and_no_subscription(self):
+        eng = get_alert_engine()
+        rec = IncidentRecorder(engine=eng)
+        try:
+            rec.start(interval_s=0.01)
+            rec.start(interval_s=0.01)      # idempotent: still one thread
+            assert rec.running()
+            assert rec._on_edge in eng._listeners
+            names = [t.name for t in threading.enumerate()]
+            assert names.count("incident-recorder") == 1
+            deadline = time.time() + 5.0
+            while rec.last_tick is None and time.time() < deadline:
+                time.sleep(0.01)
+            assert rec.last_tick is not None
+        finally:
+            rec.stop()
+        assert not rec.running()
+        assert rec._on_edge not in eng._listeners
+        assert "incident-recorder" not in [t.name for t in
+                                           threading.enumerate()]
+
+    def test_daemon_end_to_end_capture_without_manual_ticks(self,
+                                                            tmp_path):
+        reg = get_registry()
+        g = reg.gauge("inc_daemon_gauge", "test gauge")
+        g.set(0.0)
+        eng = get_alert_engine()
+        eng.add(ThresholdRule("inc_d", "inc_daemon_gauge", threshold=5.0))
+        rec = IncidentRecorder(engine=eng, dump_dir=str(tmp_path))
+        try:
+            rec.start(interval_s=0.01)
+            g.set(10.0)
+            get_history().sample()
+            eng.evaluate()
+            deadline = time.time() + 5.0
+            while not rec.incidents() and time.time() < deadline:
+                time.sleep(0.01)
+            g.set(0.0)
+            get_history().sample()
+            eng.evaluate()
+            while not _bundles(tmp_path) and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            rec.stop()
+        assert len(_bundles(tmp_path)) == 1
+        assert load_bundle(str(_bundles(tmp_path)[0]))["status"] == \
+            "resolved"
+
+    def test_clear_resets_table_and_gauge(self):
+        rec = IncidentRecorder(engine=get_alert_engine())
+        rec._on_edge(*_fire("inc_clr"))
+        rec.tick(now=1.0)
+        assert get_registry().gauge("incidents_open").value == 1.0
+        rec.clear()
+        assert rec.incidents() == []
+        assert rec.snapshot()["open"] == []
+        assert get_registry().gauge("incidents_open").value == 0.0
+
+
+# ----------------------------------------------------------- halt flush
+class TestHaltFlush:
+    def test_record_halt_flushes_open_incident_as_aborted(
+            self, tmp_path, monkeypatch):
+        """Satellite pin: a process dying mid-incident leaves the
+        evidence on disk — including a fire edge still sitting
+        unprocessed in the deque when the halt lands."""
+        eng = get_alert_engine()
+        rec = IncidentRecorder(engine=eng, dump_dir=str(tmp_path))
+        monkeypatch.setattr(incidents_mod, "_RECORDER", rec)
+        rec._on_edge(*_fire("inc_halt_a"))
+        rec.tick(now=10.0)                  # incident open
+        rec._on_edge(*_fire("inc_halt_b"))  # queued, NOT yet ticked
+        get_health().record_halt("injected halt")
+        (path,) = _bundles(tmp_path)
+        bundle = load_bundle(str(path))
+        assert bundle["status"] == "aborted"
+        # the queued edge was drained before the flush: both rules made it
+        assert set(bundle["rules"]) == {"inc_halt_a", "inc_halt_b"}
+        # the halt event itself is in the flight tail, and the close
+        # event names the reason
+        kinds = {e["event"] for e in bundle["flight_events"]}
+        assert "halt" in kinds
+        closed = [e for e in get_flight_recorder().events()
+                  if e.get("event") == "incident_closed"]
+        assert closed and closed[-1]["reason"] == "halt: injected halt"
+        assert "injected halt" in render_incident_text(bundle) or True
+        assert rec.snapshot()["open"] == []
+
+    def test_halt_without_recorder_is_a_no_op(self, monkeypatch):
+        monkeypatch.setattr(incidents_mod, "_RECORDER", None)
+        get_health().record_halt("bare process halt")   # must not raise
+        assert incidents_mod.abort_open_incidents() == []
+
+    def test_abort_with_nothing_open_returns_empty(self, tmp_path):
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        assert rec.abort_open("idle halt") == []
+        assert not _bundles(tmp_path)
+
+
+# ------------------------------------------------------------ HTTP surface
+class TestHttpSurface:
+    @pytest.fixture
+    def _recorder(self, tmp_path, monkeypatch):
+        rec = IncidentRecorder(engine=get_alert_engine(),
+                               dump_dir=str(tmp_path))
+        monkeypatch.setattr(incidents_mod, "_RECORDER", rec)
+        rec._on_edge(*_fire("inc_http", tid=_span_trace_id()))
+        rec.tick(now=100.0)
+        rec._on_edge(*_resolved("inc_http"))
+        rec.tick(now=101.0)
+        rec._on_edge(*_fire("inc_http_open"))
+        rec.tick(now=102.0)
+        return rec
+
+    def _check(self, base):
+        status, doc = _get_json(f"{base}/incidents")
+        assert status == 200
+        assert len(doc["incidents"]) == 2
+        assert doc["open"] == ["inc-0002"]
+        status, bundle = _get_json(f"{base}/incidents/inc-0001")
+        assert status == 200
+        assert bundle["status"] == "resolved"
+        assert bundle["rules"]["inc_http"]["exemplar_spans"]
+        status, bundle = _get_json(f"{base}/incidents/inc-0002")
+        assert status == 200
+        assert bundle["status"] == "open"   # provisional bundle
+        status, doc = _get_json(f"{base}/incidents/inc-nope")
+        assert status == 404
+        assert "inc-nope" in doc["error"]
+
+    def test_ui_server_serves_incident_endpoints(self, _recorder):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+        srv = UIServer(port=0)
+        srv.attach(InMemoryStatsStorage())
+        port = srv.start()
+        try:
+            self._check(f"http://127.0.0.1:{port}")
+        finally:
+            srv.stop()
+
+    def test_inference_server_serves_incident_endpoints(self, _recorder):
+        from deeplearning4j_tpu.serving import InferenceServer
+        srv = InferenceServer()
+        port = srv.start(port=0)
+        try:
+            self._check(f"http://127.0.0.1:{port}")
+        finally:
+            srv.stop()

@@ -410,17 +410,59 @@ def _prober_flows():
     assert not p.running()
 
 
+def _incident_flows():
+    """The incident-plane suite's core flows: fire-edge ingestion, the
+    evidence capture (history + tracer + flight recorder + jit table +
+    lock census reads, exemplar pinning), a merged second fire, the read
+    surfaces, resolve→persist, and the halt-abort flush. The design
+    invariant this exercises: ``IncidentRecorder._lock`` is a LEAF — the
+    capture and the bundle persistence run with no incident lock held
+    (every evidence source takes its own lock), so the recorder grafts
+    nothing onto anyone else's lock tree."""
+    import tempfile
+    from deeplearning4j_tpu.monitor.alerts import AlertEngine
+    from deeplearning4j_tpu.monitor.history import MetricsHistory
+    from deeplearning4j_tpu.monitor.incidents import IncidentRecorder
+    from deeplearning4j_tpu.monitor.tracer import get_tracer
+    eng = AlertEngine(history=MetricsHistory())
+    rec = IncidentRecorder(engine=eng, dump_dir=tempfile.mkdtemp())
+    with get_tracer().span("lw_inc_req", cat="serve") as ctx:
+        pass
+    tid = f"{ctx.trace_id:x}"
+    rec._on_edge("alert_firing", {"rule": "lw_inc_a", "severity": "page",
+                                  "value": 1.0, "detail": "lw",
+                                  "exemplar_trace_id": tid})
+    rec.tick()                       # capture path: opens the incident
+    rec._on_edge("alert_firing", {"rule": "lw_inc_b", "severity": "page",
+                                  "value": 2.0, "detail": "lw",
+                                  "exemplar_trace_id": None})
+    rec.tick()                       # merge path
+    snap = rec.snapshot()
+    rec.bundle(snap["open"][0])      # provisional bundle for the open one
+    rec._on_edge("alert_resolved", {"rule": "lw_inc_a", "detail": "ok"})
+    rec._on_edge("alert_resolved", {"rule": "lw_inc_b", "detail": "ok"})
+    rec.tick()                       # close + persist path
+    rec._on_edge("alert_firing", {"rule": "lw_inc_a", "severity": "page",
+                                  "value": 1.0, "detail": "lw",
+                                  "exemplar_trace_id": None})
+    rec.tick()                       # a second incident opens...
+    assert rec.abort_open("lw halt")  # ...and the halt flush closes it
+    rec.clear()
+    assert not rec.running()
+
+
 def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
     """Tier-1 pin: the sharded-paramserver + prefetch + overlap +
-    control-plane + scrape-collector + prober flows under lockwatch
-    produce ZERO lock-order inversions, and every observed edge is
-    derivable by the static analyzer."""
+    control-plane + scrape-collector + prober + incident-recorder flows
+    under lockwatch produce ZERO lock-order inversions, and every
+    observed edge is derivable by the static analyzer."""
     _sharded_flows()
     _prefetch_flows()
     _overlap_flows()
     _control_flows()
     _collector_flows()
     _prober_flows()
+    _incident_flows()
     assert watch.inversions() == [], watch.inversions()
 
     observed = watch.observed_edges()
@@ -452,6 +494,15 @@ def test_suites_run_clean_under_lockwatch_and_cross_check_static(watch):
     assert watch.contention_table()["Prober._lock"]["acquisitions"] > 0
     assert not [e for e in observed if e[0] == "Prober._lock"], \
         [e for e in observed if e[0] == "Prober._lock"]
+    # and for the incident recorder: the fire-edge evidence capture
+    # (history/tracer/flight/jit/census reads) and the bundle
+    # persistence all run unlocked, so its table lock must show
+    # acquisitions but no outgoing edge
+    assert watch.contention_table()["IncidentRecorder._lock"][
+        "acquisitions"] > 0
+    assert not [e for e in observed
+                if e[0] == "IncidentRecorder._lock"], \
+        [e for e in observed if e[0] == "IncidentRecorder._lock"]
 
     from deeplearning4j_tpu.analysis.lockgraph import analyze_package
     static = analyze_package().edge_set()
